@@ -1,0 +1,18 @@
+"""tpu-bigclam: a TPU-native framework for overlapping community detection.
+
+Re-implements the capabilities of thangdnsf/BigCLAM-ApacheSpark (BigCLAM,
+Yang & Leskovec WSDM'13, on Apache Spark) as an idiomatic JAX/XLA/Pallas/pjit
+framework: the node x community affiliation matrix F lives as a sharded device
+array, the per-node gradient (sparse neighbor sum + global sumF term) runs as
+edge-parallel fused kernels with `psum` over ICI, and the whole optimization
+loop (conductance seeding -> Armijo backtracking gradient ascent -> K
+selection -> delta-threshold extraction) stays on device.
+
+See SURVEY.md for the structural analysis of the reference this build follows.
+"""
+
+__version__ = "0.1.0"
+
+from bigclam_tpu.config import BigClamConfig
+
+__all__ = ["BigClamConfig", "__version__"]

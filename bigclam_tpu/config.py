@@ -1,0 +1,86 @@
+"""Typed configuration for the whole framework.
+
+The reference's "config system" is hard-coded ``var``s at the top of each of
+its three spark-shell scripts (SURVEY.md C19; reference codes/Bigclamv2.scala:22-31,
+codes/bigclam4-7.scala:14-43 -- paths, K, numCore, hyper-parameters). Here it
+is a single dataclass covering dataset, model, optimizer, K-selection, mesh,
+precision, and checkpointing knobs.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+
+@dataclasses.dataclass(frozen=True)
+class BigClamConfig:
+    """Hyper-parameters of BigCLAM gradient ascent.
+
+    Defaults replicate the reference's magic-constant block exactly
+    (SURVEY.md §2.2; reference codes/Bigclamv2.scala:27-31,104-106,214).
+    """
+
+    # --- model size ---
+    num_communities: int = 100          # K (Bigclamv2.scala:22)
+
+    # --- probability / F clipping (Bigclamv2.scala:28-31) ---
+    min_p: float = 1e-4                 # MIN_P_: lower clip of exp(-Fu.Fv)
+    max_p: float = 0.9999               # MAX_P_: upper clip of exp(-Fu.Fv)
+    min_f: float = 0.0                  # MIN_F_: box lower bound on F entries
+    max_f: float = 1000.0               # MAX_F_: box upper bound on F entries
+
+    # --- Armijo backtracking line search (Bigclamv2.scala:104-114) ---
+    alpha: float = 0.05                 # Armijo slope factor
+    beta: float = 0.1                   # geometric step shrink factor
+    max_backtracks: int = 15            # -> 16 candidate steps {1, beta, ..., beta^15}
+
+    # --- outer loop (Bigclamv2.scala:214) ---
+    conv_tol: float = 1e-4              # stop when |1 - LLH_new/LLH_old| < conv_tol
+    max_iters: int = 1000               # safety cap (reference loops unboundedly)
+
+    # --- K-sweep model selection (bigclam4-7.scala:14-20,116-133,259) ---
+    min_com: int = 1000
+    max_com: int = 9000
+    div_com: int = 100
+    ksweep_tol: float = 1e-3            # stop when (1 - LLH_Knew/LLH_Kold) < ksweep_tol
+
+    # --- seeding (conductance locally-minimal, Bigclamv2.scala:42-59) ---
+    seed_include_self: bool = True      # v2 ego-net indicator (adj row + self=1.0,
+                                        # Bigclamv2.scala:70); False = v3 neighbor-only
+                                        # indicator (bigclamv3-7.scala:64-65)
+    isolated_phi_sentinel: float = 10.0  # conductance for neighbor-less nodes (v3:51)
+
+    # --- numerics ---
+    dtype: str = "float32"              # F / gradient dtype on device
+    accum_dtype: str = "float32"        # LLH accumulation dtype
+    seed: int = 0                       # PRNG seed for Bernoulli(0.5) F-row padding
+
+    # --- execution shape ---
+    edge_chunk: int = 1 << 18           # directed edges per on-device chunk; bounds
+                                        # the (chunk, K) gather working set in HBM
+    mesh_shape: Tuple[int, int] = (1, 1)  # (node-shards, k-shards) = (DP, TP-analog)
+
+    # --- checkpointing / logging ---
+    checkpoint_dir: Optional[str] = None
+    checkpoint_every: int = 0           # iterations between checkpoints; 0 = off
+    metrics_path: Optional[str] = None  # JSONL per-step records; None = stdout only
+
+    @property
+    def step_candidates(self) -> Tuple[float, ...]:
+        """The candidate step sizes {1, beta, beta^2, ..., beta^max_backtracks}.
+
+        Same set as the reference's listSearch (Bigclamv2.scala:108-113, which
+        prepends and so ends up smallest-first). Order here is descending, and
+        consumers must not rely on it: the chosen step is the max accepted
+        (Bigclamv2.scala:145), which is order-independent.
+        """
+        steps = [1.0]
+        s = 1.0
+        for _ in range(self.max_backtracks):
+            s *= self.beta
+            steps.append(s)
+        return tuple(steps)
+
+    def replace(self, **kw) -> "BigClamConfig":
+        return dataclasses.replace(self, **kw)

@@ -1,0 +1,4 @@
+from bigclam_tpu.graph.csr import Graph
+from bigclam_tpu.graph.ingest import load_edge_list, build_graph, graph_from_edges
+
+__all__ = ["Graph", "load_edge_list", "build_graph", "graph_from_edges"]

@@ -1,0 +1,83 @@
+"""Host-side graph container: symmetric CSR over contiguous node ids.
+
+Replaces the reference's L1 graph layer (SURVEY.md C1-C3): GraphX
+``collectNeighborIds(Either)`` materialized per-node neighbor arrays AND a
+full driver-side broadcast copy on every executor (Bigclamv2.scala:33-34).
+Here the graph is a deduplicated, symmetrized CSR (``indptr``/``indices``)
+over node ids remapped to [0, N); device code consumes flat directed-edge
+arrays (``src``/``dst``) so the hot kernels are edge-parallel, and shards are
+node-contiguous ranges (no replication).
+
+Node-id remapping to contiguous [0, N) also removes the reference's
+missing-row fallback lookup (C10, bigclamv3-7.scala:94-104): every id in
+range is a real row.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+
+import numpy as np
+
+
+@dataclasses.dataclass(frozen=True)
+class Graph:
+    """Undirected simple graph in CSR form.
+
+    Attributes:
+      indptr:  (N+1,) int64 — CSR row pointers.
+      indices: (2E,) int32 — concatenated sorted neighbor lists.
+      raw_ids: (N,) original node ids from the input file (raw_ids[i] is the
+               id that was remapped to i); identity for synthetic graphs.
+    """
+
+    indptr: np.ndarray
+    indices: np.ndarray
+    raw_ids: np.ndarray
+
+    @property
+    def num_nodes(self) -> int:
+        return int(self.indptr.shape[0] - 1)
+
+    @property
+    def num_edges(self) -> int:
+        """Number of undirected edges E (indices stores both directions)."""
+        return int(self.indices.shape[0] // 2)
+
+    @property
+    def num_directed_edges(self) -> int:
+        return int(self.indices.shape[0])
+
+    @functools.cached_property
+    def degrees(self) -> np.ndarray:
+        return np.diff(self.indptr).astype(np.int64)
+
+    @functools.cached_property
+    def src(self) -> np.ndarray:
+        """(2E,) int32 source node of each directed edge, aligned with indices."""
+        return np.repeat(
+            np.arange(self.num_nodes, dtype=np.int32), self.degrees
+        )
+
+    @property
+    def dst(self) -> np.ndarray:
+        """(2E,) int32 destination node of each directed edge (= indices)."""
+        return self.indices
+
+    def neighbors(self, u: int) -> np.ndarray:
+        return self.indices[self.indptr[u] : self.indptr[u + 1]]
+
+    def validate(self) -> None:
+        n = self.num_nodes
+        assert self.indptr[0] == 0 and self.indptr[-1] == self.indices.shape[0]
+        assert np.all(np.diff(self.indptr) >= 0)
+        if self.indices.size:
+            assert self.indices.min() >= 0 and self.indices.max() < n
+        # symmetry: the reversed edge set must equal the forward edge set
+        s, d = self.src, self.dst
+        fwd = np.stack([s, d], axis=1)
+        rev = np.stack([d, s], axis=1)
+        fwd_sorted = fwd[np.lexsort((fwd[:, 1], fwd[:, 0]))]
+        rev_sorted = rev[np.lexsort((rev[:, 1], rev[:, 0]))]
+        assert np.array_equal(fwd_sorted, rev_sorted), "CSR is not symmetric"

@@ -1,0 +1,3 @@
+from bigclam_tpu.spec.interpreter import SpecState, grad_llh, line_search_step, fit
+
+__all__ = ["SpecState", "grad_llh", "line_search_step", "fit"]

@@ -1,0 +1,143 @@
+"""Spec interpreter: the reference's exact semantics in plain NumPy (float64).
+
+This is the normative oracle (SURVEY.md §4.2) for every device kernel in the
+framework: ~200 lines of obviously-correct NumPy that reproduce the
+reference's per-node LLH/gradient math (Bigclamv2.scala:121-133, SURVEY.md
+§2.1), the 16-candidate Armijo backtracking line search with max-accepted-step
+selection (Bigclamv2.scala:136-146), and the Jacobi-style simultaneous update
+(all nodes updated at once per outer iteration, Bigclamv2.scala:145-155).
+
+Semantics notes (quirk decisions, SURVEY.md §2.3):
+  * The reference's "pass-3" LLH (Bigclamv2.scala:158-181) looks mixed-state
+    but substitutes updated rows for BOTH endpoints of every edge and the
+    updated sumF — it equals the plain LLH of the post-update state. We
+    compute exactly that (LLH(F_new, colsum(F_new))).
+  * sumF is recomputed as column sums each step instead of incrementally
+    updated (fixes the float-drift quirk Q7; values agree in exact arithmetic).
+  * Node ids are contiguous [0, N) (ingest remaps), so the reference's
+    missing-row fallback (C10) cannot trigger.
+
+Model (SURVEY.md §2.1): P(edge u,v) = 1 - exp(-F_u . F_v), F in R^{N x K}, F >= 0.
+
+  ell(u) = sum_{v in N(u)} [ log(1 - clip(exp(-F_u.F_v), min_p, max_p)) + F_u.F_v ]
+           - F_u . sumF + F_u . F_u
+  grad_u = sum_{v in N(u)} F_v / (1 - clip(exp(-F_u.F_v))) - sumF + F_u
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+from bigclam_tpu.config import BigClamConfig
+from bigclam_tpu.graph.csr import Graph
+
+
+@dataclasses.dataclass
+class SpecState:
+    F: np.ndarray        # (N, K) float64, >= 0
+    sumF: np.ndarray     # (K,) float64 — column sums of F
+    llh: float           # LLH of the current F (post-update)
+    num_iters: int = 0
+
+
+def _edge_terms(F_src_rows, F_dst_rows, cfg: BigClamConfig):
+    """Per-directed-edge dot, clipped prob, and LLH term log(1-p) + x."""
+    x = np.einsum("ek,ek->e", F_src_rows, F_dst_rows)
+    p = np.clip(np.exp(-x), cfg.min_p, cfg.max_p)
+    return x, p, np.log(1.0 - p) + x
+
+
+def grad_llh(F, sumF, g: Graph, cfg: BigClamConfig):
+    """Per-node gradient and per-node LLH in one pass (Bigclamv2.scala:121-133).
+
+    Returns (grad (N,K), node_llh (N,)).
+    """
+    n = g.num_nodes
+    src, dst = g.src, g.dst
+    x, p, ell_e = _edge_terms(F[src], F[dst], cfg)
+    nbr_llh = np.zeros(n)
+    np.add.at(nbr_llh, src, ell_e)
+    coeff = 1.0 / (1.0 - p)                      # folds the +sum F_v term (§2.1)
+    nbr_grad = np.zeros_like(F)
+    np.add.at(nbr_grad, src, F[dst] * coeff[:, None])
+    grad = nbr_grad - sumF[None, :] + F
+    node_llh = nbr_llh - F @ sumF + np.einsum("nk,nk->n", F, F)
+    return grad, node_llh
+
+
+def loglikelihood(F, sumF, g: Graph, cfg: BigClamConfig) -> float:
+    """Global LLH = sum of per-node LLH (Bigclamv2.scala:187-200)."""
+    src, dst = g.src, g.dst
+    _, _, ell_e = _edge_terms(F[src], F[dst], cfg)
+    node_tail = -F @ sumF + np.einsum("nk,nk->n", F, F)
+    return float(ell_e.sum() + node_tail.sum())
+
+
+def line_search_step(F, sumF, g: Graph, cfg: BigClamConfig):
+    """One outer iteration: grad/LLH pass, 16-candidate Armijo search,
+    Jacobi simultaneous update. Returns (F_new, sumF_new, post_llh).
+
+    Candidate evaluation follows Bigclamv2.scala:136-144 exactly: the
+    candidate row F_u' = clip(F_u + eta*grad_u) is scored against everyone
+    else's OLD rows, with sumF' = sumF - F_u + F_u' (node-local adjustment),
+    and accepted iff ell_eta(u) >= ell(u) + alpha*eta*||grad_u||^2.
+    The chosen step is the LARGEST accepted eta (groupByKey.max,
+    Bigclamv2.scala:145); nodes with no accepted candidate keep their row.
+    """
+    n = g.num_nodes
+    src, dst = g.src, g.dst
+    grad, node_llh = grad_llh(F, sumF, g, cfg)
+    gg = np.einsum("nk,nk->n", grad, grad)
+
+    best_eta = np.zeros(n)
+    accepted = np.zeros(n, dtype=bool)
+    F_dst = F[dst]
+    for eta in cfg.step_candidates:
+        newF = np.clip(F + eta * grad, cfg.min_f, cfg.max_f)
+        _, _, ell_e = _edge_terms(newF[src], F_dst, cfg)
+        nbr = np.zeros(n)
+        np.add.at(nbr, src, ell_e)
+        sf_adj = sumF[None, :] - F + newF      # per-node adjusted sumF
+        cand_llh = (
+            nbr
+            - np.einsum("nk,nk->n", newF, sf_adj)
+            + np.einsum("nk,nk->n", newF, newF)
+        )
+        ok = cand_llh >= node_llh + cfg.alpha * eta * gg
+        # max accepted step, independent of candidate evaluation order
+        best_eta = np.where(ok, np.maximum(best_eta, eta), best_eta)
+        accepted |= ok
+
+    F_new = np.where(
+        accepted[:, None],
+        np.clip(F + best_eta[:, None] * grad, cfg.min_f, cfg.max_f),
+        F,
+    )
+    sumF_new = F_new.sum(axis=0)
+    post_llh = loglikelihood(F_new, sumF_new, g, cfg)
+    return F_new, sumF_new, post_llh
+
+
+def fit(F0, g: Graph, cfg: BigClamConfig, verbose: bool = False) -> SpecState:
+    """Full training loop (MBSGD, Bigclamv2.scala:203-219): iterate line-search
+    steps until |1 - LLH_new/LLH_old| < conv_tol, starting from the true
+    initial LLH (v2 semantics; v3 starts from 0.0 — quirk Q4, not replicated).
+    """
+    F = np.asarray(F0, dtype=np.float64)
+    sumF = F.sum(axis=0)
+    llh_old = loglikelihood(F, sumF, g, cfg)
+    if verbose:
+        print(f"LLH: {llh_old}")
+    it = 0
+    while it < cfg.max_iters:
+        F, sumF, llh = line_search_step(F, sumF, g, cfg)
+        it += 1
+        if verbose:
+            print(f" Iter: {it} LLH: {llh}")
+        if abs(1.0 - llh / llh_old) < cfg.conv_tol:
+            llh_old = llh
+            break
+        llh_old = llh
+    return SpecState(F=F, sumF=sumF, llh=llh_old, num_iters=it)

@@ -1,0 +1,50 @@
+"""Test environment: 8 virtual CPU devices + float64.
+
+Must run before jax is imported anywhere (SURVEY.md §4.4): multi-device
+sharding tests use XLA's host-platform device-count fake, and trajectory
+tests compare against the float64 NumPy spec interpreter.
+"""
+
+# The outer environment pins JAX_PLATFORMS to the real TPU and pre-imports
+# jaxlib at interpreter startup, so env vars are too late here — jax.config
+# before any backend is initialized is the mechanism that actually works.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
+jax.config.update("jax_num_cpu_devices", 8)
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+REFERENCE_DATA = "/root/reference/data"
+
+
+@pytest.fixture(scope="session")
+def rng():
+    return np.random.default_rng(0)
+
+
+@pytest.fixture(scope="session")
+def toy_graphs():
+    """Small hand-checkable graphs: triangle, star, two cliques + bridge."""
+    from bigclam_tpu.graph.ingest import graph_from_edges
+
+    triangle = graph_from_edges([(0, 1), (1, 2), (2, 0)])
+    star = graph_from_edges([(0, 1), (0, 2), (0, 3), (0, 4)])
+    # two 4-cliques {0..3} and {4..7} joined by the bridge 3-4
+    cliq = []
+    for base in (0, 4):
+        for i in range(4):
+            for j in range(i + 1, 4):
+                cliq.append((base + i, base + j))
+    cliq.append((3, 4))
+    two_cliques = graph_from_edges(cliq)
+    return {"triangle": triangle, "star": star, "two_cliques": two_cliques}
+
+
+@pytest.fixture(scope="session")
+def facebook_graph():
+    from bigclam_tpu.graph.ingest import build_graph
+
+    return build_graph(f"{REFERENCE_DATA}/facebook_combined.txt")
