@@ -53,7 +53,7 @@ class BigClamConfig:
 
     # --- numerics ---
     dtype: str = "float32"              # F / gradient dtype on device
-    accum_dtype: str = "float32"        # LLH accumulation dtype
+    accum_dtype: Optional[str] = None   # LLH accumulation dtype; None = dtype
     seed: int = 0                       # PRNG seed for Bernoulli(0.5) F-row padding
 
     # --- execution shape ---

@@ -1,0 +1,8 @@
+from bigclam_tpu.models.bigclam import (
+    BigClamModel,
+    TrainState,
+    FitResult,
+    prepare_graph,
+)
+
+__all__ = ["BigClamModel", "TrainState", "FitResult", "prepare_graph"]

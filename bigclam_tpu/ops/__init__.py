@@ -1,0 +1,4 @@
+from bigclam_tpu.ops.objective import grad_llh, loglikelihood
+from bigclam_tpu.ops.linesearch import candidates_pass, armijo_update
+
+__all__ = ["grad_llh", "loglikelihood", "candidates_pass", "armijo_update"]

@@ -1,0 +1,103 @@
+"""Armijo backtracking line search, all 16 candidates in one fused pass.
+
+Replaces C14 (SURVEY.md §2; reference Bigclamv2.scala:136-146): the reference
+evaluated the 16 candidate steps via an RDD `cartesian` — 16 more full
+neighbor sweeps, each re-broadcasting F. Here each edge chunk is gathered
+ONCE (F_src, grad_src, F_dst) and all candidates are evaluated against the
+gathered tiles (lax.scan over candidates inside the chunk), so HBM traffic is
+~1 gather per edge instead of 16. Candidate semantics are exactly the
+reference's: F_u' = clip(F_u + eta*grad_u, min_f, max_f) scored against
+everyone else's OLD rows with the node-local sumF adjustment
+sumF' = sumF - F_u + F_u' (Bigclamv2.scala:137-143), accepted iff
+
+    ell_eta(u) >= ell(u) + alpha * eta * ||grad_u||^2     (Bigclamv2.scala:144)
+
+and the chosen step is the LARGEST accepted eta (groupByKey.max,
+Bigclamv2.scala:145); nodes with no accepted candidate keep their row
+(the Jacobi simultaneous update, C15).
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from bigclam_tpu.config import BigClamConfig
+from bigclam_tpu.ops.objective import EdgeChunks, edge_terms
+
+
+def candidates_pass(
+    F: jax.Array,
+    grad: jax.Array,
+    edges: EdgeChunks,
+    cfg: BigClamConfig,
+) -> jax.Array:
+    """Neighbor-sum part of ell_eta(u) for every candidate step.
+
+    Returns (S, N): for each candidate eta_i and node u,
+    sum_{v in N(u)} [log(1 - clip(exp(-F_u'.F_v))) + F_u'.F_v].
+    """
+    n = F.shape[0]
+    adt = jnp.dtype(cfg.accum_dtype) if cfg.accum_dtype else F.dtype
+    etas = jnp.asarray(cfg.step_candidates, F.dtype)
+    num_s = etas.shape[0]
+
+    def chunk_body(acc, sdm):
+        s, d, m = sdm
+        fs, gs, fd = F[s], grad[s], F[d]   # gathered once per chunk
+
+        def one_eta(eta):
+            nf = jnp.clip(fs + eta * gs, cfg.min_f, cfg.max_f)
+            x = jnp.einsum("ek,ek->e", nf, fd)
+            _, ell = edge_terms(x, cfg)
+            return jax.ops.segment_sum(
+                (ell * m).astype(adt), s, num_segments=n, indices_are_sorted=True
+            )
+
+        parts = lax.map(one_eta, etas)   # (S, n), sequential: gathers reused
+        return acc + parts, None
+
+    acc, _ = lax.scan(chunk_body, jnp.zeros((num_s, n), adt), edges)
+    return acc
+
+
+def armijo_update(
+    F: jax.Array,
+    sumF: jax.Array,
+    grad: jax.Array,
+    node_llh: jax.Array,
+    cand_nbr: jax.Array,
+    cfg: BigClamConfig,
+) -> Tuple[jax.Array, jax.Array]:
+    """Acceptance test + max-accepted-step selection + Jacobi update.
+
+    Returns (F_new, sumF_new) with sumF recomputed as fresh column sums
+    (fixes the incremental-update float drift, SURVEY.md Q7).
+    """
+    adt = jnp.dtype(cfg.accum_dtype) if cfg.accum_dtype else F.dtype
+    etas = jnp.asarray(cfg.step_candidates, F.dtype)
+    gg = jnp.einsum("nk,nk->n", grad, grad).astype(adt)
+
+    def tail_for(eta):
+        nf = jnp.clip(F + eta * grad, cfg.min_f, cfg.max_f)
+        sf_adj = sumF[None, :] - F + nf        # node-local sumF adjustment
+        return (
+            -jnp.einsum("nk,nk->n", nf, sf_adj)
+            + jnp.einsum("nk,nk->n", nf, nf)
+        ).astype(adt)
+
+    tails = lax.map(tail_for, etas)            # (S, N)
+    cand_llh = cand_nbr + tails
+    ok = cand_llh >= node_llh[None, :] + cfg.alpha * etas[:, None] * gg[None, :]
+    # max accepted step per node; 0.0 when nothing accepted
+    best_eta = jnp.max(jnp.where(ok, etas[:, None], 0.0), axis=0)
+    accepted = jnp.any(ok, axis=0)
+    F_new = jnp.where(
+        accepted[:, None],
+        jnp.clip(F + best_eta[:, None] * grad, cfg.min_f, cfg.max_f),
+        F,
+    )
+    return F_new, F_new.sum(axis=0)

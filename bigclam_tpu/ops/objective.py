@@ -1,0 +1,101 @@
+"""Fused LLH + gradient kernels: the reference's hot inner loop, edge-parallel.
+
+Replaces C11/C13 (SURVEY.md §2; reference Bigclamv2.scala:121-133,187-200):
+the reference's PASS-1 looped each node's neighbor list on an executor,
+computing F_u.F_v dots against a driver-broadcast copy of all of F. Here the
+same math is one fused edge-parallel pass on device: gather F rows at both
+endpoints of every directed edge, dot on the MXU-friendly K axis, clipped
+log-prob terms, and `segment_sum` back to nodes. Edges are processed in
+static-shape chunks (lax.scan) so the (chunk, K) gather working set stays
+bounded in HBM regardless of graph size.
+
+Math (SURVEY.md §2.1, normative):
+  ell(u)  = sum_{v in N(u)} [ log(1 - clip(exp(-F_u.F_v), min_p, max_p)) + F_u.F_v ]
+            - F_u . sumF + F_u . F_u
+  grad_u  = sum_{v in N(u)} F_v / (1 - clip(exp(-F_u.F_v))) - sumF + F_u
+
+Padding conventions (established by models.bigclam.prepare_graph):
+  * edge padding: src = n_pad - 1, dst = 0, mask = 0 (keeps src sorted so
+    segment_sum can use indices_are_sorted=True; masked terms add 0.0)
+  * node padding: all-zero F rows are mathematically inert (their LLH terms
+    are 0 and Armijo never accepts a step for them, since grad = -sumF <= 0
+    clips to the zero row again) — verified by tests/test_jax_core.py
+  * K padding: all-zero columns are preserved by the update and contribute 0
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from bigclam_tpu.config import BigClamConfig
+
+
+class EdgeChunks(NamedTuple):
+    """Static-shape directed-edge arrays, chunked: each (num_chunks, chunk)."""
+
+    src: jax.Array   # int32
+    dst: jax.Array   # int32
+    mask: jax.Array  # float (1.0 = real edge, 0.0 = padding)
+
+
+def edge_terms(x: jax.Array, cfg: BigClamConfig) -> Tuple[jax.Array, jax.Array]:
+    """Per-edge clipped probability p = clip(exp(-x)) and LLH term log(1-p)+x."""
+    p = jnp.clip(jnp.exp(-x), cfg.min_p, cfg.max_p)
+    return p, jnp.log1p(-p) + x
+
+
+def node_tail(F: jax.Array, sumF: jax.Array) -> jax.Array:
+    """The folded non-edge terms per node: -F_u.sumF + F_u.F_u (SURVEY.md §2.1)."""
+    return -(F @ sumF) + jnp.einsum("nk,nk->n", F, F)
+
+
+def grad_llh(
+    F: jax.Array, sumF: jax.Array, edges: EdgeChunks, cfg: BigClamConfig
+) -> Tuple[jax.Array, jax.Array]:
+    """Fused per-node gradient + per-node LLH (one edge sweep).
+
+    Returns (grad (N,K), node_llh (N,)); global LLH = node_llh.sum().
+    """
+    n = F.shape[0]
+    adt = jnp.dtype(cfg.accum_dtype) if cfg.accum_dtype else F.dtype
+
+    def body(carry, sdm):
+        nbr_llh, nbr_grad = carry
+        s, d, m = sdm
+        fd = F[d]
+        x = jnp.einsum("ek,ek->e", F[s], fd)
+        p, ell = edge_terms(x, cfg)
+        coeff = m / (1.0 - p)              # folds the +sum_N F_v term
+        nbr_llh = nbr_llh + jax.ops.segment_sum(
+            (ell * m).astype(adt), s, num_segments=n, indices_are_sorted=True
+        )
+        nbr_grad = nbr_grad + jax.ops.segment_sum(
+            fd * coeff[:, None], s, num_segments=n, indices_are_sorted=True
+        )
+        return (nbr_llh, nbr_grad), None
+
+    init = (jnp.zeros(n, adt), jnp.zeros_like(F))
+    (nbr_llh, nbr_grad), _ = lax.scan(body, init, edges)
+    grad = nbr_grad - sumF[None, :] + F
+    node_llh = nbr_llh + node_tail(F, sumF).astype(adt)
+    return grad, node_llh
+
+
+def loglikelihood(
+    F: jax.Array, sumF: jax.Array, edges: EdgeChunks, cfg: BigClamConfig
+) -> jax.Array:
+    """Global LLH only (Bigclamv2.scala:187-200), one edge sweep."""
+    adt = jnp.dtype(cfg.accum_dtype) if cfg.accum_dtype else F.dtype
+
+    def body(acc, sdm):
+        s, d, m = sdm
+        x = jnp.einsum("ek,ek->e", F[s], F[d])
+        _, ell = edge_terms(x, cfg)
+        return acc + (ell * m).sum(dtype=adt), None
+
+    acc, _ = lax.scan(body, jnp.zeros((), adt), edges)
+    return acc + node_tail(F, sumF).sum(dtype=adt)

@@ -1,0 +1,124 @@
+"""JAX single-chip core vs the NumPy spec interpreter (SURVEY.md §4.2):
+the device kernels must reproduce the oracle's F and LLH trajectories
+bit-tightly in float64 on CPU."""
+
+import numpy as np
+import pytest
+
+from bigclam_tpu.config import BigClamConfig
+from bigclam_tpu.models.bigclam import BigClamModel, prepare_graph
+from bigclam_tpu.ops import linesearch as ls_ops
+from bigclam_tpu.ops import objective as obj_ops
+from bigclam_tpu.spec import interpreter as spec
+
+CFG = BigClamConfig(num_communities=4, dtype="float64")
+
+
+def _rand_F(seed, n, k):
+    return np.random.default_rng(seed).uniform(0.1, 1.0, size=(n, k))
+
+
+def _device_inputs(g, cfg, F):
+    import jax.numpy as jnp
+
+    edges, n_pad = prepare_graph(g, cfg, dtype=jnp.float64)
+    assert n_pad == g.num_nodes
+    Fd = jnp.asarray(F)
+    return edges, Fd, Fd.sum(axis=0)
+
+
+def test_grad_llh_matches_spec(toy_graphs):
+    for name, g in toy_graphs.items():
+        F = _rand_F(0, g.num_nodes, 4)
+        edges, Fd, sumFd = _device_inputs(g, CFG, F)
+        grad_j, node_llh_j = obj_ops.grad_llh(Fd, sumFd, edges, CFG)
+        grad_s, node_llh_s = spec.grad_llh(F, F.sum(0), g, CFG)
+        np.testing.assert_allclose(np.asarray(grad_j), grad_s, rtol=1e-12, err_msg=name)
+        np.testing.assert_allclose(
+            np.asarray(node_llh_j), node_llh_s, rtol=1e-12, err_msg=name
+        )
+
+
+def test_loglikelihood_matches_spec(toy_graphs):
+    g = toy_graphs["two_cliques"]
+    F = _rand_F(1, g.num_nodes, 4)
+    edges, Fd, sumFd = _device_inputs(g, CFG, F)
+    llh_j = float(obj_ops.loglikelihood(Fd, sumFd, edges, CFG))
+    llh_s = spec.loglikelihood(F, F.sum(0), g, CFG)
+    assert np.isclose(llh_j, llh_s, rtol=1e-12)
+
+
+def test_single_step_matches_spec(toy_graphs):
+    for name, g in toy_graphs.items():
+        F = _rand_F(2, g.num_nodes, 4)
+        edges, Fd, sumFd = _device_inputs(g, CFG, F)
+        grad, node_llh = obj_ops.grad_llh(Fd, sumFd, edges, CFG)
+        cand = ls_ops.candidates_pass(Fd, grad, edges, CFG)
+        F1_j, sumF1_j = ls_ops.armijo_update(Fd, sumFd, grad, node_llh, cand, CFG)
+        F1_s, sumF1_s, _ = spec.line_search_step(F, F.sum(0), g, CFG)
+        np.testing.assert_allclose(np.asarray(F1_j), F1_s, rtol=1e-12, err_msg=name)
+        np.testing.assert_allclose(np.asarray(sumF1_j), sumF1_s, rtol=1e-12)
+
+
+def test_trajectory_matches_spec_chunked(toy_graphs):
+    """Multi-iteration trajectory with a tiny edge_chunk to force chunked
+    sweeps; F must track the oracle through several Jacobi updates."""
+    g = toy_graphs["two_cliques"]
+    cfg = CFG.replace(edge_chunk=8, max_iters=5, conv_tol=0.0)  # never converge
+    F = _rand_F(3, g.num_nodes, 4)
+    model = BigClamModel(g, cfg)
+    state = model.init_state(F)
+    Fs, sumFs = F.copy(), F.sum(0)
+    for _ in range(5):
+        state = model._step(state)
+        Fs, sumFs, _ = spec.line_search_step(Fs, sumFs, g, cfg)
+    np.testing.assert_allclose(np.asarray(state.F), Fs, rtol=1e-11)
+
+
+def test_fit_matches_spec_facebook(facebook_graph):
+    """BASELINE config-1-shaped run: facebook_combined K=25, few iterations,
+    device trajectory vs oracle trajectory (SURVEY.md §4.2)."""
+    g = facebook_graph
+    cfg = BigClamConfig(num_communities=25, dtype="float64", max_iters=3)
+    rng = np.random.default_rng(0)
+    F0 = rng.integers(0, 2, size=(g.num_nodes, 25)).astype(np.float64)
+    model = BigClamModel(g, cfg)
+    res = model.fit(F0)
+    st = spec.fit(F0, g, cfg)
+    assert res.num_iters == st.num_iters
+    np.testing.assert_allclose(res.F, st.F, rtol=1e-9)
+    assert np.isclose(res.llh, st.llh, rtol=1e-12)
+
+
+def test_padding_inert(toy_graphs):
+    """Node and K padding must not change the trajectory (all-zero rows and
+    columns are mathematically inert — ops/objective.py docstring)."""
+    g = toy_graphs["two_cliques"]
+    F = _rand_F(4, g.num_nodes, 4)
+    plain = BigClamModel(g, CFG.replace(max_iters=3, conv_tol=0.0))
+    padded = BigClamModel(
+        g, CFG.replace(max_iters=3, conv_tol=0.0), node_multiple=16, k_multiple=8
+    )
+    assert padded.n_pad > g.num_nodes and padded.k_pad > 4
+    s1, s2 = plain.init_state(F), padded.init_state(F)
+    for _ in range(3):
+        s1, s2 = plain._step(s1), padded._step(s2)
+    np.testing.assert_allclose(
+        np.asarray(s2.F[: g.num_nodes, :4]), np.asarray(s1.F), rtol=1e-12
+    )
+    # padded rows/cols stayed identically zero
+    assert np.all(np.asarray(s2.F[g.num_nodes :]) == 0)
+    assert np.all(np.asarray(s2.F[:, 4:]) == 0)
+
+
+def test_fit_convergence_state_matches_spec(toy_graphs):
+    """When the tolerance fires, fit must return the same final F and
+    iteration count as the oracle (the speculative extra update discarded)."""
+    g = toy_graphs["two_cliques"]
+    cfg = CFG.replace(conv_tol=1e-4, max_iters=200)
+    F0 = _rand_F(5, g.num_nodes, 4)
+    res = BigClamModel(g, cfg).fit(F0)
+    st = spec.fit(F0, g, cfg)
+    assert res.num_iters == st.num_iters
+    np.testing.assert_allclose(res.F, st.F, rtol=1e-10)
+    assert np.isclose(res.llh, st.llh, rtol=1e-12)
