@@ -1,0 +1,56 @@
+"""AGM synthetic graph generator: sample a graph from a planted F.
+
+The Community-Affiliation Graph Model underlying BigCLAM (Yang & Leskovec
+WSDM'13): P(edge u,v) = 1 - exp(-F_u . F_v). Not present in the reference —
+built new as the recovery-test harness (generate from a planted F, fit, score
+F1 against the planted communities), used by tests/test_eval.py.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+
+from bigclam_tpu.graph.csr import Graph
+from bigclam_tpu.graph.ingest import graph_from_edges
+
+
+def sample_graph(
+    F: np.ndarray, rng: Optional[np.random.Generator] = None
+) -> Graph:
+    """Sample an undirected simple graph with P(u~v) = 1 - exp(-F_u.F_v).
+
+    Dense O(N^2) sampling — intended for test-scale graphs.
+    """
+    rng = rng or np.random.default_rng(0)
+    F = np.asarray(F, dtype=np.float64)
+    n = F.shape[0]
+    P = 1.0 - np.exp(-(F @ F.T))
+    iu, ju = np.triu_indices(n, k=1)
+    hit = rng.random(iu.shape[0]) < P[iu, ju]
+    edges = np.stack([iu[hit], ju[hit]], axis=1)
+    return graph_from_edges(edges, num_nodes=n)
+
+
+def planted_partition_F(
+    n: int,
+    k: int,
+    strength: float = 3.0,
+    overlap: int = 0,
+    rng: Optional[np.random.Generator] = None,
+) -> tuple[np.ndarray, List[List[int]]]:
+    """A planted F with k equal blocks of n//k nodes at the given membership
+    strength; `overlap` extra nodes per community straddle the next block.
+    Returns (F, ground-truth communities as node-id lists)."""
+    rng = rng or np.random.default_rng(0)
+    F = np.zeros((n, k))
+    size = n // k
+    truth: List[List[int]] = []
+    for c in range(k):
+        members = list(range(c * size, min((c + 1) * size, n)))
+        extra = [(m + size) % n for m in members[:overlap]]
+        for u in members + extra:
+            F[u, c] = strength
+        truth.append(sorted(members + extra))
+    return F, truth

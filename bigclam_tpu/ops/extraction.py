@@ -1,0 +1,80 @@
+"""Community extraction: delta-thresholding of F with argmax fallback.
+
+Replaces C18 (SURVEY.md §2; reference Bigclamv2.scala:223-230). The
+threshold is delta = sqrt(-log(1 - eps)) with eps = 2E / (N(N-1)) — the
+*intended* Yang & Leskovec formula. The reference's eps numerator actually
+counted vertices-with-edges, not edges (`collectEdges(...).count`,
+Bigclamv2.scala:223 — quirk Q8); we implement the intended formula and
+document the deviation in PARITY.md.
+
+Membership semantics exactly as Bigclamv2.scala:226-229: node u belongs to
+community c iff F_uc >= delta; if max(F_u) < delta, u is assigned to every
+community whose value EQUALS the row max (the reference's `value == Fmax`
+indicator — on ties, all tied columns; an all-zero row therefore lands in
+every community, which we preserve for parity).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List
+
+import numpy as np
+
+from bigclam_tpu.graph.csr import Graph
+
+
+def delta_threshold(num_nodes: int, num_edges: int) -> float:
+    """delta = sqrt(-log(1 - eps)), eps = 2E/(N(N-1)) (background edge prob)."""
+    n = max(num_nodes, 2)
+    eps = 2.0 * num_edges / (n * (n - 1.0))
+    eps = min(eps, 1.0 - 1e-12)
+    return float(np.sqrt(-np.log1p(-eps)))
+
+
+def membership_mask(F: np.ndarray, delta: float) -> np.ndarray:
+    """(N, K) boolean membership per Bigclamv2.scala:226-229."""
+    F = np.asarray(F)
+    above = F >= delta
+    row_max = F.max(axis=1, keepdims=True)
+    fallback = (row_max < delta) & (F == row_max)
+    return above | fallback
+
+
+def extract_communities(F: np.ndarray, g: Graph, delta: float | None = None
+                        ) -> Dict[int, List[int]]:
+    """Invert per-node memberships to community -> sorted member list
+    (the reference's flatMap/groupByKey inversion, Bigclamv2.scala:230).
+    Empty communities are omitted. Node ids are the graph's raw ids."""
+    if delta is None:
+        delta = delta_threshold(g.num_nodes, g.num_edges)
+    mask = membership_mask(F, delta)
+    nodes, comms = np.nonzero(mask)
+    raw = g.raw_ids[nodes]
+    # single linear pass: group members by community via sort + split
+    order = np.argsort(comms, kind="stable")
+    comms_sorted, raw_sorted = comms[order], raw[order]
+    uniq, starts = np.unique(comms_sorted, return_index=True)
+    out: Dict[int, List[int]] = {}
+    for c, members in zip(uniq, np.split(raw_sorted, starts[1:])):
+        out[int(c)] = sorted(members.tolist())
+    return out
+
+
+def save_communities(path: str, communities: Dict[int, List[int]]) -> None:
+    """SNAP cmty format: one community per line, tab-separated member ids
+    (the format of com-amazon.all.dedup.cmty.txt, SURVEY.md §0/C22)."""
+    with open(path, "w") as f:
+        for c in sorted(communities):
+            f.write("\t".join(str(u) for u in communities[c]) + "\n")
+
+
+def load_communities(path: str) -> List[List[int]]:
+    """Parse a SNAP cmty file into a list of member-id lists."""
+    out = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line or line.startswith("#"):
+                continue
+            out.append([int(t) for t in line.split()])
+    return out

@@ -1,0 +1,183 @@
+"""Conductance-based seeding: ego-net conductance, locally-minimal ranking,
+and the conductance-seeded F initializer.
+
+Replaces C4-C7 (SURVEY.md §2; reference Bigclamv2.scala:37-96): the reference
+computed, for every node u with ego-net S(u) = {u} ∪ N(u), the multiset z of
+all members' neighbor lists, then
+
+    cut_S = #entries of z outside S          (Bigclamv2.scala:49)
+    vol_S = |z| - cut_S                      (Bigclamv2.scala:50)
+    vol_T = 2E - vol_S - 2*cut_S             (Bigclamv2.scala:51)
+    phi   = 0 if vol_S==0 else 1 if vol_T==0 else cut_S/min(vol_S, vol_T)
+
+— a two-hop sweep per node. Here the same quantities come from closed forms
+over per-node triangle counts (tri(u) = #edges among N(u)):
+
+    |z|    = deg(u) + S1(u),   S1(u) = sum_{v in N(u)} deg(v)
+    vol_S  = 2*deg(u) + 2*tri(u)          (ordered intra-ego edges)
+    cut_S  = S1(u) - deg(u) - 2*tri(u)
+
+so the whole scorer is one common-neighbor pass + segment sums. Two backends:
+a NumPy host pass (one vectorized gather per node) and a dense-adjacency
+device pass (A@A on the MXU) for graphs that fit an (N, N) tile; the C++
+masked-SpGEMM backend in graph/native is used when built.
+
+Seed ranking (Bigclamv2.scala:56; bigclamv3-7.scala:51): each node nominates
+its minimum-conductance neighbor (neighbor-less nodes nominate themselves at
+the sentinel phi = 10.0, the v3 fix); nominees are deduplicated and ranked by
+ascending phi. NOTE a reference quirk (documented in PARITY.md): its Scala
+``.min`` on (id, phi) tuples is lexicographic — it nominates the *smallest-id*
+neighbor, not the min-phi one. We implement the intended min-phi semantics
+(tie-broken by id for determinism), as in Yang & Leskovec's locally-minimal
+neighborhood seeding.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from bigclam_tpu.config import BigClamConfig
+from bigclam_tpu.graph.csr import Graph
+
+# Above this node count the dense (N, N) device adjacency no longer fits
+# comfortably in HBM; use the host/native sparse path instead.
+DENSE_DEVICE_MAX_NODES = 16384
+
+
+def triangle_counts(g: Graph) -> np.ndarray:
+    """tri(u) = number of edges among N(u) (= triangles through u).
+
+    Host pass: per node u, one boolean-mask gather over the concatenated
+    neighbor lists of N(u); sum of hits double-counts intra-neighborhood
+    edges, so tri(u) = hits / 2. Cost O(sum_v deg(v)^2) total.
+    """
+    try:
+        from bigclam_tpu.graph.native import triangle_counts as _native
+
+        out = _native(g)
+        if out is not None:
+            return out
+    except ImportError:
+        pass
+    n = g.num_nodes
+    indptr, indices = g.indptr, g.indices
+    flags = np.zeros(n, dtype=bool)
+    tri = np.zeros(n, dtype=np.int64)
+    for u in range(n):
+        nbrs = indices[indptr[u] : indptr[u + 1]]
+        if nbrs.size == 0:
+            continue
+        flags[nbrs] = True
+        z = np.concatenate([indices[indptr[v] : indptr[v + 1]] for v in nbrs])
+        tri[u] = np.count_nonzero(flags[z]) // 2
+        flags[nbrs] = False
+    return tri
+
+
+def triangle_counts_dense_device(g: Graph) -> np.ndarray:
+    """Device backend: tri = rowsum(A@A * A) / 2 on a dense adjacency.
+
+    The A@A contraction maps straight onto the MXU; only viable while the
+    (N, N) tile fits HBM (guarded by DENSE_DEVICE_MAX_NODES at call sites).
+    """
+    import jax.numpy as jnp
+
+    n = g.num_nodes
+    A = np.zeros((n, n), dtype=np.float32)
+    A[g.src, g.dst] = 1.0
+    Ad = jnp.asarray(A)
+    tri = jnp.einsum("ij,jk,ik->i", Ad, Ad, Ad) / 2.0
+    return np.asarray(jnp.round(tri)).astype(np.int64)
+
+
+def conductance(g: Graph, backend: str = "auto") -> np.ndarray:
+    """Ego-net conductance phi(u) for every node (float64)."""
+    deg = g.degrees
+    two_e = float(g.num_directed_edges)
+    if backend == "dense" or (
+        backend == "auto" and 0 < g.num_nodes <= DENSE_DEVICE_MAX_NODES
+    ):
+        tri = triangle_counts_dense_device(g)
+    else:
+        tri = triangle_counts(g)
+    s1 = np.zeros(g.num_nodes)
+    np.add.at(s1, g.src, deg[g.dst].astype(np.float64))
+    cut = s1 - deg - 2.0 * tri
+    vol_s = 2.0 * deg + 2.0 * tri
+    vol_t = two_e - vol_s - 2.0 * cut
+    phi = np.where(
+        vol_s == 0,
+        0.0,
+        np.where(vol_t == 0, 1.0, cut / np.maximum(np.minimum(vol_s, vol_t), 1e-300)),
+    )
+    return phi
+
+
+def rank_seeds(g: Graph, phi: np.ndarray, cfg: Optional[BigClamConfig] = None
+               ) -> np.ndarray:
+    """Locally-minimal seed ranking (intended semantics of Bigclamv2.scala:56).
+
+    Each node nominates argmin_{v in N(u)} (phi(v), v); neighbor-less nodes
+    nominate themselves at the sentinel phi (bigclamv3-7.scala:51). Returns
+    nominee ids deduplicated, sorted ascending by (phi, id).
+    """
+    cfg = cfg or BigClamConfig()
+    n = g.num_nodes
+    indptr, indices = g.indptr, g.indices
+    if indices.size == 0:
+        # every node self-nominates at the sentinel; rank ties by id
+        return np.arange(n, dtype=np.int64)
+    # segmented argmin over each neighbor list on the key (phi(v), v),
+    # vectorized: sort all directed edges by (src, phi(dst), dst) and take
+    # the first entry of every segment
+    phi_nbr = phi[indices]
+    order = np.lexsort((indices, phi_nbr, g.src))
+    starts = indptr[:-1]
+    has_nbrs = g.degrees > 0
+    nominee = np.arange(n, dtype=np.int64)          # self-nomination default
+    nominee_phi = np.full(n, float(cfg.isolated_phi_sentinel))
+    first_in_seg = order[np.minimum(starts, indices.size - 1)]
+    nominee[has_nbrs] = indices[first_in_seg[has_nbrs]]
+    nominee_phi[has_nbrs] = phi_nbr[first_in_seg[has_nbrs]]
+    cand, first = np.unique(nominee, return_index=True)
+    cand_phi = nominee_phi[first]
+    rank = np.lexsort((cand, cand_phi))
+    return cand[rank]
+
+
+def init_F(
+    g: Graph,
+    seeds: np.ndarray,
+    cfg: BigClamConfig,
+    rng: Optional[np.random.Generator] = None,
+) -> np.ndarray:
+    """Conductance-seeded F0 (C7; Bigclamv2.scala:65-96).
+
+    Community k's membership column is the ego-net indicator of seed k
+    (adjacency row + self = 1.0, Bigclamv2.scala:70; set
+    cfg.seed_include_self=False for the v3 neighbor-only variant,
+    bigclamv3-7.scala:64-65). Columns beyond len(seeds) are Bernoulli(0.5)
+    {0,1} rows of the transposed community matrix (Bigclamv2.scala:61-63).
+    Seeds beyond K are dropped (bigclamv3-7.scala:62).
+    """
+    rng = rng or np.random.default_rng(cfg.seed)
+    n, k = g.num_nodes, cfg.num_communities
+    seeds = np.asarray(seeds, dtype=np.int64)[:k]
+    F = np.zeros((n, k), dtype=np.float64)
+    for c, s in enumerate(seeds):
+        F[g.neighbors(s), c] = 1.0
+        if cfg.seed_include_self:
+            F[s, c] = 1.0
+    if len(seeds) < k:
+        F[:, len(seeds):] = rng.integers(0, 2, size=(n, k - len(seeds)))
+    return F
+
+
+def conductance_seeds(
+    g: Graph, cfg: Optional[BigClamConfig] = None, backend: str = "auto"
+) -> np.ndarray:
+    """conductanceLocalMin (Bigclamv2.scala:42-59): phi + ranking in one call."""
+    cfg = cfg or BigClamConfig()
+    return rank_seeds(g, conductance(g, backend=backend), cfg)

@@ -1,0 +1,102 @@
+"""Extraction + evaluation tests (SURVEY.md §4.7) and the AGM recovery
+integration test."""
+
+import numpy as np
+
+from bigclam_tpu.config import BigClamConfig
+from bigclam_tpu.evaluation import avg_f1, overlapping_nmi
+from bigclam_tpu.graph.ingest import graph_from_edges
+from bigclam_tpu.models.agm import planted_partition_F, sample_graph
+from bigclam_tpu.ops import extraction
+
+
+def test_delta_threshold_formula():
+    # eps = 2*3/(3*2) = 1 -> clipped; realistic case: N=100, E=50
+    d = extraction.delta_threshold(100, 50)
+    eps = 2 * 50 / (100 * 99)
+    assert np.isclose(d, np.sqrt(-np.log(1 - eps)))
+
+
+def test_membership_mask_threshold_and_fallback():
+    F = np.array(
+        [
+            [0.9, 0.1, 0.0],   # above delta in col 0
+            [0.1, 0.2, 0.1],   # all below: fallback to argmax col 1
+            [0.2, 0.2, 0.1],   # fallback tie: cols 0 AND 1 (reference ==Fmax)
+            [0.0, 0.0, 0.0],   # zero row: every column ties at max -> all
+        ]
+    )
+    mask = extraction.membership_mask(F, delta=0.5)
+    np.testing.assert_array_equal(
+        mask,
+        [
+            [True, False, False],
+            [False, True, False],
+            [True, True, False],
+            [True, True, True],
+        ],
+    )
+
+
+def test_extract_communities_raw_ids():
+    # graph with non-contiguous raw ids: output must use raw ids
+    g = graph_from_edges([(10, 20), (20, 30)])
+    F = np.array([[1.0, 0.0], [1.0, 0.0], [0.0, 1.0]])
+    com = extraction.extract_communities(F, g, delta=0.5)
+    assert com[0] == [10, 20]
+    assert com[1] == [30]
+
+
+def test_save_load_roundtrip(tmp_path):
+    com = {0: [1, 2, 3], 1: [4, 5]}
+    p = str(tmp_path / "cmty.txt")
+    extraction.save_communities(p, com)
+    loaded = extraction.load_communities(p)
+    assert loaded == [[1, 2, 3], [4, 5]]
+
+
+def test_f1_perfect_and_disjoint():
+    a = [[1, 2, 3], [4, 5]]
+    assert avg_f1(a, a) == 1.0
+    assert avg_f1([[1, 2]], [[3, 4]]) == 0.0
+    # partial overlap, hand-computed: f1({1,2,3},{2,3,4}) = 2*(2/3)*(2/3)/(4/3)=2/3
+    assert np.isclose(avg_f1([[1, 2, 3]], [[2, 3, 4]]), 2 / 3)
+
+
+def test_nmi_perfect_and_independent():
+    a = [[0, 1, 2, 3], [4, 5, 6, 7]]
+    assert np.isclose(overlapping_nmi(a, a), 1.0)
+    # identical single community vs its complement-ish unrelated cover
+    b = [[0, 2, 4, 6], [1, 3, 5, 7]]
+    v = overlapping_nmi(a, b)
+    assert 0.0 <= v < 0.2
+
+
+def test_nmi_permutation_invariant():
+    a = [[0, 1, 2], [3, 4, 5]]
+    b = [[3, 4, 5], [0, 1, 2]]
+    assert np.isclose(overlapping_nmi(a, b), 1.0)
+
+
+def test_agm_recovery_end_to_end():
+    """Plant 3 strong communities, sample a graph from the AGM, fit from a
+    conductance-seeded init, extract, and score: F1 and NMI near 1."""
+    from bigclam_tpu.models import BigClamModel
+    from bigclam_tpu.ops import seeding
+
+    rng = np.random.default_rng(42)
+    Fp, truth = planted_partition_F(60, 3, strength=2.5, rng=rng)
+    g = sample_graph(Fp, rng=rng)
+    cfg = BigClamConfig(num_communities=3, dtype="float64", max_iters=60)
+    # one seed per planted block (conductance ranking itself is covered by
+    # test_seeding; with near-clique blocks its top-K nominees tie within a
+    # single block, which is faithful to the reference but not a recovery
+    # fixture)
+    F0 = seeding.init_F(g, np.array([0, 20, 40]), cfg)
+    res = BigClamModel(g, cfg).fit(F0)
+    com = extraction.extract_communities(res.F, g)
+    pred = list(com.values())
+    f1 = avg_f1(pred, truth)
+    nmi = overlapping_nmi(pred, truth)
+    assert f1 > 0.85, (f1, nmi)
+    assert nmi > 0.7, (f1, nmi)

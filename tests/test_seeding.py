@@ -1,0 +1,102 @@
+"""Seeding tests (SURVEY.md §4.3): hand-computed conductance on toy graphs,
+locally-minimal ranking order, isolated-node sentinel, init_F structure."""
+
+import numpy as np
+
+from bigclam_tpu.config import BigClamConfig
+from bigclam_tpu.graph.ingest import graph_from_edges
+from bigclam_tpu.ops import seeding
+
+
+CFG = BigClamConfig()
+
+
+def test_conductance_triangle(toy_graphs):
+    # ego-net of every node is the whole triangle: cut=0, vol_T=0 -> phi=1
+    phi = seeding.conductance(toy_graphs["triangle"], backend="numpy")
+    np.testing.assert_allclose(phi, [1.0, 1.0, 1.0])
+
+
+def test_conductance_star(toy_graphs):
+    # center: ego = whole graph -> vol_T=0 -> 1; leaf u: S={u,center},
+    # z = {center} + 4 leaves, cut=3, vol_S=2, vol_T=8-2-6=0 -> phi=1
+    phi = seeding.conductance(toy_graphs["star"], backend="numpy")
+    np.testing.assert_allclose(phi, [1.0, 1.0, 1.0, 1.0, 1.0])
+
+
+def test_conductance_two_cliques(toy_graphs):
+    # hand-derived (see closed forms in ops/seeding.py docstring):
+    # interior clique node: cut=1 (bridge), vol_S=12, vol_T=12 -> 1/12
+    # bridge endpoint (deg 4): cut=3, vol_S=14, vol_T=6 -> 3/6 = 0.5
+    phi = seeding.conductance(toy_graphs["two_cliques"], backend="numpy")
+    expect = [1 / 12, 1 / 12, 1 / 12, 0.5, 0.5, 1 / 12, 1 / 12, 1 / 12]
+    np.testing.assert_allclose(phi, expect)
+
+
+def test_dense_device_backend_matches_numpy(toy_graphs, facebook_graph):
+    for g in [*toy_graphs.values(), facebook_graph]:
+        tri_np = seeding.triangle_counts(g)
+        tri_dev = seeding.triangle_counts_dense_device(g)
+        np.testing.assert_array_equal(tri_np, tri_dev)
+
+
+def test_rank_seeds_two_cliques(toy_graphs):
+    g = toy_graphs["two_cliques"]
+    phi = seeding.conductance(g, backend="numpy")
+    seeds = seeding.rank_seeds(g, phi, CFG)
+    # nominees: clique interiors nominate each other's minima -> {0,1,5,6},
+    # ranked by (phi, id)
+    np.testing.assert_array_equal(seeds, [0, 1, 5, 6])
+
+
+def test_rank_seeds_isolated_sentinel():
+    # node 2 exists (explicit num_nodes) but has no edges: nominates itself
+    # at sentinel phi=10 and ranks last (bigclamv3-7.scala:51)
+    g = graph_from_edges([(0, 1)], num_nodes=3)
+    phi = seeding.conductance(g, backend="numpy")
+    seeds = seeding.rank_seeds(g, phi, CFG)
+    assert seeds[-1] == 2
+    assert set(seeds.tolist()) <= {0, 1, 2}
+
+
+def test_init_F_ego_indicator(toy_graphs):
+    g = toy_graphs["two_cliques"]
+    cfg = CFG.replace(num_communities=3, seed=7)
+    F = seeding.init_F(g, np.array([0, 5]), cfg)
+    # column 0 = ego-net of 0 = {0,1,2,3}; column 1 = ego-net of 5 = {4..7}
+    np.testing.assert_array_equal(F[:, 0], [1, 1, 1, 1, 0, 0, 0, 0])
+    np.testing.assert_array_equal(F[:, 1], [0, 0, 0, 0, 1, 1, 1, 1])
+    # padded column is Bernoulli {0,1}
+    assert set(np.unique(F[:, 2]).tolist()) <= {0.0, 1.0}
+
+
+def test_init_F_v3_variant(toy_graphs):
+    g = toy_graphs["star"]
+    cfg = CFG.replace(num_communities=1, seed_include_self=False)
+    F = seeding.init_F(g, np.array([0]), cfg)
+    # neighbor-only indicator: center excluded
+    np.testing.assert_array_equal(F[:, 0], [0, 1, 1, 1, 1])
+
+
+def test_init_F_truncates_seeds(toy_graphs):
+    g = toy_graphs["triangle"]
+    cfg = CFG.replace(num_communities=2)
+    F = seeding.init_F(g, np.array([0, 1, 2]), cfg)  # 3 seeds, K=2
+    assert F.shape == (3, 2)
+
+
+def test_seeded_fit_beats_random_init(toy_graphs):
+    """Integration: conductance-seeded init on two_cliques recovers the two
+    planted communities after thresholding-free inspection of F columns."""
+    from bigclam_tpu.models import BigClamModel
+
+    g = toy_graphs["two_cliques"]
+    # seeds rank [0,1,5,6]: 0,1 seed the left clique's ego-net, 5,6 the
+    # right's — K=4 gives each clique at least one dedicated column
+    cfg = BigClamConfig(num_communities=4, dtype="float64", max_iters=30)
+    seeds = seeding.conductance_seeds(g, cfg, backend="numpy")
+    F0 = seeding.init_F(g, seeds, cfg)
+    res = BigClamModel(g, cfg).fit(F0)
+    left = set(res.F[:4].argmax(axis=1).tolist())
+    right = set(res.F[4:].argmax(axis=1).tolist())
+    assert left <= {0, 1} and right <= {2, 3}
