@@ -1,0 +1,82 @@
+"""Headline benchmark: BigCLAM optimizer throughput on Email-Enron, K=100
+(BASELINE config 2), on the available accelerator.
+
+Prints ONE JSON line:
+  {"metric": "edges/sec/chip", "value": N, "unit": "edges/sec/chip",
+   "vs_baseline": R, ...}
+
+metric: directed-edge traversals of the graph per second per chip, counting
+one optimizer iteration as ONE traversal of the 2E directed edges (each
+iteration internally performs 17 fused sweeps — 1 gradient/LLH + 16 Armijo
+candidates — so multiply by 17 for raw gather-dot throughput).
+
+vs_baseline: speedup over the float64 NumPy spec interpreter (the exact
+reference semantics, SURVEY.md §4.2) running the same iteration on this
+host's CPU — the reference itself publishes no numbers (BASELINE.md), so the
+oracle's single-core throughput is the anchor; it is re-measured here (one
+iteration) for comparability.
+"""
+
+import json
+import time
+
+import numpy as np
+
+ENRON = "/root/reference/data/Email-Enron.txt"
+K = 100
+TIMED_ITERS = 10
+
+
+def main() -> None:
+    import jax
+
+    from bigclam_tpu.config import BigClamConfig
+    from bigclam_tpu.graph import build_graph
+    from bigclam_tpu.models import BigClamModel
+    from bigclam_tpu.spec import interpreter as spec
+
+    g = build_graph(ENRON)
+    cfg = BigClamConfig(num_communities=K)
+    rng = np.random.default_rng(0)
+    F0 = rng.integers(0, 2, size=(g.num_nodes, K)).astype(np.float64)
+
+    # --- accelerator run (float32, K padded to the 128-lane boundary) ---
+    model = BigClamModel(g, cfg, k_multiple=128)
+    state = model.init_state(F0)
+    state = model._step(state)                 # warmup / compile
+    jax.block_until_ready(state.F)
+    t0 = time.perf_counter()
+    for _ in range(TIMED_ITERS):
+        state = model._step(state)
+    jax.block_until_ready(state.F)
+    dt = time.perf_counter() - t0
+    n_chips = 1                                # single-chip benchmark config
+    edges_per_sec = g.num_directed_edges * TIMED_ITERS / dt / n_chips
+
+    # --- oracle baseline: one exact-semantics iteration on host CPU ---
+    Fb = F0.copy()
+    sb = Fb.sum(0)
+    t0 = time.perf_counter()
+    spec.line_search_step(Fb, sb, g, cfg)
+    base_dt = time.perf_counter() - t0
+    base_edges_per_sec = g.num_directed_edges / base_dt
+
+    print(
+        json.dumps(
+            {
+                "metric": "edges/sec/chip",
+                "value": round(edges_per_sec, 1),
+                "unit": "edges/sec/chip",
+                "vs_baseline": round(edges_per_sec / base_edges_per_sec, 2),
+                "config": f"Email-Enron N={g.num_nodes} 2E={g.num_directed_edges} K={K}",
+                "iters_timed": TIMED_ITERS,
+                "sec_per_iter": round(dt / TIMED_ITERS, 4),
+                "device": str(jax.devices()[0]),
+                "final_llh": float(state.llh),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

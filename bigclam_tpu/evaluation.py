@@ -54,9 +54,13 @@ def avg_f1(pred: Sequence[Iterable[int]], truth: Sequence[Iterable[int]]) -> flo
     return 0.5 * (best_pt.mean() + best_tp.mean())
 
 
-def _h(p: float) -> float:
-    """Entropy contribution -p*log2(p), 0 at p=0."""
-    return 0.0 if p <= 0.0 else -p * np.log2(p)
+def _h(p):
+    """Entropy contribution -p*log2(p) (elementwise, 0 at p=0)."""
+    p = np.asarray(p, dtype=np.float64)
+    out = np.zeros_like(p)
+    nz = p > 0
+    out[nz] = -p[nz] * np.log2(p[nz])
+    return out if out.ndim else float(out)
 
 
 def _cover_matrix(cover: Sequence[Iterable[int]], nodes: dict[int, int]) -> np.ndarray:
@@ -83,13 +87,13 @@ def overlapping_nmi(
     def cond_norm(A: np.ndarray, B: np.ndarray) -> float:
         """mean_i min_j H(a_i | b_j) / H(a_i), with the LFK admissibility rule."""
         pb1 = B.mean(axis=1)                      # loop-invariant: H(b_j)
-        hB = np.array([_h(p) + _h(1 - p) for p in pb1])
+        hB = _h(pb1) + _h(1 - pb1)
         ratios = []
         # joint counts via boolean algebra, vectorized over j for each i
         for i in range(A.shape[0]):
             a = A[i]
             pa1 = a.mean()
-            ha = _h(pa1) + _h(1 - pa1)
+            ha = float(_h(pa1) + _h(1 - pa1))
             if ha == 0.0:
                 ratios.append(1.0)  # degenerate (empty/full) community carries
                 continue            # no information about the other cover
@@ -97,10 +101,7 @@ def overlapping_nmi(
             c = (~B & a).sum(axis=1) / n         # P(a=1, b=0)
             b_ = (B & ~a).sum(axis=1) / n        # P(a=0, b=1)
             e = (~B & ~a).sum(axis=1) / n        # P(a=0, b=0)
-            hd = np.array([_h(x) for x in d])
-            hc = np.array([_h(x) for x in c])
-            hb = np.array([_h(x) for x in b_])
-            he = np.array([_h(x) for x in e])
+            hd, hc, hb, he = _h(d), _h(c), _h(b_), _h(e)
             admissible = (hd + he) >= (hc + hb)
             h_cond = (hd + hc + hb + he) - hB     # H(a,b) - H(b)
             h_cond = np.where(admissible, h_cond, ha)

@@ -38,12 +38,11 @@ def planted_partition_F(
     k: int,
     strength: float = 3.0,
     overlap: int = 0,
-    rng: Optional[np.random.Generator] = None,
 ) -> tuple[np.ndarray, List[List[int]]]:
-    """A planted F with k equal blocks of n//k nodes at the given membership
-    strength; `overlap` extra nodes per community straddle the next block.
+    """A deterministic planted F with k equal blocks of n//k nodes at the
+    given membership strength; `overlap` extra nodes per community straddle
+    the next block. Randomness enters via sample_graph's rng, not here.
     Returns (F, ground-truth communities as node-id lists)."""
-    rng = rng or np.random.default_rng(0)
     F = np.zeros((n, k))
     size = n // k
     truth: List[List[int]] = []

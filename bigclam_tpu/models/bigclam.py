@@ -90,6 +90,46 @@ def prepare_graph(
     )
 
 
+def run_fit_loop(
+    step_fn: Callable[[TrainState], TrainState],
+    state: TrainState,
+    cfg: BigClamConfig,
+    callback: Optional[Callable[[int, float], None]],
+    extract_F: Callable[[TrainState], np.ndarray],
+) -> FitResult:
+    """Shared convergence loop (MBSGD semantics, Bigclamv2.scala:203-219),
+    used by both the single-chip and the sharded trainer.
+
+    The convergence check compares LLH(F_t) against LLH(F_{t-1}); when it
+    fires, F_{t-1} is the final model (exactly the reference's stopping
+    state). The step that computed LLH(F_t) also eagerly produced F_{t+1};
+    that speculative update is discarded.
+    """
+    prev_state = state
+    hist: list[float] = []
+    for _ in range(cfg.max_iters + 1):
+        new_state = step_fn(state)
+        llh_t = float(new_state.llh)           # LLH of state.F
+        if callback is not None:
+            callback(int(state.it), llh_t)
+        if hist and _rel_change(llh_t, hist[-1]) < cfg.conv_tol:
+            final, final_llh, iters = state, llh_t, int(state.it)
+            hist.append(llh_t)
+            break
+        hist.append(llh_t)
+        prev_state = state
+        state = new_state
+    else:
+        # hit max_iters without converging; prev_state is the last state
+        # whose LLH was actually evaluated (hist[-1])
+        final, final_llh, iters = prev_state, hist[-1], int(prev_state.it)
+    F = extract_F(final)
+    return FitResult(
+        F=F, sumF=F.sum(axis=0), llh=final_llh,
+        num_iters=iters, llh_history=tuple(hist),
+    )
+
+
 def make_train_step(
     edges: EdgeChunks, cfg: BigClamConfig
 ) -> Callable[[TrainState], TrainState]:
@@ -161,41 +201,14 @@ class BigClamModel:
         F0: np.ndarray,
         callback: Optional[Callable[[int, float], None]] = None,
     ) -> FitResult:
-        """Train to convergence (MBSGD semantics, Bigclamv2.scala:203-219).
-
-        The convergence check compares LLH(F_t) against LLH(F_{t-1}); when it
-        fires, F_{t-1} is the final model (exactly the reference's stopping
-        state). The step that computed LLH(F_t) also eagerly produced F_{t+1};
-        that speculative update is discarded.
-        """
-        cfg = self.cfg
-        state = self.init_state(F0)
-        prev_state = state
-        hist: list[float] = []
-        for _ in range(cfg.max_iters + 1):
-            new_state = self._step(state)
-            llh_t = float(new_state.llh)       # LLH of state.F
-            if callback is not None:
-                callback(int(state.it), llh_t)
-            if hist and _rel_change(llh_t, hist[-1]) < cfg.conv_tol:
-                final, final_llh, iters = state, llh_t, int(state.it)
-                hist.append(llh_t)
-                break
-            hist.append(llh_t)
-            prev_state = state
-            state = new_state
-        else:
-            # hit max_iters without converging; prev_state is the last state
-            # whose LLH was actually evaluated (hist[-1])
-            final, final_llh, iters = prev_state, hist[-1], int(prev_state.it)
-        n, k = self.g.num_nodes, cfg.num_communities
-        F = np.asarray(final.F[:n, :k])
-        return FitResult(
-            F=F,
-            sumF=F.sum(axis=0),
-            llh=final_llh,
-            num_iters=iters,
-            llh_history=tuple(hist),
+        """Train to convergence (see run_fit_loop)."""
+        n, k = self.g.num_nodes, self.cfg.num_communities
+        return run_fit_loop(
+            self._step,
+            self.init_state(F0),
+            self.cfg,
+            callback,
+            lambda st: np.asarray(st.F[:n, :k]),
         )
 
     def random_init(self, seed: Optional[int] = None) -> np.ndarray:

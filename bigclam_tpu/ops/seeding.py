@@ -44,6 +44,9 @@ from bigclam_tpu.graph.csr import Graph
 # Above this node count the dense (N, N) device adjacency no longer fits
 # comfortably in HBM; use the host/native sparse path instead.
 DENSE_DEVICE_MAX_NODES = 16384
+# float32 matmul accumulators are exact only below 2^24; 2*tri(u) <= deg(u)^2,
+# so cap the degree the dense backend accepts
+DENSE_DEVICE_MAX_DEGREE = 4095
 
 
 def triangle_counts(g: Graph) -> np.ndarray:
@@ -80,10 +83,16 @@ def triangle_counts_dense_device(g: Graph) -> np.ndarray:
     """Device backend: tri = rowsum(A@A * A) / 2 on a dense adjacency.
 
     The A@A contraction maps straight onto the MXU; only viable while the
-    (N, N) tile fits HBM (guarded by DENSE_DEVICE_MAX_NODES at call sites).
+    (N, N) tile fits HBM (DENSE_DEVICE_MAX_NODES) and counts stay exactly
+    representable in the float32 accumulator (DENSE_DEVICE_MAX_DEGREE).
     """
     import jax.numpy as jnp
 
+    if g.degrees.size and int(g.degrees.max()) > DENSE_DEVICE_MAX_DEGREE:
+        raise ValueError(
+            f"max degree {int(g.degrees.max())} exceeds float32-exact bound "
+            f"{DENSE_DEVICE_MAX_DEGREE}; use the host backend"
+        )
     n = g.num_nodes
     A = np.zeros((n, n), dtype=np.float32)
     A[g.src, g.dst] = 1.0
@@ -97,7 +106,9 @@ def conductance(g: Graph, backend: str = "auto") -> np.ndarray:
     deg = g.degrees
     two_e = float(g.num_directed_edges)
     if backend == "dense" or (
-        backend == "auto" and 0 < g.num_nodes <= DENSE_DEVICE_MAX_NODES
+        backend == "auto"
+        and 0 < g.num_nodes <= DENSE_DEVICE_MAX_NODES
+        and (deg.size == 0 or int(deg.max()) <= DENSE_DEVICE_MAX_DEGREE)
     ):
         tri = triangle_counts_dense_device(g)
     else:

@@ -1,0 +1,4 @@
+from bigclam_tpu.parallel.mesh import make_mesh
+from bigclam_tpu.parallel.sharded import ShardedBigClamModel
+
+__all__ = ["make_mesh", "ShardedBigClamModel"]

@@ -1,0 +1,50 @@
+"""Device-mesh construction for BigCLAM's two parallel axes.
+
+Replaces C20/C21 (SURVEY.md §2): the reference's only distribution strategy
+was Spark data-parallelism over node partitions with the model fully
+replicated (F and the adjacency broadcast to every executor each iteration,
+Bigclamv2.scala:34,118). Here the mesh has two named axes:
+
+  * "nodes" — data parallelism over contiguous node ranges: F rows, edge
+    lists and all per-node state are sharded; the analog of the reference's
+    RDD partitioning, minus the replication.
+  * "k"     — tensor parallelism over the community axis: F columns and sumF
+    are sharded when N*K exceeds a chip's HBM (the TP analog in SURVEY.md
+    §5); per-node F_u.F_v dots become partial dots + psum over "k".
+
+Collectives ride ICI within a slice and DCN across slices, scheduled by XLA
+from the shardings (jax.lax.psum / all_gather inside shard_map) — there is no
+driver in the data path.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+NODES_AXIS = "nodes"
+K_AXIS = "k"
+
+
+def make_mesh(
+    shape: Tuple[int, int] = (1, 1),
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """Build a (nodes, k) mesh over the given devices (default: all).
+
+    shape = (node_shards, k_shards); their product must equal the device
+    count used. For multi-host meshes pass jax.devices() after
+    jax.distributed.initialize() — device order determines which axis rides
+    ICI; keep the faster-varying axis ("k") within a host/slice.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    dp, tp = shape
+    if dp * tp != len(devices):
+        raise ValueError(
+            f"mesh shape {shape} needs {dp * tp} devices, got {len(devices)}"
+        )
+    arr = np.asarray(devices).reshape(dp, tp)
+    return Mesh(arr, (NODES_AXIS, K_AXIS))
