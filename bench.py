@@ -72,7 +72,9 @@ def main() -> None:
                 "iters_timed": TIMED_ITERS,
                 "sec_per_iter": round(dt / TIMED_ITERS, 4),
                 "device": str(jax.devices()[0]),
-                "final_llh": float(state.llh),
+                # TrainState.llh is the LLH of the step's INPUT F, so this is
+                # the last *evaluated* LLH (one update behind state.F)
+                "llh_at_last_eval": float(state.llh),
             }
         )
     )

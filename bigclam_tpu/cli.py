@@ -1,0 +1,232 @@
+"""Command-line interface: the reference's "config mechanism" was editing
+hard-coded vars in three spark-shell scripts (SURVEY.md §5/C19); here one CLI
+covers fitting, K-sweeps and ground-truth evaluation.
+
+    python -m bigclam_tpu.cli fit   --graph data.txt --k 100 --out cmty.txt
+    python -m bigclam_tpu.cli sweep --graph data.txt --min-com 50 --max-com 200
+    python -m bigclam_tpu.cli eval  --pred cmty.txt --truth truth.cmty
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+import numpy as np
+
+
+def _add_common(p: argparse.ArgumentParser) -> None:
+    p.add_argument("--graph", required=True, help="SNAP edge-list path")
+    p.add_argument("--dtype", default="float32", choices=["float32", "float64"])
+    p.add_argument("--max-iters", type=int, default=1000)
+    p.add_argument("--conv-tol", type=float, default=1e-4)
+    p.add_argument("--alpha", type=float, default=0.05)
+    p.add_argument("--beta", type=float, default=0.1)
+    p.add_argument("--max-backtracks", type=int, default=15)
+    p.add_argument("--edge-chunk", type=int, default=1 << 18)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument(
+        "--init", default="conductance", choices=["conductance", "random"],
+        help="F initialization (conductance seeding is the reference default)",
+    )
+    p.add_argument(
+        "--mesh", default=None,
+        help="'DP,TP' device mesh, e.g. 4,2 (default: single device)",
+    )
+    p.add_argument("--checkpoint-dir", default=None)
+    p.add_argument("--checkpoint-every", type=int, default=0)
+    p.add_argument("--metrics", default=None, help="JSONL metrics path")
+    p.add_argument("--profile-dir", default=None, help="jax.profiler trace dir")
+    p.add_argument("--quiet", action="store_true")
+    p.add_argument(
+        "--platform", default=None, choices=["cpu", "tpu"],
+        help="force a JAX platform (the env may pin one; this overrides it)",
+    )
+
+
+def _build(args, k: int):
+    from bigclam_tpu.config import BigClamConfig
+    from bigclam_tpu.graph import build_graph
+
+    cfg = BigClamConfig(
+        num_communities=k,
+        dtype=args.dtype,
+        max_iters=args.max_iters,
+        conv_tol=args.conv_tol,
+        alpha=args.alpha,
+        beta=args.beta,
+        max_backtracks=args.max_backtracks,
+        edge_chunk=args.edge_chunk,
+        seed=args.seed,
+        checkpoint_dir=args.checkpoint_dir,
+        checkpoint_every=args.checkpoint_every,
+        metrics_path=args.metrics,
+        min_com=getattr(args, "min_com", 1000),
+        max_com=getattr(args, "max_com", 9000),
+        div_com=getattr(args, "div_com", 100),
+        ksweep_tol=getattr(args, "ksweep_tol", 1e-3),
+    )
+    g = build_graph(args.graph)
+    return g, cfg
+
+
+def _make_model(g, cfg, args):
+    if args.mesh:
+        import jax
+
+        from bigclam_tpu.parallel import ShardedBigClamModel, make_mesh
+
+        dp, tp = (int(x) for x in args.mesh.split(","))
+        mesh = make_mesh((dp, tp), jax.devices()[: dp * tp])
+        return ShardedBigClamModel(g, cfg, mesh)
+    from bigclam_tpu.models import BigClamModel
+
+    return BigClamModel(g, cfg, k_multiple=128 if cfg.dtype == "float32" else 1)
+
+
+def _init_F(g, cfg, args):
+    from bigclam_tpu.ops import seeding
+
+    if args.init == "conductance":
+        seeds = seeding.conductance_seeds(g, cfg)
+        return seeding.init_F(g, seeds, cfg)
+    rng = np.random.default_rng(cfg.seed)
+    return rng.integers(
+        0, 2, size=(g.num_nodes, cfg.num_communities)
+    ).astype(np.float64)
+
+
+def cmd_fit(args) -> int:
+    from bigclam_tpu.ops import extraction
+    from bigclam_tpu.utils import CheckpointManager, MetricsLogger
+    from bigclam_tpu.utils.profiling import trace
+
+    g, cfg = _build(args, args.k)
+    if args.checkpoint_dir and cfg.checkpoint_every <= 0:
+        # a checkpoint dir without a cadence would restore but never save
+        cfg = cfg.replace(checkpoint_every=50)
+        print(
+            "note: --checkpoint-dir given without --checkpoint-every; "
+            "defaulting to every 50 iterations",
+            file=sys.stderr,
+        )
+    model = _make_model(g, cfg, args)
+    F0 = _init_F(g, cfg, args)
+    ckpt = (
+        CheckpointManager(args.checkpoint_dir) if args.checkpoint_dir else None
+    )
+    with MetricsLogger(args.metrics, echo=not args.quiet) as ml:
+        cb = ml.step_callback(g.num_directed_edges)
+        with trace(args.profile_dir):
+            res = model.fit(F0, callback=cb, checkpoints=ckpt)
+    out = {
+        "llh": res.llh,
+        "iters": res.num_iters,
+        "n": g.num_nodes,
+        "edges": g.num_edges,
+        "k": cfg.num_communities,
+    }
+    if args.out:
+        com = extraction.extract_communities(res.F, g)
+        extraction.save_communities(args.out, com)
+        out["communities"] = len(com)
+        out["out"] = args.out
+    if args.save_f:
+        np.save(args.save_f, res.F)
+        out["save_f"] = args.save_f
+    print(json.dumps(out))
+    return 0
+
+
+def cmd_sweep(args) -> int:
+    from bigclam_tpu.models.model_selection import sweep_k
+    from bigclam_tpu.utils.profiling import trace
+
+    g, cfg = _build(args, getattr(args, "max_com"))
+    if args.checkpoint_dir:
+        print(
+            "note: checkpointing is per-fit; the sweep records progress in "
+            f"{args.checkpoint_dir}/sweep_state.json",
+            file=sys.stderr,
+        )
+    factory = (lambda c: _make_model(g, c, args)) if args.mesh else None
+    with trace(args.profile_dir):
+        res = sweep_k(
+            g,
+            cfg,
+            model_factory=factory,
+            callback=None if args.quiet else (
+                lambda k, llh: print(f"K={k} LLH={llh:.2f}", file=sys.stderr)
+            ),
+            state_dir=args.checkpoint_dir,
+        )
+    print(
+        json.dumps(
+            {
+                "chosen_k": res.chosen_k,
+                "kset": res.kset,
+                "llh_by_k": {str(k): v for k, v in res.llh_by_k.items()},
+            }
+        )
+    )
+    return 0
+
+
+def cmd_eval(args) -> int:
+    from bigclam_tpu.evaluation import avg_f1, overlapping_nmi
+    from bigclam_tpu.ops.extraction import load_communities
+
+    pred = load_communities(args.pred)
+    truth = load_communities(args.truth)
+    out = {"f1": avg_f1(pred, truth)}
+    if not args.no_nmi:
+        out["nmi"] = overlapping_nmi(pred, truth)
+    print(json.dumps(out))
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="bigclam_tpu", description=__doc__)
+    sub = ap.add_subparsers(dest="cmd", required=True)
+
+    p_fit = sub.add_parser("fit", help="train at a fixed K and extract communities")
+    _add_common(p_fit)
+    p_fit.add_argument("--k", type=int, default=100)
+    p_fit.add_argument("--out", default=None, help="write SNAP cmty file")
+    p_fit.add_argument("--save-f", default=None, help="write F as .npy")
+    p_fit.set_defaults(fn=cmd_fit)
+
+    p_sweep = sub.add_parser("sweep", help="automatic K selection over a log grid")
+    _add_common(p_sweep)
+    p_sweep.add_argument("--min-com", type=int, default=1000)
+    p_sweep.add_argument("--max-com", type=int, default=9000)
+    p_sweep.add_argument("--div-com", type=int, default=100)
+    p_sweep.add_argument("--ksweep-tol", type=float, default=1e-3)
+    p_sweep.set_defaults(fn=cmd_sweep)
+
+    p_eval = sub.add_parser("eval", help="score predicted vs ground-truth communities")
+    p_eval.add_argument("--pred", required=True)
+    p_eval.add_argument("--truth", required=True)
+    p_eval.add_argument("--no-nmi", action="store_true")
+    p_eval.set_defaults(fn=cmd_eval)
+
+    args = ap.parse_args(argv)
+    # platform/precision must be pinned before the first jax backend use
+    # (env vars are too late when the host env pre-imports jaxlib)
+    if getattr(args, "platform", None):
+        import jax
+
+        jax.config.update("jax_platforms", args.platform)
+        if args.platform == "cpu" and getattr(args, "mesh", None):
+            dp, tp = (int(x) for x in args.mesh.split(","))
+            jax.config.update("jax_num_cpu_devices", dp * tp)
+    if getattr(args, "dtype", None) == "float64":
+        import jax
+
+        jax.config.update("jax_enable_x64", True)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,88 @@
+"""ctypes bindings for the native host-side kernels (native.cpp).
+
+Importing this module loads ``libbigclam_native.so`` next to it, building it
+with `make` on first use if the toolchain is available. Callers
+(graph.ingest, ops.seeding) guard the import and fall back to NumPy, so a
+missing compiler degrades performance, not functionality.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+
+import numpy as np
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SO = os.path.join(_DIR, "libbigclam_native.so")
+
+
+def _load() -> ctypes.CDLL:
+    if not os.path.exists(_SO):
+        try:
+            subprocess.run(
+                ["make", "-C", _DIR],
+                check=True,
+                capture_output=True,
+                timeout=120,
+            )
+        except (subprocess.SubprocessError, FileNotFoundError) as e:
+            raise ImportError(f"cannot build native library: {e}") from e
+    try:
+        lib = ctypes.CDLL(_SO)
+    except OSError as e:
+        raise ImportError(f"cannot load {_SO}: {e}") from e
+    lib.bc_parse_edge_list.restype = ctypes.POINTER(ctypes.c_int64)
+    lib.bc_parse_edge_list.argtypes = [
+        ctypes.c_char_p,
+        ctypes.POINTER(ctypes.c_int64),
+    ]
+    lib.bc_free.restype = None
+    lib.bc_free.argtypes = [ctypes.c_void_p]
+    lib.bc_triangle_counts.restype = None
+    lib.bc_triangle_counts.argtypes = [
+        ctypes.POINTER(ctypes.c_int64),
+        ctypes.POINTER(ctypes.c_int32),
+        ctypes.c_int64,
+        ctypes.POINTER(ctypes.c_int64),
+    ]
+    return lib
+
+
+_lib = _load()
+
+
+def parse_edge_list(path: str) -> np.ndarray:
+    """Parse a SNAP edge-list file into an (M, 2) int64 array."""
+    n_pairs = ctypes.c_int64(0)
+    ptr = _lib.bc_parse_edge_list(path.encode(), ctypes.byref(n_pairs))
+    if not ptr:
+        if n_pairs.value == -1:
+            raise ValueError(
+                f"{path}: malformed edge list (odd or non-integer tokens)"
+            )
+        raise OSError(f"{path}: cannot read")
+    try:
+        m = n_pairs.value
+        out = np.ctypeslib.as_array(ptr, shape=(m, 2)).copy() if m else np.empty(
+            (0, 2), np.int64
+        )
+    finally:
+        _lib.bc_free(ptr)
+    return out
+
+
+def triangle_counts(g) -> np.ndarray:
+    """tri(u) = #edges among N(u) via the OpenMP two-hop pass."""
+    indptr = np.ascontiguousarray(g.indptr, dtype=np.int64)
+    indices = np.ascontiguousarray(g.indices, dtype=np.int32)
+    n = g.num_nodes
+    out = np.zeros(n, dtype=np.int64)
+    _lib.bc_triangle_counts(
+        indptr.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        indices.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        ctypes.c_int64(n),
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+    )
+    return out

@@ -1,0 +1,118 @@
+// Native host-side graph kernels for tpu-bigclam.
+//
+// The reference (thangdnsf/BigCLAM-ApacheSpark) has no native code at all —
+// its ingest was Spark GraphLoader (JVM) and its two-hop conductance sweep a
+// Spark map over broadcast neighbor lists (Bigclamv2.scala:14,42-59). These
+// are the framework's host-side hot paths (device kernels are JAX/Pallas):
+//
+//   bc_parse_edge_list — streaming SNAP edge-list parser ('#' comments,
+//       whitespace-separated integer pairs); one pass, no line splitting.
+//   bc_triangle_counts — tri(u) = #edges among N(u), the masked-SpGEMM-style
+//       two-hop pass behind the conductance closed forms (ops/seeding.py);
+//       OpenMP over nodes with per-thread flag arrays, O(sum deg^2) work.
+//
+// Exposed to Python via ctypes (see __init__.py); NumPy fallbacks exist for
+// every entry point, so the .so is an accelerator, not a dependency.
+
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <vector>
+
+extern "C" {
+
+// Returns a malloc'd buffer of 2*n_pairs int64 values (caller frees with
+// bc_free). On failure returns nullptr with *n_pairs_out = -1 (parse error:
+// odd token count or non-integer token) or -2 (I/O error).
+int64_t* bc_parse_edge_list(const char* path, int64_t* n_pairs_out) {
+  *n_pairs_out = -2;
+  FILE* f = fopen(path, "rb");
+  if (!f) return nullptr;
+  fseek(f, 0, SEEK_END);
+  long sz = ftell(f);
+  fseek(f, 0, SEEK_SET);
+  char* buf = (char*)malloc((size_t)sz + 1);
+  if (!buf) {
+    fclose(f);
+    return nullptr;
+  }
+  if (sz > 0 && fread(buf, 1, (size_t)sz, f) != (size_t)sz) {
+    free(buf);
+    fclose(f);
+    return nullptr;
+  }
+  fclose(f);
+  buf[sz] = '\0';
+
+  std::vector<int64_t> vals;
+  vals.reserve(1 << 20);
+  const char* p = buf;
+  const char* end = buf + sz;
+  while (p < end) {
+    while (p < end && (*p == ' ' || *p == '\t' || *p == '\r' || *p == '\n'))
+      p++;
+    if (p >= end) break;
+    if (*p == '#') {  // comment line
+      while (p < end && *p != '\n') p++;
+      continue;
+    }
+    bool neg = false;
+    if (*p == '-' || *p == '+') {
+      neg = (*p == '-');
+      p++;
+    }
+    if (p >= end || *p < '0' || *p > '9') {
+      free(buf);
+      *n_pairs_out = -1;
+      return nullptr;
+    }
+    int64_t v = 0;
+    while (p < end && *p >= '0' && *p <= '9') {
+      v = v * 10 + (*p - '0');
+      p++;
+    }
+    vals.push_back(neg ? -v : v);
+  }
+  free(buf);
+  if (vals.size() % 2 != 0) {
+    *n_pairs_out = -1;
+    return nullptr;
+  }
+  int64_t* out = (int64_t*)malloc(vals.size() * sizeof(int64_t));
+  if (!out) {
+    *n_pairs_out = -2;
+    return nullptr;
+  }
+  if (!vals.empty()) memcpy(out, vals.data(), vals.size() * sizeof(int64_t));
+  *n_pairs_out = (int64_t)(vals.size() / 2);
+  return out;
+}
+
+void bc_free(void* p) { free(p); }
+
+// tri(u) = #edges among N(u): mark N(u) in a flag array, then count flagged
+// entries across the neighbor lists of every v in N(u); each intra-
+// neighborhood edge is seen twice.
+void bc_triangle_counts(const int64_t* indptr, const int32_t* indices,
+                        int64_t n, int64_t* out) {
+#pragma omp parallel
+  {
+    std::vector<uint8_t> flags((size_t)n, 0);
+#pragma omp for schedule(dynamic, 64)
+    for (int64_t u = 0; u < n; u++) {
+      int64_t lo = indptr[u], hi = indptr[u + 1];
+      for (int64_t i = lo; i < hi; i++) flags[indices[i]] = 1;
+      int64_t hits = 0;
+      for (int64_t i = lo; i < hi; i++) {
+        int32_t v = indices[i];
+        for (int64_t j = indptr[v]; j < indptr[v + 1]; j++)
+          hits += flags[indices[j]];
+      }
+      for (int64_t i = lo; i < hi; i++) flags[indices[i]] = 0;
+      out[u] = hits / 2;
+    }
+  }
+}
+
+}  // extern "C"

@@ -96,6 +96,10 @@ def run_fit_loop(
     cfg: BigClamConfig,
     callback: Optional[Callable[[int, float], None]],
     extract_F: Callable[[TrainState], np.ndarray],
+    checkpoints=None,
+    state_to_arrays: Optional[Callable[[TrainState], dict]] = None,
+    initial_hist: tuple = (),
+    ckpt_meta: Optional[dict] = None,
 ) -> FitResult:
     """Shared convergence loop (MBSGD semantics, Bigclamv2.scala:203-219),
     used by both the single-chip and the sharded trainer.
@@ -104,10 +108,16 @@ def run_fit_loop(
     fires, F_{t-1} is the final model (exactly the reference's stopping
     state). The step that computed LLH(F_t) also eagerly produced F_{t+1};
     that speculative update is discarded.
+
+    When a utils.checkpoint.CheckpointManager is given, the state tuple is
+    saved every cfg.checkpoint_every iterations (SURVEY.md §5 — the
+    reference had no checkpointing); initial_hist carries the restored LLH
+    history on resume so convergence tests continue seamlessly.
     """
     prev_state = state
-    hist: list[float] = []
-    for _ in range(cfg.max_iters + 1):
+    hist: list[float] = list(initial_hist)
+    remaining = max(cfg.max_iters - int(state.it), 0)
+    for _ in range(remaining + 1):
         new_state = step_fn(state)
         llh_t = float(new_state.llh)           # LLH of state.F
         if callback is not None:
@@ -119,6 +129,17 @@ def run_fit_loop(
         hist.append(llh_t)
         prev_state = state
         state = new_state
+        if (
+            checkpoints is not None
+            and cfg.checkpoint_every > 0
+            and int(state.it) % cfg.checkpoint_every == 0
+            and state_to_arrays is not None
+        ):
+            checkpoints.save(
+                int(state.it),
+                state_to_arrays(state),
+                meta={"llh_history": hist, **(ckpt_meta or {})},
+            )
     else:
         # hit max_iters without converging; prev_state is the last state
         # whose LLH was actually evaluated (hist[-1])
@@ -128,6 +149,33 @@ def run_fit_loop(
         F=F, sumF=F.sum(axis=0), llh=final_llh,
         num_iters=iters, llh_history=tuple(hist),
     )
+
+
+def restore_checkpoint(checkpoints, expected_meta: dict, state_from_arrays):
+    """Restore the newest checkpoint, refusing shape/graph mismatches.
+
+    JAX clips out-of-range gathers and drops out-of-range scatters silently,
+    so resuming with an F whose padding or graph differs from the compiled
+    step would corrupt results without an exception — validate instead.
+    Returns (state, llh_history) or (None, ()) when nothing is stored.
+    """
+    restored = checkpoints.restore()
+    if restored is None:
+        return None, ()
+    _, arrays, meta = restored
+    for key, val in expected_meta.items():
+        got = meta.get(key)
+        if got != val:
+            raise ValueError(
+                f"checkpoint incompatible with this run: {key}={got} in "
+                f"checkpoint vs {val} expected (dir: {checkpoints.directory})"
+            )
+    if tuple(arrays["F"].shape) != (expected_meta["n_pad"], expected_meta["k_pad"]):
+        raise ValueError(
+            f"checkpoint F shape {arrays['F'].shape} != padded shape "
+            f"({expected_meta['n_pad']}, {expected_meta['k_pad']})"
+        )
+    return state_from_arrays(arrays), tuple(meta.get("llh_history", ()))
 
 
 def make_train_step(
@@ -196,19 +244,58 @@ class BigClamModel:
             it=jnp.zeros((), jnp.int32),
         )
 
+    def _ckpt_meta(self) -> dict:
+        return {
+            "num_nodes": self.g.num_nodes,
+            "num_directed_edges": self.g.num_directed_edges,
+            "k": self.cfg.num_communities,
+            "n_pad": self.n_pad,
+            "k_pad": self.k_pad,
+        }
+
+    def _state_to_arrays(self, state: TrainState) -> dict:
+        return {
+            "F": np.asarray(state.F),
+            "sumF": np.asarray(state.sumF),
+            "llh": np.asarray(state.llh),
+            "it": np.asarray(state.it),
+        }
+
+    def _state_from_arrays(self, arrays: dict) -> TrainState:
+        return TrainState(
+            F=jnp.asarray(arrays["F"], self.dtype),
+            sumF=jnp.asarray(arrays["sumF"], self.dtype),
+            llh=jnp.asarray(arrays["llh"], self.dtype),
+            it=jnp.asarray(arrays["it"], jnp.int32),
+        )
+
     def fit(
         self,
         F0: np.ndarray,
         callback: Optional[Callable[[int, float], None]] = None,
+        checkpoints=None,
     ) -> FitResult:
-        """Train to convergence (see run_fit_loop)."""
+        """Train to convergence (see run_fit_loop). If `checkpoints` (a
+        utils.checkpoint.CheckpointManager) holds a saved state, training
+        resumes from it; F0 is only the cold-start init."""
         n, k = self.g.num_nodes, self.cfg.num_communities
+        state, hist = self.init_state(F0), ()
+        if checkpoints is not None:
+            restored, hist = restore_checkpoint(
+                checkpoints, self._ckpt_meta(), self._state_from_arrays
+            )
+            if restored is not None:
+                state = restored
         return run_fit_loop(
             self._step,
-            self.init_state(F0),
+            state,
             self.cfg,
             callback,
             lambda st: np.asarray(st.F[:n, :k]),
+            checkpoints=checkpoints,
+            state_to_arrays=self._state_to_arrays,
+            initial_hist=hist,
+            ckpt_meta=self._ckpt_meta(),
         )
 
     def random_init(self, seed: Optional[int] = None) -> np.ndarray:

@@ -1,0 +1,143 @@
+"""Automatic selection of the community count K (C17, SURVEY.md §2).
+
+Replaces bigclam4-7.scala:115-133 (log-spaced K grid) and :244-266 (the
+sweep): seeds are computed ONCE (v4:75) and reused for every K; for each K
+in the grid the model is re-seeded and trained to convergence; the sweep
+stops at the first K whose relative LLH improvement over the previous K
+falls below ksweep_tol ((1 - LLH_Knew/LLH_Kold) < tol, v4:259 — NOT an
+absolute value, faithfully replicated).
+
+TPU-shaped difference: the F buffer is allocated once at K_max and masked
+per-K (columns >= K stay identically zero, which the padding-inertness
+property of the kernels guarantees — see ops/objective.py), so ONE
+compilation of the train step serves the whole sweep instead of re-jitting
+per K.
+
+Quirk fixes (documented in PARITY.md):
+  * Q3 (v4:251): `LLHKold == null` on a Double is always false, so the
+    reference compared the first K's LLH against 0.0; here the first K
+    simply primes LLH_Kold.
+  * SGDFindC (v4:225-243) returns LLHold — the second-to-last LLH — and
+    burns one untracked update before the loop (v4:228); we use the
+    converged LLH from the shared fit loop.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Callable, Dict, List, Optional
+
+import numpy as np
+
+from bigclam_tpu.config import BigClamConfig
+from bigclam_tpu.graph.csr import Graph
+from bigclam_tpu.models.bigclam import BigClamModel, FitResult
+from bigclam_tpu.ops import seeding
+
+
+def build_kset(min_com: int, max_com: int, div_com: int) -> List[int]:
+    """The log-spaced K grid, exactly as bigclam4-7.scala:116-133.
+
+    conGap = exp(log(maxCom/minCom)/divCom) with Scala *integer* division of
+    maxCom/minCom; the walk multiplies-and-truncates, bumps by 1 when stuck,
+    stops at maxCom and appends it. Golden: (50, 200, 15) reproduces the
+    pasted run artifact Array(50, 54, 59, ..., 184, 200) at v4:268.
+    """
+    if min_com <= 0 or max_com < min_com:
+        raise ValueError(f"need 0 < min_com <= max_com, got {min_com}, {max_com}")
+    ratio = max_com // min_com               # Scala Int/Int division
+    if ratio < 1:
+        return [int(min_com), int(max_com)]
+    con_gap = math.exp(math.log(ratio) / div_com)
+    kset = [int(min_com)]
+    x = int(min_com)
+    while True:
+        xtemp = int(x * con_gap)             # .toInt truncation
+        if xtemp == x:
+            xtemp += 1
+        x = xtemp
+        if x >= max_com:
+            break
+        kset.append(x)
+    kset.append(int(max_com))
+    return kset
+
+
+@dataclasses.dataclass(frozen=True)
+class SweepResult:
+    chosen_k: int                 # KforC: first K with sub-tol improvement
+    llh_by_k: Dict[int, float]    # converged LLH per trained K
+    kset: List[int]               # the full grid (sweep may stop early)
+    best_fit: Optional[FitResult]  # fit at the last trained K
+
+
+def sweep_k(
+    g: Graph,
+    cfg: BigClamConfig,
+    model_factory: Optional[Callable[[BigClamConfig], object]] = None,
+    callback: Optional[Callable[[int, float], None]] = None,
+    rng: Optional[np.random.Generator] = None,
+    state_dir: Optional[str] = None,
+) -> SweepResult:
+    """Train across the K grid and pick KforC (bigclam4-7.scala:244-266).
+
+    model_factory(cfg_at_kmax) may supply a sharded trainer; default is the
+    single-chip BigClamModel with K padded to the grid max so one compiled
+    step serves every K.
+
+    When state_dir is given, per-K converged LLHs are journaled to
+    state_dir/sweep_state.json and already-trained Ks are skipped on restart
+    (SURVEY.md §5: a K-sweep on a large graph is hours; the reference could
+    only restart from scratch).
+    """
+    import json
+    import os
+
+    kset = build_kset(cfg.min_com, cfg.max_com, cfg.div_com)
+    k_max = kset[-1]
+    cfg_max = cfg.replace(num_communities=k_max)
+    model = (
+        model_factory(cfg_max) if model_factory is not None
+        else BigClamModel(g, cfg_max)
+    )
+    rng = rng or np.random.default_rng(cfg.seed)
+    seeds = seeding.conductance_seeds(g, cfg)      # computed once (v4:75)
+
+    llh_by_k: Dict[int, float] = {}
+    state_path = None
+    if state_dir is not None:
+        os.makedirs(state_dir, exist_ok=True)
+        state_path = os.path.join(state_dir, "sweep_state.json")
+        if os.path.exists(state_path):
+            with open(state_path) as f:
+                llh_by_k = {int(k): v for k, v in json.load(f).items()}
+
+    llh_old: Optional[float] = None
+    chosen = kset[-1]
+    best_fit: Optional[FitResult] = None
+    for k in kset:
+        if k in llh_by_k:                           # journaled on a prior run
+            res_llh = llh_by_k[k]
+        else:
+            F0k = seeding.init_F(g, seeds, cfg.replace(num_communities=k), rng)
+            F0 = np.zeros((g.num_nodes, k_max))
+            F0[:, :k] = F0k                         # columns >= k stay zero
+            res = model.fit(F0)
+            res_llh = res.llh
+            llh_by_k[k] = res_llh
+            best_fit = res
+            if state_path is not None:
+                with open(state_path + ".tmp", "w") as f:
+                    json.dump({str(kk): v for kk, v in llh_by_k.items()}, f)
+                os.replace(state_path + ".tmp", state_path)
+        if callback is not None:
+            callback(k, res_llh)
+        if llh_old is not None and llh_old != 0.0:
+            if (1.0 - res_llh / llh_old) < cfg.ksweep_tol:
+                chosen = k                          # KforC = current K (v4:260)
+                break
+        llh_old = res_llh
+    return SweepResult(
+        chosen_k=chosen, llh_by_k=llh_by_k, kset=kset, best_fit=best_fit
+    )

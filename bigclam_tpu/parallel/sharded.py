@@ -37,6 +37,7 @@ from bigclam_tpu.models.bigclam import (
     FitResult,
     TrainState,
     _round_up,
+    restore_checkpoint,
     run_fit_loop,
 )
 from bigclam_tpu.ops.objective import EdgeChunks, edge_terms
@@ -96,7 +97,7 @@ def make_sharded_train_step(
     Semantics identical to the single-chip step (shard-count invariance is
     tested on the CPU device-count fake, SURVEY.md §4.4)."""
 
-    def step_shard(F_loc, src, dst, mask, llh_prev, it):
+    def step_shard(F_loc, src, dst, mask, it):
         # squeeze the leading per-shard axis shard_map leaves on the blocks
         src, dst, mask = src[0], dst[0], mask[0]
         adt = jnp.dtype(cfg.accum_dtype) if cfg.accum_dtype else F_loc.dtype
@@ -169,7 +170,9 @@ def make_sharded_train_step(
         )
 
         # Armijo acceptance + max-accepted-step update, all node-local
-        gg = _rowdot(grad, grad)
+        # (gg in accum dtype exactly as ops.linesearch.armijo_update, so the
+        # sharded acceptance decisions match single-chip bit-for-bit)
+        gg = _rowdot(grad, grad).astype(adt)
 
         def tail_for(eta):
             nf = jnp.clip(F_loc + eta * grad, cfg.min_f, cfg.max_f)
@@ -178,9 +181,7 @@ def make_sharded_train_step(
 
         tails = lax.map(tail_for, etas)
         cand_llh = cand_nbr + tails
-        ok = cand_llh >= node_llh[None, :] + (
-            cfg.alpha * etas[:, None] * gg[None, :]
-        ).astype(adt)
+        ok = cand_llh >= node_llh[None, :] + cfg.alpha * etas[:, None] * gg[None, :]
         best_eta = jnp.max(jnp.where(ok, etas[:, None], 0.0), axis=0)
         accepted = jnp.any(ok, axis=0)
         F_new = jnp.where(
@@ -188,10 +189,11 @@ def make_sharded_train_step(
             jnp.clip(F_loc + best_eta[:, None] * grad, cfg.min_f, cfg.max_f),
             F_loc,
         )
-        return F_new, llh_cur.astype(F_loc.dtype), it + 1
+        sumF_new = lax.psum(F_new.sum(axis=0), NODES_AXIS)   # (K_loc,)
+        return F_new, sumF_new, llh_cur.astype(F_loc.dtype), it + 1
 
     def step(state: TrainState) -> TrainState:
-        F_new, llh, it = jax.shard_map(
+        F_new, sumF, llh, it = jax.shard_map(
             step_shard,
             mesh=mesh,
             in_specs=(
@@ -200,11 +202,9 @@ def make_sharded_train_step(
                 P(NODES_AXIS, None, None),
                 P(NODES_AXIS, None, None),
                 P(),
-                P(),
             ),
-            out_specs=(P(NODES_AXIS, K_AXIS), P(), P()),
-        )(state.F, edges.src, edges.dst, edges.mask, state.llh, state.it)
-        sumF = F_new.sum(axis=0)
+            out_specs=(P(NODES_AXIS, K_AXIS), P(K_AXIS), P(), P()),
+        )(state.F, edges.src, edges.dst, edges.mask, state.it)
         return TrainState(F=F_new, sumF=sumF, llh=llh, it=it)
 
     return jax.jit(step)
@@ -253,17 +253,57 @@ class ShardedBigClamModel:
             it=jnp.zeros((), jnp.int32),
         )
 
+    def _ckpt_meta(self) -> dict:
+        return {
+            "num_nodes": self.g.num_nodes,
+            "num_directed_edges": self.g.num_directed_edges,
+            "k": self.cfg.num_communities,
+            "n_pad": self.n_pad,
+            "k_pad": self.k_pad,
+        }
+
+    def _state_to_arrays(self, state: TrainState) -> dict:
+        return {
+            "F": np.asarray(state.F),
+            "sumF": np.asarray(state.sumF),
+            "llh": np.asarray(state.llh),
+            "it": np.asarray(state.it),
+        }
+
+    def _state_from_arrays(self, arrays: dict) -> TrainState:
+        fspec = NamedSharding(self.mesh, P(NODES_AXIS, K_AXIS))
+        F = jax.device_put(np.asarray(arrays["F"], self.dtype), fspec)
+        return TrainState(
+            F=F,
+            sumF=F.sum(axis=0),
+            llh=jnp.asarray(arrays["llh"], self.dtype),
+            it=jnp.asarray(arrays["it"], jnp.int32),
+        )
+
     def fit(
         self,
         F0: np.ndarray,
         callback: Optional[Callable[[int, float], None]] = None,
+        checkpoints=None,
     ) -> FitResult:
-        """Train to convergence (shared loop: models.bigclam.run_fit_loop)."""
+        """Train to convergence (shared loop: models.bigclam.run_fit_loop);
+        resumes from `checkpoints` when it holds a saved state."""
         n, k = self.g.num_nodes, self.cfg.num_communities
+        state, hist = self.init_state(F0), ()
+        if checkpoints is not None:
+            restored, hist = restore_checkpoint(
+                checkpoints, self._ckpt_meta(), self._state_from_arrays
+            )
+            if restored is not None:
+                state = restored
         return run_fit_loop(
             self._step,
-            self.init_state(F0),
+            state,
             self.cfg,
             callback,
             lambda st: np.asarray(st.F[:n, :k]),
+            checkpoints=checkpoints,
+            state_to_arrays=self._state_to_arrays,
+            initial_hist=hist,
+            ckpt_meta=self._ckpt_meta(),
         )

@@ -1,0 +1,4 @@
+from bigclam_tpu.utils.checkpoint import CheckpointManager
+from bigclam_tpu.utils.metrics import MetricsLogger
+
+__all__ = ["CheckpointManager", "MetricsLogger"]

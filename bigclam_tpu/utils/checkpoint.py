@@ -1,0 +1,93 @@
+"""Checkpoint / resume for long fits and K-sweeps.
+
+The reference had NO checkpointing (SURVEY.md §5): a crashed run restarted
+from scratch, with Spark's lineage-based RDD recomputation as the only
+implicit recovery. TPU pods are gang-scheduled with no in-job elasticity, so
+the equivalent capability is periodic checkpointing of the full state tuple
+(F, sumF, iteration, PRNG seed, K-sweep position) + restart-from-checkpoint.
+
+Format: one .npz per checkpoint (atomic tmp+rename) with a JSON sidecar of
+scalar metadata; rotation keeps the newest `keep` checkpoints. No external
+dependencies (orbax users can layer it on top; this manager is deliberately
+self-contained so restores work anywhere NumPy does).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import tempfile
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+
+class CheckpointManager:
+    def __init__(self, directory: str, keep: int = 3):
+        self.directory = directory
+        self.keep = keep
+        os.makedirs(directory, exist_ok=True)
+
+    def _path(self, step: int) -> str:
+        return os.path.join(self.directory, f"ckpt_{step:09d}.npz")
+
+    def save(
+        self,
+        step: int,
+        arrays: Dict[str, np.ndarray],
+        meta: Optional[Dict[str, Any]] = None,
+    ) -> str:
+        """Atomically write arrays + metadata for `step`, then rotate."""
+        path = self._path(step)
+        fd, tmp = tempfile.mkstemp(dir=self.directory, suffix=".tmp")
+        try:
+            with os.fdopen(fd, "wb") as f:
+                np.savez(f, **{k: np.asarray(v) for k, v in arrays.items()})
+            os.replace(tmp, path)
+        except BaseException:
+            if os.path.exists(tmp):
+                os.unlink(tmp)
+            raise
+        if meta is not None:
+            mp = path + ".json"
+            with open(mp + ".tmp", "w") as f:
+                json.dump({"step": step, **meta}, f)
+            os.replace(mp + ".tmp", mp)
+        self._rotate()
+        return path
+
+    def steps(self) -> list[int]:
+        out = []
+        for name in os.listdir(self.directory):
+            if name.startswith("ckpt_") and name.endswith(".npz"):
+                out.append(int(name[5:-4]))
+        return sorted(out)
+
+    def latest_step(self) -> Optional[int]:
+        s = self.steps()
+        return s[-1] if s else None
+
+    def restore(
+        self, step: Optional[int] = None
+    ) -> Optional[Tuple[int, Dict[str, np.ndarray], Dict[str, Any]]]:
+        """Load (step, arrays, meta); newest checkpoint when step is None."""
+        if step is None:
+            step = self.latest_step()
+            if step is None:
+                return None
+        path = self._path(step)
+        with np.load(path) as z:
+            arrays = {k: z[k] for k in z.files}
+        meta: Dict[str, Any] = {}
+        if os.path.exists(path + ".json"):
+            with open(path + ".json") as f:
+                meta = json.load(f)
+        return step, arrays, meta
+
+    def _rotate(self) -> None:
+        steps = self.steps()
+        for s in steps[: -self.keep] if self.keep > 0 else []:
+            p = self._path(s)
+            os.unlink(p)
+            if os.path.exists(p + ".json"):
+                os.unlink(p + ".json")

@@ -1,0 +1,66 @@
+"""Structured per-step metrics: JSONL records + stdout.
+
+The reference's observability was `println` of iteration count and LLH
+(Bigclamv2.scala:205,213; SURVEY.md §5). Here every step emits a structured
+record — iteration, LLH, relative ΔLLH, wall-clock, edges/sec — appended to
+a JSONL file and/or echoed to stdout, so the BASELINE headline metric
+(edges/sec/chip) is instrumented from day one.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+from typing import Any, Dict, Optional, TextIO
+
+
+class MetricsLogger:
+    def __init__(self, path: Optional[str] = None, echo: bool = True):
+        self.path = path
+        self.echo = echo
+        self._fh: Optional[TextIO] = open(path, "a") if path else None
+        self._t0 = time.perf_counter()
+        self._last_t: Optional[float] = None
+        self._last_llh: Optional[float] = None
+
+    def log(self, record: Dict[str, Any]) -> None:
+        record = {"t": round(time.perf_counter() - self._t0, 4), **record}
+        line = json.dumps(record)
+        if self._fh:
+            self._fh.write(line + "\n")
+            self._fh.flush()
+        if self.echo:
+            print(line, file=sys.stderr)
+
+    def step_callback(self, num_directed_edges: int, chips: int = 1):
+        """A fit-loop callback(it, llh) that logs iter/LLH/dllh/edges-per-sec."""
+
+        def cb(it: int, llh: float) -> None:
+            now = time.perf_counter()
+            rec: Dict[str, Any] = {"iter": it, "llh": llh}
+            if self._last_llh not in (None, 0.0):
+                rec["rel_dllh"] = abs(1.0 - llh / self._last_llh)
+            if self._last_t is not None:
+                dt = now - self._last_t
+                rec["sec_per_iter"] = round(dt, 4)
+                if dt > 0:
+                    rec["edges_per_sec_per_chip"] = round(
+                        num_directed_edges / dt / chips, 1
+                    )
+            self._last_t = now
+            self._last_llh = llh
+            self.log(rec)
+
+        return cb
+
+    def close(self) -> None:
+        if self._fh:
+            self._fh.close()
+            self._fh = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

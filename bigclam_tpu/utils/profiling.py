@@ -1,0 +1,29 @@
+"""Profiler hooks: jax.profiler trace scopes (SURVEY.md §5 — the reference
+had none; `println` was its only instrumentation)."""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Iterator, Optional
+
+
+@contextlib.contextmanager
+def trace(log_dir: Optional[str]) -> Iterator[None]:
+    """Capture a jax.profiler trace into log_dir (tensorboard-viewable);
+    no-op when log_dir is None."""
+    if log_dir is None:
+        yield
+        return
+    import jax
+
+    with jax.profiler.trace(log_dir):
+        yield
+
+
+@contextlib.contextmanager
+def annotate(name: str) -> Iterator[None]:
+    """Named sub-scope inside a trace (shows up on the TPU timeline)."""
+    import jax
+
+    with jax.profiler.TraceAnnotation(name):
+        yield

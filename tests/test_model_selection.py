@@ -1,0 +1,57 @@
+"""Model-selection tests (SURVEY.md §4.6): the K-grid golden artifact and the
+sweep's stop rule."""
+
+import numpy as np
+
+from bigclam_tpu.config import BigClamConfig
+from bigclam_tpu.models.agm import planted_partition_F, sample_graph
+from bigclam_tpu.models.model_selection import build_kset, sweep_k
+
+
+def test_kset_golden_artifact():
+    """The pasted run artifact at bigclam4-7.scala:268 — Kset for a (50, 200)
+    grid: reproduced exactly with div_com=15."""
+    assert build_kset(50, 200, 15) == [
+        50, 54, 59, 64, 70, 76, 83, 91, 99, 108, 118, 129, 141, 154, 168,
+        184, 200,
+    ]
+
+
+def test_kset_default_grid_properties():
+    ks = build_kset(1000, 9000, 100)
+    assert ks[0] == 1000 and ks[-1] == 9000
+    assert all(b > a for a, b in zip(ks, ks[1:]))
+
+
+def test_kset_stuck_bump():
+    # tiny ratio: conGap so small the walk must bump by +1 each time
+    ks = build_kset(5, 10, 1000)
+    assert ks == [5, 6, 7, 8, 9, 10]
+
+
+def test_kset_degenerate_ratio():
+    # max_com // min_com == 0 cannot happen (max>=min), but ratio 1 gives
+    # log(1)=0 -> conGap=1 -> pure +1 walk
+    ks = build_kset(7, 9, 100)
+    assert ks == [7, 8, 9]
+
+
+def test_sweep_on_planted_graph():
+    """Sweep K over a graph with 4 planted blocks: LLH improves sharply up
+    to ~4 and the sweep stops early with a sensible KforC."""
+    rng = np.random.default_rng(11)
+    Fp, _ = planted_partition_F(48, 4, strength=2.0)
+    g = sample_graph(Fp, rng=rng)
+    cfg = BigClamConfig(
+        num_communities=8, dtype="float64", max_iters=40,
+        min_com=2, max_com=8, div_com=4, ksweep_tol=1e-3,
+    )
+    res = sweep_k(g, cfg)
+    assert res.kset[0] == 2 and res.kset[-1] == 8
+    assert res.chosen_k in res.llh_by_k
+    # every trained K got a finite LLH and the sweep trained at least 2 Ks
+    assert len(res.llh_by_k) >= 2
+    assert all(np.isfinite(v) for v in res.llh_by_k.values())
+    # LLH at the largest trained K is no worse than at the smallest
+    trained = sorted(res.llh_by_k)
+    assert res.llh_by_k[trained[-1]] >= res.llh_by_k[trained[0]]

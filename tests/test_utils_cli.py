@@ -1,0 +1,170 @@
+"""Checkpoint/resume, metrics, and CLI tests."""
+
+import json
+import subprocess
+import sys
+
+import numpy as np
+
+from bigclam_tpu.config import BigClamConfig
+from bigclam_tpu.models import BigClamModel
+from bigclam_tpu.utils import CheckpointManager, MetricsLogger
+
+
+def test_checkpoint_roundtrip(tmp_path):
+    cm = CheckpointManager(str(tmp_path), keep=2)
+    cm.save(5, {"F": np.ones((3, 2))}, meta={"llh_history": [-10.0]})
+    cm.save(10, {"F": np.zeros((3, 2))}, meta={"llh_history": [-10.0, -5.0]})
+    cm.save(15, {"F": np.full((3, 2), 7.0)}, meta={"llh_history": [-1.0]})
+    assert cm.steps() == [10, 15]          # rotation keeps newest 2
+    step, arrays, meta = cm.restore()
+    assert step == 15
+    np.testing.assert_array_equal(arrays["F"], np.full((3, 2), 7.0))
+    assert meta["llh_history"] == [-1.0]
+    step, arrays, _ = cm.restore(10)
+    np.testing.assert_array_equal(arrays["F"], np.zeros((3, 2)))
+
+
+def test_fit_resume_matches_uninterrupted(toy_graphs, tmp_path):
+    """Fit with mid-run checkpointing, then resume from the checkpoint: the
+    final state must equal an uninterrupted run (SURVEY.md §5)."""
+    g = toy_graphs["two_cliques"]
+    cfg = BigClamConfig(
+        num_communities=2, dtype="float64", max_iters=6, conv_tol=0.0,
+        checkpoint_every=3,
+    )
+    rng = np.random.default_rng(5)
+    F0 = rng.uniform(0.1, 1.0, size=(g.num_nodes, 2))
+
+    full = BigClamModel(g, cfg).fit(F0)
+
+    cm = CheckpointManager(str(tmp_path))
+    partial_cfg = cfg.replace(max_iters=3)
+    BigClamModel(g, partial_cfg).fit(F0, checkpoints=cm)   # stops at iter 3
+    assert cm.latest_step() == 3
+    resumed = BigClamModel(g, cfg).fit(
+        np.zeros_like(F0), checkpoints=cm                  # F0 ignored on resume
+    )
+    np.testing.assert_allclose(resumed.F, full.F, rtol=1e-12)
+    assert resumed.llh_history == full.llh_history
+
+
+def test_metrics_logger(tmp_path):
+    p = tmp_path / "m.jsonl"
+    with MetricsLogger(str(p), echo=False) as ml:
+        cb = ml.step_callback(num_directed_edges=1000)
+        cb(0, -100.0)
+        cb(1, -90.0)
+    lines = [json.loads(x) for x in p.read_text().splitlines()]
+    assert lines[0]["iter"] == 0 and lines[0]["llh"] == -100.0
+    assert "rel_dllh" in lines[1] and "edges_per_sec_per_chip" in lines[1]
+
+
+def _run_cli(*argv):
+    return subprocess.run(
+        [sys.executable, "-m", "bigclam_tpu.cli", *argv],
+        capture_output=True, text=True, timeout=600,
+        cwd="/root/repo",
+    )
+
+
+def test_cli_fit_and_eval(tmp_path):
+    graph = tmp_path / "g.txt"
+    edges = []
+    for base in (0, 8):
+        for i in range(8):
+            for j in range(i + 1, 8):
+                edges.append((base + i, base + j))
+    edges.append((7, 8))
+    graph.write_text("# toy\n" + "\n".join(f"{u} {v}" for u, v in edges))
+    out = tmp_path / "pred.cmty"
+    # random init: with K=2 the conductance seeds tie inside one clique
+    # (faithful reference behavior) and the symmetric seeded solution merges
+    # the communities — covered in test_seeding; here we smoke the CLI
+    r = _run_cli(
+        "fit", "--graph", str(graph), "--k", "2", "--dtype", "float64",
+        "--max-iters", "60", "--init", "random", "--out", str(out),
+        "--quiet", "--platform", "cpu",
+    )
+    assert r.returncode == 0, r.stderr
+    rec = json.loads(r.stdout.strip().splitlines()[-1])
+    assert rec["n"] == 16 and rec["k"] == 2 and out.exists()
+
+    truth = tmp_path / "truth.cmty"
+    truth.write_text("0\t1\t2\t3\t4\t5\t6\t7\n8\t9\t10\t11\t12\t13\t14\t15\n")
+    r2 = _run_cli("eval", "--pred", str(out), "--truth", str(truth))
+    assert r2.returncode == 0, r2.stderr
+    scores = json.loads(r2.stdout.strip())
+    assert scores["f1"] > 0.85, scores
+
+
+def test_cli_sweep(tmp_path):
+    graph = tmp_path / "g.txt"
+    edges = []
+    for base in (0, 6, 12):
+        for i in range(6):
+            for j in range(i + 1, 6):
+                edges.append((base + i, base + j))
+    edges += [(5, 6), (11, 12)]
+    graph.write_text("\n".join(f"{u} {v}" for u, v in edges))
+    r = _run_cli(
+        "sweep", "--graph", str(graph), "--min-com", "2", "--max-com", "6",
+        "--div-com", "3", "--dtype", "float64", "--max-iters", "20", "--quiet",
+        "--platform", "cpu",
+    )
+    assert r.returncode == 0, r.stderr
+    rec = json.loads(r.stdout.strip())
+    assert rec["kset"][0] == 2 and rec["kset"][-1] == 6
+    assert rec["chosen_k"] >= 2
+
+
+def test_checkpoint_mismatch_refused(toy_graphs, tmp_path):
+    """Resuming with a different graph/K must raise, not silently corrupt."""
+    import pytest
+
+    g = toy_graphs["two_cliques"]
+    cfg = BigClamConfig(
+        num_communities=2, dtype="float64", max_iters=2, conv_tol=0.0,
+        checkpoint_every=1,
+    )
+    rng = np.random.default_rng(1)
+    F0 = rng.uniform(0.1, 1.0, size=(g.num_nodes, 2))
+    cm = CheckpointManager(str(tmp_path))
+    BigClamModel(g, cfg).fit(F0, checkpoints=cm)
+    assert cm.latest_step() is not None
+    # different K -> refuse
+    cfg3 = cfg.replace(num_communities=3)
+    F03 = rng.uniform(0.1, 1.0, size=(g.num_nodes, 3))
+    with pytest.raises(ValueError, match="checkpoint incompatible"):
+        BigClamModel(g, cfg3).fit(F03, checkpoints=cm)
+    # different graph -> refuse
+    g2 = toy_graphs["star"]
+    F05 = rng.uniform(0.1, 1.0, size=(g2.num_nodes, 2))
+    with pytest.raises(ValueError, match="checkpoint incompatible"):
+        BigClamModel(g2, cfg).fit(F05, checkpoints=cm)
+
+
+def test_sweep_state_resume(tmp_path):
+    """sweep_k journals per-K LLHs and skips them on restart."""
+    import json as _json
+
+    from bigclam_tpu.graph.ingest import graph_from_edges
+    from bigclam_tpu.models.model_selection import sweep_k
+
+    edges = []
+    for base in (0, 6):
+        for i in range(6):
+            for j in range(i + 1, 6):
+                edges.append((base + i, base + j))
+    edges.append((5, 6))
+    g = graph_from_edges(edges)
+    cfg = BigClamConfig(
+        num_communities=4, dtype="float64", max_iters=15,
+        min_com=2, max_com=4, div_com=2, ksweep_tol=1e-3,
+    )
+    r1 = sweep_k(g, cfg, state_dir=str(tmp_path))
+    journal = _json.loads((tmp_path / "sweep_state.json").read_text())
+    assert set(int(k) for k in journal) == set(r1.llh_by_k)
+    r2 = sweep_k(g, cfg, state_dir=str(tmp_path))   # all Ks from journal
+    assert r2.chosen_k == r1.chosen_k
+    assert r2.llh_by_k == r1.llh_by_k
