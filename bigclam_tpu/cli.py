@@ -34,6 +34,12 @@ def _add_common(p: argparse.ArgumentParser) -> None:
         "--mesh", default=None,
         help="'DP,TP' device mesh, e.g. 4,2 (default: single device)",
     )
+    p.add_argument(
+        "--schedule", default="allgather", choices=["allgather", "ring"],
+        help="F-row exchange schedule for --mesh runs: allgather materializes"
+             " a full F per device (fastest at small N); ring rotates shards"
+             " around the ICI ring (O(N/dp) peak memory, pod-scale)",
+    )
     p.add_argument("--checkpoint-dir", default=None)
     p.add_argument("--checkpoint-every", type=int, default=0)
     p.add_argument("--metrics", default=None, help="JSONL metrics path")
@@ -75,11 +81,16 @@ def _make_model(g, cfg, args):
     if args.mesh:
         import jax
 
-        from bigclam_tpu.parallel import ShardedBigClamModel, make_mesh
+        from bigclam_tpu.parallel import (
+            RingBigClamModel,
+            ShardedBigClamModel,
+            make_mesh,
+        )
 
         dp, tp = (int(x) for x in args.mesh.split(","))
         mesh = make_mesh((dp, tp), jax.devices()[: dp * tp])
-        return ShardedBigClamModel(g, cfg, mesh)
+        cls = RingBigClamModel if args.schedule == "ring" else ShardedBigClamModel
+        return cls(g, cfg, mesh)
     from bigclam_tpu.models import BigClamModel
 
     return BigClamModel(g, cfg, k_multiple=128 if cfg.dtype == "float32" else 1)
@@ -116,8 +127,12 @@ def cmd_fit(args) -> int:
     ckpt = (
         CheckpointManager(args.checkpoint_dir) if args.checkpoint_dir else None
     )
+    n_chips = 1
+    if args.mesh:
+        dp, tp = (int(x) for x in args.mesh.split(","))
+        n_chips = dp * tp
     with MetricsLogger(args.metrics, echo=not args.quiet) as ml:
-        cb = ml.step_callback(g.num_directed_edges)
+        cb = ml.step_callback(g.num_directed_edges, chips=n_chips)
         with trace(args.profile_dir):
             res = model.fit(F0, callback=cb, checkpoints=ckpt)
     out = {
@@ -150,17 +165,21 @@ def cmd_sweep(args) -> int:
             f"{args.checkpoint_dir}/sweep_state.json",
             file=sys.stderr,
         )
+    from bigclam_tpu.utils import MetricsLogger
+
     factory = (lambda c: _make_model(g, c, args)) if args.mesh else None
-    with trace(args.profile_dir):
-        res = sweep_k(
-            g,
-            cfg,
-            model_factory=factory,
-            callback=None if args.quiet else (
-                lambda k, llh: print(f"K={k} LLH={llh:.2f}", file=sys.stderr)
-            ),
-            state_dir=args.checkpoint_dir,
-        )
+    with MetricsLogger(args.metrics, echo=not args.quiet) as ml:
+        def cb(k, llh):
+            ml.log({"k": k, "llh": llh})
+
+        with trace(args.profile_dir):
+            res = sweep_k(
+                g,
+                cfg,
+                model_factory=factory,
+                callback=cb,
+                state_dir=args.checkpoint_dir,
+            )
     print(
         json.dumps(
             {

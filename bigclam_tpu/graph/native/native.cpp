@@ -49,14 +49,24 @@ int64_t* bc_parse_edge_list(const char* path, int64_t* n_pairs_out) {
   vals.reserve(1 << 20);
   const char* p = buf;
   const char* end = buf + sz;
+  bool line_has_token = false;  // '#' only starts a comment at line start,
+                                // matching the NumPy fallback's semantics
   while (p < end) {
-    while (p < end && (*p == ' ' || *p == '\t' || *p == '\r' || *p == '\n'))
+    while (p < end && (*p == ' ' || *p == '\t' || *p == '\r' || *p == '\n')) {
+      if (*p == '\n') line_has_token = false;
       p++;
+    }
     if (p >= end) break;
-    if (*p == '#') {  // comment line
+    if (*p == '#') {
+      if (line_has_token) {  // mid-line '#': malformed, as in NumPy path
+        free(buf);
+        *n_pairs_out = -1;
+        return nullptr;
+      }
       while (p < end && *p != '\n') p++;
       continue;
     }
+    line_has_token = true;
     bool neg = false;
     if (*p == '-' || *p == '+') {
       neg = (*p == '-');

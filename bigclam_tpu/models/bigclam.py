@@ -133,7 +133,8 @@ def run_fit_loop(
             checkpoints is not None
             and cfg.checkpoint_every > 0
             and int(state.it) % cfg.checkpoint_every == 0
-            and state_to_arrays is not None
+            and int(state.it) <= cfg.max_iters   # never persist the final
+            and state_to_arrays is not None      # speculative (unevaluated) F
         ):
             checkpoints.save(
                 int(state.it),

@@ -1,4 +1,5 @@
 from bigclam_tpu.parallel.mesh import make_mesh
+from bigclam_tpu.parallel.ring import RingBigClamModel
 from bigclam_tpu.parallel.sharded import ShardedBigClamModel
 
-__all__ = ["make_mesh", "ShardedBigClamModel"]
+__all__ = ["make_mesh", "RingBigClamModel", "ShardedBigClamModel"]

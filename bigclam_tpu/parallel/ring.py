@@ -1,0 +1,233 @@
+"""Ring-pass sharded training: rotate F node-shards around the ICI ring
+instead of all-gathering F.
+
+The C21 "ring-attention analog" (SURVEY.md §2/§5): at pod scale the
+all-gather schedule of parallel/sharded.py materializes a full (N_pad, K_loc)
+copy of F per device — impossible for com-Friendster-class graphs
+(N=65M x K=25K). Here each device only ever holds TWO (N_pad/dp, K_loc)
+shards: its own F_loc and a rotating buffer F_rot that `lax.ppermute`s
+around the "nodes" ring, one hop per phase, exactly like ring attention
+rotates KV blocks. Edges are bucketed by destination shard at ingest; in
+phase r device i processes the bucket whose destinations live in shard
+(i + r) % dp, accumulating neighbor LLH/gradient contributions, then passes
+F_rot to its ring predecessor. Communication totals match the all-gather
+(every shard visits every device) but peak HBM drops from O(N*K_loc) to
+O(2 * N/dp * K_loc); the gradient pass and the 16-candidate Armijo pass each
+take one full rotation (the candidate pass re-rotates because it needs the
+finished gradient).
+
+Semantics are IDENTICAL to the single-chip and all-gather trainers —
+verified by the shard-invariance suite (tests/test_ring.py).
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from bigclam_tpu.config import BigClamConfig
+from bigclam_tpu.graph.csr import Graph
+from bigclam_tpu.models.bigclam import TrainState
+from bigclam_tpu.ops.objective import EdgeChunks, edge_terms
+from bigclam_tpu.parallel.mesh import K_AXIS, NODES_AXIS
+from bigclam_tpu.parallel.sharded import ShardedBigClamModel, _mark_varying, _rowdot
+
+
+def ring_shard_edges(
+    g: Graph, cfg: BigClamConfig, dp: int, n_pad: int, dtype
+) -> EdgeChunks:
+    """Bucket each src shard's edges by destination shard.
+
+    Returns (dp, dp, C, chunk) arrays: axis 0 = owning (src) shard, axis 1 =
+    ring phase r (destinations in shard (i + r) % dp). BOTH src and dst are
+    stored shard-local; padding keeps src sorted (last local row) with
+    mask 0. All buckets are padded to the global max bucket size (static
+    SPMD shapes; power-law skew shows up as padding, mitigated by the
+    degree-bucketing planned in PARITY.md).
+    """
+    shard_rows = n_pad // dp
+    src_shard = g.src // shard_rows
+    dst_shard = g.dst // shard_rows
+    phase = (dst_shard - src_shard) % dp
+    counts = np.zeros((dp, dp), dtype=np.int64)
+    np.add.at(counts, (src_shard, phase), 1)
+    max_count = max(int(counts.max()), 1)
+    chunk = min(cfg.edge_chunk, max_count)
+    c = -(-max_count // chunk)
+    padded = c * chunk
+    src = np.full((dp, dp, padded), shard_rows - 1, dtype=np.int32)
+    dst = np.zeros((dp, dp, padded), dtype=np.int32)
+    mask = np.zeros((dp, dp, padded), dtype=np.float32)
+    # stable bucket fill preserving CSR (src-sorted) order per bucket
+    order = np.lexsort((np.arange(g.src.size), phase, src_shard))
+    s_sorted = g.src[order]
+    d_sorted = g.dst[order]
+    ss = src_shard[order]
+    ph = phase[order]
+    # walk contiguous (shard, phase) runs
+    run_starts = np.flatnonzero(
+        np.r_[True, (ss[1:] != ss[:-1]) | (ph[1:] != ph[:-1])]
+    )
+    run_ends = np.r_[run_starts[1:], ss.size]
+    for lo, hi in zip(run_starts, run_ends):
+        i, r = int(ss[lo]), int(ph[lo])
+        m = hi - lo
+        src[i, r, :m] = s_sorted[lo:hi] - i * shard_rows
+        dst[i, r, :m] = d_sorted[lo:hi] - ((i + r) % dp) * shard_rows
+        mask[i, r, :m] = 1.0
+    return EdgeChunks(
+        src=src.reshape(dp, dp, c, chunk),
+        dst=dst.reshape(dp, dp, c, chunk),
+        mask=mask.reshape(dp, dp, c, chunk).astype(dtype),
+    )
+
+
+def make_ring_train_step(
+    mesh: Mesh, edges: EdgeChunks, cfg: BigClamConfig
+) -> Callable[[TrainState], TrainState]:
+    """One jitted iteration with ring-rotated F shards (two rotations:
+    gradient pass + candidate pass)."""
+    dp = mesh.shape[NODES_AXIS]
+    perm = [(j, (j - 1) % dp) for j in range(dp)]   # send to ring predecessor
+
+    def step_shard(F_loc, src, dst, mask, it):
+        src, dst, mask = src[0], dst[0], mask[0]    # (dp, C, chunk), phase-major
+        adt = jnp.dtype(cfg.accum_dtype) if cfg.accum_dtype else F_loc.dtype
+        etas = jnp.asarray(cfg.step_candidates, F_loc.dtype)
+        n_loc = F_loc.shape[0]
+        sumF = lax.psum(F_loc.sum(axis=0), NODES_AXIS)
+
+        def sweep_chunks(carry_fn, init, s_ph, d_ph, m_ph, F_rot):
+            """Scan a phase's chunks, accumulating via carry_fn."""
+            def body(acc, sdm):
+                return carry_fn(acc, sdm, F_rot), None
+            out, _ = lax.scan(body, init, (s_ph, d_ph, m_ph))
+            return out
+
+        # --- rotation 1: fused gradient + LLH ---
+        def grad_chunk(acc, sdm, F_rot):
+            nbr_llh, nbr_grad = acc
+            s, d, m = sdm
+            fs, fd = F_loc[s], F_rot[d]
+            x = lax.psum(jnp.einsum("ek,ek->e", fs, fd), K_AXIS)
+            p, ell = edge_terms(x, cfg)
+            coeff = m / (1.0 - p)
+            return (
+                nbr_llh + jax.ops.segment_sum(
+                    (ell * m).astype(adt), s, num_segments=n_loc,
+                    indices_are_sorted=True,
+                ),
+                nbr_grad + jax.ops.segment_sum(
+                    fd * coeff[:, None], s, num_segments=n_loc,
+                    indices_are_sorted=True,
+                ),
+            )
+
+        def grad_phase(carry, sdm_ph):
+            (F_rot, acc) = carry
+            s_ph, d_ph, m_ph = sdm_ph
+            acc = sweep_chunks(grad_chunk, acc, s_ph, d_ph, m_ph, F_rot)
+            F_rot = lax.ppermute(F_rot, NODES_AXIS, perm)
+            return (F_rot, acc), None
+
+        init_acc = (
+            _mark_varying(jnp.zeros(n_loc, adt), (NODES_AXIS,)),
+            _mark_varying(jnp.zeros_like(F_loc), (NODES_AXIS, K_AXIS)),
+        )
+        (F_back, (nbr_llh, nbr_grad)), _ = lax.scan(
+            grad_phase, (F_loc, init_acc), (src, dst, mask)
+        )
+        grad = nbr_grad - sumF[None, :] + F_loc
+        node_llh = nbr_llh + (
+            -lax.psum(F_loc @ sumF, K_AXIS) + _rowdot(F_loc, F_loc)
+        ).astype(adt)
+        llh_cur = lax.psum(node_llh.sum(), NODES_AXIS)
+
+        # --- rotation 2: the 16 Armijo candidates ---
+        def cand_chunk(cand, sdm, F_rot):
+            s, d, m = sdm
+            fs, gs, fd = F_loc[s], grad[s], F_rot[d]
+
+            def one_eta(eta):
+                nf = jnp.clip(fs + eta * gs, cfg.min_f, cfg.max_f)
+                xc = lax.psum(jnp.einsum("ek,ek->e", nf, fd), K_AXIS)
+                _, ellc = edge_terms(xc, cfg)
+                return jax.ops.segment_sum(
+                    (ellc * m).astype(adt), s, num_segments=n_loc,
+                    indices_are_sorted=True,
+                )
+
+            return cand + lax.map(one_eta, etas)
+
+        def cand_phase(carry, sdm_ph):
+            (F_rot, cand) = carry
+            s_ph, d_ph, m_ph = sdm_ph
+            cand = sweep_chunks(cand_chunk, cand, s_ph, d_ph, m_ph, F_rot)
+            F_rot = lax.ppermute(F_rot, NODES_AXIS, perm)
+            return (F_rot, cand), None
+
+        init_cand = _mark_varying(
+            jnp.zeros((len(cfg.step_candidates), n_loc), adt), (NODES_AXIS,)
+        )
+        (_, cand_nbr), _ = lax.scan(
+            cand_phase, (F_back, init_cand), (src, dst, mask)
+        )
+
+        # --- Armijo acceptance + Jacobi update (node-local, as sharded.py) ---
+        gg = _rowdot(grad, grad).astype(adt)
+
+        def tail_for(eta):
+            nf = jnp.clip(F_loc + eta * grad, cfg.min_f, cfg.max_f)
+            sf_adj = sumF[None, :] - F_loc + nf
+            return (-_rowdot(nf, sf_adj) + _rowdot(nf, nf)).astype(adt)
+
+        tails = lax.map(tail_for, etas)
+        cand_llh = cand_nbr + tails
+        ok = cand_llh >= node_llh[None, :] + cfg.alpha * etas[:, None] * gg[None, :]
+        best_eta = jnp.max(jnp.where(ok, etas[:, None], 0.0), axis=0)
+        accepted = jnp.any(ok, axis=0)
+        F_new = jnp.where(
+            accepted[:, None],
+            jnp.clip(F_loc + best_eta[:, None] * grad, cfg.min_f, cfg.max_f),
+            F_loc,
+        )
+        sumF_new = lax.psum(F_new.sum(axis=0), NODES_AXIS)
+        return F_new, sumF_new, llh_cur.astype(F_loc.dtype), it + 1
+
+    def step(state: TrainState) -> TrainState:
+        F_new, sumF, llh, it = jax.shard_map(
+            step_shard,
+            mesh=mesh,
+            in_specs=(
+                P(NODES_AXIS, K_AXIS),
+                P(NODES_AXIS, None, None, None),
+                P(NODES_AXIS, None, None, None),
+                P(NODES_AXIS, None, None, None),
+                P(),
+            ),
+            out_specs=(P(NODES_AXIS, K_AXIS), P(K_AXIS), P(), P()),
+        )(state.F, edges.src, edges.dst, edges.mask, state.it)
+        return TrainState(F=F_new, sumF=sumF, llh=llh, it=it)
+
+    return jax.jit(step)
+
+
+class RingBigClamModel(ShardedBigClamModel):
+    """Sharded trainer using the ring-pass schedule (same API/trajectories
+    as ShardedBigClamModel; different memory/communication profile)."""
+
+    def _build_edges_and_step(self) -> None:
+        dp = self.mesh.shape[NODES_AXIS]
+        edges_host = ring_shard_edges(self.g, self.cfg, dp, self.n_pad, np.float32)
+        espec = NamedSharding(self.mesh, P(NODES_AXIS, None, None, None))
+        self.edges = EdgeChunks(
+            src=jax.device_put(edges_host.src, espec),
+            dst=jax.device_put(edges_host.dst, espec),
+            mask=jax.device_put(edges_host.mask.astype(self.dtype), espec),
+        )
+        self._step = make_ring_train_step(self.mesh, self.edges, self.cfg)

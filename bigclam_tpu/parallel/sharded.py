@@ -230,14 +230,18 @@ class ShardedBigClamModel:
             raise ValueError("sharded padding requires min_f == 0.0")
         self.n_pad = _round_up(max(g.num_nodes, dp), dp)
         self.k_pad = _round_up(cfg.num_communities, tp)
-        edges_host = shard_edges(g, cfg, dp, self.n_pad, np.float32)
-        espec = NamedSharding(mesh, P(NODES_AXIS, None, None))
+        self._build_edges_and_step()    # hook: subclasses swap the schedule
+
+    def _build_edges_and_step(self) -> None:
+        dp = self.mesh.shape[NODES_AXIS]
+        edges_host = shard_edges(self.g, self.cfg, dp, self.n_pad, np.float32)
+        espec = NamedSharding(self.mesh, P(NODES_AXIS, None, None))
         self.edges = EdgeChunks(
             src=jax.device_put(edges_host.src, espec),
             dst=jax.device_put(edges_host.dst, espec),
             mask=jax.device_put(edges_host.mask.astype(self.dtype), espec),
         )
-        self._step = make_sharded_train_step(mesh, self.edges, cfg)
+        self._step = make_sharded_train_step(self.mesh, self.edges, self.cfg)
 
     def init_state(self, F0: np.ndarray) -> TrainState:
         n, k = self.g.num_nodes, self.cfg.num_communities

@@ -168,3 +168,22 @@ def test_sweep_state_resume(tmp_path):
     r2 = sweep_k(g, cfg, state_dir=str(tmp_path))   # all Ks from journal
     assert r2.chosen_k == r1.chosen_k
     assert r2.llh_by_k == r1.llh_by_k
+
+
+def test_rerun_with_checkpoints_is_idempotent(toy_graphs, tmp_path):
+    """checkpoint_every=1 with max_iters hit: the speculative final state is
+    never persisted, so re-running the same fit returns the identical
+    result instead of drifting an extra iteration per run."""
+    g = toy_graphs["two_cliques"]
+    cfg = BigClamConfig(
+        num_communities=2, dtype="float64", max_iters=6, conv_tol=0.0,
+        checkpoint_every=1,
+    )
+    rng = np.random.default_rng(9)
+    F0 = rng.uniform(0.1, 1.0, size=(g.num_nodes, 2))
+    cm = CheckpointManager(str(tmp_path))
+    r1 = BigClamModel(g, cfg).fit(F0, checkpoints=cm)
+    assert cm.latest_step() <= cfg.max_iters
+    r2 = BigClamModel(g, cfg).fit(F0, checkpoints=cm)
+    assert r2.num_iters == r1.num_iters
+    np.testing.assert_array_equal(r2.F, r1.F)
