@@ -60,6 +60,9 @@ class BigClamConfig:
     edge_chunk: int = 1 << 18           # directed edges per on-device chunk; bounds
                                         # the (chunk, K) gather working set in HBM
     mesh_shape: Tuple[int, int] = (1, 1)  # (node-shards, k-shards) = (DP, TP-analog)
+    use_pallas: Optional[bool] = None   # fused VMEM candidate kernel; None =
+                                        # auto (on for TPU backends when tile
+                                        # constraints are met)
 
     # --- checkpointing / logging ---
     checkpoint_dir: Optional[str] = None

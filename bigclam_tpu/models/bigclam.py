@@ -74,8 +74,15 @@ def prepare_graph(
     n_pad = _round_up(max(g.num_nodes, 1), node_multiple)
     src, dst = g.src, g.dst
     m = src.shape[0]
-    chunk = min(cfg.edge_chunk, max(m, 1))
-    c = max(1, -(-m // chunk))
+    # balance chunks: pick the chunk count from the configured bound, then
+    # size chunks evenly — avoids up to chunk-1 edges of padding waste in
+    # the last chunk. Chunks >= 1024 align to the Pallas edge-tile size
+    # (XLA lays 1-D operands out in 1024-element tiles and Mosaic blocks
+    # must match); smaller chunks (tiny graphs / chunking tests) align to 8
+    # and dispatch to the XLA candidate path instead.
+    c = max(1, -(-m // max(cfg.edge_chunk, 1)))
+    chunk = max(-(-m // c), 1)
+    chunk = _round_up(chunk, 1024 if chunk >= 1024 else 8)
     pad = c * chunk - m
     src_p = np.pad(src, (0, pad), constant_values=n_pad - 1).reshape(c, chunk)
     dst_p = np.pad(dst, (0, pad), constant_values=0).reshape(c, chunk)
@@ -183,13 +190,42 @@ def make_train_step(
     edges: EdgeChunks, cfg: BigClamConfig
 ) -> Callable[[TrainState], TrainState]:
     """Build the jitted one-iteration update: 17 fused edge sweeps total
-    (1 grad/LLH + 16 candidates), no host round trips."""
+    (1 grad/LLH + 16 candidates), no host round trips.
+
+    The candidate pass dispatches to the Pallas VMEM kernel
+    (ops.pallas_kernels) on TPU backends when the edge-chunk/K tiling
+    constraints hold; cfg.use_pallas overrides the auto choice."""
+
+    def _pick_candidates_impl(F: jax.Array):
+        want = cfg.use_pallas
+        if want is None:
+            want = jax.default_backend() == "tpu"
+        if not want:
+            return candidates_pass
+        from bigclam_tpu.ops.pallas_kernels import (
+            candidates_pass_pallas,
+            pallas_block_size,
+        )
+
+        chunk = int(edges.src.shape[-1])
+        k_pad = int(F.shape[1])
+        ok = pallas_block_size(chunk, k_pad) is not None and k_pad % 128 == 0
+        if not ok:
+            if cfg.use_pallas:                 # explicit request: refuse loudly
+                raise ValueError(
+                    f"use_pallas=True but tiling constraints unmet "
+                    f"(chunk={chunk}, K_pad={k_pad}); pad K to a multiple of "
+                    "128 (k_multiple=128) and keep edge chunks >= 1024"
+                )
+            return candidates_pass             # auto mode: silent fallback
+        return candidates_pass_pallas
 
     def step(state: TrainState) -> TrainState:
         F, sumF = state.F, state.sumF
         grad, node_llh = grad_llh(F, sumF, edges, cfg)
         llh_cur = node_llh.sum()               # LLH of current F
-        cand_nbr = candidates_pass(F, grad, edges, cfg)
+        cand_impl = _pick_candidates_impl(F)
+        cand_nbr = cand_impl(F, grad, edges, cfg)
         F_new, sumF_new = armijo_update(F, sumF, grad, node_llh, cand_nbr, cfg)
         return TrainState(F=F_new, sumF=sumF_new, llh=llh_cur, it=state.it + 1)
 

@@ -29,39 +29,59 @@ from bigclam_tpu.config import BigClamConfig
 from bigclam_tpu.ops.objective import EdgeChunks, edge_terms
 
 
+def candidates_scan(
+    F: jax.Array,
+    grad: jax.Array,
+    edges: EdgeChunks,
+    cfg: BigClamConfig,
+    terms_fn,
+) -> jax.Array:
+    """Shared chunk-scan scaffold for the candidate pass: gather edge tiles
+    once per chunk, let terms_fn produce the (S, chunk) masked LLH edge
+    terms, segment-sum back to nodes. terms_fn(fs, gs, fd, mask) is either
+    the XLA body below or the Pallas VMEM kernel
+    (ops.pallas_kernels.candidate_edge_terms)."""
+    n = F.shape[0]
+    adt = jnp.dtype(cfg.accum_dtype) if cfg.accum_dtype else F.dtype
+    num_s = len(cfg.step_candidates)
+
+    def chunk_body(acc, sdm):
+        s, d, m = sdm
+        ell = terms_fn(F[s], grad[s], F[d], m)   # (S, chunk)
+        parts = jax.vmap(
+            lambda v: jax.ops.segment_sum(
+                v.astype(adt), s, num_segments=n, indices_are_sorted=True
+            )
+        )(ell)
+        return acc + parts, None
+
+    acc, _ = lax.scan(chunk_body, jnp.zeros((num_s, n), adt), edges)
+    return acc
+
+
 def candidates_pass(
     F: jax.Array,
     grad: jax.Array,
     edges: EdgeChunks,
     cfg: BigClamConfig,
 ) -> jax.Array:
-    """Neighbor-sum part of ell_eta(u) for every candidate step.
+    """Neighbor-sum part of ell_eta(u) for every candidate step (XLA body).
 
     Returns (S, N): for each candidate eta_i and node u,
     sum_{v in N(u)} [log(1 - clip(exp(-F_u'.F_v))) + F_u'.F_v].
     """
-    n = F.shape[0]
-    adt = jnp.dtype(cfg.accum_dtype) if cfg.accum_dtype else F.dtype
     etas = jnp.asarray(cfg.step_candidates, F.dtype)
-    num_s = etas.shape[0]
 
-    def chunk_body(acc, sdm):
-        s, d, m = sdm
-        fs, gs, fd = F[s], grad[s], F[d]   # gathered once per chunk
-
+    def terms_fn(fs, gs, fd, m):
         def one_eta(eta):
             nf = jnp.clip(fs + eta * gs, cfg.min_f, cfg.max_f)
             x = jnp.einsum("ek,ek->e", nf, fd)
             _, ell = edge_terms(x, cfg)
-            return jax.ops.segment_sum(
-                (ell * m).astype(adt), s, num_segments=n, indices_are_sorted=True
-            )
+            return ell * m
 
-        parts = lax.map(one_eta, etas)   # (S, n), sequential: gathers reused
-        return acc + parts, None
+        return lax.map(one_eta, etas)   # (S, chunk), gathered tiles reused
 
-    acc, _ = lax.scan(chunk_body, jnp.zeros((num_s, n), adt), edges)
-    return acc
+    return candidates_scan(F, grad, edges, cfg, terms_fn)
 
 
 def armijo_update(

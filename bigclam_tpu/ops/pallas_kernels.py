@@ -1,0 +1,129 @@
+"""Pallas TPU kernel for the hot loop: all 16 Armijo candidates evaluated in
+VMEM against once-loaded edge tiles.
+
+Why: profiling the XLA path on Email-Enron K=100 (TPU v5e) shows the
+candidate pass dominating the step (≈116 ms of 148 ms) at ~39 GB/s effective
+HBM traffic — the 16 per-candidate sweeps re-stream the gathered
+(chunk, K) F_src/grad_src/F_dst tiles from HBM. This kernel loads each edge
+tile into VMEM ONCE and evaluates every candidate step size against it on
+the VPU, writing only the (S, chunk) per-edge LLH terms back — a ~16x cut
+in candidate-pass HBM reads.
+
+The kernel consumes PRE-GATHERED per-edge rows (XLA's gather feeds it); the
+semantics are bit-identical to ops.linesearch.candidates_pass's inner body:
+
+    nf  = clip(F_src + eta * grad_src, min_f, max_f)
+    x   = sum(nf * F_dst, axis=-1)
+    p   = clip(exp(-x), min_p, max_p)
+    ell = log1p(-p) + x        (masked)
+
+Layout: edge tiles (BLOCK_E, K_pad) with K_pad a multiple of 128 lanes;
+the eta loop is unrolled at trace time (16 candidates). Correctness vs the
+XLA path is tested in interpret mode on CPU and exercised on real TPU by
+bench.py.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from bigclam_tpu.config import BigClamConfig
+
+BLOCK_E = 1024          # edges per tile: 3 * 1024 * 128 * 4B = 1.5 MB at K=128
+VMEM_BUDGET_BYTES = 10 * 1024 * 1024   # input tiles must fit well under ~16 MB
+
+
+def pallas_block_size(m_e: int, k: int = 128, interpret: bool = False):
+    """The edge-tile size for a (m_e, k) chunk, or None if unsupported.
+
+    Hardware constraints: XLA lays the 1-D mask out in 1024-element tiles,
+    so the edge block must be exactly BLOCK_E and divide the chunk; the
+    three (BLOCK_E, k) input tiles must also fit the VMEM budget (large
+    K_pad falls back to the XLA path rather than failing Mosaic compile).
+    Interpret mode relaxes only the alignment, not divisibility."""
+    if 3 * BLOCK_E * k * 4 > VMEM_BUDGET_BYTES:
+        return None
+    if m_e % BLOCK_E == 0:
+        return BLOCK_E
+    if interpret and m_e <= BLOCK_E:
+        return m_e          # single exact block; no tiling in interpret mode
+    return None
+
+
+def _cand_kernel(fs_ref, gs_ref, fd_ref, m_ref, out_ref, *, etas, cfg):
+    fs = fs_ref[:]
+    gs = gs_ref[:]
+    fd = fd_ref[:]
+    m = m_ref[:]
+    for i, eta in enumerate(etas):
+        nf = jnp.clip(fs + eta * gs, cfg.min_f, cfg.max_f)
+        x = jnp.sum(nf * fd, axis=1)
+        p = jnp.clip(jnp.exp(-x), cfg.min_p, cfg.max_p)
+        out_ref[i, :] = (jnp.log1p(-p) + x) * m
+
+
+def candidate_edge_terms(
+    fs: jax.Array,
+    gs: jax.Array,
+    fd: jax.Array,
+    mask: jax.Array,
+    cfg: BigClamConfig,
+    interpret: bool = False,
+) -> jax.Array:
+    """(S, M) masked candidate LLH edge terms from pre-gathered rows.
+
+    fs/gs/fd: (M, K_pad) gathered F_src/grad_src/F_dst; mask: (M,).
+    M must be a multiple of BLOCK_E and K_pad a multiple of 128 (the
+    caller pads; models.bigclam.prepare_graph chunks are already padded).
+    """
+    m_e, k = fs.shape
+    block = pallas_block_size(m_e, k, interpret)
+    if block is None:
+        raise ValueError(
+            f"chunk {m_e} x K_pad {k} unsupported by the pallas kernel "
+            f"(needs chunk % {BLOCK_E} == 0 and tiles within VMEM budget)"
+        )
+    if not interpret:
+        assert k % 128 == 0, k
+    etas = cfg.step_candidates
+    num_s = len(etas)
+    kernel = functools.partial(_cand_kernel, etas=etas, cfg=cfg)
+    grid = (m_e // block,)
+    return pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((num_s, m_e), fs.dtype),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((block, k), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((block, k), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((block, k), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((block,), lambda i: (i,), memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec(
+            (num_s, block), lambda i: (0, i), memory_space=pltpu.VMEM
+        ),
+        interpret=interpret,
+    )(fs, gs, fd, mask)
+
+
+def candidates_pass_pallas(
+    F: jax.Array,
+    grad: jax.Array,
+    edges,
+    cfg: BigClamConfig,
+    interpret: bool = False,
+) -> jax.Array:
+    """Drop-in replacement for ops.linesearch.candidates_pass using the
+    fused VMEM kernel for the per-edge terms (gather + segment_sum stay in
+    XLA, via the shared candidates_scan scaffold). Returns (S, N)."""
+    from bigclam_tpu.ops.linesearch import candidates_scan
+
+    def terms_fn(fs, gs, fd, m):
+        return candidate_edge_terms(fs, gs, fd, m, cfg, interpret=interpret)
+
+    return candidates_scan(F, grad, edges, cfg, terms_fn)
