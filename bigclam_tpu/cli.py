@@ -35,6 +35,13 @@ def _add_common(p: argparse.ArgumentParser) -> None:
         help="'DP,TP' device mesh, e.g. 4,2 (default: single device)",
     )
     p.add_argument(
+        "--distributed", action="store_true",
+        help="multi-host run: join the jax.distributed process group "
+             "(coordinator from JAX_COORDINATOR_ADDRESS) and build the mesh "
+             "over every process's devices, DCN-aware (slice-major nodes "
+             "axis). Run the same command on every host.",
+    )
+    p.add_argument(
         "--schedule", default="allgather", choices=["allgather", "ring"],
         help="F-row exchange schedule for --mesh runs: allgather materializes"
              " a full F per device (fastest at small N); ring rotates shards"
@@ -78,17 +85,34 @@ def _build(args, k: int):
 
 
 def _make_model(g, cfg, args):
-    if args.mesh:
+    if args.mesh or args.distributed:
         import jax
 
         from bigclam_tpu.parallel import (
             RingBigClamModel,
             ShardedBigClamModel,
             make_mesh,
+            make_multihost_mesh,
         )
 
-        dp, tp = (int(x) for x in args.mesh.split(","))
-        mesh = make_mesh((dp, tp), jax.devices()[: dp * tp])
+        if args.distributed:
+            from bigclam_tpu.parallel import initialize_distributed
+
+            if not initialize_distributed() and jax.process_count() == 1:
+                print(
+                    "warning: --distributed but no coordinator found "
+                    "(set JAX_COORDINATOR_ADDRESS + JAX_NUM_PROCESSES + "
+                    "JAX_PROCESS_ID on every host); continuing "
+                    "single-process over local devices only",
+                    file=sys.stderr,
+                )
+            shape = None
+            if args.mesh:
+                shape = tuple(int(x) for x in args.mesh.split(","))
+            mesh = make_multihost_mesh(shape)
+        else:
+            dp, tp = (int(x) for x in args.mesh.split(","))
+            mesh = make_mesh((dp, tp), jax.devices()[: dp * tp])
         cls = RingBigClamModel if args.schedule == "ring" else ShardedBigClamModel
         return cls(g, cfg, mesh)
     from bigclam_tpu.models import BigClamModel
@@ -127,10 +151,8 @@ def cmd_fit(args) -> int:
     ckpt = (
         CheckpointManager(args.checkpoint_dir) if args.checkpoint_dir else None
     )
-    n_chips = 1
-    if args.mesh:
-        dp, tp = (int(x) for x in args.mesh.split(","))
-        n_chips = dp * tp
+    mesh = getattr(model, "mesh", None)
+    n_chips = mesh.size if mesh is not None else 1
     with MetricsLogger(args.metrics, echo=not args.quiet) as ml:
         cb = ml.step_callback(g.num_directed_edges, chips=n_chips)
         with trace(args.profile_dir):
@@ -167,7 +189,11 @@ def cmd_sweep(args) -> int:
         )
     from bigclam_tpu.utils import MetricsLogger
 
-    factory = (lambda c: _make_model(g, c, args)) if args.mesh else None
+    factory = (
+        (lambda c: _make_model(g, c, args))
+        if (args.mesh or args.distributed)
+        else None
+    )
     with MetricsLogger(args.metrics, echo=not args.quiet) as ml:
         def cb(k, llh):
             ml.log({"k": k, "llh": llh})

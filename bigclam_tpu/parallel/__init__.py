@@ -1,5 +1,17 @@
 from bigclam_tpu.parallel.mesh import make_mesh
+from bigclam_tpu.parallel.multihost import (
+    initialize_distributed,
+    make_multihost_mesh,
+    put_sharded,
+)
 from bigclam_tpu.parallel.ring import RingBigClamModel
 from bigclam_tpu.parallel.sharded import ShardedBigClamModel
 
-__all__ = ["make_mesh", "RingBigClamModel", "ShardedBigClamModel"]
+__all__ = [
+    "initialize_distributed",
+    "make_mesh",
+    "make_multihost_mesh",
+    "put_sharded",
+    "RingBigClamModel",
+    "ShardedBigClamModel",
+]

@@ -1,0 +1,173 @@
+"""Multi-host runtime: jax.distributed init, DCN x ICI hybrid meshes, and
+process-local array placement.
+
+Replaces the scale-out half of C20 (SURVEY.md §2/§5): the reference scaled
+out by pointing spark-shell at a 36-core cluster + HDFS (bigclam4-7.scala:14,
+45) with the Spark driver coordinating every collective as a TCP round trip.
+Here scale-out is the standard JAX multi-controller model: every host runs
+the same program, `jax.distributed.initialize` forms the process group, the
+mesh places the "nodes" axis so that node shards within a slice exchange F
+rows over ICI while only the slice-boundary hops cross DCN, and XLA
+schedules the collectives — no driver in the data path (Q9).
+
+Host-side data never materializes globally on every process at scale:
+`put_sharded` gives each process only the rows its addressable devices own
+(`jax.make_array_from_process_local_data`), the multi-host analog of the
+reference's HDFS-partitioned RDD loads.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding
+
+from bigclam_tpu.parallel.mesh import K_AXIS, NODES_AXIS, make_mesh
+
+# env vars understood by initialize_distributed (standard JAX names first)
+_COORD_ENVS = ("JAX_COORDINATOR_ADDRESS", "COORDINATOR_ADDRESS")
+
+
+def initialize_distributed(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+    local_device_ids: Optional[Sequence[int]] = None,
+) -> bool:
+    """Join the jax.distributed process group; returns True if initialized.
+
+    Resolution order: explicit args > env vars (JAX_COORDINATOR_ADDRESS /
+    COORDINATOR_ADDRESS + JAX_NUM_PROCESSES + JAX_PROCESS_ID) > no-op.
+    On TPU pods jax.distributed can auto-detect everything, but we only
+    auto-call it when a coordinator is named so that single-host runs (and
+    the CPU test fake) never try to open a coordination channel. Idempotent:
+    re-initialization is detected and skipped.
+    """
+    if jax.distributed.is_initialized():
+        return True
+    if coordinator_address is None:
+        for k in _COORD_ENVS:
+            if os.environ.get(k):
+                coordinator_address = os.environ[k]
+                break
+    if coordinator_address is None:
+        return False
+    if num_processes is None and os.environ.get("JAX_NUM_PROCESSES"):
+        num_processes = int(os.environ["JAX_NUM_PROCESSES"])
+    if process_id is None and os.environ.get("JAX_PROCESS_ID"):
+        process_id = int(os.environ["JAX_PROCESS_ID"])
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+        local_device_ids=local_device_ids,
+    )
+    return True
+
+
+def slice_groups(devices: Sequence) -> Dict[int, List]:
+    """Group devices by ICI slice (TPU `slice_index`; hosts/platforms without
+    the attribute form one group — a single ICI domain)."""
+    groups: Dict[int, List] = {}
+    for d in devices:
+        groups.setdefault(getattr(d, "slice_index", 0), []).append(d)
+    return groups
+
+
+def make_multihost_mesh(
+    shape: Optional[Tuple[int, int]] = None,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """Build the (nodes, k) mesh across every process's devices.
+
+    shape = (node_shards, k_shards); default ((num_devices, 1)). On a single
+    slice this is parallel/mesh.make_mesh. Across slices the "nodes" axis is
+    laid out slice-major (mesh_utils.create_hybrid_device_mesh with the DCN
+    axis on "nodes"), so the ring/all-gather of F shards does consecutive
+    hops over ICI and only slice boundaries cross DCN; "k" (whose collective
+    is the small psum of per-edge partial dots and sumF) stays inside a
+    slice.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    if shape is None:
+        shape = (len(devices), 1)
+    dp, tp = shape
+    if dp * tp != len(devices):
+        raise ValueError(
+            f"mesh shape {shape} needs {dp * tp} devices, got {len(devices)}"
+        )
+    groups = slice_groups(devices)
+    n_slices = len(groups)
+    if n_slices == 1:
+        return make_mesh(shape, devices)
+    if dp % n_slices != 0:
+        raise ValueError(
+            f"node_shards={dp} must be a multiple of the {n_slices} slices"
+        )
+    from jax.experimental import mesh_utils
+
+    dev_mesh = mesh_utils.create_hybrid_device_mesh(
+        mesh_shape=(dp // n_slices, tp),
+        dcn_mesh_shape=(n_slices, 1),
+        devices=devices,
+    )
+    return Mesh(dev_mesh, (NODES_AXIS, K_AXIS))
+
+
+def addressable_row_bounds(
+    sharding: NamedSharding, global_shape: Tuple[int, ...]
+) -> Tuple[int, int]:
+    """[lo, hi) rows of a dim-0-sharded global array that this process's
+    devices own. Requires the process's row coverage to be contiguous (true
+    for slice-major meshes, where consecutive node shards live on one host);
+    raises otherwise rather than silently mis-slicing."""
+    n_rows = global_shape[0]
+    idx_map = sharding.addressable_devices_indices_map(global_shape)
+    intervals = set()
+    for idx in idx_map.values():
+        r = idx[0] if idx else slice(None)
+        intervals.add((r.start or 0, n_rows if r.stop is None else r.stop))
+    ordered = sorted(intervals)
+    lo, hi = ordered[0][0], ordered[-1][1]
+    end = lo
+    for s, e in ordered:       # distinct intervals must tile [lo, hi)
+        if s != end:
+            raise ValueError(
+                "process's addressable row shards are not contiguous; "
+                "use a slice-major mesh (make_multihost_mesh)"
+            )
+        end = e
+    return lo, hi
+
+
+def put_process_local(host_array: np.ndarray, sharding: NamedSharding):
+    """Place a dim-0-sharded array giving jax only this process's rows."""
+    lo, hi = addressable_row_bounds(sharding, host_array.shape)
+    return jax.make_array_from_process_local_data(
+        sharding, np.ascontiguousarray(host_array[lo:hi]), host_array.shape
+    )
+
+
+def put_sharded(host_array: np.ndarray, sharding: NamedSharding):
+    """device_put that works under multi-controller: single-process runs use
+    plain jax.device_put; multi-process runs hand each process only its own
+    rows (the host_array is still parsed per host — cheap CSR ints — but
+    device HBM only ever holds the local shard)."""
+    if jax.process_count() == 1:
+        return jax.device_put(host_array, sharding)
+    return put_process_local(np.asarray(host_array), sharding)
+
+
+def fetch_global(x: jax.Array) -> np.ndarray:
+    """np.asarray that works under multi-controller: a globally-sharded array
+    spans devices this process cannot address, so multi-process runs
+    all-gather it across hosts first (every host gets the full array — fine
+    for results/checkpoints, which are O(N*K) host RAM by construction)."""
+    if jax.process_count() == 1:
+        return np.asarray(x)
+    from jax.experimental import multihost_utils
+
+    return np.asarray(multihost_utils.process_allgather(x, tiled=True))

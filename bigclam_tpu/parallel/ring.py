@@ -35,6 +35,7 @@ from bigclam_tpu.graph.csr import Graph
 from bigclam_tpu.models.bigclam import TrainState
 from bigclam_tpu.ops.objective import EdgeChunks, edge_terms
 from bigclam_tpu.parallel.mesh import K_AXIS, NODES_AXIS
+from bigclam_tpu.parallel.multihost import put_sharded
 from bigclam_tpu.parallel.sharded import ShardedBigClamModel, _mark_varying, _rowdot
 
 
@@ -226,8 +227,8 @@ class RingBigClamModel(ShardedBigClamModel):
         edges_host = ring_shard_edges(self.g, self.cfg, dp, self.n_pad, np.float32)
         espec = NamedSharding(self.mesh, P(NODES_AXIS, None, None, None))
         self.edges = EdgeChunks(
-            src=jax.device_put(edges_host.src, espec),
-            dst=jax.device_put(edges_host.dst, espec),
-            mask=jax.device_put(edges_host.mask.astype(self.dtype), espec),
+            src=put_sharded(edges_host.src, espec),
+            dst=put_sharded(edges_host.dst, espec),
+            mask=put_sharded(edges_host.mask.astype(self.dtype), espec),
         )
         self._step = make_ring_train_step(self.mesh, self.edges, self.cfg)

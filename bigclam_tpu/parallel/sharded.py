@@ -42,6 +42,7 @@ from bigclam_tpu.models.bigclam import (
 )
 from bigclam_tpu.ops.objective import EdgeChunks, edge_terms
 from bigclam_tpu.parallel.mesh import K_AXIS, NODES_AXIS
+from bigclam_tpu.parallel.multihost import fetch_global, put_sharded
 
 
 def shard_edges(
@@ -237,9 +238,9 @@ class ShardedBigClamModel:
         edges_host = shard_edges(self.g, self.cfg, dp, self.n_pad, np.float32)
         espec = NamedSharding(self.mesh, P(NODES_AXIS, None, None))
         self.edges = EdgeChunks(
-            src=jax.device_put(edges_host.src, espec),
-            dst=jax.device_put(edges_host.dst, espec),
-            mask=jax.device_put(edges_host.mask.astype(self.dtype), espec),
+            src=put_sharded(edges_host.src, espec),
+            dst=put_sharded(edges_host.dst, espec),
+            mask=put_sharded(edges_host.mask.astype(self.dtype), espec),
         )
         self._step = make_sharded_train_step(self.mesh, self.edges, self.cfg)
 
@@ -249,7 +250,7 @@ class ShardedBigClamModel:
         F_host = np.zeros((self.n_pad, self.k_pad), dtype=np.float64)
         F_host[:n, :k] = F0
         fspec = NamedSharding(self.mesh, P(NODES_AXIS, K_AXIS))
-        F = jax.device_put(F_host.astype(self.dtype), fspec)
+        F = put_sharded(F_host.astype(self.dtype), fspec)
         return TrainState(
             F=F,
             sumF=F.sum(axis=0),
@@ -268,15 +269,15 @@ class ShardedBigClamModel:
 
     def _state_to_arrays(self, state: TrainState) -> dict:
         return {
-            "F": np.asarray(state.F),
-            "sumF": np.asarray(state.sumF),
+            "F": fetch_global(state.F),
+            "sumF": fetch_global(state.sumF),
             "llh": np.asarray(state.llh),
             "it": np.asarray(state.it),
         }
 
     def _state_from_arrays(self, arrays: dict) -> TrainState:
         fspec = NamedSharding(self.mesh, P(NODES_AXIS, K_AXIS))
-        F = jax.device_put(np.asarray(arrays["F"], self.dtype), fspec)
+        F = put_sharded(np.asarray(arrays["F"], self.dtype), fspec)
         return TrainState(
             F=F,
             sumF=F.sum(axis=0),
@@ -305,7 +306,7 @@ class ShardedBigClamModel:
             state,
             self.cfg,
             callback,
-            lambda st: np.asarray(st.F[:n, :k]),
+            lambda st: fetch_global(st.F)[:n, :k],
             checkpoints=checkpoints,
             state_to_arrays=self._state_to_arrays,
             initial_hist=hist,
