@@ -42,6 +42,12 @@ def _add_common(p: argparse.ArgumentParser) -> None:
              "axis). Run the same command on every host.",
     )
     p.add_argument(
+        "--balance", action="store_true",
+        help="degree-balanced node relabeling before sharding (evens "
+             "per-shard edge counts on power-law graphs; results are mapped "
+             "back to original ids)",
+    )
+    p.add_argument(
         "--schedule", default="allgather", choices=["allgather", "ring"],
         help="F-row exchange schedule for --mesh runs: allgather materializes"
              " a full F per device (fastest at small N); ring rotates shards"
@@ -114,7 +120,7 @@ def _make_model(g, cfg, args):
             dp, tp = (int(x) for x in args.mesh.split(","))
             mesh = make_mesh((dp, tp), jax.devices()[: dp * tp])
         cls = RingBigClamModel if args.schedule == "ring" else ShardedBigClamModel
-        return cls(g, cfg, mesh)
+        return cls(g, cfg, mesh, balance=args.balance)
     from bigclam_tpu.models import BigClamModel
 
     return BigClamModel(g, cfg, k_multiple=128 if cfg.dtype == "float32" else 1)

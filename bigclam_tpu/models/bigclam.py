@@ -173,6 +173,9 @@ def restore_checkpoint(checkpoints, expected_meta: dict, state_from_arrays):
     _, arrays, meta = restored
     for key, val in expected_meta.items():
         got = meta.get(key)
+        if got is None and not val:
+            continue    # key added after this checkpoint was written; a
+            # falsy expectation matches its implicit default
         if got != val:
             raise ValueError(
                 f"checkpoint incompatible with this run: {key}={got} in "

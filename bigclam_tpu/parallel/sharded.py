@@ -218,7 +218,14 @@ class ShardedBigClamModel:
     changes the schedule, not the math).
     """
 
-    def __init__(self, g: Graph, cfg: BigClamConfig, mesh: Mesh, dtype=None):
+    def __init__(
+        self,
+        g: Graph,
+        cfg: BigClamConfig,
+        mesh: Mesh,
+        dtype=None,
+        balance: bool = False,
+    ):
         self.g = g
         self.cfg = cfg
         self.mesh = mesh
@@ -231,7 +238,26 @@ class ShardedBigClamModel:
             raise ValueError("sharded padding requires min_f == 0.0")
         self.n_pad = _round_up(max(g.num_nodes, dp), dp)
         self.k_pad = _round_up(cfg.num_communities, tp)
+        # degree-balanced relabeling (parallel/balance.py): the trainer runs
+        # on the relabeled graph; F0 in / results out stay in original ids
+        self._perm = None
+        if balance and dp > 1:
+            from bigclam_tpu.parallel.balance import balance_graph
+
+            self.g, self._perm = balance_graph(g, dp, self.n_pad)
         self._build_edges_and_step()    # hook: subclasses swap the schedule
+
+    def _to_internal_rows(self, F0: np.ndarray) -> np.ndarray:
+        """Original-id F rows -> the trainer's (possibly relabeled) row order."""
+        if self._perm is None:
+            return F0
+        out = np.empty_like(F0)
+        out[self._perm] = F0
+        return out
+
+    def _from_internal_rows(self, F: np.ndarray) -> np.ndarray:
+        """Trainer row order -> original ids (inverse of _to_internal_rows)."""
+        return F if self._perm is None else F[self._perm]
 
     def _build_edges_and_step(self) -> None:
         dp = self.mesh.shape[NODES_AXIS]
@@ -248,7 +274,7 @@ class ShardedBigClamModel:
         n, k = self.g.num_nodes, self.cfg.num_communities
         assert F0.shape == (n, k), (F0.shape, (n, k))
         F_host = np.zeros((self.n_pad, self.k_pad), dtype=np.float64)
-        F_host[:n, :k] = F0
+        F_host[:n, :k] = self._to_internal_rows(F0)
         fspec = NamedSharding(self.mesh, P(NODES_AXIS, K_AXIS))
         F = put_sharded(F_host.astype(self.dtype), fspec)
         return TrainState(
@@ -265,6 +291,13 @@ class ShardedBigClamModel:
             "k": self.cfg.num_communities,
             "n_pad": self.n_pad,
             "k_pad": self.k_pad,
+            # checkpointed F is stored in the trainer's internal row order,
+            # which depends on the balance setting AND (when balanced) on the
+            # node-shard count: a run with either different must not restore
+            "balanced": self._perm is not None,
+            "node_shards": (
+                self.mesh.shape[NODES_AXIS] if self._perm is not None else 0
+            ),
         }
 
     def _state_to_arrays(self, state: TrainState) -> dict:
@@ -306,7 +339,7 @@ class ShardedBigClamModel:
             state,
             self.cfg,
             callback,
-            lambda st: fetch_global(st.F)[:n, :k],
+            lambda st: self._from_internal_rows(fetch_global(st.F)[:n])[:, :k],
             checkpoints=checkpoints,
             state_to_arrays=self._state_to_arrays,
             initial_hist=hist,

@@ -1,0 +1,184 @@
+"""Degree-balanced relabeling (parallel/balance.py): permutation validity,
+skew reduction on a real power-law graph, and end-to-end invisibility (same
+converged model, original-id rows) through the sharded trainer."""
+
+import numpy as np
+import pytest
+
+from bigclam_tpu.config import BigClamConfig
+from bigclam_tpu.models import BigClamModel
+from bigclam_tpu.parallel import ShardedBigClamModel, make_mesh
+from bigclam_tpu.parallel.balance import (
+    balance_graph,
+    balance_permutation,
+    shard_edge_counts,
+)
+
+
+def test_balance_permutation_is_shard_capacity_respecting(facebook_graph):
+    g = facebook_graph
+    dp, n_pad = 8, 4040
+    perm = balance_permutation(g.degrees, dp, n_pad)
+    # a permutation of [0, N)
+    assert np.array_equal(np.sort(perm), np.arange(g.num_nodes))
+    # per-shard node counts match the contiguous id ranges exactly
+    rows = n_pad // dp
+    counts = np.bincount(perm // rows, minlength=dp)
+    expected = np.minimum(np.arange(1, dp + 1) * rows, g.num_nodes) - np.minimum(
+        np.arange(dp) * rows, g.num_nodes
+    )
+    np.testing.assert_array_equal(counts, expected)
+
+
+def test_balance_reduces_edge_skew(facebook_graph):
+    """facebook_combined is an ego-net union: hubs sit at low ids, so
+    contiguous sharding is badly skewed; LPT must flatten it."""
+    g = facebook_graph
+    dp, n_pad = 8, 4040
+    before = shard_edge_counts(g, dp, n_pad)
+    g_bal, _ = balance_graph(g, dp, n_pad)
+    after = shard_edge_counts(g_bal, dp, n_pad)
+    assert after.sum() == before.sum() == g.num_directed_edges
+    skew_before = before.max() / before.mean()
+    skew_after = after.max() / after.mean()
+    assert skew_before > 1.5          # the problem is real on this graph
+    assert skew_after < 1.05          # and LPT solves it
+    assert skew_after < skew_before
+
+
+def test_permute_roundtrip_preserves_structure(toy_graphs):
+    g = toy_graphs["two_cliques"]
+    rng = np.random.default_rng(0)
+    perm = rng.permutation(g.num_nodes)
+    gp = g.permute(perm)
+    gp.validate()
+    np.testing.assert_array_equal(gp.degrees[perm], g.degrees)
+    np.testing.assert_array_equal(gp.raw_ids[perm], g.raw_ids)
+    for u in range(g.num_nodes):
+        np.testing.assert_array_equal(
+            np.sort(perm[g.neighbors(u)]), gp.neighbors(perm[u])
+        )
+
+
+def test_balanced_trainer_matches_unbalanced(agm_graph_mod):
+    """balance=True must be invisible: same trajectory (up to float summation
+    order) with rows returned in original ids."""
+    import jax
+
+    g = agm_graph_mod
+    cfg = BigClamConfig(
+        num_communities=4, dtype="float64", max_iters=6, conv_tol=0.0
+    )
+    rng = np.random.default_rng(1)
+    F0 = rng.uniform(0.1, 1.0, size=(g.num_nodes, 4))
+    mesh = make_mesh((4, 2), jax.devices())
+    res_plain = ShardedBigClamModel(g, cfg, mesh).fit(F0)
+    res_bal = ShardedBigClamModel(g, cfg, mesh, balance=True).fit(F0)
+    np.testing.assert_allclose(res_bal.F, res_plain.F, rtol=1e-9, atol=1e-12)
+    np.testing.assert_allclose(res_bal.llh, res_plain.llh, rtol=1e-11)
+
+
+def test_balanced_ring_matches_single_chip(agm_graph_mod):
+    import jax
+
+    from bigclam_tpu.parallel import RingBigClamModel
+
+    g = agm_graph_mod
+    cfg = BigClamConfig(
+        num_communities=4, dtype="float64", max_iters=4, conv_tol=0.0
+    )
+    rng = np.random.default_rng(2)
+    F0 = rng.uniform(0.1, 1.0, size=(g.num_nodes, 4))
+    res_1 = BigClamModel(g, cfg).fit(F0)
+    mesh = make_mesh((8, 1), jax.devices())
+    res_r = RingBigClamModel(g, cfg, mesh, balance=True).fit(F0)
+    np.testing.assert_allclose(res_r.F, res_1.F, rtol=1e-9, atol=1e-12)
+
+
+def test_balanced_checkpoint_mismatch_rejected(tmp_path, agm_graph_mod):
+    """A checkpoint written by a balanced run stores internal row order; a
+    non-balanced run must refuse to restore it."""
+    import jax
+
+    from bigclam_tpu.utils import CheckpointManager
+
+    g = agm_graph_mod
+    cfg = BigClamConfig(
+        num_communities=4, dtype="float64", max_iters=3, conv_tol=0.0,
+        checkpoint_every=1,
+    )
+    rng = np.random.default_rng(3)
+    F0 = rng.uniform(0.1, 1.0, size=(g.num_nodes, 4))
+    mesh = make_mesh((4, 1), jax.devices()[:4])
+    ckpt = CheckpointManager(str(tmp_path))
+    ShardedBigClamModel(g, cfg, mesh, balance=True).fit(F0, checkpoints=ckpt)
+    with pytest.raises(ValueError, match="balanced"):
+        ShardedBigClamModel(g, cfg, mesh, balance=False).fit(
+            F0, checkpoints=CheckpointManager(str(tmp_path))
+        )
+
+
+def test_balanced_checkpoint_dp_mismatch_rejected(tmp_path, agm_graph_mod):
+    """Balanced internal row order depends on the node-shard count; resuming
+    a balanced checkpoint on a different dp (same n_pad/k_pad) must fail
+    rather than restore scrambled rows."""
+    import jax
+
+    from bigclam_tpu.utils import CheckpointManager
+
+    g = agm_graph_mod
+    cfg = BigClamConfig(
+        num_communities=4, dtype="float64", max_iters=2, conv_tol=0.0,
+        checkpoint_every=1,
+    )
+    rng = np.random.default_rng(4)
+    F0 = rng.uniform(0.1, 1.0, size=(g.num_nodes, 4))
+    # dp=4 and dp=8 both give n_pad=48 here, so only node_shards differs
+    mesh4 = make_mesh((4, 1), jax.devices()[:4])
+    ckpt = CheckpointManager(str(tmp_path))
+    ShardedBigClamModel(g, cfg, mesh4, balance=True).fit(F0, checkpoints=ckpt)
+    mesh8 = make_mesh((8, 1), jax.devices())
+    with pytest.raises(ValueError, match="node_shards"):
+        ShardedBigClamModel(g, cfg, mesh8, balance=True).fit(
+            F0, checkpoints=CheckpointManager(str(tmp_path))
+        )
+
+
+def test_checkpoint_missing_falsy_meta_key_accepted(tmp_path, agm_graph_mod):
+    """Checkpoints written before a falsy meta key existed must still
+    restore (missing key == implicit default)."""
+    import jax
+
+    from bigclam_tpu.utils import CheckpointManager
+
+    g = agm_graph_mod
+    cfg = BigClamConfig(
+        num_communities=4, dtype="float64", max_iters=2, conv_tol=0.0,
+        checkpoint_every=1,
+    )
+    rng = np.random.default_rng(6)
+    F0 = rng.uniform(0.1, 1.0, size=(g.num_nodes, 4))
+    mesh = make_mesh((4, 1), jax.devices()[:4])
+    ckpt = CheckpointManager(str(tmp_path))
+    ShardedBigClamModel(g, cfg, mesh).fit(F0, checkpoints=ckpt)
+    # simulate an old checkpoint: strip the newer meta keys
+    import json, pathlib
+
+    for meta_file in pathlib.Path(tmp_path).glob("*.json"):
+        meta = json.loads(meta_file.read_text())
+        meta.pop("balanced", None)
+        meta.pop("node_shards", None)
+        meta_file.write_text(json.dumps(meta))
+    res = ShardedBigClamModel(g, cfg, mesh).fit(
+        F0, checkpoints=CheckpointManager(str(tmp_path))
+    )
+    assert res.num_iters >= 2
+
+
+@pytest.fixture(scope="module")
+def agm_graph_mod():
+    from bigclam_tpu.models.agm import planted_partition_F, sample_graph
+
+    rng = np.random.default_rng(11)
+    Fp, _ = planted_partition_F(48, 4, strength=1.5)
+    return sample_graph(Fp, rng=rng)
