@@ -24,7 +24,10 @@ def _add_common(p: argparse.ArgumentParser) -> None:
     p.add_argument("--alpha", type=float, default=0.05)
     p.add_argument("--beta", type=float, default=0.1)
     p.add_argument("--max-backtracks", type=int, default=15)
-    p.add_argument("--edge-chunk", type=int, default=1 << 18)
+    p.add_argument(
+        "--edge-chunk", type=int, default=None,
+        help="directed edges per device chunk (default: config default)",
+    )
     p.add_argument("--seed", type=int, default=0)
     p.add_argument(
         "--init", default="conductance", choices=["conductance", "random"],
@@ -76,7 +79,7 @@ def _build(args, k: int):
         alpha=args.alpha,
         beta=args.beta,
         max_backtracks=args.max_backtracks,
-        edge_chunk=args.edge_chunk,
+        edge_chunk=args.edge_chunk or BigClamConfig.edge_chunk,
         seed=args.seed,
         checkpoint_dir=args.checkpoint_dir,
         checkpoint_every=args.checkpoint_every,

@@ -57,8 +57,12 @@ class BigClamConfig:
     seed: int = 0                       # PRNG seed for Bernoulli(0.5) F-row padding
 
     # --- execution shape ---
-    edge_chunk: int = 1 << 18           # directed edges per on-device chunk; bounds
-                                        # the (chunk, K) gather working set in HBM
+    edge_chunk: int = 1 << 20           # directed edges per on-device chunk,
+                                        # further capped by gather bytes (see
+                                        # models.bigclam.edge_chunk_bound).
+                                        # Fewer chunks = fewer scan steps
+                                        # re-reading the (N, K) carry
+                                        # accumulators (measurably cheaper)
     mesh_shape: Tuple[int, int] = (1, 1)  # (node-shards, k-shards) = (DP, TP-analog)
     use_pallas: Optional[bool] = None   # fused VMEM candidate kernel; None =
                                         # auto (on for TPU backends when tile

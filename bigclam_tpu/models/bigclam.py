@@ -59,18 +59,35 @@ def _rel_change(new: float, old: float) -> float:
     return abs(1.0 - new / old)
 
 
+def edge_chunk_bound(
+    cfg: BigClamConfig, k_cols: Optional[int] = None, dtype=None
+) -> int:
+    """cfg.edge_chunk capped so one gathered (chunk, k_cols) array stays under
+    ~1 GB of HBM — the candidate pass holds several such arrays live at once.
+    Shared by the single-chip (prepare_graph), sharded, and ring edge preps.
+    k_cols is the per-device column count of the gathered arrays (k_pad on a
+    single chip, k_pad // tp under K-axis sharding); dtype their element type.
+    """
+    cols = k_cols if k_cols else cfg.num_communities
+    per_edge_bytes = max(cols, 1) * jnp.dtype(dtype or jnp.float32).itemsize
+    return min(max(cfg.edge_chunk, 1), max((1 << 30) // per_edge_bytes, 1024))
+
+
 def prepare_graph(
     g: Graph,
     cfg: BigClamConfig,
     node_multiple: int = 1,
     dtype=None,
+    k_pad: Optional[int] = None,
 ) -> tuple[EdgeChunks, int]:
     """Chunk + pad directed-edge arrays for static-shape device sweeps.
 
     Padding: src = n_pad - 1 (keeps src sorted for segment_sum), dst = 0,
-    mask = 0. Returns (EdgeChunks, padded node count).
+    mask = 0. Returns (EdgeChunks, padded node count). k_pad is the padded
+    community count the gathered (chunk, k_pad) arrays will actually have;
+    it defaults to the unpadded K for callers that do not pad.
     """
-    dtype = dtype or jnp.float32
+    dtype = jnp.dtype(dtype or jnp.float32)
     n_pad = _round_up(max(g.num_nodes, 1), node_multiple)
     src, dst = g.src, g.dst
     m = src.shape[0]
@@ -80,7 +97,8 @@ def prepare_graph(
     # (XLA lays 1-D operands out in 1024-element tiles and Mosaic blocks
     # must match); smaller chunks (tiny graphs / chunking tests) align to 8
     # and dispatch to the XLA candidate path instead.
-    c = max(1, -(-m // max(cfg.edge_chunk, 1)))
+    chunk_bound = edge_chunk_bound(cfg, k_pad, dtype)
+    c = max(1, -(-m // chunk_bound))
     chunk = max(-(-m // c), 1)
     chunk = _round_up(chunk, 1024 if chunk >= 1024 else 8)
     pad = c * chunk - m
@@ -256,10 +274,11 @@ class BigClamModel:
         self.dtype = dtype or (
             jnp.float64 if cfg.dtype == "float64" else jnp.float32
         )
-        self.edges, self.n_pad = prepare_graph(
-            g, cfg, node_multiple=node_multiple, dtype=self.dtype
-        )
         self.k_pad = _round_up(cfg.num_communities, k_multiple)
+        self.edges, self.n_pad = prepare_graph(
+            g, cfg, node_multiple=node_multiple, dtype=self.dtype,
+            k_pad=self.k_pad,
+        )
         if (self.n_pad > g.num_nodes or self.k_pad > cfg.num_communities) and (
             cfg.min_f != 0.0
         ):

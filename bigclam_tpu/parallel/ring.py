@@ -32,7 +32,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from bigclam_tpu.config import BigClamConfig
 from bigclam_tpu.graph.csr import Graph
-from bigclam_tpu.models.bigclam import TrainState
+from bigclam_tpu.models.bigclam import TrainState, edge_chunk_bound
 from bigclam_tpu.ops.objective import EdgeChunks, edge_terms
 from bigclam_tpu.parallel.mesh import K_AXIS, NODES_AXIS
 from bigclam_tpu.parallel.multihost import put_sharded
@@ -40,7 +40,12 @@ from bigclam_tpu.parallel.sharded import ShardedBigClamModel, _mark_varying, _ro
 
 
 def ring_shard_edges(
-    g: Graph, cfg: BigClamConfig, dp: int, n_pad: int, dtype
+    g: Graph,
+    cfg: BigClamConfig,
+    dp: int,
+    n_pad: int,
+    dtype,
+    chunk_bound: int = 0,
 ) -> EdgeChunks:
     """Bucket each src shard's edges by destination shard.
 
@@ -58,7 +63,7 @@ def ring_shard_edges(
     counts = np.zeros((dp, dp), dtype=np.int64)
     np.add.at(counts, (src_shard, phase), 1)
     max_count = max(int(counts.max()), 1)
-    chunk = min(cfg.edge_chunk, max_count)
+    chunk = min(chunk_bound or cfg.edge_chunk, max_count)
     c = -(-max_count // chunk)
     padded = c * chunk
     src = np.full((dp, dp, padded), shard_rows - 1, dtype=np.int32)
@@ -224,7 +229,13 @@ class RingBigClamModel(ShardedBigClamModel):
 
     def _build_edges_and_step(self) -> None:
         dp = self.mesh.shape[NODES_AXIS]
-        edges_host = ring_shard_edges(self.g, self.cfg, dp, self.n_pad, np.float32)
+        tp = self.mesh.shape[K_AXIS]
+        bound = edge_chunk_bound(
+            self.cfg, max(self.k_pad // tp, 1), self.dtype
+        )
+        edges_host = ring_shard_edges(
+            self.g, self.cfg, dp, self.n_pad, np.float32, chunk_bound=bound
+        )
         espec = NamedSharding(self.mesh, P(NODES_AXIS, None, None, None))
         self.edges = EdgeChunks(
             src=put_sharded(edges_host.src, espec),

@@ -37,6 +37,7 @@ from bigclam_tpu.models.bigclam import (
     FitResult,
     TrainState,
     _round_up,
+    edge_chunk_bound,
     restore_checkpoint,
     run_fit_loop,
 )
@@ -46,19 +47,26 @@ from bigclam_tpu.parallel.multihost import fetch_global, put_sharded
 
 
 def shard_edges(
-    g: Graph, cfg: BigClamConfig, dp: int, n_pad: int, dtype
+    g: Graph,
+    cfg: BigClamConfig,
+    dp: int,
+    n_pad: int,
+    dtype,
+    chunk_bound: int = 0,
 ) -> EdgeChunks:
     """Partition directed edges by src ownership into (dp, C, chunk) blocks.
 
     CSR order means each shard's edges are one contiguous slice. src indices
     are rebased to shard-local rows; padding uses the shard's last local row
-    (keeps src sorted) with mask 0.
+    (keeps src sorted) with mask 0. chunk_bound caps the per-chunk gather
+    bytes (callers derive it via models.bigclam.edge_chunk_bound from the
+    per-device gathered column count and model dtype).
     """
     shard_rows = n_pad // dp
     bounds = np.searchsorted(g.src, np.arange(0, n_pad + shard_rows, shard_rows))
     counts = np.diff(bounds)
     max_count = int(counts.max()) if counts.size else 1
-    chunk = min(cfg.edge_chunk, max(max_count, 1))
+    chunk = min(chunk_bound or cfg.edge_chunk, max(max_count, 1))
     c = max(1, -(-max_count // chunk))
     padded = c * chunk
     src = np.full((dp, padded), shard_rows - 1, dtype=np.int32)
@@ -261,7 +269,13 @@ class ShardedBigClamModel:
 
     def _build_edges_and_step(self) -> None:
         dp = self.mesh.shape[NODES_AXIS]
-        edges_host = shard_edges(self.g, self.cfg, dp, self.n_pad, np.float32)
+        tp = self.mesh.shape[K_AXIS]
+        bound = edge_chunk_bound(
+            self.cfg, max(self.k_pad // tp, 1), self.dtype
+        )
+        edges_host = shard_edges(
+            self.g, self.cfg, dp, self.n_pad, np.float32, chunk_bound=bound
+        )
         espec = NamedSharding(self.mesh, P(NODES_AXIS, None, None))
         self.edges = EdgeChunks(
             src=put_sharded(edges_host.src, espec),
