@@ -67,6 +67,18 @@ class BigClamConfig:
     use_pallas: Optional[bool] = None   # fused VMEM candidate kernel; None =
                                         # auto (on for TPU backends when tile
                                         # constraints are met)
+    use_pallas_csr: Optional[bool] = None  # blocked-CSR MXU kernels
+                                        # (ops.pallas_csr) replacing the whole
+                                        # edge sweep; None = auto (on for TPU
+                                        # when tiling constraints + the fd
+                                        # gather memory budget hold). When on,
+                                        # it supersedes use_pallas.
+    csr_block_b: int = 256              # node rows per F block in VMEM
+                                        # (256/512 tuned fastest on v5e:
+                                        # one-hot matmul cost scales with B)
+    csr_tile_t: int = 512               # edges per kernel tile
+    pallas_interpret: bool = False      # run Pallas kernels in interpret mode
+                                        # (CPU testing of the kernel paths)
 
     # --- checkpointing / logging ---
     checkpoint_dir: Optional[str] = None

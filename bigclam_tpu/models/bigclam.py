@@ -51,6 +51,12 @@ def _round_up(x: int, m: int) -> int:
     return ((x + m - 1) // m) * m
 
 
+def _lcm(a: int, b: int) -> int:
+    import math
+
+    return a * b // math.gcd(a, b)
+
+
 def _rel_change(new: float, old: float) -> float:
     """|1 - new/old| with the old == 0.0 corner handled (all-zero F0 has
     LLH exactly 0.0): converged iff new is also 0."""
@@ -183,13 +189,23 @@ def restore_checkpoint(checkpoints, expected_meta: dict, state_from_arrays):
     JAX clips out-of-range gathers and drops out-of-range scatters silently,
     so resuming with an F whose padding or graph differs from the compiled
     step would corrupt results without an exception — validate instead.
+
+    PADDED shapes (n_pad, k_pad) are soft: padding rows/columns are inert
+    zeros (ops.objective padding conventions), so a checkpoint written under
+    a different padding regime (e.g. CPU XLA path vs TPU CSR-kernel path) is
+    cropped to the live (num_nodes, k) region and re-padded. The live graph
+    and K must match exactly.
+
     Returns (state, llh_history) or (None, ()) when nothing is stored.
     """
     restored = checkpoints.restore()
     if restored is None:
         return None, ()
     _, arrays, meta = restored
+    soft = {"n_pad", "k_pad"}
     for key, val in expected_meta.items():
+        if key in soft:
+            continue
         got = meta.get(key)
         if got is None and not val:
             continue    # key added after this checkpoint was written; a
@@ -199,23 +215,62 @@ def restore_checkpoint(checkpoints, expected_meta: dict, state_from_arrays):
                 f"checkpoint incompatible with this run: {key}={got} in "
                 f"checkpoint vs {val} expected (dir: {checkpoints.directory})"
             )
-    if tuple(arrays["F"].shape) != (expected_meta["n_pad"], expected_meta["k_pad"]):
-        raise ValueError(
-            f"checkpoint F shape {arrays['F'].shape} != padded shape "
-            f"({expected_meta['n_pad']}, {expected_meta['k_pad']})"
-        )
+    n_pad, k_pad = expected_meta["n_pad"], expected_meta["k_pad"]
+    n, k = expected_meta["num_nodes"], expected_meta["k"]
+    F = np.asarray(arrays["F"])
+    if tuple(F.shape) != (n_pad, k_pad):
+        if F.shape[0] < n or F.shape[1] < k:
+            raise ValueError(
+                f"checkpoint F shape {F.shape} smaller than live region "
+                f"({n}, {k}) (dir: {checkpoints.directory})"
+            )
+        repad = np.zeros((n_pad, k_pad), F.dtype)
+        repad[:n, :k] = F[:n, :k]
+        arrays = dict(arrays)
+        arrays["F"] = repad
+        arrays["sumF"] = repad.sum(axis=0)
     return state_from_arrays(arrays), tuple(meta.get("llh_history", ()))
 
 
 def make_train_step(
-    edges: EdgeChunks, cfg: BigClamConfig
+    edges: EdgeChunks, cfg: BigClamConfig, tiles=None
 ) -> Callable[[TrainState], TrainState]:
     """Build the jitted one-iteration update: 17 fused edge sweeps total
     (1 grad/LLH + 16 candidates), no host round trips.
 
-    The candidate pass dispatches to the Pallas VMEM kernel
-    (ops.pallas_kernels) on TPU backends when the edge-chunk/K tiling
-    constraints hold; cfg.use_pallas overrides the auto choice."""
+    With `tiles` (an ops.pallas_csr.TilesDev), the whole edge sweep runs in
+    the blocked-CSR MXU kernels: ONE dst-row gather shared by the grad and
+    candidate passes, src expansion / scatter as one-hot matmuls, Armijo
+    tails folded into the candidate kernel. Otherwise the candidate pass
+    dispatches to the older Pallas VMEM kernel (ops.pallas_kernels) on TPU
+    backends when the edge-chunk/K tiling constraints hold; cfg.use_pallas
+    overrides that auto choice."""
+    if tiles is not None:
+        from bigclam_tpu.ops.linesearch import armijo_select
+        from bigclam_tpu.ops.pallas_csr import (
+            candidates_csr,
+            gather_dst_rows,
+            grad_llh_csr,
+        )
+
+        interp = cfg.pallas_interpret
+
+        def csr_step(state: TrainState) -> TrainState:
+            F, sumF = state.F, state.sumF
+            fd = gather_dst_rows(F, tiles)
+            grad, node_llh = grad_llh_csr(
+                F, sumF, tiles, cfg, fd=fd, interpret=interp
+            )
+            llh_cur = node_llh.sum()
+            cand_full = candidates_csr(
+                F, grad, sumF, tiles, cfg, fd=fd, interpret=interp
+            )
+            F_new, sumF_new = armijo_select(F, grad, node_llh, cand_full, cfg)
+            return TrainState(
+                F=F_new, sumF=sumF_new, llh=llh_cur, it=state.it + 1
+            )
+
+        return jax.jit(csr_step)
 
     def _pick_candidates_impl(F: jax.Array):
         want = cfg.use_pallas
@@ -275,10 +330,19 @@ class BigClamModel:
             jnp.float64 if cfg.dtype == "float64" else jnp.float32
         )
         self.k_pad = _round_up(cfg.num_communities, k_multiple)
-        self.edges, self.n_pad = prepare_graph(
-            g, cfg, node_multiple=node_multiple, dtype=self.dtype,
-            k_pad=self.k_pad,
-        )
+        self._tiles = self._maybe_build_tiles(node_multiple)
+        if self._tiles is not None:
+            # the CSR kernels never read the EdgeChunks arrays — defer their
+            # (device-resident) construction so HBM holds only the tiles
+            self._node_multiple = _lcm(node_multiple, cfg.csr_block_b)
+            self._edges = None
+            self.n_pad = self._tiles.n_pad
+        else:
+            self._node_multiple = node_multiple
+            self._edges, self.n_pad = prepare_graph(
+                g, cfg, node_multiple=node_multiple, dtype=self.dtype,
+                k_pad=self.k_pad,
+            )
         if (self.n_pad > g.num_nodes or self.k_pad > cfg.num_communities) and (
             cfg.min_f != 0.0
         ):
@@ -289,7 +353,97 @@ class BigClamModel:
                 f"min_f={cfg.min_f} with padding "
                 f"{g.num_nodes}->{self.n_pad}, {cfg.num_communities}->{self.k_pad}"
             )
-        self._step = make_train_step(self.edges, cfg)
+        self._step = make_train_step(self._edges, cfg, tiles=self._tiles)
+
+    @property
+    def edges(self) -> EdgeChunks:
+        """Chunked edge arrays (built lazily on the CSR-kernel path, where
+        the train step itself never reads them)."""
+        if self._edges is None:
+            self._edges, n_pad = prepare_graph(
+                self.g, self.cfg, node_multiple=self._node_multiple,
+                dtype=self.dtype, k_pad=self.k_pad,
+            )
+            assert n_pad == self.n_pad, (n_pad, self.n_pad)
+        return self._edges
+
+    def _maybe_build_tiles(self, node_multiple: int):
+        """Decide + build the blocked-CSR tile layout (ops.csr_tiles).
+
+        Auto mode (use_pallas_csr=None): engage on TPU backends when f32,
+        the Mosaic tiling constraints hold, the tile padding overhead is
+        bounded, and the shared dst-row gather fits a ~2 GB HBM budget.
+        Explicit True raises on unmet constraints rather than degrading."""
+        cfg = self.cfg
+        want = cfg.use_pallas_csr
+        if want is None:
+            want = jax.default_backend() == "tpu" or cfg.pallas_interpret
+        if not want:
+            return None
+        from bigclam_tpu.ops.csr_tiles import build_block_tiles
+        from bigclam_tpu.ops.pallas_csr import csr_tiles_supported, device_tiles
+
+        explicit = cfg.use_pallas_csr is True
+        if self.dtype != jnp.float32 or cfg.accum_dtype not in (None, "float32"):
+            # the kernels accumulate per-block sums in F.dtype; a promised
+            # wider accum_dtype must keep the XLA path
+            if explicit:
+                raise ValueError(
+                    "use_pallas_csr requires float32 F and "
+                    "accum_dtype in (None, 'float32')"
+                )
+            return None
+        # MXU/VMEM lane alignment: pad K up rather than fall back — zero
+        # columns are inert (see ops.objective padding conventions). Only
+        # committed to self.k_pad once the path actually engages.
+        k_pad = _round_up(self.k_pad, 128)
+        n = self.g.num_nodes
+        if not csr_tiles_supported(
+            cfg.csr_block_b, cfg.csr_tile_t, k_pad, cfg.pallas_interpret
+        ):
+            if explicit:
+                raise ValueError(
+                    f"use_pallas_csr=True but tiling unsupported: "
+                    f"block_b={cfg.csr_block_b}, tile_t={cfg.csr_tile_t}, "
+                    f"k_pad={k_pad} (need multiples of 128)"
+                )
+            return None
+        if cfg.min_f != 0.0 and (
+            _round_up(n, cfg.csr_block_b) != n or k_pad != cfg.num_communities
+        ):
+            # padding inertness needs min_f == 0 (see __init__'s guard);
+            # auto mode degrades to the XLA path instead of raising there
+            if explicit:
+                raise ValueError(
+                    "use_pallas_csr=True requires min_f == 0.0 when node/K "
+                    f"padding is introduced (min_f={cfg.min_f})"
+                )
+            return None
+        if _round_up(n, _lcm(node_multiple, cfg.csr_block_b)) != _round_up(
+            n, cfg.csr_block_b
+        ):
+            # caller's node_multiple would pad rows beyond the tile layout's
+            # n_pad = n_blocks * block_b
+            if explicit:
+                raise ValueError(
+                    f"use_pallas_csr=True incompatible with "
+                    f"node_multiple={node_multiple} (block_b={cfg.csr_block_b})"
+                )
+            return None
+        bt = build_block_tiles(self.g, cfg.csr_block_b, cfg.csr_tile_t)
+        fd_bytes = bt.src_local.size * k_pad * 4
+        e = max(self.g.num_directed_edges, 1)
+        pad_ok = bt.src_local.size <= 1.5 * e + bt.n_blocks * cfg.csr_tile_t
+        if not (pad_ok and fd_bytes <= (2 << 30)):
+            if explicit:
+                raise ValueError(
+                    f"use_pallas_csr=True but layout uneconomical: "
+                    f"{bt.padded_edges} padded edges on {e}, "
+                    f"fd gather {fd_bytes >> 20} MiB"
+                )
+            return None
+        self.k_pad = k_pad
+        return device_tiles(bt, self.dtype)
 
     def init_state(self, F0: np.ndarray) -> TrainState:
         n, k = self.g.num_nodes, self.cfg.num_communities

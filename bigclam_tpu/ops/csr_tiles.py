@@ -1,0 +1,113 @@
+"""Host-side CSR block-tile builder for the MXU edge kernels.
+
+The XLA edge path (ops.objective / ops.linesearch) gathers BOTH endpoint rows
+per directed edge and scatters (E, K) gradient contributions with
+`segment_sum` — three memory-bound passes that run far below HBM peak on TPU
+(gather/scatter achieve ~15% of streaming bandwidth). The blocked-CSR layout
+built here lets the Pallas kernels (ops.pallas_csr) eliminate the src-side
+gather and the big scatter entirely:
+
+  * nodes are grouped into blocks of B consecutive rows; each block's CSR
+    edge range (already contiguous, src-sorted) is padded to tiles of T edges
+  * per tile, `src` is stored block-LOCAL (src - B*block_id), so the kernel
+    can expand F rows / scatter contributions with a (B, T) one-hot matmul
+    on the MXU against the (B, K) F block resident in VMEM
+  * `block_id[tile]` is scalar-prefetched; tiles of one block are contiguous,
+    so the kernel accumulates the block's (B, K) output in VMEM and Pallas
+    writes it back once per block
+
+Only the dst-side F-row gather remains in XLA (random access is the one part
+the hardware actually has to pay for); everything else rides the MXU.
+
+Replaces the hot-loop data layout of C11/C13/C14 (SURVEY.md §2; reference
+Bigclamv2.scala:121-146 looped per-node neighbor lists against a broadcast F).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import numpy as np
+
+from bigclam_tpu.graph.csr import Graph
+
+
+class BlockTiles(NamedTuple):
+    """Edge tiles aligned to node blocks (all host NumPy; device-put later).
+
+    src_local: (n_tiles, T) int32 — src row index RELATIVE to the tile's block
+    dst:       (n_tiles, T) int32 — global dst node index (0 for padding)
+    mask:      (n_tiles, T) float32 — 1.0 real edge, 0.0 padding
+    block_id:  (n_tiles,)   int32 — owning node block of every tile
+    """
+
+    src_local: np.ndarray
+    dst: np.ndarray
+    mask: np.ndarray
+    block_id: np.ndarray
+    block_b: int
+    tile_t: int
+    n_blocks: int
+
+    @property
+    def n_tiles(self) -> int:
+        return self.src_local.shape[0]
+
+    @property
+    def n_pad(self) -> int:
+        return self.n_blocks * self.block_b
+
+    @property
+    def padded_edges(self) -> int:
+        return self.src_local.size - int(self.mask.sum())
+
+
+def build_block_tiles(g: Graph, block_b: int = 512, tile_t: int = 512) -> BlockTiles:
+    """Tile the graph's CSR edge ranges by node block.
+
+    Every node block gets at least one tile (possibly all-padding) so the
+    kernels visit — and zero-initialize — every output block.
+    """
+    assert block_b >= 1 and tile_t >= 1
+    n = g.num_nodes
+    n_blocks = max(-(-n // block_b), 1)
+    indptr = np.asarray(g.indptr, np.int64)
+    src = np.asarray(g.src, np.int32)
+    dst = np.asarray(g.dst, np.int32)
+
+    # vectorized layout (no per-block Python work — Friendster-scale graphs
+    # have hundreds of thousands of blocks): every block's CSR edge range is
+    # laid into its own ntile*T slot span; edges land at
+    #   slot = span_start[block] + (edge_index - block_edge_start)
+    block_edge_start = indptr[np.minimum(np.arange(n_blocks) * block_b, n)]
+    block_edge_end = indptr[np.minimum((np.arange(n_blocks) + 1) * block_b, n)]
+    counts = block_edge_end - block_edge_start
+    ntiles = np.maximum(-(-counts // tile_t), 1)
+    span_start = np.concatenate([[0], np.cumsum(ntiles * tile_t)])
+    total = int(span_start[-1])
+
+    blk_of_edge = src // block_b
+    slot = (
+        span_start[blk_of_edge]
+        + np.arange(src.shape[0], dtype=np.int64)
+        - block_edge_start[blk_of_edge]
+    )
+    src_local = np.zeros(total, np.int32)
+    dst_out = np.zeros(total, np.int32)
+    mask = np.zeros(total, np.float32)
+    src_local[slot] = src - (blk_of_edge * block_b).astype(np.int32)
+    dst_out[slot] = dst
+    mask[slot] = 1.0
+
+    n_tiles = int(ntiles.sum())
+    return BlockTiles(
+        src_local=src_local.reshape(n_tiles, tile_t),
+        dst=dst_out.reshape(n_tiles, tile_t),
+        mask=mask.reshape(n_tiles, tile_t),
+        block_id=np.repeat(
+            np.arange(n_blocks, dtype=np.int32), ntiles
+        ),
+        block_b=block_b,
+        tile_t=tile_t,
+        n_blocks=n_blocks,
+    )

@@ -84,15 +84,15 @@ def candidates_pass(
     return candidates_scan(F, grad, edges, cfg, terms_fn)
 
 
-def armijo_update(
+def armijo_select(
     F: jax.Array,
-    sumF: jax.Array,
     grad: jax.Array,
     node_llh: jax.Array,
-    cand_nbr: jax.Array,
+    cand_llh: jax.Array,
     cfg: BigClamConfig,
 ) -> Tuple[jax.Array, jax.Array]:
-    """Acceptance test + max-accepted-step selection + Jacobi update.
+    """Acceptance test + max-accepted-step selection + Jacobi update, given
+    the FULL per-candidate LLH (neighbor terms + Armijo tails), shape (S, N).
 
     Returns (F_new, sumF_new) with sumF recomputed as fresh column sums
     (fixes the incremental-update float drift, SURVEY.md Q7).
@@ -100,17 +100,6 @@ def armijo_update(
     adt = jnp.dtype(cfg.accum_dtype) if cfg.accum_dtype else F.dtype
     etas = jnp.asarray(cfg.step_candidates, F.dtype)
     gg = jnp.einsum("nk,nk->n", grad, grad).astype(adt)
-
-    def tail_for(eta):
-        nf = jnp.clip(F + eta * grad, cfg.min_f, cfg.max_f)
-        sf_adj = sumF[None, :] - F + nf        # node-local sumF adjustment
-        return (
-            -jnp.einsum("nk,nk->n", nf, sf_adj)
-            + jnp.einsum("nk,nk->n", nf, nf)
-        ).astype(adt)
-
-    tails = lax.map(tail_for, etas)            # (S, N)
-    cand_llh = cand_nbr + tails
     ok = cand_llh >= node_llh[None, :] + cfg.alpha * etas[:, None] * gg[None, :]
     # max accepted step per node; 0.0 when nothing accepted
     best_eta = jnp.max(jnp.where(ok, etas[:, None], 0.0), axis=0)
@@ -121,3 +110,29 @@ def armijo_update(
         F,
     )
     return F_new, F_new.sum(axis=0)
+
+
+def armijo_update(
+    F: jax.Array,
+    sumF: jax.Array,
+    grad: jax.Array,
+    node_llh: jax.Array,
+    cand_nbr: jax.Array,
+    cfg: BigClamConfig,
+) -> Tuple[jax.Array, jax.Array]:
+    """armijo_select for callers holding only the NEIGHBOR candidate terms
+    (candidates_pass output): adds the Armijo tail terms
+    -F'.(sumF - F_u + F') + F'.F' per candidate, then selects/updates."""
+    adt = jnp.dtype(cfg.accum_dtype) if cfg.accum_dtype else F.dtype
+    etas = jnp.asarray(cfg.step_candidates, F.dtype)
+
+    def tail_for(eta):
+        nf = jnp.clip(F + eta * grad, cfg.min_f, cfg.max_f)
+        sf_adj = sumF[None, :] - F + nf        # node-local sumF adjustment
+        return (
+            -jnp.einsum("nk,nk->n", nf, sf_adj)
+            + jnp.einsum("nk,nk->n", nf, nf)
+        ).astype(adt)
+
+    tails = lax.map(tail_for, etas)            # (S, N)
+    return armijo_select(F, grad, node_llh, cand_nbr + tails, cfg)

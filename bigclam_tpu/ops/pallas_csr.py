@@ -1,0 +1,281 @@
+"""Blocked-CSR Pallas kernels: the hot edge sweeps on the MXU.
+
+The XLA edge path (ops.objective.grad_llh / ops.linesearch.candidates_pass)
+is three memory-bound stages per sweep — gather F[src], gather F[dst],
+scatter (E, K) contributions via segment_sum — and profiling on TPU v5e shows
+gather/scatter running at ~15% of streaming HBM bandwidth while the MXU sits
+idle. These kernels restructure the sweeps around the blocked-CSR tile layout
+of ops.csr_tiles:
+
+  * the ONLY remaining random access is the dst-side row gather, done once
+    per step in XLA (`F[tiles.dst]`) and shared by both kernels
+  * src-side row expansion is a (T, B)x(B, K) one-hot matmul against the
+    (B, K) F block resident in VMEM (exact: one-hot entries are 0/1 and
+    3-pass f32 matmul reconstructs f32 operands)
+  * the (E, K) gradient scatter becomes a (B, T)x(T, K) one-hot matmul,
+    accumulated into the block's VMEM output across its consecutive tiles
+    (Pallas writes each output block back to HBM once)
+  * the Armijo tail terms fold into the candidate kernel using the algebraic
+    simplification  -F'.(sumF - F + F') + F'.F' = F'.(F - sumF)
+    (SURVEY.md §2.1; reference Bigclamv2.scala:137-143), so the XLA-side
+    update no longer makes 16 passes over (N, K)
+
+Semantics are identical to the XLA path (same clipping, same masked terms;
+reference Bigclamv2.scala:121-146); tests compare both in interpret mode.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from bigclam_tpu.config import BigClamConfig
+from bigclam_tpu.ops.csr_tiles import BlockTiles
+from bigclam_tpu.ops.objective import edge_terms, node_tail
+
+# one-hot matmul precision: f32 multi-pass decomposition — exact enough to
+# reconstruct f32 rows (one-hot operand is 0/1). Mosaic supports only
+# DEFAULT (1-pass bf16, would truncate F to bf16) and HIGHEST (6-pass).
+_PREC = lax.Precision.HIGHEST
+
+
+class TilesDev(NamedTuple):
+    """Device-resident copy of ops.csr_tiles.BlockTiles.
+
+    The per-tile vectors carry a middle singleton dim — Mosaic requires the
+    last TWO dims of a block shape to be (8, 128)-aligned or full-size, so
+    (n_tiles, 1, T) blocks as (1, 1, T) satisfy the rule where (n_tiles, T)
+    as (1, T) would not."""
+
+    src_local: jax.Array   # (n_tiles, 1, T) int32, block-local
+    dst: jax.Array         # (n_tiles, T) int32, global (XLA gather operand)
+    mask: jax.Array        # (n_tiles, 1, T) float
+    block_id: jax.Array    # (n_tiles,) int32
+    block_b: int
+    tile_t: int
+    n_blocks: int
+
+    @property
+    def n_pad(self) -> int:
+        return self.n_blocks * self.block_b
+
+
+def device_tiles(bt: BlockTiles, dtype=jnp.float32) -> TilesDev:
+    n_tiles, t = bt.src_local.shape
+    return TilesDev(
+        src_local=jnp.asarray(bt.src_local, jnp.int32).reshape(n_tiles, 1, t),
+        dst=jnp.asarray(bt.dst, jnp.int32),
+        mask=jnp.asarray(bt.mask, dtype).reshape(n_tiles, 1, t),
+        block_id=jnp.asarray(bt.block_id, jnp.int32),
+        block_b=bt.block_b,
+        tile_t=bt.tile_t,
+        n_blocks=bt.n_blocks,
+    )
+
+
+def csr_tiles_supported(
+    block_b: int, tile_t: int, k_pad: int, interpret: bool = False
+) -> bool:
+    """Mosaic tiling constraints for the two kernels (relaxed in interpret).
+
+    Static — callable BEFORE the O(E) host tile build."""
+    if interpret:
+        return True
+    return (
+        tile_t % 128 == 0
+        and block_b % 128 == 0      # llh/cand outputs have B as minor dim
+        and k_pad % 128 == 0
+    )
+
+
+def _first_tile_of_block(bid_ref, i):
+    prev = bid_ref[jnp.maximum(i - 1, 0)]
+    return jnp.logical_or(i == 0, bid_ref[i] != prev)
+
+
+def _expand_onehot(srcl, b, dtype):
+    """(B, T) one-hot: row r of the block <- edges with src_local == r."""
+    t = srcl.shape[0]
+    rows = lax.broadcasted_iota(jnp.int32, (b, t), 0)
+    return (rows == srcl[None, :]).astype(dtype)
+
+
+def _grad_kernel(bid_ref, srcl_ref, mask_ref, fd_ref, f_blk_ref,
+                 grad_out_ref, llh_out_ref, *, cfg, block_b):
+    i = pl.program_id(0)
+    srcl = srcl_ref[0, 0]                   # (T,)
+    m = mask_ref[0, 0]                      # (T,)
+    fd = fd_ref[0]                          # (T, K)
+    fb = f_blk_ref[:]                       # (B, K)
+    one = _expand_onehot(srcl, block_b, fd.dtype)        # (B, T)
+    fs = lax.dot_general(                   # expand: (T, K) src rows
+        one, fb, (((0,), (0,)), ((), ())),
+        precision=_PREC, preferred_element_type=fd.dtype,
+    )
+    x = jnp.sum(fs * fd, axis=1)            # (T,) edge dots, VPU f32
+    p, ell_raw = edge_terms(x, cfg)         # same clipping as the XLA path
+    ell = ell_raw * m
+    coeff = m / (1.0 - p)                   # folds the +sum_N F_v term
+    contrib = lax.dot_general(              # scatter: (B, K) block partial
+        one, fd * coeff[:, None], (((1,), (0,)), ((), ())),
+        precision=_PREC, preferred_element_type=fd.dtype,
+    )
+    llh_c = jnp.sum(one * ell[None, :], axis=1)          # (B,) VPU
+
+    @pl.when(_first_tile_of_block(bid_ref, i))
+    def _():
+        grad_out_ref[0] = jnp.zeros_like(grad_out_ref)[0]
+        llh_out_ref[0, 0] = jnp.zeros_like(llh_out_ref)[0, 0]
+
+    grad_out_ref[0] += contrib
+    llh_out_ref[0, 0] += llh_c
+
+
+def _cand_kernel(bid_ref, srcl_ref, mask_ref, fd_ref, f_blk_ref, g_blk_ref,
+                 sumf_ref, out_ref, *, cfg, block_b):
+    i = pl.program_id(0)
+    srcl = srcl_ref[0, 0]
+    m = mask_ref[0, 0]
+    fd = fd_ref[0]
+    fb = f_blk_ref[:]
+    gb = g_blk_ref[:]
+    sumf = sumf_ref[0]                       # (K,)
+    one = _expand_onehot(srcl, block_b, fd.dtype)
+    dims = (((0,), (0,)), ((), ()))
+    fs = lax.dot_general(one, fb, dims, precision=_PREC,
+                         preferred_element_type=fd.dtype)
+    gs = lax.dot_general(one, gb, dims, precision=_PREC,
+                         preferred_element_type=fd.dtype)
+    ells = []
+    for eta in cfg.step_candidates:
+        nf = jnp.clip(fs + eta * gs, cfg.min_f, cfg.max_f)
+        x = jnp.sum(nf * fd, axis=1)
+        _, ell = edge_terms(x, cfg)         # same clipping as the XLA path
+        ells.append(ell * m)
+    ell_t = jnp.stack(ells, axis=0)          # (S, T)
+    scat = lax.dot_general(                  # (S, B) neighbor terms
+        ell_t, one, (((1,), (1,)), ((), ())),
+        precision=_PREC, preferred_element_type=fd.dtype,
+    )
+
+    @pl.when(_first_tile_of_block(bid_ref, i))
+    def _():
+        # Armijo tail terms, once per block: nf.(F_u - sumF) per candidate
+        fms = fb - sumf[None, :]             # (B, K)
+        tails = []
+        for eta in cfg.step_candidates:
+            nfb = jnp.clip(fb + eta * gb, cfg.min_f, cfg.max_f)
+            tails.append(jnp.sum(nfb * fms, axis=1))
+        out_ref[0] = jnp.stack(tails, axis=0)            # (S, B)
+
+    out_ref[0] += scat
+
+
+def gather_dst_rows(F: jax.Array, tiles: TilesDev) -> jax.Array:
+    """The one true gather: (n_tiles, T, K) dst-endpoint F rows (XLA)."""
+    return jnp.take(F, tiles.dst, axis=0)
+
+
+def grad_llh_csr(
+    F: jax.Array,
+    sumF: jax.Array,
+    tiles: TilesDev,
+    cfg: BigClamConfig,
+    fd: jax.Array = None,
+    interpret: bool = False,
+) -> Tuple[jax.Array, jax.Array]:
+    """Fused gradient + per-node LLH via the blocked-CSR MXU kernel.
+
+    Drop-in for ops.objective.grad_llh (same math, SURVEY.md §2.1): returns
+    (grad (n_pad, K), node_llh (n_pad,)). `fd` lets the caller share one
+    dst-row gather between this and candidates_csr.
+    """
+    n_pad, k = F.shape
+    assert n_pad == tiles.n_pad, (n_pad, tiles.n_pad)
+    if fd is None:
+        fd = gather_dst_rows(F, tiles)
+    b, t = tiles.block_b, tiles.tile_t
+    n_tiles = tiles.src_local.shape[0]
+    kernel = functools.partial(_grad_kernel, cfg=cfg, block_b=b)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(n_tiles,),
+        in_specs=[
+            pl.BlockSpec((1, 1, t), lambda i, bid: (i, 0, 0)),
+            pl.BlockSpec((1, 1, t), lambda i, bid: (i, 0, 0)),
+            pl.BlockSpec((1, t, k), lambda i, bid: (i, 0, 0)),
+            pl.BlockSpec((b, k), lambda i, bid: (bid[i], 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, b, k), lambda i, bid: (bid[i], 0, 0)),
+            pl.BlockSpec((1, 1, b), lambda i, bid: (bid[i], 0, 0)),
+        ],
+    )
+    grad_nbr, llh_nbr = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=[
+            jax.ShapeDtypeStruct((tiles.n_blocks, b, k), F.dtype),
+            jax.ShapeDtypeStruct((tiles.n_blocks, 1, b), F.dtype),
+        ],
+        interpret=interpret,
+    )(tiles.block_id, tiles.src_local, tiles.mask, fd, F)
+    grad = grad_nbr.reshape(n_pad, k) - sumF[None, :] + F
+    adt = jnp.dtype(cfg.accum_dtype) if cfg.accum_dtype else F.dtype
+    node_llh = (
+        llh_nbr.reshape(n_pad).astype(adt) + node_tail(F, sumF).astype(adt)
+    )
+    return grad, node_llh
+
+
+def candidates_csr(
+    F: jax.Array,
+    grad: jax.Array,
+    sumF: jax.Array,
+    tiles: TilesDev,
+    cfg: BigClamConfig,
+    fd: jax.Array = None,
+    interpret: bool = False,
+) -> jax.Array:
+    """FULL candidate LLH (neighbor terms + Armijo tails) for all 16 steps.
+
+    Returns (S, n_pad) — unlike ops.linesearch.candidates_pass this already
+    includes the tail terms, so feed it to armijo_select, not armijo_update.
+    """
+    n_pad, k = F.shape
+    assert n_pad == tiles.n_pad, (n_pad, tiles.n_pad)
+    if fd is None:
+        fd = gather_dst_rows(F, tiles)
+    b, t = tiles.block_b, tiles.tile_t
+    n_tiles = tiles.src_local.shape[0]
+    num_s = len(cfg.step_candidates)
+    kernel = functools.partial(_cand_kernel, cfg=cfg, block_b=b)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(n_tiles,),
+        in_specs=[
+            pl.BlockSpec((1, 1, t), lambda i, bid: (i, 0, 0)),
+            pl.BlockSpec((1, 1, t), lambda i, bid: (i, 0, 0)),
+            pl.BlockSpec((1, t, k), lambda i, bid: (i, 0, 0)),
+            pl.BlockSpec((b, k), lambda i, bid: (bid[i], 0)),
+            pl.BlockSpec((b, k), lambda i, bid: (bid[i], 0)),
+            pl.BlockSpec((1, k), lambda i, bid: (0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, num_s, b), lambda i, bid: (bid[i], 0, 0)),
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((tiles.n_blocks, num_s, b), F.dtype),
+        interpret=interpret,
+    )(
+        tiles.block_id, tiles.src_local, tiles.mask, fd, F, grad,
+        sumF.reshape(1, k),
+    )
+    return out.transpose(1, 0, 2).reshape(num_s, n_pad)

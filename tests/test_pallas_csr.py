@@ -1,0 +1,136 @@
+"""Blocked-CSR MXU kernel path (ops.csr_tiles + ops.pallas_csr) vs the XLA
+edge path, in Pallas interpret mode on CPU.
+
+The kernels are the performance rewrite of the hot loop (reference
+Bigclamv2.scala:121-146); semantics must match ops.objective.grad_llh and
+ops.linesearch.candidates_pass exactly (same clipping, same masked terms,
+SURVEY.md §2.1)."""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from bigclam_tpu.config import BigClamConfig
+from bigclam_tpu.graph.ingest import graph_from_edges
+from bigclam_tpu.models.bigclam import BigClamModel, prepare_graph
+from bigclam_tpu.ops.csr_tiles import build_block_tiles
+from bigclam_tpu.ops.linesearch import armijo_select, armijo_update, candidates_pass
+from bigclam_tpu.ops.objective import grad_llh
+from bigclam_tpu.ops.pallas_csr import (
+    candidates_csr,
+    device_tiles,
+    grad_llh_csr,
+)
+
+
+def _random_graph(rng, n=57, p=0.12):
+    a = rng.random((n, n)) < p
+    edges = [(i, j) for i in range(n) for j in range(i + 1, n) if a[i, j]]
+    edges.append((0, n - 1))          # ensure the last node is connected
+    return graph_from_edges(edges, num_nodes=n)
+
+
+@pytest.fixture(scope="module")
+def setup(rng):
+    g = _random_graph(rng)
+    cfg = BigClamConfig(num_communities=5, dtype="float32", edge_chunk=64)
+    bt = build_block_tiles(g, block_b=16, tile_t=8)
+    k_pad = 8
+    n_pad = bt.n_blocks * bt.block_b
+    F = np.zeros((n_pad, k_pad), np.float32)
+    F[: g.num_nodes, :5] = rng.uniform(0.0, 1.5, (g.num_nodes, 5))
+    F = jnp.asarray(F)
+    edges, n_pad2 = prepare_graph(g, cfg, node_multiple=bt.block_b)
+    assert n_pad2 == n_pad
+    return g, cfg, bt, F, edges
+
+
+class TestTileBuilder:
+    def test_every_edge_exactly_once(self, rng):
+        g = _random_graph(rng, n=41)
+        bt = build_block_tiles(g, block_b=8, tile_t=4)
+        m = bt.mask.astype(bool)
+        src_global = bt.src_local + bt.block_id[:, None] * bt.block_b
+        got = sorted(zip(src_global[m].tolist(), bt.dst[m].tolist()))
+        want = sorted(zip(g.src.tolist(), g.dst.tolist()))
+        assert got == want
+
+    def test_src_local_in_range_and_blocks_monotonic(self, rng):
+        g = _random_graph(rng, n=41)
+        bt = build_block_tiles(g, block_b=8, tile_t=4)
+        assert bt.src_local.min() >= 0 and bt.src_local.max() < bt.block_b
+        assert (np.diff(bt.block_id) >= 0).all()
+        # every block owns at least one tile (kernels must zero every output
+        # block, even node blocks with no edges)
+        assert set(bt.block_id.tolist()) == set(range(bt.n_blocks))
+
+    def test_isolated_tail_nodes_get_tiles(self):
+        # nodes 20..29 isolated -> last blocks empty but present
+        g = graph_from_edges([(0, 1), (1, 2)], num_nodes=30)
+        bt = build_block_tiles(g, block_b=4, tile_t=4)
+        assert bt.n_blocks == 8
+        assert set(bt.block_id.tolist()) == set(range(8))
+        assert int(bt.mask.sum()) == g.num_directed_edges
+
+    def test_padded_edges_accounting(self, rng):
+        g = _random_graph(rng, n=41)
+        bt = build_block_tiles(g, block_b=8, tile_t=4)
+        assert bt.padded_edges == bt.src_local.size - g.num_directed_edges
+
+
+class TestKernelsMatchXLA:
+    def test_grad_llh_matches(self, setup):
+        g, cfg, bt, F, edges = setup
+        tiles = device_tiles(bt)
+        sumF = F.sum(axis=0)
+        grad_x, llh_x = grad_llh(F, sumF, edges, cfg)
+        grad_p, llh_p = grad_llh_csr(F, sumF, tiles, cfg, interpret=True)
+        np.testing.assert_allclose(grad_p, grad_x, rtol=2e-5, atol=2e-5)
+        np.testing.assert_allclose(llh_p, llh_x, rtol=2e-5, atol=2e-5)
+
+    def test_candidates_and_update_match(self, setup):
+        g, cfg, bt, F, edges = setup
+        tiles = device_tiles(bt)
+        sumF = F.sum(axis=0)
+        grad, node_llh = grad_llh(F, sumF, edges, cfg)
+        cand_nbr = candidates_pass(F, grad, edges, cfg)
+        F_x, sumF_x = armijo_update(F, sumF, grad, node_llh, cand_nbr, cfg)
+        cand_full = candidates_csr(F, grad, sumF, tiles, cfg, interpret=True)
+        F_p, sumF_p = armijo_select(F, grad, node_llh, cand_full, cfg)
+        np.testing.assert_allclose(F_p, F_x, rtol=2e-5, atol=2e-5)
+        np.testing.assert_allclose(sumF_p, sumF_x, rtol=2e-4, atol=2e-4)
+
+    def test_model_step_csr_matches_xla(self, rng):
+        g = _random_graph(rng, n=37)
+        k = 6
+        cfg = BigClamConfig(num_communities=k, dtype="float32", edge_chunk=64)
+        F0 = rng.uniform(0.0, 1.0, size=(g.num_nodes, k))
+        ref = BigClamModel(g, cfg.replace(use_pallas_csr=False))
+        csr = BigClamModel(
+            g,
+            cfg.replace(
+                use_pallas_csr=True,
+                pallas_interpret=True,
+                csr_block_b=8,
+                csr_tile_t=8,
+            ),
+        )
+        s_ref, s_csr = ref.init_state(F0), csr.init_state(F0)
+        for _ in range(3):
+            s_ref, s_csr = ref._step(s_ref), csr._step(s_csr)
+        n = g.num_nodes
+        np.testing.assert_allclose(
+            np.asarray(s_csr.F)[:n, :k],
+            np.asarray(s_ref.F)[:n, :k],
+            rtol=3e-5,
+            atol=3e-5,
+        )
+        np.testing.assert_allclose(
+            float(s_csr.llh), float(s_ref.llh), rtol=1e-5
+        )
+
+    def test_auto_mode_off_on_cpu(self, rng):
+        g = _random_graph(rng, n=37)
+        cfg = BigClamConfig(num_communities=6)
+        model = BigClamModel(g, cfg)
+        assert model._tiles is None
