@@ -50,6 +50,11 @@ class BigClamConfig:
                                         # Bigclamv2.scala:70); False = v3 neighbor-only
                                         # indicator (bigclamv3-7.scala:64-65)
     isolated_phi_sentinel: float = 10.0  # conductance for neighbor-less nodes (v3:51)
+    seeding_degree_cap: Optional[int] = None  # sample at most this many
+                                        # neighbors per node in the conductance
+                                        # scorer (the exact pass is edge-
+                                        # quadratic on hubs); None = exact.
+                                        # Exact anyway when cap >= max degree.
 
     # --- numerics ---
     dtype: str = "float32"              # F / gradient dtype on device

@@ -19,6 +19,12 @@ _SO = os.path.join(_DIR, "libbigclam_native.so")
 
 
 def _load() -> ctypes.CDLL:
+    src = os.path.join(_DIR, "native.cpp")
+    stale = os.path.exists(_SO) and os.path.exists(src) and (
+        os.path.getmtime(_SO) < os.path.getmtime(src)
+    )
+    if stale:
+        os.remove(_SO)   # rebuild below; dlopen caching makes reload unsafe
     if not os.path.exists(_SO):
         try:
             subprocess.run(
@@ -47,6 +53,20 @@ def _load() -> ctypes.CDLL:
         ctypes.c_int64,
         ctypes.POINTER(ctypes.c_int64),
     ]
+    try:
+        lib.bc_triangle_counts_capped.restype = None
+        lib.bc_triangle_counts_capped.argtypes = [
+            ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.c_int32),
+            ctypes.c_int64,
+            ctypes.c_int64,
+            ctypes.c_uint64,
+            ctypes.POINTER(ctypes.c_double),
+        ]
+    except AttributeError as e:
+        # stale prebuilt .so missing the symbol (mtime check can be fooled by
+        # copies): degrade to the NumPy fallbacks, as the module promises
+        raise ImportError(f"stale {_SO}: {e}") from e
     return lib
 
 
@@ -84,5 +104,23 @@ def triangle_counts(g) -> np.ndarray:
         indices.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
         ctypes.c_int64(n),
         out.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+    )
+    return out
+
+
+def triangle_counts_capped(g, cap: int, seed: int = 0) -> np.ndarray:
+    """Degree-capped tri(u) estimator (O(n*cap^2); exact when cap >= max
+    degree). Semantics documented in ops.seeding.triangle_counts_sampled."""
+    indptr = np.ascontiguousarray(g.indptr, dtype=np.int64)
+    indices = np.ascontiguousarray(g.indices, dtype=np.int32)
+    n = g.num_nodes
+    out = np.zeros(n, dtype=np.float64)
+    _lib.bc_triangle_counts_capped(
+        indptr.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        indices.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        ctypes.c_int64(n),
+        ctypes.c_int64(int(cap)),
+        ctypes.c_uint64(int(seed) & (2**64 - 1)),
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
     )
     return out

@@ -125,4 +125,79 @@ void bc_triangle_counts(const int64_t* indptr, const int32_t* indices,
   }
 }
 
+// Degree-capped triangle-count ESTIMATOR (ops/seeding.py documents the
+// math): each node keeps a uniform sample of at most `cap` neighbors
+// (partial Fisher-Yates, per-node splitmix64 stream, O(E) total); hits are
+// weighted by deg(v)/|S_v| and the per-node total rescaled by
+// C(deg,2)/C(|S|,2). With cap >= max degree this equals the exact count.
+// Work O(n * cap^2) instead of the exact pass's O(sum deg^2).
+static inline uint64_t bc_splitmix64(uint64_t x) {
+  x += 0x9e3779b97f4a7c15ULL;
+  x = (x ^ (x >> 30)) * 0xbf58476d1ce4e5b9ULL;
+  x = (x ^ (x >> 27)) * 0x94d049bb133111ebULL;
+  return x ^ (x >> 31);
+}
+
+void bc_triangle_counts_capped(const int64_t* indptr, const int32_t* indices,
+                               int64_t n, int64_t cap, uint64_t seed,
+                               double* out) {
+  std::vector<int64_t> cptr((size_t)n + 1, 0);
+  for (int64_t u = 0; u < n; u++) {
+    int64_t d = indptr[u + 1] - indptr[u];
+    cptr[u + 1] = cptr[u] + (d < cap ? d : cap);
+  }
+  std::vector<int32_t> cind((size_t)cptr[n]);
+#pragma omp parallel
+  {
+    std::vector<int32_t> scratch;
+#pragma omp for schedule(dynamic, 256)
+    for (int64_t u = 0; u < n; u++) {
+      int64_t lo = indptr[u], d = indptr[u + 1] - lo;
+      int64_t cd = cptr[u + 1] - cptr[u];
+      if (d <= cap) {
+        for (int64_t i = 0; i < d; i++) cind[cptr[u] + i] = indices[lo + i];
+        continue;
+      }
+      scratch.assign(indices + lo, indices + lo + d);
+      uint64_t s = bc_splitmix64(seed ^ (uint64_t)u * 0x2545f4914f6cdd1dULL);
+      for (int64_t i = 0; i < cd; i++) {  // partial Fisher-Yates
+        s = bc_splitmix64(s);
+        int64_t j = i + (int64_t)(s % (uint64_t)(d - i));
+        int32_t tmp = scratch[i];
+        scratch[i] = scratch[j];
+        scratch[j] = tmp;
+        cind[cptr[u] + i] = scratch[i];
+      }
+    }
+  }
+#pragma omp parallel
+  {
+    std::vector<uint8_t> flags((size_t)n, 0);
+#pragma omp for schedule(dynamic, 64)
+    for (int64_t u = 0; u < n; u++) {
+      int64_t lo = cptr[u], hi = cptr[u + 1];
+      int64_t cd = hi - lo;
+      int64_t d = indptr[u + 1] - indptr[u];
+      if (cd < 2) {
+        out[u] = 0.0;
+        continue;
+      }
+      for (int64_t i = lo; i < hi; i++) flags[cind[i]] = 1;
+      double hits = 0.0;
+      for (int64_t i = lo; i < hi; i++) {
+        int32_t v = cind[i];
+        int64_t vd = indptr[v + 1] - indptr[v];
+        int64_t vc = cptr[v + 1] - cptr[v];
+        double w = vc ? (double)vd / (double)vc : 0.0;
+        for (int64_t j = cptr[v]; j < cptr[v + 1]; j++)
+          if (flags[cind[j]]) hits += w;
+      }
+      for (int64_t i = lo; i < hi; i++) flags[cind[i]] = 0;
+      double scale =
+          (double)d * (double)(d - 1) / ((double)cd * (double)(cd - 1));
+      out[u] = hits / 2.0 * scale;
+    }
+  }
+}
+
 }  // extern "C"

@@ -101,11 +101,127 @@ def triangle_counts_dense_device(g: Graph) -> np.ndarray:
     return np.asarray(jnp.round(tri)).astype(np.int64)
 
 
-def conductance(g: Graph, backend: str = "auto") -> np.ndarray:
-    """Ego-net conductance phi(u) for every node (float64)."""
+def capped_csr(g: Graph, cap: int, rng: np.random.Generator):
+    """Per-node uniform sample (without replacement) of at most `cap`
+    neighbors. Returns (indptr_c, indices_c) with each capped list sorted
+    ascending (so u*N + w keys are globally sorted for searchsorted).
+    Vectorized: one lexsort of the directed edges by (src, random key)."""
+    n = g.num_nodes
+    deg = g.degrees.astype(np.int64)
+    order = np.lexsort((rng.random(g.indices.size), g.src))
+    pos = np.arange(g.indices.size, dtype=np.int64) - np.repeat(
+        g.indptr[:-1].astype(np.int64), deg
+    )
+    keep = order[pos < cap]
+    cdeg = np.minimum(deg, cap)
+    indptr_c = np.concatenate([[0], np.cumsum(cdeg)])
+    src_kept = g.src[keep]
+    dst_kept = g.indices[keep]
+    resort = np.lexsort((dst_kept, src_kept))
+    return indptr_c, dst_kept[resort]
+
+
+def triangle_counts_sampled(
+    g: Graph,
+    cap: int,
+    rng: Optional[np.random.Generator] = None,
+    chunk_entries: int = 1 << 26,
+    use_native: bool = True,
+) -> np.ndarray:
+    """Unbiased-style estimator of tri(u) with per-node degree cap.
+
+    The exact pass is O(sum_v deg(v)^2) — edge-quadratic on hub nodes, which
+    SURVEY.md §7 flags as infeasible at com-Friendster scale. Here each node
+    keeps a uniform sample S_u of at most `cap` neighbors; triangles are
+    counted over (v in S_u, w in S_v-capped-list) hits w in S_u, each hit
+    weighted by deg(v)/|S_v| (inner-list thinning correction), and the total
+    rescaled by C(deg_u, 2)/C(|S_u|, 2) (pair-sampling correction). With
+    cap >= max degree this reduces EXACTLY to the unsampled count (all
+    weights and scales are 1) — the exactness flag for small graphs.
+
+    Work is O(N * cap^2), processed in node chunks bounded by
+    `chunk_entries` two-hop entries at a time.
+    """
+    rng = rng or np.random.default_rng(0)
+    n = g.num_nodes
+    deg = g.degrees.astype(np.int64)
+    if n == 0 or g.indices.size == 0:
+        return np.zeros(n, dtype=np.float64)
+    if use_native:
+        try:
+            from bigclam_tpu.graph.native import triangle_counts_capped
+
+            return triangle_counts_capped(
+                g, cap, seed=int(rng.integers(2**63))
+            )
+        except ImportError:
+            pass
+    indptr_c, indices_c = capped_csr(g, cap, rng)
+    cdeg = np.diff(indptr_c)
+    # globally sorted ego keys u*n + w, one per capped edge
+    ego_src = np.repeat(np.arange(n, dtype=np.int64), cdeg)
+    ego_keys = ego_src * n + indices_c
+    inner_w = deg / np.maximum(cdeg, 1)      # deg(v)/|S_v| hit weight
+    tri_w = np.zeros(n, dtype=np.float64)
+
+    # chunk nodes so the expanded two-hop arrays stay bounded
+    two_hop = np.zeros(n, dtype=np.int64)    # per-u expanded entry count
+    np.add.at(two_hop, ego_src, cdeg[indices_c])
+    bounds = np.searchsorted(
+        np.cumsum(two_hop), np.arange(1, two_hop.sum() // chunk_entries + 2)
+        * chunk_entries
+    )
+    starts = np.concatenate([[0], np.minimum(bounds + 1, n)])
+    for lo, hi in zip(starts[:-1], starts[1:]):
+        if lo >= hi:
+            continue
+        e0, e1 = indptr_c[lo], indptr_c[hi]
+        if e0 == e1:
+            continue                         # chunk of isolated nodes only
+        v = indices_c[e0:e1]                 # first-hop targets
+        reps = cdeg[v]
+        z_u = np.repeat(ego_src[e0:e1], reps)          # origin node u
+        z_wt = np.repeat(inner_w[v], reps)             # deg(v)/|S_v|
+        # second hop: concatenate v's capped lists
+        take = np.repeat(indptr_c[v], reps) + (
+            np.arange(reps.sum(), dtype=np.int64)
+            - np.repeat(np.concatenate([[0], np.cumsum(reps[:-1])]), reps)
+        )
+        z_w = indices_c[take]
+        # membership w in S_u via the sorted ego keys
+        cand = z_u * n + z_w
+        idx = np.searchsorted(ego_keys, cand)
+        hit = (idx < ego_keys.size) & (ego_keys[np.minimum(idx, ego_keys.size - 1)] == cand)
+        np.add.at(tri_w, z_u[hit], z_wt[hit])
+    pairs = cdeg * (cdeg - 1)
+    scale = np.where(
+        pairs > 0, deg * (deg - 1) / np.maximum(pairs, 1), 0.0
+    )
+    return tri_w / 2.0 * scale
+
+
+def conductance(
+    g: Graph, backend: str = "auto", degree_cap: Optional[int] = None,
+    rng: Optional[np.random.Generator] = None,
+) -> np.ndarray:
+    """Ego-net conductance phi(u) for every node (float64).
+
+    backends: "numpy" (exact host pass), "dense" (A@A on the MXU, small
+    graphs), "sampled" (degree-capped estimator, Friendster-scale), "auto"
+    (dense if it fits; sampled when degree_cap is set and some node exceeds
+    it; exact host pass otherwise).
+    """
     deg = g.degrees
     two_e = float(g.num_directed_edges)
-    if backend == "dense" or (
+    use_sampled = backend == "sampled" or (
+        backend == "auto"
+        and degree_cap is not None
+        and deg.size > 0
+        and int(deg.max()) > degree_cap
+    )
+    if use_sampled:
+        tri = triangle_counts_sampled(g, degree_cap or 128, rng)
+    elif backend == "dense" or (
         backend == "auto"
         and 0 < g.num_nodes <= DENSE_DEVICE_MAX_NODES
         and (deg.size == 0 or int(deg.max()) <= DENSE_DEVICE_MAX_DEGREE)
@@ -115,13 +231,21 @@ def conductance(g: Graph, backend: str = "auto") -> np.ndarray:
         tri = triangle_counts(g)
     s1 = np.zeros(g.num_nodes)
     np.add.at(s1, g.src, deg[g.dst].astype(np.float64))
+    # clamp tri into its feasible range [0, (s1-deg)/2] (exact counts always
+    # satisfy it; the sampled estimator can stray and would otherwise drive
+    # cut — and phi — negative, corrupting the seed ranking)
+    tri = np.clip(tri, 0.0, np.maximum(s1 - deg, 0.0) / 2.0)
     cut = s1 - deg - 2.0 * tri
     vol_s = 2.0 * deg + 2.0 * tri
-    vol_t = two_e - vol_s - 2.0 * cut
-    phi = np.where(
-        vol_s == 0,
+    vol_t = two_e - vol_s - 2.0 * cut      # >= 0 exact; may dip below under
+    phi = np.where(                        # estimation -> treat as the
+        vol_s == 0,                        # vol_t == 0 boundary case
         0.0,
-        np.where(vol_t == 0, 1.0, cut / np.maximum(np.minimum(vol_s, vol_t), 1e-300)),
+        np.where(
+            vol_t <= 0,
+            1.0,
+            cut / np.maximum(np.minimum(vol_s, vol_t), 1e-300),
+        ),
     )
     return phi
 
@@ -191,4 +315,10 @@ def conductance_seeds(
 ) -> np.ndarray:
     """conductanceLocalMin (Bigclamv2.scala:42-59): phi + ranking in one call."""
     cfg = cfg or BigClamConfig()
-    return rank_seeds(g, conductance(g, backend=backend), cfg)
+    phi = conductance(
+        g,
+        backend=backend,
+        degree_cap=cfg.seeding_degree_cap,
+        rng=np.random.default_rng(cfg.seed),
+    )
+    return rank_seeds(g, phi, cfg)

@@ -100,3 +100,85 @@ def test_seeded_fit_beats_random_init(toy_graphs):
     left = set(res.F[:4].argmax(axis=1).tolist())
     right = set(res.F[4:].argmax(axis=1).tolist())
     assert left <= {0, 1} and right <= {2, 3}
+
+
+class TestSampledTriangles:
+    """Degree-capped conductance estimator (SURVEY.md §7 'Seeding at
+    Friendster scale'): exact when cap >= max degree, rank-preserving
+    approximation below it."""
+
+    def test_exact_when_cap_covers_max_degree(self, facebook_graph):
+        g = facebook_graph
+        exact = seeding.triangle_counts(g)
+        cap = int(g.degrees.max())
+        samp = seeding.triangle_counts_sampled(g, cap, np.random.default_rng(1))
+        np.testing.assert_allclose(samp, exact.astype(float), rtol=0, atol=1e-9)
+
+    def test_exact_small_chunks(self, toy_graphs):
+        # the NumPy fallback path, chunked: chunking must not change results
+        g = toy_graphs["two_cliques"]
+        exact = seeding.triangle_counts(g)
+        samp = seeding.triangle_counts_sampled(
+            g, 10, np.random.default_rng(0), chunk_entries=4, use_native=False
+        )
+        np.testing.assert_allclose(samp, exact.astype(float), atol=1e-9)
+
+    def test_numpy_fallback_exact_when_uncapped(self, facebook_graph):
+        g = facebook_graph
+        exact = seeding.triangle_counts(g)
+        cap = int(g.degrees.max())
+        samp = seeding.triangle_counts_sampled(
+            g, cap, np.random.default_rng(1), use_native=False
+        )
+        np.testing.assert_allclose(samp, exact.astype(float), atol=1e-9)
+
+    def test_sampled_ranking_correlates(self, facebook_graph):
+        g = facebook_graph
+        phi_exact = seeding.conductance(g, backend="numpy")
+        phi_samp = seeding.conductance(
+            g, backend="sampled", degree_cap=64, rng=np.random.default_rng(2)
+        )
+        # Spearman rank correlation over all nodes
+        def ranks(x):
+            r = np.empty_like(x)
+            r[np.argsort(x, kind="stable")] = np.arange(len(x))
+            return r
+        rx, ry = ranks(phi_exact), ranks(phi_samp)
+        rho = np.corrcoef(rx, ry)[0, 1]
+        assert rho > 0.9, rho
+
+    def test_deterministic_given_seed(self, facebook_graph):
+        g = facebook_graph
+        a = seeding.triangle_counts_sampled(g, 32, np.random.default_rng(7))
+        b = seeding.triangle_counts_sampled(g, 32, np.random.default_rng(7))
+        np.testing.assert_array_equal(a, b)
+
+    def test_auto_backend_uses_cap(self, facebook_graph):
+        cfg = BigClamConfig(seeding_degree_cap=32, num_communities=10)
+        seeds = seeding.conductance_seeds(facebook_graph, cfg)
+        assert len(np.unique(seeds)) == len(seeds) > 0
+
+    def test_sampled_phi_stays_in_domain(self):
+        # estimator noise must not push phi out of [0, 1]-ish domain
+        rng = np.random.default_rng(3)
+        n = 300
+        a = rng.random((n, n)) < 0.05
+        edges = [(i, j) for i in range(n) for j in range(i + 1, n) if a[i, j]]
+        g = graph_from_edges(edges, num_nodes=n)
+        for use_native in (True, False):
+            phi = seeding.conductance(
+                g, backend="sampled", degree_cap=4,
+                rng=np.random.default_rng(4),
+            )
+            assert (phi >= 0).all(), phi.min()
+
+    def test_chunk_of_isolated_tail_nodes(self):
+        # chunk boundary landing after the last edge-bearing node (NumPy path)
+        g = graph_from_edges(
+            [(0, 1), (0, 2), (0, 3), (1, 2), (1, 3), (2, 3)], num_nodes=6
+        )
+        out = seeding.triangle_counts_sampled(
+            g, 10, np.random.default_rng(0), chunk_entries=6, use_native=False
+        )
+        np.testing.assert_allclose(out[:4], 3.0)
+        np.testing.assert_allclose(out[4:], 0.0)
