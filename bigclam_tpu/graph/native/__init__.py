@@ -39,6 +39,25 @@ def _load() -> ctypes.CDLL:
         lib = ctypes.CDLL(_SO)
     except OSError as e:
         raise ImportError(f"cannot load {_SO}: {e}") from e
+    try:
+        return _register(lib)
+    except AttributeError:
+        # stale prebuilt .so missing a symbol (the mtime check can be fooled
+        # by copied artifacts): rebuild once, then register or give up
+        try:
+            os.remove(_SO)
+            subprocess.run(
+                ["make", "-C", _DIR], check=True, capture_output=True,
+                timeout=120,
+            )
+            return _register(ctypes.CDLL(_SO))
+        except (subprocess.SubprocessError, FileNotFoundError, OSError,
+                AttributeError) as e:
+            raise ImportError(f"stale {_SO} and rebuild failed: {e}") from e
+
+
+def _register(lib: ctypes.CDLL) -> ctypes.CDLL:
+    """Declare every exported symbol's signature (AttributeError = stale)."""
     lib.bc_parse_edge_list.restype = ctypes.POINTER(ctypes.c_int64)
     lib.bc_parse_edge_list.argtypes = [
         ctypes.c_char_p,
@@ -53,20 +72,15 @@ def _load() -> ctypes.CDLL:
         ctypes.c_int64,
         ctypes.POINTER(ctypes.c_int64),
     ]
-    try:
-        lib.bc_triangle_counts_capped.restype = None
-        lib.bc_triangle_counts_capped.argtypes = [
-            ctypes.POINTER(ctypes.c_int64),
-            ctypes.POINTER(ctypes.c_int32),
-            ctypes.c_int64,
-            ctypes.c_int64,
-            ctypes.c_uint64,
-            ctypes.POINTER(ctypes.c_double),
-        ]
-    except AttributeError as e:
-        # stale prebuilt .so missing the symbol (mtime check can be fooled by
-        # copies): degrade to the NumPy fallbacks, as the module promises
-        raise ImportError(f"stale {_SO}: {e}") from e
+    lib.bc_triangle_counts_capped.restype = None
+    lib.bc_triangle_counts_capped.argtypes = [
+        ctypes.POINTER(ctypes.c_int64),
+        ctypes.POINTER(ctypes.c_int32),
+        ctypes.c_int64,
+        ctypes.c_int64,
+        ctypes.c_uint64,
+        ctypes.POINTER(ctypes.c_double),
+    ]
     return lib
 
 

@@ -62,31 +62,37 @@ class BlockTiles(NamedTuple):
         return self.src_local.size - int(self.mask.sum())
 
 
-def build_block_tiles(g: Graph, block_b: int = 512, tile_t: int = 512) -> BlockTiles:
-    """Tile the graph's CSR edge ranges by node block.
+def build_block_tiles_arrays(
+    src: np.ndarray,
+    dst: np.ndarray,
+    num_nodes: int,
+    block_b: int,
+    tile_t: int,
+) -> BlockTiles:
+    """Tile src-sorted directed-edge arrays by node block (core builder).
 
     Every node block gets at least one tile (possibly all-padding) so the
-    kernels visit — and zero-initialize — every output block.
+    kernels visit — and zero-initialize — every output block. `num_nodes`
+    may exceed max(src)+1 (trailing isolated/padding rows get empty tiles).
     """
     assert block_b >= 1 and tile_t >= 1
-    n = g.num_nodes
+    n = num_nodes
     n_blocks = max(-(-n // block_b), 1)
-    indptr = np.asarray(g.indptr, np.int64)
-    src = np.asarray(g.src, np.int32)
-    dst = np.asarray(g.dst, np.int32)
+    src = np.asarray(src, np.int32)
+    dst = np.asarray(dst, np.int32)
 
     # vectorized layout (no per-block Python work — Friendster-scale graphs
     # have hundreds of thousands of blocks): every block's CSR edge range is
     # laid into its own ntile*T slot span; edges land at
     #   slot = span_start[block] + (edge_index - block_edge_start)
-    block_edge_start = indptr[np.minimum(np.arange(n_blocks) * block_b, n)]
-    block_edge_end = indptr[np.minimum((np.arange(n_blocks) + 1) * block_b, n)]
+    block_edge_start = np.searchsorted(src, np.arange(n_blocks) * block_b)
+    block_edge_end = np.searchsorted(src, (np.arange(n_blocks) + 1) * block_b)
     counts = block_edge_end - block_edge_start
     ntiles = np.maximum(-(-counts // tile_t), 1)
     span_start = np.concatenate([[0], np.cumsum(ntiles * tile_t)])
     total = int(span_start[-1])
 
-    blk_of_edge = src // block_b
+    blk_of_edge = src.astype(np.int64) // block_b
     slot = (
         span_start[blk_of_edge]
         + np.arange(src.shape[0], dtype=np.int64)
@@ -110,4 +116,86 @@ def build_block_tiles(g: Graph, block_b: int = 512, tile_t: int = 512) -> BlockT
         block_b=block_b,
         tile_t=tile_t,
         n_blocks=n_blocks,
+    )
+
+
+def build_block_tiles(g: Graph, block_b: int = 512, tile_t: int = 512) -> BlockTiles:
+    """Tile the graph's CSR edge ranges by node block."""
+    return build_block_tiles_arrays(g.src, g.dst, g.num_nodes, block_b, tile_t)
+
+
+class ShardedBlockTiles(NamedTuple):
+    """Per-shard tile layouts, stacked on a leading shard axis (equal tile
+    counts across shards — shard_map runs one SPMD program).
+
+    src_local: (dp, n_tiles, T) int32 — src relative to the TILE'S BLOCK,
+               blocks counted within the shard
+    dst:       (dp, n_tiles, T) int32 — GLOBAL dst (gathered from the
+               all-gathered F)
+    mask:      (dp, n_tiles, T) float32
+    block_id:  (dp, n_tiles)    int32 — shard-local block index
+    """
+
+    src_local: np.ndarray
+    dst: np.ndarray
+    mask: np.ndarray
+    block_id: np.ndarray
+    block_b: int
+    tile_t: int
+    n_blocks: int            # per shard
+    shard_rows: int
+
+    @property
+    def n_tiles(self) -> int:
+        return self.src_local.shape[1]
+
+
+def shard_block_tiles(
+    g: Graph, dp: int, n_pad: int, block_b: int, tile_t: int
+) -> ShardedBlockTiles:
+    """Build each node shard's block-tile layout (src rebased shard-local,
+    dst global), padded with all-masked tiles to the max shard tile count.
+
+    n_pad must be a multiple of dp * block_b.
+    """
+    assert n_pad % dp == 0 and (n_pad // dp) % block_b == 0, (n_pad, dp, block_b)
+    shard_rows = n_pad // dp
+    bounds = np.searchsorted(g.src, np.arange(0, n_pad + shard_rows, shard_rows))
+    parts = []
+    for i in range(dp):
+        lo, hi = bounds[i], bounds[i + 1]
+        parts.append(
+            build_block_tiles_arrays(
+                g.src[lo:hi] - i * shard_rows,
+                g.dst[lo:hi],
+                shard_rows,
+                block_b,
+                tile_t,
+            )
+        )
+    n_tiles = max(p.n_tiles for p in parts)
+    n_blocks = parts[0].n_blocks
+
+    def pad_stack(field: str, fill):
+        outs = []
+        for p in parts:
+            a = getattr(p, field)
+            pad = n_tiles - a.shape[0]
+            if pad:
+                shape = (pad,) + a.shape[1:]
+                filler = np.full(shape, fill, a.dtype)
+                a = np.concatenate([a, filler])
+            outs.append(a)
+        return np.stack(outs)
+
+    return ShardedBlockTiles(
+        src_local=pad_stack("src_local", 0),
+        dst=pad_stack("dst", 0),
+        mask=pad_stack("mask", 0.0),
+        # padding tiles attach to the last block (valid id, zero mask)
+        block_id=pad_stack("block_id", n_blocks - 1),
+        block_b=block_b,
+        tile_t=tile_t,
+        n_blocks=n_blocks,
+        shard_rows=shard_rows,
     )

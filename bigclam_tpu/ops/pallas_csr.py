@@ -94,6 +94,17 @@ def csr_tiles_supported(
     )
 
 
+def _out_struct(shape, dtype, *operands) -> jax.ShapeDtypeStruct:
+    """Output spec carrying the union of the operands' varying-mesh-axes
+    (vma) types — required when the kernels run inside jax.shard_map."""
+    vma = frozenset().union(
+        *(getattr(jax.typeof(x), "vma", frozenset()) for x in operands)
+    )
+    if vma:
+        return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
+    return jax.ShapeDtypeStruct(shape, dtype)
+
+
 def _first_tile_of_block(bid_ref, i):
     prev = bid_ref[jnp.maximum(i - 1, 0)]
     return jnp.logical_or(i == 0, bid_ref[i] != prev)
@@ -221,8 +232,8 @@ def grad_llh_csr(
         kernel,
         grid_spec=grid_spec,
         out_shape=[
-            jax.ShapeDtypeStruct((tiles.n_blocks, b, k), F.dtype),
-            jax.ShapeDtypeStruct((tiles.n_blocks, 1, b), F.dtype),
+            _out_struct((tiles.n_blocks, b, k), F.dtype, F, fd, tiles.mask),
+            _out_struct((tiles.n_blocks, 1, b), F.dtype, F, fd, tiles.mask),
         ],
         interpret=interpret,
     )(tiles.block_id, tiles.src_local, tiles.mask, fd, F)
@@ -272,7 +283,9 @@ def candidates_csr(
     out = pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct((tiles.n_blocks, num_s, b), F.dtype),
+        out_shape=_out_struct(
+            (tiles.n_blocks, num_s, b), F.dtype, F, grad, fd, tiles.mask, sumF
+        ),
         interpret=interpret,
     )(
         tiles.block_id, tiles.src_local, tiles.mask, fd, F, grad,

@@ -227,6 +227,17 @@ class RingBigClamModel(ShardedBigClamModel):
     """Sharded trainer using the ring-pass schedule (same API/trajectories
     as ShardedBigClamModel; different memory/communication profile)."""
 
+    def _csr_static_ok(self, tp: int) -> bool:
+        # the ring schedule rotates F shards; the blocked-CSR kernels assume
+        # an all-gathered F — not applicable here (future work, PARITY.md)
+        if self.cfg.use_pallas_csr is True:
+            raise ValueError(
+                "use_pallas_csr=True is not supported on the ring schedule "
+                "(the kernels need an all-gathered F); use "
+                "ShardedBigClamModel or leave use_pallas_csr unset"
+            )
+        return False
+
     def _build_edges_and_step(self) -> None:
         dp = self.mesh.shape[NODES_AXIS]
         tp = self.mesh.shape[K_AXIS]

@@ -98,6 +98,79 @@ def _mark_varying(x: jax.Array, axes: tuple) -> jax.Array:
     return lax.pcast(x, missing, to="varying") if missing else x
 
 
+def make_sharded_csr_train_step(
+    mesh: Mesh, tiles, cfg: BigClamConfig
+) -> Callable[[TrainState], TrainState]:
+    """Sharded iteration on the blocked-CSR MXU kernels (ops.pallas_csr).
+
+    DP-only (the K axis must be unsharded per device: the kernels' in-VMEM
+    edge dots cannot psum mid-kernel). Each shard all-gathers F, gathers its
+    tiles' dst rows ONCE (shared by both kernels), and runs the same two
+    Pallas kernels as the single-chip path over its shard-local tile layout
+    (ops.csr_tiles.shard_block_tiles); LLH and sumF are psums.
+    `tiles` is a dict of device arrays + static fields built by
+    ShardedBigClamModel._build_edges_and_step.
+    """
+    from bigclam_tpu.ops.linesearch import armijo_select
+    from bigclam_tpu.ops.pallas_csr import (
+        TilesDev,
+        candidates_csr,
+        grad_llh_csr,
+    )
+
+    interp = cfg.pallas_interpret
+    block_b = tiles["block_b"]
+    tile_t = tiles["tile_t"]
+    n_blocks = tiles["n_blocks"]
+
+    def step_shard(F_loc, srcl, dst, mask, bid, it):
+        srcl, dst, mask, bid = srcl[0], dst[0], mask[0], bid[0]
+        td = TilesDev(
+            src_local=srcl, dst=dst, mask=mask, block_id=bid,
+            block_b=block_b, tile_t=tile_t, n_blocks=n_blocks,
+        )
+        F_full = lax.all_gather(F_loc, NODES_AXIS, axis=0, tiled=True)
+        sumF = lax.psum(F_loc.sum(axis=0), NODES_AXIS)
+        fd = jnp.take(F_full, td.dst, axis=0)
+        grad, node_llh = grad_llh_csr(
+            F_loc, sumF, td, cfg, fd=fd, interpret=interp
+        )
+        llh_cur = lax.psum(node_llh.sum(), NODES_AXIS)
+        cand_full = candidates_csr(
+            F_loc, grad, sumF, td, cfg, fd=fd, interpret=interp
+        )
+        F_new, sum_loc = armijo_select(F_loc, grad, node_llh, cand_full, cfg)
+        sumF_new = lax.psum(sum_loc, NODES_AXIS)
+        return F_new, sumF_new, llh_cur.astype(F_loc.dtype), it + 1
+
+    def step(state: TrainState) -> TrainState:
+        # check_vma=False: pallas_call's interpret-mode lowering mixes
+        # varying (scalar-prefetched block ids) and replicated operands in
+        # dynamic_slice, which the VMA type check cannot express yet; the
+        # XLA sharded step keeps the checked path and the equivalence tests
+        # (tests/test_pallas_csr.py::TestShardedCSR) pin the semantics
+        F_new, sumF, llh, it = jax.shard_map(
+            step_shard,
+            mesh=mesh,
+            in_specs=(
+                P(NODES_AXIS, K_AXIS),
+                P(NODES_AXIS, None, None, None),
+                P(NODES_AXIS, None, None),
+                P(NODES_AXIS, None, None, None),
+                P(NODES_AXIS, None),
+                P(),
+            ),
+            out_specs=(P(NODES_AXIS, K_AXIS), P(K_AXIS), P(), P()),
+            check_vma=False,
+        )(
+            state.F, tiles["src_local"], tiles["dst"], tiles["mask"],
+            tiles["block_id"], state.it,
+        )
+        return TrainState(F=F_new, sumF=sumF, llh=llh, it=it)
+
+    return jax.jit(step)
+
+
 def make_sharded_train_step(
     mesh: Mesh, edges: EdgeChunks, cfg: BigClamConfig
 ) -> Callable[[TrainState], TrainState]:
@@ -246,6 +319,16 @@ class ShardedBigClamModel:
             raise ValueError("sharded padding requires min_f == 0.0")
         self.n_pad = _round_up(max(g.num_nodes, dp), dp)
         self.k_pad = _round_up(cfg.num_communities, tp)
+        self._csr_wanted = self._csr_static_ok(tp) and self._csr_economy_ok(dp)
+        if self._csr_wanted:
+            # blocked-CSR kernel layout: shards hold whole node blocks and
+            # K rides the 128-lane MXU tiling (padding rows/cols are inert).
+            # Committed only now — the economy probe above already accepted
+            # the layout, so the XLA fallback never sees inflated padding.
+            self.n_pad = _round_up(
+                max(g.num_nodes, dp), dp * cfg.csr_block_b
+            )
+            self.k_pad = _round_up(self.k_pad, 128)
         # degree-balanced relabeling (parallel/balance.py): the trainer runs
         # on the relabeled graph; F0 in / results out stay in original ids
         self._perm = None
@@ -267,9 +350,102 @@ class ShardedBigClamModel:
         """Trainer row order -> original ids (inverse of _to_internal_rows)."""
         return F if self._perm is None else F[self._perm]
 
+    def _csr_static_ok(self, tp: int) -> bool:
+        """Static engagement check for the blocked-CSR sharded step (the
+        economy checks that need the built tiles live in _build_csr_step)."""
+        from bigclam_tpu.ops.pallas_csr import csr_tiles_supported
+
+        cfg = self.cfg
+        want = cfg.use_pallas_csr
+        if want is None:
+            want = jax.default_backend() == "tpu" or cfg.pallas_interpret
+        if not want:
+            return False
+        ok = (
+            tp == 1
+            and self.dtype == jnp.float32
+            and cfg.accum_dtype in (None, "float32")
+            and csr_tiles_supported(
+                cfg.csr_block_b,
+                cfg.csr_tile_t,
+                _round_up(self.k_pad, 128),
+                cfg.pallas_interpret,
+            )
+        )
+        if not ok and cfg.use_pallas_csr is True:
+            raise ValueError(
+                "use_pallas_csr=True on the sharded trainer requires an "
+                "unsharded K axis (tp == 1), float32 F/accum, and 128-"
+                f"multiple block_b/tile_t/k_pad; got tp={tp}, "
+                f"dtype={self.dtype}, block_b={cfg.csr_block_b}, "
+                f"tile_t={cfg.csr_tile_t}"
+            )
+        return ok
+
+    def _csr_economy_ok(self, dp: int) -> bool:
+        """Probe the tile layout's padding/memory economy BEFORE committing
+        the CSR paddings (runs on the pre-balance graph — balancing only
+        evens the layout further). Raises when use_pallas_csr=True."""
+        from bigclam_tpu.ops.csr_tiles import shard_block_tiles
+
+        cfg = self.cfg
+        n_pad = _round_up(
+            max(self.g.num_nodes, dp), dp * cfg.csr_block_b
+        )
+        k_pad = _round_up(self.k_pad, 128)
+        sbt = shard_block_tiles(
+            self.g, dp, n_pad, cfg.csr_block_b, cfg.csr_tile_t
+        )
+        slots = sbt.src_local.size               # dp * n_tiles * T
+        e = max(self.g.num_directed_edges, 1)
+        fd_bytes = sbt.n_tiles * cfg.csr_tile_t * k_pad * 4      # per shard
+        pad_ok = slots <= 1.5 * e + dp * sbt.n_blocks * cfg.csr_tile_t
+        if pad_ok and fd_bytes <= (2 << 30):
+            return True
+        if cfg.use_pallas_csr is True:
+            raise ValueError(
+                f"use_pallas_csr=True but sharded layout uneconomical: "
+                f"{slots - e} padded edge slots on {e}, per-shard fd "
+                f"gather {fd_bytes >> 20} MiB (power-law skew? try "
+                "balance=True or the ring trainer)"
+            )
+        return False
+
+    def _build_csr_step(self, dp: int) -> None:
+        """Build shard tiles + the CSR train step (engagement already
+        decided by _csr_static_ok + _csr_economy_ok)."""
+        from bigclam_tpu.ops.csr_tiles import shard_block_tiles
+
+        cfg = self.cfg
+        sbt = shard_block_tiles(
+            self.g, dp, self.n_pad, cfg.csr_block_b, cfg.csr_tile_t
+        )
+        dp_, nt, t = sbt.src_local.shape
+        spec4 = NamedSharding(self.mesh, P(NODES_AXIS, None, None, None))
+        spec3 = NamedSharding(self.mesh, P(NODES_AXIS, None, None))
+        spec2 = NamedSharding(self.mesh, P(NODES_AXIS, None))
+        tiles = {
+            "src_local": put_sharded(
+                sbt.src_local.reshape(dp_, nt, 1, t).astype(np.int32), spec4
+            ),
+            "dst": put_sharded(sbt.dst.astype(np.int32), spec3),
+            "mask": put_sharded(
+                sbt.mask.reshape(dp_, nt, 1, t).astype(self.dtype), spec4
+            ),
+            "block_id": put_sharded(sbt.block_id.astype(np.int32), spec2),
+            "block_b": sbt.block_b,
+            "tile_t": sbt.tile_t,
+            "n_blocks": sbt.n_blocks,
+        }
+        self.edges = None                        # not used by the CSR step
+        self._step = make_sharded_csr_train_step(self.mesh, tiles, self.cfg)
+
     def _build_edges_and_step(self) -> None:
         dp = self.mesh.shape[NODES_AXIS]
         tp = self.mesh.shape[K_AXIS]
+        if self._csr_wanted:
+            self._build_csr_step(dp)
+            return
         bound = edge_chunk_bound(
             self.cfg, max(self.k_pad // tp, 1), self.dtype
         )
