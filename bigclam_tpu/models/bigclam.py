@@ -433,7 +433,11 @@ class BigClamModel:
         bt = build_block_tiles(self.g, cfg.csr_block_b, cfg.csr_tile_t)
         fd_bytes = bt.src_local.size * k_pad * 4
         e = max(self.g.num_directed_edges, 1)
-        pad_ok = bt.src_local.size <= 1.5 * e + bt.n_blocks * cfg.csr_tile_t
+        from bigclam_tpu.ops.csr_tiles import layout_economical
+
+        pad_ok = layout_economical(
+            bt.src_local.size, e, bt.n_blocks, cfg.csr_tile_t
+        )
         if not (pad_ok and fd_bytes <= (2 << 30)):
             if explicit:
                 raise ValueError(

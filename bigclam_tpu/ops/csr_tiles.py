@@ -124,6 +124,21 @@ def build_block_tiles(g: Graph, block_b: int = 512, tile_t: int = 512) -> BlockT
     return build_block_tiles_arrays(g.src, g.dst, g.num_nodes, block_b, tile_t)
 
 
+def layout_economical(
+    slots: int, num_directed_edges: int, n_blocks_total: int, tile_t: int
+) -> bool:
+    """Shared padding-economy policy for the CSR kernel layouts (single-chip
+    AND sharded — keep ONE formula): per-step kernel work scales with slot
+    count, so a layout is accepted when padding stays within ~50% of the
+    edges plus one tile per block, OR — for small graphs, where absolute
+    waste is trivial (toy/dryrun meshes) — within a 4x ratio capped at 1M
+    absolute slots."""
+    e = max(num_directed_edges, 1)
+    return slots <= max(
+        1.5 * e + n_blocks_total * tile_t, min(1 << 20, 4 * e)
+    )
+
+
 class ShardedBlockTiles(NamedTuple):
     """Per-shard tile layouts, stacked on a leading shard axis (equal tile
     counts across shards — shard_map runs one SPMD program).

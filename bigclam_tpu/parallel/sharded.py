@@ -386,7 +386,10 @@ class ShardedBigClamModel:
         """Probe the tile layout's padding/memory economy BEFORE committing
         the CSR paddings (runs on the pre-balance graph — balancing only
         evens the layout further). Raises when use_pallas_csr=True."""
-        from bigclam_tpu.ops.csr_tiles import shard_block_tiles
+        from bigclam_tpu.ops.csr_tiles import (
+            layout_economical,
+            shard_block_tiles,
+        )
 
         cfg = self.cfg
         n_pad = _round_up(
@@ -399,8 +402,13 @@ class ShardedBigClamModel:
         slots = sbt.src_local.size               # dp * n_tiles * T
         e = max(self.g.num_directed_edges, 1)
         fd_bytes = sbt.n_tiles * cfg.csr_tile_t * k_pad * 4      # per shard
-        pad_ok = slots <= 1.5 * e + dp * sbt.n_blocks * cfg.csr_tile_t
+        pad_ok = layout_economical(
+            slots, e, dp * sbt.n_blocks, cfg.csr_tile_t
+        )
         if pad_ok and fd_bytes <= (2 << 30):
+            # reuse the probe's layout in _build_csr_step unless balancing
+            # relabels the graph in between (the only thing that changes it)
+            self._probe_tiles = sbt
             return True
         if cfg.use_pallas_csr is True:
             raise ValueError(
@@ -417,9 +425,12 @@ class ShardedBigClamModel:
         from bigclam_tpu.ops.csr_tiles import shard_block_tiles
 
         cfg = self.cfg
-        sbt = shard_block_tiles(
-            self.g, dp, self.n_pad, cfg.csr_block_b, cfg.csr_tile_t
-        )
+        sbt = getattr(self, "_probe_tiles", None)
+        self._probe_tiles = None
+        if sbt is None or self._perm is not None:
+            sbt = shard_block_tiles(
+                self.g, dp, self.n_pad, cfg.csr_block_b, cfg.csr_tile_t
+            )
         dp_, nt, t = sbt.src_local.shape
         spec4 = NamedSharding(self.mesh, P(NODES_AXIS, None, None, None))
         spec3 = NamedSharding(self.mesh, P(NODES_AXIS, None, None))
