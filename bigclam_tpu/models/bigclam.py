@@ -47,6 +47,12 @@ class FitResult:
     llh_history: tuple
 
 
+# whole-graph dst-gather budget for the flat CSR layout, and the per-group
+# gather budget for the grouped (large-K) layout
+FLAT_FD_BUDGET = 2 << 30
+GROUP_FD_BUDGET = 512 << 20
+
+
 def _round_up(x: int, m: int) -> int:
     return ((x + m - 1) // m) * m
 
@@ -248,23 +254,33 @@ def make_train_step(
     if tiles is not None:
         from bigclam_tpu.ops.linesearch import armijo_select
         from bigclam_tpu.ops.pallas_csr import (
+            GroupedTilesDev,
             candidates_csr,
             gather_dst_rows,
             grad_llh_csr,
+            train_pass_csr_grouped,
         )
 
         interp = cfg.pallas_interpret
+        grouped = isinstance(tiles, GroupedTilesDev)
 
         def csr_step(state: TrainState) -> TrainState:
             F, sumF = state.F, state.sumF
-            fd = gather_dst_rows(F, tiles)
-            grad, node_llh = grad_llh_csr(
-                F, sumF, tiles, cfg, fd=fd, interpret=interp
-            )
+            if grouped:
+                # large-K layout: ONE scan over block groups, each group's
+                # dst gather shared by its grad and candidate kernels
+                grad, node_llh, cand_full = train_pass_csr_grouped(
+                    F, sumF, tiles, cfg, interpret=interp
+                )
+            else:
+                fd = gather_dst_rows(F, tiles)
+                grad, node_llh = grad_llh_csr(
+                    F, sumF, tiles, cfg, fd=fd, interpret=interp
+                )
+                cand_full = candidates_csr(
+                    F, grad, sumF, tiles, cfg, fd=fd, interpret=interp
+                )
             llh_cur = node_llh.sum()
-            cand_full = candidates_csr(
-                F, grad, sumF, tiles, cfg, fd=fd, interpret=interp
-            )
             F_new, sumF_new = armijo_select(F, grad, node_llh, cand_full, cfg)
             return TrainState(
                 F=F_new, sumF=sumF_new, llh=llh_cur, it=state.it + 1
@@ -333,8 +349,12 @@ class BigClamModel:
         self._tiles = self._maybe_build_tiles(node_multiple)
         if self._tiles is not None:
             # the CSR kernels never read the EdgeChunks arrays — defer their
-            # (device-resident) construction so HBM holds only the tiles
-            self._node_multiple = _lcm(node_multiple, cfg.csr_block_b)
+            # (device-resident) construction so HBM holds only the tiles.
+            # _node_multiple_csr (set by _maybe_build_tiles) makes the lazy
+            # EdgeChunks padding agree with the tile layout's n_pad
+            self._node_multiple = _lcm(
+                node_multiple, self._node_multiple_csr
+            )
             self._edges = None
             self.n_pad = self._tiles.n_pad
         else:
@@ -398,8 +418,24 @@ class BigClamModel:
         # committed to self.k_pad once the path actually engages.
         k_pad = _round_up(self.k_pad, 128)
         n = self.g.num_nodes
+        from bigclam_tpu.ops.pallas_csr import fit_tile_shape
+
+        shape = (
+            fit_tile_shape(cfg.csr_block_b, cfg.csr_tile_t, k_pad)
+            if not cfg.pallas_interpret
+            else (cfg.csr_block_b, cfg.csr_tile_t)
+        )
+        if shape is None:
+            # kernels cannot fit VMEM at this K — XLA path (or shard K)
+            if explicit:
+                raise ValueError(
+                    f"use_pallas_csr=True but no tile shape fits VMEM at "
+                    f"k_pad={k_pad}; shard the K axis instead"
+                )
+            return None
+        block_b, tile_t = shape
         if not csr_tiles_supported(
-            cfg.csr_block_b, cfg.csr_tile_t, k_pad, cfg.pallas_interpret
+            block_b, tile_t, k_pad, cfg.pallas_interpret
         ):
             if explicit:
                 raise ValueError(
@@ -409,7 +445,7 @@ class BigClamModel:
                 )
             return None
         if cfg.min_f != 0.0 and (
-            _round_up(n, cfg.csr_block_b) != n or k_pad != cfg.num_communities
+            _round_up(n, block_b) != n or k_pad != cfg.num_communities
         ):
             # padding inertness needs min_f == 0 (see __init__'s guard);
             # auto mode degrades to the XLA path instead of raising there
@@ -419,35 +455,72 @@ class BigClamModel:
                     f"padding is introduced (min_f={cfg.min_f})"
                 )
             return None
-        if _round_up(n, _lcm(node_multiple, cfg.csr_block_b)) != _round_up(
-            n, cfg.csr_block_b
+        if _round_up(n, _lcm(node_multiple, block_b)) != _round_up(
+            n, block_b
         ):
             # caller's node_multiple would pad rows beyond the tile layout's
             # n_pad = n_blocks * block_b
             if explicit:
                 raise ValueError(
                     f"use_pallas_csr=True incompatible with "
-                    f"node_multiple={node_multiple} (block_b={cfg.csr_block_b})"
+                    f"node_multiple={node_multiple} (block_b={block_b})"
                 )
             return None
-        bt = build_block_tiles(self.g, cfg.csr_block_b, cfg.csr_tile_t)
+        from bigclam_tpu.ops.csr_tiles import group_tiles, layout_economical
+
+        bt = build_block_tiles(self.g, block_b, tile_t)
         fd_bytes = bt.src_local.size * k_pad * 4
         e = max(self.g.num_directed_edges, 1)
-        from bigclam_tpu.ops.csr_tiles import layout_economical
-
         pad_ok = layout_economical(
-            bt.src_local.size, e, bt.n_blocks, cfg.csr_tile_t
+            bt.src_local.size, e, bt.n_blocks, tile_t
         )
-        if not (pad_ok and fd_bytes <= (2 << 30)):
+        if not pad_ok:
             if explicit:
                 raise ValueError(
                     f"use_pallas_csr=True but layout uneconomical: "
-                    f"{bt.padded_edges} padded edges on {e}, "
-                    f"fd gather {fd_bytes >> 20} MiB"
+                    f"{bt.padded_edges} padded edges on {e}"
                 )
             return None
+        if fd_bytes <= FLAT_FD_BUDGET:
+            self.k_pad = k_pad
+            self._node_multiple_csr = bt.n_blocks * bt.block_b
+            return device_tiles(bt, self.dtype)
+        # large K: one whole-graph dst gather would blow HBM — regroup into
+        # block windows scanned with per-group gathers (GROUP_FD_BUDGET each)
+        group_budget = GROUP_FD_BUDGET
+        tiles_per_group = max(
+            group_budget // (tile_t * k_pad * 4), 1
+        )
+        avg_tiles = max(bt.src_local.shape[0] / bt.n_blocks, 1e-9)
+        nb = max(int(tiles_per_group / avg_tiles), 1)
+        gbt = group_tiles(bt, nb)
+        while (
+            nb > 1
+            and gbt.src_local.shape[1] * tile_t * k_pad * 4
+            > 2 * group_budget
+        ):
+            nb = max(nb // 2, 1)
+            gbt = group_tiles(bt, nb)
+        group_fd = gbt.src_local.shape[1] * tile_t * k_pad * 4
+        ok = (
+            layout_economical(gbt.slots, e, gbt.n_groups * gbt.nb, tile_t)
+            and gbt.n_pad % max(node_multiple, 1) == 0
+            # even at nb=1 a single hub block can exceed the budget: that
+            # gather would OOM at runtime, so refuse here
+            and group_fd <= 4 * group_budget
+        )
+        if not ok:
+            if explicit:
+                raise ValueError(
+                    f"use_pallas_csr=True but grouped layout uneconomical: "
+                    f"{gbt.slots - e} padded slots on {e} (nb={nb})"
+                )
+            return None
+        from bigclam_tpu.ops.pallas_csr import device_grouped_tiles
+
         self.k_pad = k_pad
-        return device_tiles(bt, self.dtype)
+        self._node_multiple_csr = gbt.n_pad
+        return device_grouped_tiles(gbt, self.dtype)
 
     def init_state(self, F0: np.ndarray) -> TrainState:
         n, k = self.g.num_nodes, self.cfg.num_communities

@@ -139,6 +139,87 @@ def layout_economical(
     )
 
 
+class GroupedBlockTiles(NamedTuple):
+    """Block tiles regrouped into uniform scan windows for large-K runs.
+
+    When the whole-graph dst-row gather exceeds the HBM budget, the step
+    scans over groups of NB consecutive blocks, gathering only that group's
+    (G, T, K) dst rows per scan iteration. Tile counts are padded to the
+    max group (G) so one compiled kernel serves every group.
+
+    src_local: (n_groups, G, T) int32 — src relative to the tile's block
+    dst:       (n_groups, G, T) int32 — global dst
+    mask:      (n_groups, G, T) float32
+    block_id:  (n_groups, G)    int32 — block index LOCAL TO THE GROUP [0, NB)
+    """
+
+    src_local: np.ndarray
+    dst: np.ndarray
+    mask: np.ndarray
+    block_id: np.ndarray
+    block_b: int
+    tile_t: int
+    nb: int                  # blocks per group
+    n_groups: int
+
+    @property
+    def n_pad(self) -> int:
+        return self.n_groups * self.nb * self.block_b
+
+    @property
+    def slots(self) -> int:
+        return self.src_local.size
+
+
+def group_tiles(bt: BlockTiles, nb: int) -> GroupedBlockTiles:
+    """Regroup a flat BlockTiles layout into windows of `nb` whole blocks.
+
+    The block count is padded up to a multiple of nb with phantom empty
+    blocks (one all-masked tile each — the kernels must zero-init every
+    output block); group tile counts are padded to the global max G with
+    all-masked tiles attached to the group's last block (ordering keeps the
+    first-tile-of-block accumulation flags correct).
+    """
+    assert nb >= 1
+    n_blocks_pad = -(-bt.n_blocks // nb) * nb
+    t = bt.tile_t
+    # per-block tile counts (every block has >= 1 by construction)
+    counts = np.bincount(bt.block_id, minlength=n_blocks_pad)
+    counts[bt.n_blocks:] = 1                    # phantom blocks: 1 empty tile
+    starts = np.concatenate([[0], np.cumsum(counts[: bt.n_blocks])])
+    n_groups = n_blocks_pad // nb
+    g_tiles = counts.reshape(n_groups, nb).sum(axis=1)
+    g_max = int(g_tiles.max())
+
+    src = np.zeros((n_groups, g_max, t), np.int32)
+    dst = np.zeros((n_groups, g_max, t), np.int32)
+    mask = np.zeros((n_groups, g_max, t), np.float32)
+    bid = np.full((n_groups, g_max), nb - 1, np.int32)
+    for gi in range(n_groups):
+        b_lo = gi * nb
+        b_hi = min(b_lo + nb, bt.n_blocks)
+        cnt = 0
+        if b_lo < bt.n_blocks:
+            e0, e1 = starts[b_lo], starts[b_hi]
+            cnt = e1 - e0
+            src[gi, :cnt] = bt.src_local[e0:e1]
+            dst[gi, :cnt] = bt.dst[e0:e1]
+            mask[gi, :cnt] = bt.mask[e0:e1]
+            bid[gi, :cnt] = bt.block_id[e0:e1] - b_lo
+        # every phantom block gets one empty tile so its output block is
+        # visited (and zero-initialized); remaining padding rides the last
+        # block, keeping block_id non-decreasing within the group
+        n_phantom = nb - (b_hi - b_lo)
+        if n_phantom:
+            bid[gi, cnt : cnt + n_phantom] = np.arange(
+                b_hi - b_lo, nb, dtype=np.int32
+            )
+    return GroupedBlockTiles(
+        src_local=src, dst=dst, mask=mask, block_id=bid,
+        block_b=bt.block_b, tile_t=t, nb=nb, n_groups=n_groups,
+    )
+
+
 class ShardedBlockTiles(NamedTuple):
     """Per-shard tile layouts, stacked on a leading shard axis (equal tile
     counts across shards — shard_map runs one SPMD program).

@@ -27,7 +27,7 @@ reference Bigclamv2.scala:121-146); tests compare both in interpret mode.
 from __future__ import annotations
 
 import functools
-from typing import NamedTuple, Tuple
+from typing import NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -77,6 +77,31 @@ def device_tiles(bt: BlockTiles, dtype=jnp.float32) -> TilesDev:
         tile_t=bt.tile_t,
         n_blocks=bt.n_blocks,
     )
+
+
+# conservative per-kernel VMEM budget: the candidate kernel holds ~6 (T, K)
+# streams (fd double-buffered, fs/gs expansions, nf temp), ~3 (B, K) blocks
+# (F, grad, output) and the (B, T) one-hot live at once; v5e VMEM is 16 MiB
+VMEM_BUDGET = 12 << 20
+
+
+def fit_tile_shape(
+    block_b: int, tile_t: int, k_pad: int
+) -> Optional[Tuple[int, int]]:
+    """Shrink (block_b, tile_t) — halving, floor 128 — until the kernels'
+    VMEM working set fits. None = not fittable at this k_pad (fall back to
+    the XLA path or shard K)."""
+
+    def est(b: int, t: int) -> int:
+        return (6 * t * k_pad + 3 * b * k_pad + 2 * b * t) * 4
+
+    b, t = block_b, tile_t
+    while est(b, t) > VMEM_BUDGET and max(b, t) > 128:
+        if t >= b and t > 128:
+            t //= 2
+        else:
+            b //= 2
+    return (b, t) if est(b, t) <= VMEM_BUDGET else None
 
 
 def csr_tiles_supported(
@@ -193,6 +218,44 @@ def gather_dst_rows(F: jax.Array, tiles: TilesDev) -> jax.Array:
     return jnp.take(F, tiles.dst, axis=0)
 
 
+def _grad_blocks(
+    F: jax.Array,
+    tiles: TilesDev,
+    cfg: BigClamConfig,
+    fd: jax.Array,
+    interpret: bool,
+) -> Tuple[jax.Array, jax.Array]:
+    """Raw per-block kernel outputs: (n_blocks, B, K) neighbor-gradient
+    partials and (n_blocks, 1, B) neighbor-LLH partials (no tail terms)."""
+    k = F.shape[1]
+    b, t = tiles.block_b, tiles.tile_t
+    n_tiles = tiles.src_local.shape[0]
+    kernel = functools.partial(_grad_kernel, cfg=cfg, block_b=b)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(n_tiles,),
+        in_specs=[
+            pl.BlockSpec((1, 1, t), lambda i, bid: (i, 0, 0)),
+            pl.BlockSpec((1, 1, t), lambda i, bid: (i, 0, 0)),
+            pl.BlockSpec((1, t, k), lambda i, bid: (i, 0, 0)),
+            pl.BlockSpec((b, k), lambda i, bid: (bid[i], 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, b, k), lambda i, bid: (bid[i], 0, 0)),
+            pl.BlockSpec((1, 1, b), lambda i, bid: (bid[i], 0, 0)),
+        ],
+    )
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=[
+            _out_struct((tiles.n_blocks, b, k), F.dtype, F, fd, tiles.mask),
+            _out_struct((tiles.n_blocks, 1, b), F.dtype, F, fd, tiles.mask),
+        ],
+        interpret=interpret,
+    )(tiles.block_id, tiles.src_local, tiles.mask, fd, F)
+
+
 def grad_llh_csr(
     F: jax.Array,
     sumF: jax.Array,
@@ -211,9 +274,34 @@ def grad_llh_csr(
     assert n_pad == tiles.n_pad, (n_pad, tiles.n_pad)
     if fd is None:
         fd = gather_dst_rows(F, tiles)
+    grad_nbr, llh_nbr = _grad_blocks(F, tiles, cfg, fd, interpret)
+    grad = grad_nbr.reshape(n_pad, k) - sumF[None, :] + F
+    adt = jnp.dtype(cfg.accum_dtype) if cfg.accum_dtype else F.dtype
+    node_llh = (
+        llh_nbr.reshape(n_pad).astype(adt) + node_tail(F, sumF).astype(adt)
+    )
+    return grad, node_llh
+
+
+def _cand_blocks(
+    F: jax.Array,
+    grad: jax.Array,
+    sumF: jax.Array,
+    tiles: TilesDev,
+    cfg: BigClamConfig,
+    fd: jax.Array,
+    interpret: bool,
+) -> jax.Array:
+    """Raw per-block candidate-LLH outputs (n_blocks, S, B), tails included.
+
+    NOTE: F/grad here are the rows covered by `tiles` (the whole model on
+    the flat path; a group's row range on the grouped path) while `fd` rows
+    are gathered from the FULL F."""
+    k = F.shape[1]
     b, t = tiles.block_b, tiles.tile_t
     n_tiles = tiles.src_local.shape[0]
-    kernel = functools.partial(_grad_kernel, cfg=cfg, block_b=b)
+    num_s = len(cfg.step_candidates)
+    kernel = functools.partial(_cand_kernel, cfg=cfg, block_b=b)
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
         grid=(n_tiles,),
@@ -222,27 +310,22 @@ def grad_llh_csr(
             pl.BlockSpec((1, 1, t), lambda i, bid: (i, 0, 0)),
             pl.BlockSpec((1, t, k), lambda i, bid: (i, 0, 0)),
             pl.BlockSpec((b, k), lambda i, bid: (bid[i], 0)),
+            pl.BlockSpec((b, k), lambda i, bid: (bid[i], 0)),
+            pl.BlockSpec((1, k), lambda i, bid: (0, 0)),
         ],
-        out_specs=[
-            pl.BlockSpec((1, b, k), lambda i, bid: (bid[i], 0, 0)),
-            pl.BlockSpec((1, 1, b), lambda i, bid: (bid[i], 0, 0)),
-        ],
+        out_specs=pl.BlockSpec((1, num_s, b), lambda i, bid: (bid[i], 0, 0)),
     )
-    grad_nbr, llh_nbr = pl.pallas_call(
+    return pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
-        out_shape=[
-            _out_struct((tiles.n_blocks, b, k), F.dtype, F, fd, tiles.mask),
-            _out_struct((tiles.n_blocks, 1, b), F.dtype, F, fd, tiles.mask),
-        ],
+        out_shape=_out_struct(
+            (tiles.n_blocks, num_s, b), F.dtype, F, grad, fd, tiles.mask, sumF
+        ),
         interpret=interpret,
-    )(tiles.block_id, tiles.src_local, tiles.mask, fd, F)
-    grad = grad_nbr.reshape(n_pad, k) - sumF[None, :] + F
-    adt = jnp.dtype(cfg.accum_dtype) if cfg.accum_dtype else F.dtype
-    node_llh = (
-        llh_nbr.reshape(n_pad).astype(adt) + node_tail(F, sumF).astype(adt)
+    )(
+        tiles.block_id, tiles.src_local, tiles.mask, fd, F, grad,
+        sumF.reshape(1, k),
     )
-    return grad, node_llh
 
 
 def candidates_csr(
@@ -263,32 +346,165 @@ def candidates_csr(
     assert n_pad == tiles.n_pad, (n_pad, tiles.n_pad)
     if fd is None:
         fd = gather_dst_rows(F, tiles)
-    b, t = tiles.block_b, tiles.tile_t
-    n_tiles = tiles.src_local.shape[0]
+    out = _cand_blocks(F, grad, sumF, tiles, cfg, fd, interpret)
     num_s = len(cfg.step_candidates)
-    kernel = functools.partial(_cand_kernel, cfg=cfg, block_b=b)
-    grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=1,
-        grid=(n_tiles,),
-        in_specs=[
-            pl.BlockSpec((1, 1, t), lambda i, bid: (i, 0, 0)),
-            pl.BlockSpec((1, 1, t), lambda i, bid: (i, 0, 0)),
-            pl.BlockSpec((1, t, k), lambda i, bid: (i, 0, 0)),
-            pl.BlockSpec((b, k), lambda i, bid: (bid[i], 0)),
-            pl.BlockSpec((b, k), lambda i, bid: (bid[i], 0)),
-            pl.BlockSpec((1, k), lambda i, bid: (0, 0)),
-        ],
-        out_specs=pl.BlockSpec((1, num_s, b), lambda i, bid: (bid[i], 0, 0)),
-    )
-    out = pl.pallas_call(
-        kernel,
-        grid_spec=grid_spec,
-        out_shape=_out_struct(
-            (tiles.n_blocks, num_s, b), F.dtype, F, grad, fd, tiles.mask, sumF
-        ),
-        interpret=interpret,
-    )(
-        tiles.block_id, tiles.src_local, tiles.mask, fd, F, grad,
-        sumF.reshape(1, k),
-    )
     return out.transpose(1, 0, 2).reshape(num_s, n_pad)
+
+
+class GroupedTilesDev(NamedTuple):
+    """Device-resident ops.csr_tiles.GroupedBlockTiles (large-K layout)."""
+
+    src_local: jax.Array   # (n_groups, G, 1, T)
+    dst: jax.Array         # (n_groups, G, T)
+    mask: jax.Array        # (n_groups, G, 1, T)
+    block_id: jax.Array    # (n_groups, G)
+    block_b: int
+    tile_t: int
+    nb: int
+    n_groups: int
+
+    @property
+    def n_pad(self) -> int:
+        return self.n_groups * self.nb * self.block_b
+
+
+def device_grouped_tiles(gbt, dtype=jnp.float32) -> GroupedTilesDev:
+    ng, g, t = gbt.src_local.shape
+    return GroupedTilesDev(
+        src_local=jnp.asarray(gbt.src_local, jnp.int32).reshape(ng, g, 1, t),
+        dst=jnp.asarray(gbt.dst, jnp.int32),
+        mask=jnp.asarray(gbt.mask, dtype).reshape(ng, g, 1, t),
+        block_id=jnp.asarray(gbt.block_id, jnp.int32),
+        block_b=gbt.block_b,
+        tile_t=gbt.tile_t,
+        nb=gbt.nb,
+        n_groups=gbt.n_groups,
+    )
+
+
+def _group_view(gt: GroupedTilesDev, xs) -> TilesDev:
+    srcl, dst, mask, bid = xs
+    return TilesDev(
+        src_local=srcl, dst=dst, mask=mask, block_id=bid,
+        block_b=gt.block_b, tile_t=gt.tile_t, n_blocks=gt.nb,
+    )
+
+
+def grad_llh_csr_grouped(
+    F: jax.Array,
+    sumF: jax.Array,
+    gt: GroupedTilesDev,
+    cfg: BigClamConfig,
+    interpret: bool = False,
+) -> Tuple[jax.Array, jax.Array]:
+    """grad_llh_csr over the grouped layout: lax.scan over block groups,
+    gathering only each group's (G, T, K) dst rows per iteration — the
+    large-K path where one whole-graph gather would blow the HBM budget."""
+    n_pad, k = F.shape
+    assert n_pad == gt.n_pad, (n_pad, gt.n_pad)
+    rows = gt.nb * gt.block_b
+
+    def body(_, xs):
+        gi, tile_xs = xs
+        td = _group_view(gt, tile_xs)
+        fd = jnp.take(F, td.dst, axis=0)
+        F_g = lax.dynamic_slice_in_dim(F, gi * rows, rows)
+        return None, _grad_blocks(F_g, td, cfg, fd, interpret)
+
+    _, (gn, ln) = lax.scan(
+        body,
+        None,
+        (
+            jnp.arange(gt.n_groups),
+            (gt.src_local, gt.dst, gt.mask, gt.block_id),
+        ),
+    )
+    grad = gn.reshape(n_pad, k) - sumF[None, :] + F
+    adt = jnp.dtype(cfg.accum_dtype) if cfg.accum_dtype else F.dtype
+    node_llh = (
+        ln.reshape(n_pad).astype(adt) + node_tail(F, sumF).astype(adt)
+    )
+    return grad, node_llh
+
+
+def train_pass_csr_grouped(
+    F: jax.Array,
+    sumF: jax.Array,
+    gt: GroupedTilesDev,
+    cfg: BigClamConfig,
+    interpret: bool = False,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Grad + candidates in ONE scan over block groups, sharing each group's
+    dst-row gather (the dominant memory cost on this path).
+
+    Works because everything the candidate kernel needs is group-local: the
+    group's grad rows are complete once its grad kernel ran (grad_g =
+    gn_g - sumF + F_g), and fd comes from the OLD full F either way.
+    Returns (grad (n_pad, K), node_llh (n_pad,), cand_full (S, n_pad)).
+    """
+    n_pad, k = F.shape
+    assert n_pad == gt.n_pad, (n_pad, gt.n_pad)
+    rows = gt.nb * gt.block_b
+    num_s = len(cfg.step_candidates)
+
+    def body(_, xs):
+        gi, tile_xs = xs
+        td = _group_view(gt, tile_xs)
+        fd = jnp.take(F, td.dst, axis=0)
+        F_g = lax.dynamic_slice_in_dim(F, gi * rows, rows)
+        gn, ln = _grad_blocks(F_g, td, cfg, fd, interpret)
+        grad_g = gn.reshape(rows, k) - sumF[None, :] + F_g
+        cand_g = _cand_blocks(F_g, grad_g, sumF, td, cfg, fd, interpret)
+        return None, (grad_g, ln, cand_g)
+
+    _, (gr, ln, cd) = lax.scan(
+        body,
+        None,
+        (
+            jnp.arange(gt.n_groups),
+            (gt.src_local, gt.dst, gt.mask, gt.block_id),
+        ),
+    )
+    grad = gr.reshape(n_pad, k)
+    adt = jnp.dtype(cfg.accum_dtype) if cfg.accum_dtype else F.dtype
+    node_llh = (
+        ln.reshape(n_pad).astype(adt) + node_tail(F, sumF).astype(adt)
+    )
+    cand_full = cd.transpose(2, 0, 1, 3).reshape(num_s, n_pad)
+    return grad, node_llh, cand_full
+
+
+def candidates_csr_grouped(
+    F: jax.Array,
+    grad: jax.Array,
+    sumF: jax.Array,
+    gt: GroupedTilesDev,
+    cfg: BigClamConfig,
+    interpret: bool = False,
+) -> jax.Array:
+    """candidates_csr over the grouped layout (see grad_llh_csr_grouped).
+    The train step uses train_pass_csr_grouped instead (shares the gather);
+    this standalone form exists for tests and ad-hoc use."""
+    n_pad, k = F.shape
+    assert n_pad == gt.n_pad, (n_pad, gt.n_pad)
+    rows = gt.nb * gt.block_b
+    num_s = len(cfg.step_candidates)
+
+    def body(_, xs):
+        gi, tile_xs = xs
+        td = _group_view(gt, tile_xs)
+        fd = jnp.take(F, td.dst, axis=0)
+        F_g = lax.dynamic_slice_in_dim(F, gi * rows, rows)
+        G_g = lax.dynamic_slice_in_dim(grad, gi * rows, rows)
+        return None, _cand_blocks(F_g, G_g, sumF, td, cfg, fd, interpret)
+
+    _, out = lax.scan(
+        body,
+        None,
+        (
+            jnp.arange(gt.n_groups),
+            (gt.src_local, gt.dst, gt.mask, gt.block_id),
+        ),
+    )
+    # (n_groups, nb, S, B) -> (S, n_pad)
+    return out.transpose(2, 0, 1, 3).reshape(num_s, n_pad)

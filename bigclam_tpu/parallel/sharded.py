@@ -34,6 +34,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from bigclam_tpu.config import BigClamConfig
 from bigclam_tpu.graph.csr import Graph
 from bigclam_tpu.models.bigclam import (
+    FLAT_FD_BUDGET,
     FitResult,
     TrainState,
     _round_up,
@@ -326,7 +327,7 @@ class ShardedBigClamModel:
             # Committed only now — the economy probe above already accepted
             # the layout, so the XLA fallback never sees inflated padding.
             self.n_pad = _round_up(
-                max(g.num_nodes, dp), dp * cfg.csr_block_b
+                max(g.num_nodes, dp), dp * self._csr_shape[0]
             )
             self.k_pad = _round_up(self.k_pad, 128)
         # degree-balanced relabeling (parallel/balance.py): the trainer runs
@@ -353,7 +354,10 @@ class ShardedBigClamModel:
     def _csr_static_ok(self, tp: int) -> bool:
         """Static engagement check for the blocked-CSR sharded step (the
         economy checks that need the built tiles live in _build_csr_step)."""
-        from bigclam_tpu.ops.pallas_csr import csr_tiles_supported
+        from bigclam_tpu.ops.pallas_csr import (
+            csr_tiles_supported,
+            fit_tile_shape,
+        )
 
         cfg = self.cfg
         want = cfg.use_pallas_csr
@@ -361,15 +365,20 @@ class ShardedBigClamModel:
             want = jax.default_backend() == "tpu" or cfg.pallas_interpret
         if not want:
             return False
+        k_pad = _round_up(self.k_pad, 128)
+        # shrink tiles to the kernels' VMEM budget, like the single-chip path
+        self._csr_shape = (
+            (cfg.csr_block_b, cfg.csr_tile_t)
+            if cfg.pallas_interpret
+            else fit_tile_shape(cfg.csr_block_b, cfg.csr_tile_t, k_pad)
+        )
         ok = (
             tp == 1
             and self.dtype == jnp.float32
             and cfg.accum_dtype in (None, "float32")
+            and self._csr_shape is not None
             and csr_tiles_supported(
-                cfg.csr_block_b,
-                cfg.csr_tile_t,
-                _round_up(self.k_pad, 128),
-                cfg.pallas_interpret,
+                *self._csr_shape, k_pad, cfg.pallas_interpret
             )
         )
         if not ok and cfg.use_pallas_csr is True:
@@ -392,20 +401,17 @@ class ShardedBigClamModel:
         )
 
         cfg = self.cfg
+        block_b, tile_t = self._csr_shape
         n_pad = _round_up(
-            max(self.g.num_nodes, dp), dp * cfg.csr_block_b
+            max(self.g.num_nodes, dp), dp * block_b
         )
         k_pad = _round_up(self.k_pad, 128)
-        sbt = shard_block_tiles(
-            self.g, dp, n_pad, cfg.csr_block_b, cfg.csr_tile_t
-        )
+        sbt = shard_block_tiles(self.g, dp, n_pad, block_b, tile_t)
         slots = sbt.src_local.size               # dp * n_tiles * T
         e = max(self.g.num_directed_edges, 1)
-        fd_bytes = sbt.n_tiles * cfg.csr_tile_t * k_pad * 4      # per shard
-        pad_ok = layout_economical(
-            slots, e, dp * sbt.n_blocks, cfg.csr_tile_t
-        )
-        if pad_ok and fd_bytes <= (2 << 30):
+        fd_bytes = sbt.n_tiles * tile_t * k_pad * 4              # per shard
+        pad_ok = layout_economical(slots, e, dp * sbt.n_blocks, tile_t)
+        if pad_ok and fd_bytes <= FLAT_FD_BUDGET:
             # reuse the probe's layout in _build_csr_step unless balancing
             # relabels the graph in between (the only thing that changes it)
             self._probe_tiles = sbt
@@ -415,7 +421,7 @@ class ShardedBigClamModel:
                 f"use_pallas_csr=True but sharded layout uneconomical: "
                 f"{slots - e} padded edge slots on {e}, per-shard fd "
                 f"gather {fd_bytes >> 20} MiB (power-law skew? try "
-                "balance=True or the ring trainer)"
+                "balance=True, the ring trainer, or a sharded K axis)"
             )
         return False
 
@@ -429,7 +435,7 @@ class ShardedBigClamModel:
         self._probe_tiles = None
         if sbt is None or self._perm is not None:
             sbt = shard_block_tiles(
-                self.g, dp, self.n_pad, cfg.csr_block_b, cfg.csr_tile_t
+                self.g, dp, self.n_pad, *self._csr_shape
             )
         dp_, nt, t = sbt.src_local.shape
         spec4 = NamedSharding(self.mesh, P(NODES_AXIS, None, None, None))
