@@ -56,6 +56,17 @@ def _add_common(p: argparse.ArgumentParser) -> None:
              " a full F per device (fastest at small N); ring rotates shards"
              " around the ICI ring (O(N/dp) peak memory, pod-scale)",
     )
+    p.add_argument(
+        "--csr-kernels", default="auto", choices=["auto", "on", "off"],
+        help="blocked-CSR Pallas kernel path (auto: on for TPU backends "
+             "when the layout fits; on: require, error if unsupported)",
+    )
+    p.add_argument(
+        "--seeding-degree-cap", type=int, default=None,
+        help="sample at most this many neighbors per node in conductance "
+             "seeding (exact pass is edge-quadratic on hubs; exact when "
+             "cap >= max degree)",
+    )
     p.add_argument("--checkpoint-dir", default=None)
     p.add_argument("--checkpoint-every", type=int, default=0)
     p.add_argument("--metrics", default=None, help="JSONL metrics path")
@@ -88,6 +99,10 @@ def _build(args, k: int):
         max_com=getattr(args, "max_com", 9000),
         div_com=getattr(args, "div_com", 100),
         ksweep_tol=getattr(args, "ksweep_tol", 1e-3),
+        use_pallas_csr={"auto": None, "on": True, "off": False}[
+            args.csr_kernels
+        ],
+        seeding_degree_cap=args.seeding_degree_cap,
     )
     g = build_graph(args.graph)
     return g, cfg
@@ -173,14 +188,23 @@ def cmd_fit(args) -> int:
         "edges": g.num_edges,
         "k": cfg.num_communities,
     }
+    com = (
+        extraction.extract_communities(res.F, g)
+        if (args.out or args.export_gexf)
+        else None
+    )
     if args.out:
-        com = extraction.extract_communities(res.F, g)
         extraction.save_communities(args.out, com)
         out["communities"] = len(com)
         out["out"] = args.out
     if args.save_f:
         np.save(args.save_f, res.F)
         out["save_f"] = args.save_f
+    if args.export_gexf:
+        from bigclam_tpu.utils.viz import export_gexf
+
+        export_gexf(args.export_gexf, g, communities=com, F=res.F)
+        out["export_gexf"] = args.export_gexf
     print(json.dumps(out))
     return 0
 
@@ -249,6 +273,10 @@ def main(argv=None) -> int:
     p_fit.add_argument("--k", type=int, default=100)
     p_fit.add_argument("--out", default=None, help="write SNAP cmty file")
     p_fit.add_argument("--save-f", default=None, help="write F as .npy")
+    p_fit.add_argument(
+        "--export-gexf", default=None,
+        help="write a Gephi-compatible GEXF with community attributes",
+    )
     p_fit.set_defaults(fn=cmd_fit)
 
     p_sweep = sub.add_parser("sweep", help="automatic K selection over a log grid")

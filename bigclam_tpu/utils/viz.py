@@ -1,0 +1,70 @@
+"""Visualization export (C23, SURVEY.md §2): Gephi-compatible GEXF.
+
+The reference ships only static rendered figures (README.md:8-10 img.png /
+BigClamK_1sp.png — a community-colored facebook graph drawn externally).
+The equivalent capability here is a structured export: graph + per-node
+community attributes in GEXF 1.2, which Gephi/Cytoscape/networkx open
+directly. Pure-python writer, no dependencies.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterable, Optional
+from xml.sax.saxutils import escape
+
+import numpy as np
+
+from bigclam_tpu.graph.csr import Graph
+
+
+def export_gexf(
+    path: str,
+    g: Graph,
+    communities: Optional[Dict[int, Iterable[int]]] = None,
+    F: Optional[np.ndarray] = None,
+    max_edges: Optional[int] = None,
+) -> None:
+    """Write the graph (undirected, deduped) with community attributes.
+
+    Per node: `community` = its primary community (argmax F when F given,
+    else the first community containing it; -1 when none) and
+    `n_communities` = overlap count. `max_edges` caps output size for
+    viewer-friendly files (edges are kept in CSR order).
+    """
+    n = g.num_nodes
+    primary = np.full(n, -1, dtype=np.int64)
+    overlap = np.zeros(n, dtype=np.int64)
+    if communities is not None:
+        for cid in sorted(communities):
+            members = np.asarray(list(communities[cid]), dtype=np.int64)
+            overlap[members] += 1
+            unset = members[primary[members] == -1]
+            primary[unset] = cid
+    if F is not None:
+        has_mass = np.asarray(F).max(axis=1) > 0
+        primary[has_mass] = np.asarray(F).argmax(axis=1)[has_mass]
+    und = g.src < g.dst                       # one direction per edge
+    src, dst = g.src[und], g.dst[und]
+    if max_edges is not None and src.size > max_edges:
+        src, dst = src[:max_edges], dst[:max_edges]
+    with open(path, "w") as f:
+        f.write(
+            '<?xml version="1.0" encoding="UTF-8"?>\n'
+            '<gexf xmlns="http://gexf.net/1.2" version="1.2">\n'
+            '  <graph defaultedgetype="undirected">\n'
+            '    <attributes class="node">\n'
+            '      <attribute id="0" title="community" type="long"/>\n'
+            '      <attribute id="1" title="n_communities" type="long"/>\n'
+            "    </attributes>\n    <nodes>\n"
+        )
+        for u in range(n):
+            f.write(
+                f'      <node id="{u}" label="{escape(str(u))}">'
+                f'<attvalues><attvalue for="0" value="{primary[u]}"/>'
+                f'<attvalue for="1" value="{overlap[u]}"/></attvalues>'
+                "</node>\n"
+            )
+        f.write("    </nodes>\n    <edges>\n")
+        for i in range(src.size):
+            f.write(f'      <edge id="{i}" source="{src[i]}" target="{dst[i]}"/>\n')
+        f.write("    </edges>\n  </graph>\n</gexf>\n")

@@ -187,3 +187,46 @@ def test_rerun_with_checkpoints_is_idempotent(toy_graphs, tmp_path):
     r2 = BigClamModel(g, cfg).fit(F0, checkpoints=cm)
     assert r2.num_iters == r1.num_iters
     np.testing.assert_array_equal(r2.F, r1.F)
+
+
+def test_export_gexf(tmp_path, toy_graphs):
+    import xml.etree.ElementTree as ET
+
+    import numpy as np
+
+    from bigclam_tpu.utils.viz import export_gexf
+
+    g = toy_graphs["two_cliques"]
+    F = np.zeros((g.num_nodes, 2))
+    F[:4, 0] = 1.0
+    F[4:, 1] = 2.0
+    coms = {0: [0, 1, 2, 3], 1: [4, 5, 6, 7], 2: [3, 4]}
+    path = str(tmp_path / "g.gexf")
+    export_gexf(path, g, communities=coms, F=F)
+    root = ET.parse(path).getroot()
+    ns = {"g": "http://gexf.net/1.2"}
+    nodes = root.findall(".//g:node", ns)
+    edges = root.findall(".//g:edge", ns)
+    assert len(nodes) == g.num_nodes
+    assert len(edges) == g.num_directed_edges // 2
+    # node 3: argmax F -> community 0; overlap count 2 (communities 0 and 2)
+    n3 = [n for n in nodes if n.get("id") == "3"][0]
+    vals = {a.get("for"): a.get("value") for a in n3.findall(".//g:attvalue", ns)}
+    assert vals["0"] == "0" and vals["1"] == "2"
+
+
+def test_cli_csr_and_cap_flags(tmp_path):
+    from conftest import REFERENCE_DATA
+
+    out = tmp_path / "c.txt"
+    gexf = tmp_path / "g.gexf"
+    r = _run_cli(
+        "fit",
+        "--graph", f"{REFERENCE_DATA}/facebook_combined.txt",
+        "--k", "8", "--max-iters", "3", "--platform", "cpu",
+        "--csr-kernels", "off", "--seeding-degree-cap", "32",
+        "--out", str(out), "--export-gexf", str(gexf), "--quiet",
+    )
+    assert r.returncode == 0, r.stderr[-800:]
+    rec = json.loads(r.stdout.strip().splitlines()[-1])
+    assert rec["communities"] >= 1 and out.exists() and gexf.exists()
