@@ -3,12 +3,19 @@
 
 Prints ONE JSON line:
   {"metric": "edges/sec/chip", "value": N, "unit": "edges/sec/chip",
-   "vs_baseline": R, ...}
+   "vs_baseline": R, "path": "csr|csr_grouped|pallas_vmem|xla", ...}
 
 metric: directed-edge traversals of the graph per second per chip, counting
 one optimizer iteration as ONE traversal of the 2E directed edges (each
 iteration internally performs 17 fused sweeps — 1 gradient/LLH + 16 Armijo
 candidates — so multiply by 17 for raw gather-dot throughput).
+
+value: the MEDIAN over several timing windows (a single window is vulnerable
+to cold-chip / background-noise artifacts: round 1 recorded 7.66M on a run
+that steady-states at 27M). "windows_eps" carries every window so outliers
+are visible; "path" asserts which kernel implementation actually ran — on a
+TPU backend the blocked-CSR kernels MUST have engaged, a silent XLA fallback
+fails the run rather than polluting the scoreboard.
 
 vs_baseline: speedup over the float64 NumPy spec interpreter (the exact
 reference semantics, SURVEY.md §4.2) running the same iteration on this
@@ -18,13 +25,16 @@ iteration) for comparability.
 """
 
 import json
+import statistics
 import time
 
 import numpy as np
 
 ENRON = "/root/reference/data/Email-Enron.txt"
 K = 100
-TIMED_ITERS = 10
+WINDOWS = 5
+ITERS_PER_WINDOW = 10
+WARMUP_ITERS = 3
 
 
 def main() -> None:
@@ -42,16 +52,27 @@ def main() -> None:
 
     # --- accelerator run (float32, K padded to the 128-lane boundary) ---
     model = BigClamModel(g, cfg, k_multiple=128)
+    on_tpu = jax.default_backend() == "tpu"
+    if on_tpu and model.engaged_path not in ("csr", "csr_grouped"):
+        raise RuntimeError(
+            "benchmark invalid: blocked-CSR kernels did not engage on the "
+            f"TPU backend (path={model.engaged_path}, "
+            f"reason: {model.path_reason})"
+        )
     state = model.init_state(F0)
-    state = model._step(state)                 # warmup / compile
-    jax.block_until_ready(state.F)
-    t0 = time.perf_counter()
-    for _ in range(TIMED_ITERS):
+    for _ in range(WARMUP_ITERS):           # compile + reach steady state
         state = model._step(state)
     jax.block_until_ready(state.F)
-    dt = time.perf_counter() - t0
-    n_chips = 1                                # single-chip benchmark config
-    edges_per_sec = g.num_directed_edges * TIMED_ITERS / dt / n_chips
+    window_eps = []
+    for _ in range(WINDOWS):
+        t0 = time.perf_counter()
+        for _ in range(ITERS_PER_WINDOW):
+            state = model._step(state)
+        jax.block_until_ready(state.F)
+        dt = time.perf_counter() - t0
+        window_eps.append(g.num_directed_edges * ITERS_PER_WINDOW / dt)
+    n_chips = 1                             # single-chip benchmark config
+    edges_per_sec = statistics.median(window_eps) / n_chips
 
     # --- oracle baseline: one exact-semantics iteration on host CPU ---
     Fb = F0.copy()
@@ -68,9 +89,13 @@ def main() -> None:
                 "value": round(edges_per_sec, 1),
                 "unit": "edges/sec/chip",
                 "vs_baseline": round(edges_per_sec / base_edges_per_sec, 2),
+                "path": model.engaged_path,
                 "config": f"Email-Enron N={g.num_nodes} 2E={g.num_directed_edges} K={K}",
-                "iters_timed": TIMED_ITERS,
-                "sec_per_iter": round(dt / TIMED_ITERS, 4),
+                "windows_eps": [round(x, 1) for x in window_eps],
+                "iters_per_window": ITERS_PER_WINDOW,
+                "sec_per_iter": round(
+                    g.num_directed_edges / edges_per_sec, 4
+                ),
                 "device": str(jax.devices()[0]),
                 # TrainState.llh is the LLH of the step's INPUT F, so this is
                 # the last *evaluated* LLH (one update behind state.F)

@@ -82,6 +82,12 @@ def _build(args, k: int):
     from bigclam_tpu.config import BigClamConfig
     from bigclam_tpu.graph import build_graph
 
+    if getattr(args, "quiet", False):
+        # one knob: --quiet silences the model-build engagement lines too
+        import os
+
+        os.environ["BIGCLAM_QUIET"] = "1"
+
     cfg = BigClamConfig(
         num_communities=k,
         dtype=args.dtype,
@@ -178,7 +184,11 @@ def cmd_fit(args) -> int:
     mesh = getattr(model, "mesh", None)
     n_chips = mesh.size if mesh is not None else 1
     with MetricsLogger(args.metrics, echo=not args.quiet) as ml:
-        cb = ml.step_callback(g.num_directed_edges, chips=n_chips)
+        cb = ml.step_callback(
+            g.num_directed_edges,
+            chips=n_chips,
+            path=getattr(model, "engaged_path", ""),
+        )
         with trace(args.profile_dir):
             res = model.fit(F0, callback=cb, checkpoints=ckpt)
     out = {

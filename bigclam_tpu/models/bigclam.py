@@ -31,6 +31,41 @@ from bigclam_tpu.ops.linesearch import armijo_update, candidates_pass
 from bigclam_tpu.ops.objective import EdgeChunks, grad_llh
 
 
+def csr_want_reason(cfg: BigClamConfig) -> tuple[bool, str]:
+    """Shared 'should the CSR kernels engage?' predicate + the fallback
+    reason when they should not (single source for every trainer)."""
+    want = cfg.use_pallas_csr
+    if want is None:
+        want = jax.default_backend() == "tpu" or cfg.pallas_interpret
+    if want:
+        return True, ""
+    reason = (
+        "use_pallas_csr=False"
+        if cfg.use_pallas_csr is False
+        else f"auto: backend {jax.default_backend()!r} is not tpu"
+    )
+    return False, reason
+
+
+def log_engaged_path(model_name: str, path: str, reason: str = "") -> None:
+    """One-line kernel-path engagement report at model build.
+
+    Silent fallbacks hid perf regressions in round-1 production runs (the
+    7.66M-vs-27.4M bench capture artifact); every trainer now states which
+    edge-sweep implementation it compiled, and why the CSR kernels did not
+    engage when they did not. Set BIGCLAM_QUIET=1 to suppress."""
+    import os
+    import sys
+
+    if os.environ.get("BIGCLAM_QUIET") == "1":
+        return
+    why = f" ({reason})" if reason and path not in ("csr", "csr_grouped") else ""
+    print(
+        f"[bigclam] {model_name}: edge-sweep path = {path}{why}",
+        file=sys.stderr,
+    )
+
+
 class TrainState(NamedTuple):
     F: jax.Array        # (N_pad, K_pad)
     sumF: jax.Array     # (K_pad,)
@@ -238,9 +273,41 @@ def restore_checkpoint(checkpoints, expected_meta: dict, state_from_arrays):
     return state_from_arrays(arrays), tuple(meta.get("llh_history", ()))
 
 
+def pick_candidates_impl(
+    edges: EdgeChunks, k_pad: int, cfg: BigClamConfig
+) -> tuple[Callable, str]:
+    """Choose the candidate-pass implementation for the non-CSR step.
+
+    Returns (impl_fn, path_name) with path_name in {"pallas_vmem", "xla"} —
+    the single source of truth consumed by BOTH make_train_step and the
+    engagement report (engaged_path), so the recorded path is by construction
+    the one that compiles."""
+    want = cfg.use_pallas
+    if want is None:
+        want = jax.default_backend() == "tpu"
+    if not want:
+        return candidates_pass, "xla"
+    from bigclam_tpu.ops.pallas_kernels import (
+        candidates_pass_pallas,
+        pallas_block_size,
+    )
+
+    chunk = int(edges.src.shape[-1])
+    ok = pallas_block_size(chunk, k_pad) is not None and k_pad % 128 == 0
+    if not ok:
+        if cfg.use_pallas:                     # explicit request: refuse loudly
+            raise ValueError(
+                f"use_pallas=True but tiling constraints unmet "
+                f"(chunk={chunk}, K_pad={k_pad}); pad K to a multiple of "
+                "128 (k_multiple=128) and keep edge chunks >= 1024"
+            )
+        return candidates_pass, "xla"          # auto mode: reported fallback
+    return candidates_pass_pallas, "pallas_vmem"
+
+
 def make_train_step(
-    edges: EdgeChunks, cfg: BigClamConfig, tiles=None
-) -> Callable[[TrainState], TrainState]:
+    edges: EdgeChunks, cfg: BigClamConfig, tiles=None, k_pad: int = 0
+) -> tuple[Callable[[TrainState], TrainState], str]:
     """Build the jitted one-iteration update: 17 fused edge sweeps total
     (1 grad/LLH + 16 candidates), no host round trips.
 
@@ -286,42 +353,21 @@ def make_train_step(
                 F=F_new, sumF=sumF_new, llh=llh_cur, it=state.it + 1
             )
 
-        return jax.jit(csr_step)
+        return jax.jit(csr_step), ("csr_grouped" if grouped else "csr")
 
-    def _pick_candidates_impl(F: jax.Array):
-        want = cfg.use_pallas
-        if want is None:
-            want = jax.default_backend() == "tpu"
-        if not want:
-            return candidates_pass
-        from bigclam_tpu.ops.pallas_kernels import (
-            candidates_pass_pallas,
-            pallas_block_size,
-        )
-
-        chunk = int(edges.src.shape[-1])
-        k_pad = int(F.shape[1])
-        ok = pallas_block_size(chunk, k_pad) is not None and k_pad % 128 == 0
-        if not ok:
-            if cfg.use_pallas:                 # explicit request: refuse loudly
-                raise ValueError(
-                    f"use_pallas=True but tiling constraints unmet "
-                    f"(chunk={chunk}, K_pad={k_pad}); pad K to a multiple of "
-                    "128 (k_multiple=128) and keep edge chunks >= 1024"
-                )
-            return candidates_pass             # auto mode: silent fallback
-        return candidates_pass_pallas
+    cand_impl, cand_path = pick_candidates_impl(
+        edges, k_pad or cfg.num_communities, cfg
+    )
 
     def step(state: TrainState) -> TrainState:
         F, sumF = state.F, state.sumF
         grad, node_llh = grad_llh(F, sumF, edges, cfg)
         llh_cur = node_llh.sum()               # LLH of current F
-        cand_impl = _pick_candidates_impl(F)
         cand_nbr = cand_impl(F, grad, edges, cfg)
         F_new, sumF_new = armijo_update(F, sumF, grad, node_llh, cand_nbr, cfg)
         return TrainState(F=F_new, sumF=sumF_new, llh=llh_cur, it=state.it + 1)
 
-    return jax.jit(step)
+    return jax.jit(step), cand_path
 
 
 class BigClamModel:
@@ -373,7 +419,11 @@ class BigClamModel:
                 f"min_f={cfg.min_f} with padding "
                 f"{g.num_nodes}->{self.n_pad}, {cfg.num_communities}->{self.k_pad}"
             )
-        self._step = make_train_step(self._edges, cfg, tiles=self._tiles)
+        self._step, self.engaged_path = make_train_step(
+            self._edges, cfg, tiles=self._tiles, k_pad=self.k_pad
+        )
+        self.path_reason = getattr(self, "_csr_reason", "")
+        log_engaged_path("BigClamModel", self.engaged_path, self.path_reason)
 
     @property
     def edges(self) -> EdgeChunks:
@@ -393,12 +443,13 @@ class BigClamModel:
         Auto mode (use_pallas_csr=None): engage on TPU backends when f32,
         the Mosaic tiling constraints hold, the tile padding overhead is
         bounded, and the shared dst-row gather fits a ~2 GB HBM budget.
-        Explicit True raises on unmet constraints rather than degrading."""
+        Explicit True raises on unmet constraints rather than degrading.
+        Each non-engagement records its reason in self._csr_reason (surfaced
+        by engaged_path / log_engaged_path)."""
         cfg = self.cfg
-        want = cfg.use_pallas_csr
-        if want is None:
-            want = jax.default_backend() == "tpu" or cfg.pallas_interpret
+        want, reason = csr_want_reason(cfg)
         if not want:
+            self._csr_reason = reason
             return None
         from bigclam_tpu.ops.csr_tiles import build_block_tiles
         from bigclam_tpu.ops.pallas_csr import csr_tiles_supported, device_tiles
@@ -412,6 +463,10 @@ class BigClamModel:
                     "use_pallas_csr requires float32 F and "
                     "accum_dtype in (None, 'float32')"
                 )
+            self._csr_reason = (
+                f"requires float32 F/accum (dtype={self.dtype}, "
+                f"accum_dtype={cfg.accum_dtype})"
+            )
             return None
         # MXU/VMEM lane alignment: pad K up rather than fall back — zero
         # columns are inert (see ops.objective padding conventions). Only
@@ -432,6 +487,7 @@ class BigClamModel:
                     f"use_pallas_csr=True but no tile shape fits VMEM at "
                     f"k_pad={k_pad}; shard the K axis instead"
                 )
+            self._csr_reason = f"no tile shape fits VMEM at k_pad={k_pad}"
             return None
         block_b, tile_t = shape
         if not csr_tiles_supported(
@@ -443,6 +499,10 @@ class BigClamModel:
                     f"block_b={cfg.csr_block_b}, tile_t={cfg.csr_tile_t}, "
                     f"k_pad={k_pad} (need multiples of 128)"
                 )
+            self._csr_reason = (
+                f"tiling constraints unmet: block_b={block_b}, "
+                f"tile_t={tile_t}, k_pad={k_pad} (need 128-multiples)"
+            )
             return None
         if cfg.min_f != 0.0 and (
             _round_up(n, block_b) != n or k_pad != cfg.num_communities
@@ -454,6 +514,7 @@ class BigClamModel:
                     "use_pallas_csr=True requires min_f == 0.0 when node/K "
                     f"padding is introduced (min_f={cfg.min_f})"
                 )
+            self._csr_reason = f"min_f={cfg.min_f} != 0 with padding"
             return None
         if _round_up(n, _lcm(node_multiple, block_b)) != _round_up(
             n, block_b
@@ -465,6 +526,10 @@ class BigClamModel:
                     f"use_pallas_csr=True incompatible with "
                     f"node_multiple={node_multiple} (block_b={block_b})"
                 )
+            self._csr_reason = (
+                f"node_multiple={node_multiple} incompatible with "
+                f"block_b={block_b}"
+            )
             return None
         from bigclam_tpu.ops.csr_tiles import group_tiles, layout_economical
 
@@ -480,6 +545,10 @@ class BigClamModel:
                     f"use_pallas_csr=True but layout uneconomical: "
                     f"{bt.padded_edges} padded edges on {e}"
                 )
+            self._csr_reason = (
+                f"flat layout uneconomical: {bt.padded_edges} padded edge "
+                f"slots on {e} edges"
+            )
             return None
         if fd_bytes <= FLAT_FD_BUDGET:
             self.k_pad = k_pad
@@ -515,6 +584,10 @@ class BigClamModel:
                     f"use_pallas_csr=True but grouped layout uneconomical: "
                     f"{gbt.slots - e} padded slots on {e} (nb={nb})"
                 )
+            self._csr_reason = (
+                f"grouped layout uneconomical: {gbt.slots - e} padded slots "
+                f"on {e} edges (nb={nb}, group fd {group_fd >> 20} MiB)"
+            )
             return None
         from bigclam_tpu.ops.pallas_csr import device_grouped_tiles
 

@@ -95,12 +95,21 @@ def fit_tile_shape(
     def est(b: int, t: int) -> int:
         return (6 * t * k_pad + 3 * b * k_pad + 2 * b * t) * 4
 
+    def shrink(v: int) -> int:
+        # halve but keep Mosaic 128-alignment: a 128-multiple input must
+        # yield a 128-multiple (384 -> 256, not 192, which would silently
+        # fail csr_tiles_supported after an auto-shrink). Round the halved
+        # value UP — the loop's budget check keeps shrinking if it is still
+        # too big, so rounding up never over-shrinks a feasible shape
+        h = v // 2
+        return -(-h // 128) * 128 if h >= 128 else h
+
     b, t = block_b, tile_t
     while est(b, t) > VMEM_BUDGET and max(b, t) > 128:
         if t >= b and t > 128:
-            t //= 2
+            t = shrink(t)
         else:
-            b //= 2
+            b = shrink(b)
     return (b, t) if est(b, t) <= VMEM_BUDGET else None
 
 

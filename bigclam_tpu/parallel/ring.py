@@ -236,6 +236,12 @@ class RingBigClamModel(ShardedBigClamModel):
                 "(the kernels need an all-gathered F); use "
                 "ShardedBigClamModel or leave use_pallas_csr unset"
             )
+        from bigclam_tpu.models.bigclam import csr_want_reason
+
+        want, reason = csr_want_reason(self.cfg)
+        self._csr_reason = (
+            "ring schedule: CSR kernels not yet supported" if want else reason
+        )
         return False
 
     def _build_edges_and_step(self) -> None:

@@ -338,6 +338,18 @@ class ShardedBigClamModel:
 
             self.g, self._perm = balance_graph(g, dp, self.n_pad)
         self._build_edges_and_step()    # hook: subclasses swap the schedule
+        self.path_reason = getattr(self, "_csr_reason", "")
+        from bigclam_tpu.models.bigclam import log_engaged_path
+
+        log_engaged_path(
+            type(self).__name__, self.engaged_path, self.path_reason
+        )
+
+    @property
+    def engaged_path(self) -> str:
+        """Edge-sweep implementation this trainer compiled (see
+        log_engaged_path); subclasses with more schedules override."""
+        return "csr" if self._csr_wanted else "xla"
 
     def _to_internal_rows(self, F0: np.ndarray) -> np.ndarray:
         """Original-id F rows -> the trainer's (possibly relabeled) row order."""
@@ -359,11 +371,12 @@ class ShardedBigClamModel:
             fit_tile_shape,
         )
 
+        from bigclam_tpu.models.bigclam import csr_want_reason
+
         cfg = self.cfg
-        want = cfg.use_pallas_csr
-        if want is None:
-            want = jax.default_backend() == "tpu" or cfg.pallas_interpret
+        want, reason = csr_want_reason(cfg)
         if not want:
+            self._csr_reason = reason
             return False
         k_pad = _round_up(self.k_pad, 128)
         # shrink tiles to the kernels' VMEM budget, like the single-chip path
@@ -388,6 +401,11 @@ class ShardedBigClamModel:
                 f"multiple block_b/tile_t/k_pad; got tp={tp}, "
                 f"dtype={self.dtype}, block_b={cfg.csr_block_b}, "
                 f"tile_t={cfg.csr_tile_t}"
+            )
+        if not ok:
+            self._csr_reason = (
+                f"static constraints unmet: tp={tp}, dtype={self.dtype}, "
+                f"accum_dtype={cfg.accum_dtype}, tile shape={self._csr_shape}"
             )
         return ok
 
@@ -423,6 +441,10 @@ class ShardedBigClamModel:
                 f"gather {fd_bytes >> 20} MiB (power-law skew? try "
                 "balance=True, the ring trainer, or a sharded K axis)"
             )
+        self._csr_reason = (
+            f"sharded layout uneconomical: {slots - e} padded edge slots on "
+            f"{e} edges, per-shard fd gather {fd_bytes >> 20} MiB"
+        )
         return False
 
     def _build_csr_step(self, dp: int) -> None:

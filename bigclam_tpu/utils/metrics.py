@@ -33,12 +33,20 @@ class MetricsLogger:
         if self.echo:
             print(line, file=sys.stderr)
 
-    def step_callback(self, num_directed_edges: int, chips: int = 1):
-        """A fit-loop callback(it, llh) that logs iter/LLH/dllh/edges-per-sec."""
+    def step_callback(
+        self, num_directed_edges: int, chips: int = 1, path: str = ""
+    ):
+        """A fit-loop callback(it, llh) that logs iter/LLH/dllh/edges-per-sec.
+
+        `path` is the trainer's engaged edge-sweep implementation
+        (model.engaged_path: csr | csr_grouped | pallas_vmem | xla) so
+        production metrics record which kernels actually ran."""
 
         def cb(it: int, llh: float) -> None:
             now = time.perf_counter()
             rec: Dict[str, Any] = {"iter": it, "llh": llh}
+            if path:
+                rec["path"] = path
             if self._last_llh not in (None, 0.0):
                 rec["rel_dllh"] = abs(1.0 - llh / self._last_llh)
             if self._last_t is not None:
