@@ -220,6 +220,189 @@ def group_tiles(bt: BlockTiles, nb: int) -> GroupedBlockTiles:
     )
 
 
+class ShardedGroupedTiles(NamedTuple):
+    """Per-shard GroupedBlockTiles stacked on a leading shard axis — the
+    large-K layout for the SHARDED trainer (uniform (n_groups, G) across
+    shards so shard_map runs one SPMD program).
+
+    src_local: (dp, n_groups, G, T) int32 — src relative to the tile's block
+    dst:       (dp, n_groups, G, T) int32 — GLOBAL dst (points into the
+               all-gathered F)
+    mask:      (dp, n_groups, G, T) float32
+    block_id:  (dp, n_groups, G)    int32 — block index local to the group
+    """
+
+    src_local: np.ndarray
+    dst: np.ndarray
+    mask: np.ndarray
+    block_id: np.ndarray
+    block_b: int
+    tile_t: int
+    nb: int
+    n_groups: int
+    shard_rows: int          # = n_groups * nb * block_b
+
+    @property
+    def slots(self) -> int:
+        return self.src_local.size
+
+
+def shard_grouped_tiles(
+    g: Graph, dp: int, n_pad: int, block_b: int, tile_t: int, nb: int
+) -> ShardedGroupedTiles:
+    """Build each node shard's grouped tile layout (src block-local, dst
+    global), padded to uniform group count and tiles-per-group across shards.
+
+    n_pad must be a multiple of dp * nb * block_b so every shard has whole
+    groups and the same n_groups.
+    """
+    assert n_pad % dp == 0, (n_pad, dp)
+    shard_rows = n_pad // dp
+    assert shard_rows % (nb * block_b) == 0, (shard_rows, nb, block_b)
+    bounds = np.searchsorted(g.src, np.arange(0, n_pad + shard_rows, shard_rows))
+    parts = []
+    for i in range(dp):
+        lo, hi = bounds[i], bounds[i + 1]
+        bt = build_block_tiles_arrays(
+            g.src[lo:hi] - i * shard_rows,
+            g.dst[lo:hi],
+            shard_rows,
+            block_b,
+            tile_t,
+        )
+        parts.append(group_tiles(bt, nb))
+    n_groups = parts[0].n_groups
+    assert all(p.n_groups == n_groups for p in parts)
+    g_max = max(p.src_local.shape[1] for p in parts)
+
+    def pad_stack(field: str, fill):
+        outs = []
+        for p in parts:
+            a = getattr(p, field)
+            pad = g_max - a.shape[1]
+            if pad:
+                shape = (a.shape[0], pad) + a.shape[2:]
+                filler = np.full(shape, fill, a.dtype)
+                a = np.concatenate([a, filler], axis=1)
+            outs.append(a)
+        return np.stack(outs)
+
+    return ShardedGroupedTiles(
+        src_local=pad_stack("src_local", 0),
+        dst=pad_stack("dst", 0),
+        mask=pad_stack("mask", 0.0),
+        # padding tiles attach to the group's last block (valid id, zero mask)
+        block_id=pad_stack("block_id", nb - 1),
+        block_b=block_b,
+        tile_t=tile_t,
+        nb=nb,
+        n_groups=n_groups,
+        shard_rows=shard_rows,
+    )
+
+
+class RingBlockTiles(NamedTuple):
+    """Per-(shard, ring-phase) block-tile layouts for the ring-pass CSR
+    schedule (parallel/ring.py): in phase r, shard i runs the kernels over
+    the bucket of its edges whose destinations live in shard (i + r) % dp,
+    against the resident rotating F shard — so dst is stored LOCAL to that
+    shard. Uniform n_tiles across (shard, phase) keeps shard_map SPMD.
+
+    src_local: (dp, dp, n_tiles, T) int32 — src relative to the tile's block
+    dst_local: (dp, dp, n_tiles, T) int32 — dst relative to the ROTATING
+               shard resident in that phase
+    mask:      (dp, dp, n_tiles, T) float32
+    block_id:  (dp, dp, n_tiles)    int32 — shard-local block index
+    """
+
+    src_local: np.ndarray
+    dst_local: np.ndarray
+    mask: np.ndarray
+    block_id: np.ndarray
+    block_b: int
+    tile_t: int
+    n_blocks: int            # per shard
+    shard_rows: int
+
+    @property
+    def slots(self) -> int:
+        return self.src_local.size
+
+
+def ring_block_tiles(
+    g: Graph, dp: int, n_pad: int, block_b: int, tile_t: int
+) -> RingBlockTiles:
+    """Build the (shard, phase)-bucketed block-tile layouts.
+
+    Bucket membership matches parallel.ring.ring_shard_edges (phase =
+    (dst_shard - src_shard) mod dp); within a bucket, edges keep CSR
+    (src-sorted) order so tiles of one block stay contiguous. All dp*dp
+    layouts are padded to the max tile count. n_pad must be a multiple of
+    dp * block_b.
+    """
+    assert n_pad % dp == 0 and (n_pad // dp) % block_b == 0, (
+        n_pad, dp, block_b,
+    )
+    shard_rows = n_pad // dp
+    src_shard = g.src // shard_rows
+    dst_shard = g.dst // shard_rows
+    phase = (dst_shard - src_shard) % dp
+    order = np.lexsort((np.arange(g.src.size), phase, src_shard))
+    s_sorted = g.src[order]
+    d_sorted = g.dst[order]
+    ss = src_shard[order]
+    ph = phase[order]
+    if ss.size:
+        run_starts = np.flatnonzero(
+            np.r_[True, (ss[1:] != ss[:-1]) | (ph[1:] != ph[:-1])]
+        )
+        run_ends = np.r_[run_starts[1:], ss.size]
+        runs = {
+            (int(ss[lo]), int(ph[lo])): (lo, hi)
+            for lo, hi in zip(run_starts, run_ends)
+        }
+    else:
+        runs = {}                # edgeless graph: all buckets empty
+    parts = []
+    for i in range(dp):
+        for r in range(dp):
+            lo, hi = runs.get((i, r), (0, 0))
+            parts.append(
+                build_block_tiles_arrays(
+                    s_sorted[lo:hi] - i * shard_rows,
+                    d_sorted[lo:hi] - ((i + r) % dp) * shard_rows,
+                    shard_rows,
+                    block_b,
+                    tile_t,
+                )
+            )
+    n_tiles = max(p.n_tiles for p in parts)
+    n_blocks = parts[0].n_blocks
+
+    def pad_stack(field: str, fill):
+        outs = []
+        for p in parts:
+            a = getattr(p, field)
+            pad = n_tiles - a.shape[0]
+            if pad:
+                filler = np.full((pad,) + a.shape[1:], fill, a.dtype)
+                a = np.concatenate([a, filler])
+            outs.append(a)
+        return np.stack(outs).reshape((dp, dp) + outs[0].shape)
+
+    return RingBlockTiles(
+        src_local=pad_stack("src_local", 0),
+        dst_local=pad_stack("dst", 0),
+        mask=pad_stack("mask", 0.0),
+        # padding tiles attach to the last block (valid id, zero mask)
+        block_id=pad_stack("block_id", n_blocks - 1),
+        block_b=block_b,
+        tile_t=tile_t,
+        n_blocks=n_blocks,
+        shard_rows=shard_rows,
+    )
+
+
 class ShardedBlockTiles(NamedTuple):
     """Per-shard tile layouts, stacked on a leading shard axis (equal tile
     counts across shards — shard_map runs one SPMD program).

@@ -183,7 +183,7 @@ def _grad_kernel(bid_ref, srcl_ref, mask_ref, fd_ref, f_blk_ref,
 
 
 def _cand_kernel(bid_ref, srcl_ref, mask_ref, fd_ref, f_blk_ref, g_blk_ref,
-                 sumf_ref, out_ref, *, cfg, block_b):
+                 sumf_ref, out_ref, *, cfg, block_b, with_tails=True):
     i = pl.program_id(0)
     srcl = srcl_ref[0, 0]
     m = mask_ref[0, 0]
@@ -211,13 +211,18 @@ def _cand_kernel(bid_ref, srcl_ref, mask_ref, fd_ref, f_blk_ref, g_blk_ref,
 
     @pl.when(_first_tile_of_block(bid_ref, i))
     def _():
-        # Armijo tail terms, once per block: nf.(F_u - sumF) per candidate
-        fms = fb - sumf[None, :]             # (B, K)
-        tails = []
-        for eta in cfg.step_candidates:
-            nfb = jnp.clip(fb + eta * gb, cfg.min_f, cfg.max_f)
-            tails.append(jnp.sum(nfb * fms, axis=1))
-        out_ref[0] = jnp.stack(tails, axis=0)            # (S, B)
+        if with_tails:
+            # Armijo tails, once per block: nf.(F_u - sumF) per candidate
+            fms = fb - sumf[None, :]         # (B, K)
+            tails = []
+            for eta in cfg.step_candidates:
+                nfb = jnp.clip(fb + eta * gb, cfg.min_f, cfg.max_f)
+                tails.append(jnp.sum(nfb * fms, axis=1))
+            out_ref[0] = jnp.stack(tails, axis=0)        # (S, B)
+        else:
+            # neighbor terms only (ring schedule: each phase sees a partial
+            # edge set, tails are added once outside)
+            out_ref[0] = jnp.zeros_like(out_ref)[0]
 
     out_ref[0] += scat
 
@@ -300,8 +305,10 @@ def _cand_blocks(
     cfg: BigClamConfig,
     fd: jax.Array,
     interpret: bool,
+    with_tails: bool = True,
 ) -> jax.Array:
-    """Raw per-block candidate-LLH outputs (n_blocks, S, B), tails included.
+    """Raw per-block candidate-LLH outputs (n_blocks, S, B), tails included
+    unless with_tails=False (ring phases add tails once outside).
 
     NOTE: F/grad here are the rows covered by `tiles` (the whole model on
     the flat path; a group's row range on the grouped path) while `fd` rows
@@ -310,7 +317,9 @@ def _cand_blocks(
     b, t = tiles.block_b, tiles.tile_t
     n_tiles = tiles.src_local.shape[0]
     num_s = len(cfg.step_candidates)
-    kernel = functools.partial(_cand_kernel, cfg=cfg, block_b=b)
+    kernel = functools.partial(
+        _cand_kernel, cfg=cfg, block_b=b, with_tails=with_tails
+    )
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
         grid=(n_tiles,),
@@ -360,6 +369,253 @@ def candidates_csr(
     return out.transpose(1, 0, 2).reshape(num_s, n_pad)
 
 
+# --- K-sharded (TP) kernel suite -------------------------------------------
+#
+# Under a sharded K axis each device holds K_loc = K/tp columns of F, so the
+# per-edge dot F_u.F_v needs a psum over "k" — which cannot happen inside a
+# Pallas kernel. The sweep splits into two kernels with an XLA psum of the
+# per-edge PARTIAL dots in between:
+#
+#   dots kernel   : (B, K_loc) F block x one-hot -> partial x per edge tile
+#   [lax.psum over "k" of the (n_tiles, T) partials — 1 float/edge, far
+#    smaller than any F-row exchange]
+#   consume kernel: full x -> clipped edge terms -> (B, K_loc) grad partial
+#                   (K-local: fd rows are K-local) / (S, B) candidate LLH
+#                   terms (replicated over "k")
+#
+# The Armijo candidate dots are also K-local: clip(F_u + eta*grad_u) is
+# ELEMENTWISE over K, so clipped candidate rows shard like F and their dots
+# psum the same way. Armijo tail terms (which need row dots vs sumF) stay in
+# XLA where psum is natural (parallel/sharded.py). Callers: the TP branch of
+# parallel.sharded.make_sharded_csr_train_step.
+
+
+def _dot_kernel(bid_ref, srcl_ref, fd_ref, f_blk_ref, x_out_ref, *, block_b):
+    srcl = srcl_ref[0, 0]                   # (T,)
+    fd = fd_ref[0]                          # (T, K_loc)
+    fb = f_blk_ref[:]                       # (B, K_loc)
+    one = _expand_onehot(srcl, block_b, fd.dtype)        # (B, T)
+    fs = lax.dot_general(
+        one, fb, (((0,), (0,)), ((), ())),
+        precision=_PREC, preferred_element_type=fd.dtype,
+    )
+    x_out_ref[0, 0] = jnp.sum(fs * fd, axis=1)           # partial edge dots
+
+
+def edge_dots_csr(
+    F: jax.Array,
+    tiles: TilesDev,
+    fd: jax.Array,
+    interpret: bool = False,
+) -> jax.Array:
+    """Per-edge PARTIAL dots over this device's K_loc columns: (n_tiles, 1, T).
+
+    psum the result over the "k" mesh axis to obtain the full F_u.F_v dots."""
+    n_pad, k = F.shape
+    assert n_pad == tiles.n_pad, (n_pad, tiles.n_pad)
+    b, t = tiles.block_b, tiles.tile_t
+    n_tiles = tiles.src_local.shape[0]
+    kernel = functools.partial(_dot_kernel, block_b=b)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(n_tiles,),
+        in_specs=[
+            pl.BlockSpec((1, 1, t), lambda i, bid: (i, 0, 0)),
+            pl.BlockSpec((1, t, k), lambda i, bid: (i, 0, 0)),
+            pl.BlockSpec((b, k), lambda i, bid: (bid[i], 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, t), lambda i, bid: (i, 0, 0)),
+    )
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=_out_struct((n_tiles, 1, t), F.dtype, F, fd),
+        interpret=interpret,
+    )(tiles.block_id, tiles.src_local, fd, F)
+
+
+def _grad_from_x_kernel(bid_ref, srcl_ref, mask_ref, x_ref, fd_ref,
+                        grad_out_ref, llh_out_ref, *, cfg, block_b):
+    i = pl.program_id(0)
+    srcl = srcl_ref[0, 0]
+    m = mask_ref[0, 0]
+    x = x_ref[0, 0]                         # (T,) FULL edge dots (post-psum)
+    fd = fd_ref[0]                          # (T, K_loc)
+    one = _expand_onehot(srcl, block_b, fd.dtype)
+    p, ell_raw = edge_terms(x, cfg)
+    ell = ell_raw * m
+    coeff = m / (1.0 - p)
+    contrib = lax.dot_general(
+        one, fd * coeff[:, None], (((1,), (0,)), ((), ())),
+        precision=_PREC, preferred_element_type=fd.dtype,
+    )
+    llh_c = jnp.sum(one * ell[None, :], axis=1)
+
+    @pl.when(_first_tile_of_block(bid_ref, i))
+    def _():
+        grad_out_ref[0] = jnp.zeros_like(grad_out_ref)[0]
+        llh_out_ref[0, 0] = jnp.zeros_like(llh_out_ref)[0, 0]
+
+    grad_out_ref[0] += contrib
+    llh_out_ref[0, 0] += llh_c
+
+
+def grad_nbr_from_x_csr(
+    x: jax.Array,
+    tiles: TilesDev,
+    fd: jax.Array,
+    cfg: BigClamConfig,
+    interpret: bool = False,
+) -> Tuple[jax.Array, jax.Array]:
+    """Neighbor-gradient partial (n_pad, K_loc) + neighbor LLH (n_pad,) from
+    FULL edge dots `x` (edge_dots_csr psum'd over "k").
+
+    The gradient output is K-local (fd rows are this device's columns); the
+    LLH output depends only on x and so is replicated over "k". The caller
+    adds the -sumF + F and tail terms (they need their own psums)."""
+    n_tiles, _, t = x.shape
+    b = tiles.block_b
+    k = fd.shape[-1]
+    kernel = functools.partial(_grad_from_x_kernel, cfg=cfg, block_b=b)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(n_tiles,),
+        in_specs=[
+            pl.BlockSpec((1, 1, t), lambda i, bid: (i, 0, 0)),
+            pl.BlockSpec((1, 1, t), lambda i, bid: (i, 0, 0)),
+            pl.BlockSpec((1, 1, t), lambda i, bid: (i, 0, 0)),
+            pl.BlockSpec((1, t, k), lambda i, bid: (i, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, b, k), lambda i, bid: (bid[i], 0, 0)),
+            pl.BlockSpec((1, 1, b), lambda i, bid: (bid[i], 0, 0)),
+        ],
+    )
+    grad_nbr, llh_nbr = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=[
+            _out_struct((tiles.n_blocks, b, k), fd.dtype, x, fd, tiles.mask),
+            _out_struct((tiles.n_blocks, 1, b), fd.dtype, x, fd, tiles.mask),
+        ],
+        interpret=interpret,
+    )(tiles.block_id, tiles.src_local, tiles.mask, x, fd)
+    return grad_nbr.reshape(tiles.n_pad, k), llh_nbr.reshape(tiles.n_pad)
+
+
+def _cand_dot_kernel(bid_ref, srcl_ref, fd_ref, f_blk_ref, g_blk_ref,
+                     xc_out_ref, *, cfg, block_b):
+    srcl = srcl_ref[0, 0]
+    fd = fd_ref[0]
+    fb = f_blk_ref[:]
+    gb = g_blk_ref[:]
+    one = _expand_onehot(srcl, block_b, fd.dtype)
+    dims = (((0,), (0,)), ((), ()))
+    fs = lax.dot_general(one, fb, dims, precision=_PREC,
+                         preferred_element_type=fd.dtype)
+    gs = lax.dot_general(one, gb, dims, precision=_PREC,
+                         preferred_element_type=fd.dtype)
+    for s, eta in enumerate(cfg.step_candidates):
+        # clip is elementwise over K: the clipped candidate row's K_loc
+        # slice only needs this device's fs/gs columns
+        nf = jnp.clip(fs + eta * gs, cfg.min_f, cfg.max_f)
+        xc_out_ref[0, s] = jnp.sum(nf * fd, axis=1)
+
+
+def cand_dots_csr(
+    F: jax.Array,
+    grad: jax.Array,
+    tiles: TilesDev,
+    fd: jax.Array,
+    cfg: BigClamConfig,
+    interpret: bool = False,
+) -> jax.Array:
+    """Per-edge PARTIAL candidate dots for all S steps: (n_tiles, S, T).
+
+    psum over "k" gives the full clip(F_u + eta*grad_u).F_v dots."""
+    n_pad, k = F.shape
+    assert n_pad == tiles.n_pad, (n_pad, tiles.n_pad)
+    b, t = tiles.block_b, tiles.tile_t
+    n_tiles = tiles.src_local.shape[0]
+    num_s = len(cfg.step_candidates)
+    kernel = functools.partial(_cand_dot_kernel, cfg=cfg, block_b=b)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(n_tiles,),
+        in_specs=[
+            pl.BlockSpec((1, 1, t), lambda i, bid: (i, 0, 0)),
+            pl.BlockSpec((1, t, k), lambda i, bid: (i, 0, 0)),
+            pl.BlockSpec((b, k), lambda i, bid: (bid[i], 0)),
+            pl.BlockSpec((b, k), lambda i, bid: (bid[i], 0)),
+        ],
+        out_specs=pl.BlockSpec((1, num_s, t), lambda i, bid: (i, 0, 0)),
+    )
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=_out_struct((n_tiles, num_s, t), F.dtype, F, grad, fd),
+        interpret=interpret,
+    )(tiles.block_id, tiles.src_local, fd, F, grad)
+
+
+def _cand_from_x_kernel(bid_ref, srcl_ref, mask_ref, xc_ref, out_ref,
+                        *, cfg, block_b):
+    i = pl.program_id(0)
+    srcl = srcl_ref[0, 0]
+    m = mask_ref[0, 0]
+    xc = xc_ref[0]                          # (S, T) FULL candidate dots
+    one = _expand_onehot(srcl, block_b, xc.dtype)
+    ells = []
+    for s in range(len(cfg.step_candidates)):
+        _, ell = edge_terms(xc[s], cfg)
+        ells.append(ell * m)
+    ell_t = jnp.stack(ells, axis=0)          # (S, T)
+    scat = lax.dot_general(
+        ell_t, one, (((1,), (1,)), ((), ())),
+        precision=_PREC, preferred_element_type=xc.dtype,
+    )
+
+    @pl.when(_first_tile_of_block(bid_ref, i))
+    def _():
+        out_ref[0] = jnp.zeros_like(out_ref)[0]
+
+    out_ref[0] += scat
+
+
+def cand_nbr_from_x_csr(
+    xc: jax.Array,
+    tiles: TilesDev,
+    cfg: BigClamConfig,
+    interpret: bool = False,
+) -> jax.Array:
+    """NEIGHBOR candidate-LLH terms (S, n_pad) from full candidate dots.
+
+    Unlike candidates_csr this does NOT include the Armijo tails (they need
+    psums over "k"; the TP caller computes them in XLA)."""
+    n_tiles, num_s, t = xc.shape
+    b = tiles.block_b
+    kernel = functools.partial(_cand_from_x_kernel, cfg=cfg, block_b=b)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(n_tiles,),
+        in_specs=[
+            pl.BlockSpec((1, 1, t), lambda i, bid: (i, 0, 0)),
+            pl.BlockSpec((1, 1, t), lambda i, bid: (i, 0, 0)),
+            pl.BlockSpec((1, num_s, t), lambda i, bid: (i, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, num_s, b), lambda i, bid: (bid[i], 0, 0)),
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=_out_struct(
+            (tiles.n_blocks, num_s, b), xc.dtype, xc, tiles.mask
+        ),
+        interpret=interpret,
+    )(tiles.block_id, tiles.src_local, tiles.mask, xc)
+    return out.transpose(1, 0, 2).reshape(num_s, tiles.n_pad)
+
+
 class GroupedTilesDev(NamedTuple):
     """Device-resident ops.csr_tiles.GroupedBlockTiles (large-K layout)."""
 
@@ -405,18 +661,24 @@ def grad_llh_csr_grouped(
     gt: GroupedTilesDev,
     cfg: BigClamConfig,
     interpret: bool = False,
+    F_gather: jax.Array = None,
 ) -> Tuple[jax.Array, jax.Array]:
     """grad_llh_csr over the grouped layout: lax.scan over block groups,
     gathering only each group's (G, T, K) dst rows per iteration — the
-    large-K path where one whole-graph gather would blow the HBM budget."""
+    large-K path where one whole-graph gather would blow the HBM budget.
+
+    `F_gather` is the array dst indices point into (defaults to F itself;
+    the sharded trainer passes the all-gathered full F while F holds only
+    this shard's rows)."""
     n_pad, k = F.shape
     assert n_pad == gt.n_pad, (n_pad, gt.n_pad)
     rows = gt.nb * gt.block_b
+    F_src = F if F_gather is None else F_gather
 
     def body(_, xs):
         gi, tile_xs = xs
         td = _group_view(gt, tile_xs)
-        fd = jnp.take(F, td.dst, axis=0)
+        fd = jnp.take(F_src, td.dst, axis=0)
         F_g = lax.dynamic_slice_in_dim(F, gi * rows, rows)
         return None, _grad_blocks(F_g, td, cfg, fd, interpret)
 
@@ -442,6 +704,7 @@ def train_pass_csr_grouped(
     gt: GroupedTilesDev,
     cfg: BigClamConfig,
     interpret: bool = False,
+    F_gather: jax.Array = None,
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """Grad + candidates in ONE scan over block groups, sharing each group's
     dst-row gather (the dominant memory cost on this path).
@@ -449,17 +712,20 @@ def train_pass_csr_grouped(
     Works because everything the candidate kernel needs is group-local: the
     group's grad rows are complete once its grad kernel ran (grad_g =
     gn_g - sumF + F_g), and fd comes from the OLD full F either way.
+    `F_gather` as in grad_llh_csr_grouped (sharded trainers pass the
+    all-gathered F).
     Returns (grad (n_pad, K), node_llh (n_pad,), cand_full (S, n_pad)).
     """
     n_pad, k = F.shape
     assert n_pad == gt.n_pad, (n_pad, gt.n_pad)
     rows = gt.nb * gt.block_b
     num_s = len(cfg.step_candidates)
+    F_src = F if F_gather is None else F_gather
 
     def body(_, xs):
         gi, tile_xs = xs
         td = _group_view(gt, tile_xs)
-        fd = jnp.take(F, td.dst, axis=0)
+        fd = jnp.take(F_src, td.dst, axis=0)
         F_g = lax.dynamic_slice_in_dim(F, gi * rows, rows)
         gn, ln = _grad_blocks(F_g, td, cfg, fd, interpret)
         grad_g = gn.reshape(rows, k) - sumF[None, :] + F_g

@@ -17,7 +17,10 @@ take one full rotation (the candidate pass re-rotates because it needs the
 finished gradient).
 
 Semantics are IDENTICAL to the single-chip and all-gather trainers —
-verified by the shard-invariance suite (tests/test_ring.py).
+verified by the shard-invariance suite (tests/test_ring.py). The hot sweeps
+run either as XLA chunk scans (the fallback and the tp > 1 path) or on the
+blocked-CSR MXU kernels via per-(shard, phase) tile buckets
+(make_ring_csr_train_step; auto-engaged on TPU at tp == 1).
 """
 
 from __future__ import annotations
@@ -32,11 +35,16 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from bigclam_tpu.config import BigClamConfig
 from bigclam_tpu.graph.csr import Graph
-from bigclam_tpu.models.bigclam import TrainState, edge_chunk_bound
+from bigclam_tpu.models.bigclam import TrainState, _round_up, edge_chunk_bound
 from bigclam_tpu.ops.objective import EdgeChunks, edge_terms
 from bigclam_tpu.parallel.mesh import K_AXIS, NODES_AXIS
 from bigclam_tpu.parallel.multihost import put_sharded
-from bigclam_tpu.parallel.sharded import ShardedBigClamModel, _mark_varying, _rowdot
+from bigclam_tpu.parallel.sharded import (
+    ShardedBigClamModel,
+    _mark_varying,
+    _rowdot,
+    armijo_tail_select_sharded,
+)
 
 
 def ring_shard_edges(
@@ -184,25 +192,11 @@ def make_ring_train_step(
             cand_phase, (F_back, init_cand), (src, dst, mask)
         )
 
-        # --- Armijo acceptance + Jacobi update (node-local, as sharded.py) ---
-        gg = _rowdot(grad, grad).astype(adt)
-
-        def tail_for(eta):
-            nf = jnp.clip(F_loc + eta * grad, cfg.min_f, cfg.max_f)
-            sf_adj = sumF[None, :] - F_loc + nf
-            return (-_rowdot(nf, sf_adj) + _rowdot(nf, nf)).astype(adt)
-
-        tails = lax.map(tail_for, etas)
-        cand_llh = cand_nbr + tails
-        ok = cand_llh >= node_llh[None, :] + cfg.alpha * etas[:, None] * gg[None, :]
-        best_eta = jnp.max(jnp.where(ok, etas[:, None], 0.0), axis=0)
-        accepted = jnp.any(ok, axis=0)
-        F_new = jnp.where(
-            accepted[:, None],
-            jnp.clip(F_loc + best_eta[:, None] * grad, cfg.min_f, cfg.max_f),
-            F_loc,
+        # --- Armijo acceptance + Jacobi update (shared helper) ---
+        F_new, sum_loc = armijo_tail_select_sharded(
+            F_loc, grad, node_llh, cand_nbr, sumF, cfg
         )
-        sumF_new = lax.psum(F_new.sum(axis=0), NODES_AXIS)
+        sumF_new = lax.psum(sum_loc, NODES_AXIS)
         return F_new, sumF_new, llh_cur.astype(F_loc.dtype), it + 1
 
     def step(state: TrainState) -> TrainState:
@@ -223,30 +217,225 @@ def make_ring_train_step(
     return jax.jit(step)
 
 
+def make_ring_csr_train_step(
+    mesh: Mesh, tiles: dict, cfg: BigClamConfig
+) -> Callable[[TrainState], TrainState]:
+    """Ring-pass iteration on the blocked-CSR MXU kernels.
+
+    Same two rotations as make_ring_train_step, but each phase runs the
+    grad / candidate Pallas kernels (ops.pallas_csr) over that phase's
+    pre-built block-tile bucket (ops.csr_tiles.ring_block_tiles) against
+    the resident rotating F shard: the per-phase (n_tiles, T, K) fd gather
+    reads only F_rot — peak HBM stays O(2 * N/dp * K) like the XLA ring.
+    Per-block kernel outputs accumulate across phases in the scan carry;
+    Armijo tails are added once at the end (shared helper — the candidate
+    kernels run with with_tails=False since each phase sees only a partial
+    edge set)."""
+    from bigclam_tpu.ops.pallas_csr import TilesDev, _cand_blocks, _grad_blocks
+
+    dp = mesh.shape[NODES_AXIS]
+    perm = [(j, (j - 1) % dp) for j in range(dp)]
+    interp = cfg.pallas_interpret
+    block_b = tiles["block_b"]
+    tile_t = tiles["tile_t"]
+    n_blocks = tiles["n_blocks"]
+    num_s = len(cfg.step_candidates)
+
+    def step_shard(F_loc, srcl, dstl, mask, bid, it):
+        srcl, dstl, mask, bid = srcl[0], dstl[0], mask[0], bid[0]
+        n_loc, k = F_loc.shape
+        adt = jnp.dtype(cfg.accum_dtype) if cfg.accum_dtype else F_loc.dtype
+        sumF = lax.psum(F_loc.sum(axis=0), NODES_AXIS)
+
+        def td_of(xs):
+            s, d, m, b_ = xs
+            td = TilesDev(
+                src_local=s, dst=d, mask=m, block_id=b_,
+                block_b=block_b, tile_t=tile_t, n_blocks=n_blocks,
+            )
+            return td, d
+
+        # --- rotation 1: per-phase grad/LLH kernels, block accumulators ---
+        def grad_phase(carry, xs):
+            F_rot, gn_acc, ln_acc = carry
+            td, d = td_of(xs)
+            fd = jnp.take(F_rot, d, axis=0)      # local rows of F_rot
+            gn, ln = _grad_blocks(F_loc, td, cfg, fd, interp)
+            F_rot = lax.ppermute(F_rot, NODES_AXIS, perm)
+            return (F_rot, gn_acc + gn, ln_acc + ln), None
+
+        init = (
+            F_loc,
+            _mark_varying(
+                jnp.zeros((n_blocks, block_b, k), F_loc.dtype),
+                (NODES_AXIS,),
+            ),
+            _mark_varying(
+                jnp.zeros((n_blocks, 1, block_b), F_loc.dtype),
+                (NODES_AXIS,),
+            ),
+        )
+        (F_back, gn, ln), _ = lax.scan(
+            grad_phase, init, (srcl, dstl, mask, bid)
+        )
+        grad = gn.reshape(n_loc, k) - sumF[None, :] + F_loc
+        node_llh = ln.reshape(n_loc).astype(adt) + (
+            -lax.psum(F_loc @ sumF, K_AXIS) + _rowdot(F_loc, F_loc)
+        ).astype(adt)
+        llh_cur = lax.psum(node_llh.sum(), NODES_AXIS)
+
+        # --- rotation 2: per-phase candidate kernels (neighbor terms) ---
+        def cand_phase(carry, xs):
+            F_rot, cn_acc = carry
+            td, d = td_of(xs)
+            fd = jnp.take(F_rot, d, axis=0)
+            cb = _cand_blocks(
+                F_loc, grad, sumF, td, cfg, fd, interp, with_tails=False
+            )
+            F_rot = lax.ppermute(F_rot, NODES_AXIS, perm)
+            return (F_rot, cn_acc + cb), None
+
+        initc = (
+            F_back,                              # full rotation restored F
+            _mark_varying(
+                jnp.zeros((n_blocks, num_s, block_b), F_loc.dtype),
+                (NODES_AXIS,),
+            ),
+        )
+        (_, cb), _ = lax.scan(cand_phase, initc, (srcl, dstl, mask, bid))
+        cand_nbr = cb.transpose(1, 0, 2).reshape(num_s, n_loc).astype(adt)
+        F_new, sum_loc = armijo_tail_select_sharded(
+            F_loc, grad, node_llh, cand_nbr, sumF, cfg
+        )
+        sumF_new = lax.psum(sum_loc, NODES_AXIS)
+        return F_new, sumF_new, llh_cur.astype(F_loc.dtype), it + 1
+
+    def step(state: TrainState) -> TrainState:
+        F_new, sumF, llh, it = jax.shard_map(
+            step_shard,
+            mesh=mesh,
+            in_specs=(
+                P(NODES_AXIS, K_AXIS),
+                P(NODES_AXIS, None, None, None, None),
+                P(NODES_AXIS, None, None, None),
+                P(NODES_AXIS, None, None, None, None),
+                P(NODES_AXIS, None, None),
+                P(),
+            ),
+            out_specs=(P(NODES_AXIS, K_AXIS), P(K_AXIS), P(), P()),
+            check_vma=False,       # pallas interpret + prefetch (see sharded)
+        )(
+            state.F, tiles["src_local"], tiles["dst_local"], tiles["mask"],
+            tiles["block_id"], state.it,
+        )
+        return TrainState(F=F_new, sumF=sumF, llh=llh, it=it)
+
+    return jax.jit(step)
+
+
 class RingBigClamModel(ShardedBigClamModel):
     """Sharded trainer using the ring-pass schedule (same API/trajectories
-    as ShardedBigClamModel; different memory/communication profile)."""
+    as ShardedBigClamModel; different memory/communication profile).
+
+    With the blocked-CSR kernels engaged (auto on TPU, tp == 1) each ring
+    phase runs the MXU kernels over its (shard, phase) tile bucket; the XLA
+    chunk-scan schedule remains the fallback and the tp > 1 path."""
 
     def _csr_static_ok(self, tp: int) -> bool:
-        # the ring schedule rotates F shards; the blocked-CSR kernels assume
-        # an all-gathered F — not applicable here (future work, PARITY.md)
+        if tp > 1:
+            if self.cfg.use_pallas_csr is True:
+                raise ValueError(
+                    "use_pallas_csr=True on the ring schedule requires an "
+                    f"unsharded K axis (tp == 1); got tp={tp}"
+                )
+            from bigclam_tpu.models.bigclam import csr_want_reason
+
+            want, reason = csr_want_reason(self.cfg)
+            self._csr_reason = (
+                "ring schedule: CSR kernels need an unsharded K axis "
+                f"(tp={tp})" if want else reason
+            )
+            return False
+        return super()._csr_static_ok(tp)
+
+    def _csr_economy_ok(self, dp: int) -> bool:
+        """Probe the ring tile layout: dp*dp buckets padded to the max tile
+        count (empty buckets cost one tile each), per-phase fd gather
+        bounded by GROUP_FD_BUDGET (it is materialized per scan step)."""
+        from bigclam_tpu.models.bigclam import GROUP_FD_BUDGET
+        from bigclam_tpu.ops.csr_tiles import (
+            layout_economical,
+            ring_block_tiles,
+        )
+
+        block_b, tile_t = self._csr_shape
+        n_pad = _round_up(max(self.g.num_nodes, dp), dp * block_b)
+        rbt = ring_block_tiles(self.g, dp, n_pad, block_b, tile_t)
+        e = max(self.g.num_directed_edges, 1)
+        n_tiles = rbt.src_local.shape[2]
+        phase_fd = n_tiles * tile_t * self._csr_k_pad * 4
+        pad_ok = layout_economical(
+            rbt.slots, e, dp * dp * rbt.n_blocks, tile_t
+        )
+        if pad_ok and phase_fd <= GROUP_FD_BUDGET:
+            self._probe_tiles = rbt
+            self._csr_nb = None
+            return True
         if self.cfg.use_pallas_csr is True:
             raise ValueError(
-                "use_pallas_csr=True is not supported on the ring schedule "
-                "(the kernels need an all-gathered F); use "
-                "ShardedBigClamModel or leave use_pallas_csr unset"
+                f"use_pallas_csr=True but ring layout uneconomical: "
+                f"{rbt.slots - e} padded edge slots on {e}, per-phase fd "
+                f"gather {phase_fd >> 20} MiB (try balance=True or the "
+                "all-gather trainer)"
             )
-        from bigclam_tpu.models.bigclam import csr_want_reason
-
-        want, reason = csr_want_reason(self.cfg)
         self._csr_reason = (
-            "ring schedule: CSR kernels not yet supported" if want else reason
+            f"ring layout uneconomical: {rbt.slots - e} padded edge slots "
+            f"on {e} edges, per-phase fd gather {phase_fd >> 20} MiB"
         )
         return False
+
+    def _build_csr_step(self, dp: int) -> None:
+        from bigclam_tpu.ops.csr_tiles import ring_block_tiles
+
+        rbt = getattr(self, "_probe_tiles", None)
+        self._probe_tiles = None
+        if rbt is None or self._perm is not None:
+            rbt = ring_block_tiles(
+                self.g, dp, self.n_pad, *self._csr_shape
+            )
+        dp_, dpp, nt, t = rbt.src_local.shape
+
+        def nspec(ndim: int) -> NamedSharding:
+            return NamedSharding(
+                self.mesh, P(NODES_AXIS, *([None] * (ndim - 1)))
+            )
+
+        tiles = {
+            "src_local": put_sharded(
+                rbt.src_local.reshape(dp_, dpp, nt, 1, t).astype(np.int32),
+                nspec(5),
+            ),
+            "dst_local": put_sharded(
+                rbt.dst_local.astype(np.int32), nspec(4)
+            ),
+            "mask": put_sharded(
+                rbt.mask.reshape(dp_, dpp, nt, 1, t).astype(self.dtype),
+                nspec(5),
+            ),
+            "block_id": put_sharded(rbt.block_id.astype(np.int32), nspec(3)),
+            "block_b": rbt.block_b,
+            "tile_t": rbt.tile_t,
+            "n_blocks": rbt.n_blocks,
+        }
+        self.edges = None
+        self._step = make_ring_csr_train_step(self.mesh, tiles, self.cfg)
 
     def _build_edges_and_step(self) -> None:
         dp = self.mesh.shape[NODES_AXIS]
         tp = self.mesh.shape[K_AXIS]
+        if self._csr_wanted:
+            self._build_csr_step(dp)
+            return
         bound = edge_chunk_bound(
             self.cfg, max(self.k_pad // tp, 1), self.dtype
         )

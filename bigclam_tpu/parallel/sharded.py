@@ -35,6 +35,7 @@ from bigclam_tpu.config import BigClamConfig
 from bigclam_tpu.graph.csr import Graph
 from bigclam_tpu.models.bigclam import (
     FLAT_FD_BUDGET,
+    GROUP_FD_BUDGET,
     FitResult,
     TrainState,
     _round_up,
@@ -99,36 +100,100 @@ def _mark_varying(x: jax.Array, axes: tuple) -> jax.Array:
     return lax.pcast(x, missing, to="varying") if missing else x
 
 
+def armijo_tail_select_sharded(
+    F_loc: jax.Array,
+    grad: jax.Array,
+    node_llh: jax.Array,
+    cand_nbr: jax.Array,
+    sumF: jax.Array,
+    cfg: BigClamConfig,
+) -> tuple[jax.Array, jax.Array]:
+    """Armijo tails (rowdot-psums over "k") + acceptance + max-accepted-step
+    Jacobi update, K-shard aware. ONE implementation shared by the XLA
+    sharded step, the ring step, and the CSR TP step — any tuning of the
+    acceptance rule lands in all schedules at once.
+
+    gg is computed in accum dtype exactly as ops.linesearch.armijo_update,
+    so sharded acceptance decisions match single-chip bit-for-bit. Returns
+    (F_new, local column sums of F_new) — the caller psums the latter.
+    """
+    adt = jnp.dtype(cfg.accum_dtype) if cfg.accum_dtype else F_loc.dtype
+    etas = jnp.asarray(cfg.step_candidates, F_loc.dtype)
+    gg = _rowdot(grad, grad).astype(adt)
+
+    def tail_for(eta):
+        nf = jnp.clip(F_loc + eta * grad, cfg.min_f, cfg.max_f)
+        sf_adj = sumF[None, :] - F_loc + nf
+        return (-_rowdot(nf, sf_adj) + _rowdot(nf, nf)).astype(adt)
+
+    tails = lax.map(tail_for, etas)
+    cand_llh = cand_nbr + tails
+    ok = cand_llh >= node_llh[None, :] + cfg.alpha * etas[:, None] * gg[None, :]
+    best_eta = jnp.max(jnp.where(ok, etas[:, None], 0.0), axis=0)
+    accepted = jnp.any(ok, axis=0)
+    F_new = jnp.where(
+        accepted[:, None],
+        jnp.clip(F_loc + best_eta[:, None] * grad, cfg.min_f, cfg.max_f),
+        F_loc,
+    )
+    return F_new, F_new.sum(axis=0)
+
+
 def make_sharded_csr_train_step(
     mesh: Mesh, tiles, cfg: BigClamConfig
 ) -> Callable[[TrainState], TrainState]:
     """Sharded iteration on the blocked-CSR MXU kernels (ops.pallas_csr).
 
-    DP-only (the K axis must be unsharded per device: the kernels' in-VMEM
-    edge dots cannot psum mid-kernel). Each shard all-gathers F, gathers its
-    tiles' dst rows ONCE (shared by both kernels), and runs the same two
-    Pallas kernels as the single-chip path over its shard-local tile layout
-    (ops.csr_tiles.shard_block_tiles); LLH and sumF are psums.
-    `tiles` is a dict of device arrays + static fields built by
-    ShardedBigClamModel._build_edges_and_step.
+    Three schedules, chosen by the tile layout + mesh:
+
+    * tp == 1, flat: each shard all-gathers F over "nodes", gathers its
+      tiles' dst rows ONCE (shared by both kernels), runs the same two
+      fused Pallas kernels as the single-chip path.
+    * tp > 1 (K axis sharded): the in-VMEM edge dots cannot psum mid-kernel,
+      so each sweep splits into a partial-dot kernel, a lax.psum of the
+      per-edge partials over "k" (1 float/edge — tiny next to any F-row
+      exchange), and a consume kernel (see the TP suite in ops.pallas_csr).
+      Armijo tails are XLA rowdot-psums as in the XLA sharded step.
+    * grouped (large K, tp == 1): scan over block-group windows with
+      per-group dst gathers from the all-gathered F (bounds the fd gather
+      to GROUP_FD_BUDGET where the flat gather would blow HBM).
+
+    LLH and sumF are psums either way. `tiles` is a dict of device arrays +
+    static fields built by ShardedBigClamModel._build_csr_step.
     """
     from bigclam_tpu.ops.linesearch import armijo_select
     from bigclam_tpu.ops.pallas_csr import (
+        GroupedTilesDev,
         TilesDev,
+        cand_dots_csr,
+        cand_nbr_from_x_csr,
         candidates_csr,
+        edge_dots_csr,
         grad_llh_csr,
+        grad_nbr_from_x_csr,
+        train_pass_csr_grouped,
     )
 
     interp = cfg.pallas_interpret
+    tp = mesh.shape[K_AXIS]
     block_b = tiles["block_b"]
     tile_t = tiles["tile_t"]
-    n_blocks = tiles["n_blocks"]
+    grouped = tiles.get("nb") is not None
 
-    def step_shard(F_loc, srcl, dst, mask, bid, it):
+    def finish(F_loc, grad, node_llh, cand_nbr, sumF, it):
+        """Armijo tails + select + update (shared helper) + the psums."""
+        F_new, sum_loc = armijo_tail_select_sharded(
+            F_loc, grad, node_llh, cand_nbr, sumF, cfg
+        )
+        sumF_new = lax.psum(sum_loc, NODES_AXIS)
+        llh_cur = lax.psum(node_llh.sum(), NODES_AXIS)
+        return F_new, sumF_new, llh_cur.astype(F_loc.dtype), it + 1
+
+    def step_shard_flat(F_loc, srcl, dst, mask, bid, it):
         srcl, dst, mask, bid = srcl[0], dst[0], mask[0], bid[0]
         td = TilesDev(
             src_local=srcl, dst=dst, mask=mask, block_id=bid,
-            block_b=block_b, tile_t=tile_t, n_blocks=n_blocks,
+            block_b=block_b, tile_t=tile_t, n_blocks=tiles["n_blocks"],
         )
         F_full = lax.all_gather(F_loc, NODES_AXIS, axis=0, tiled=True)
         sumF = lax.psum(F_loc.sum(axis=0), NODES_AXIS)
@@ -144,6 +209,59 @@ def make_sharded_csr_train_step(
         sumF_new = lax.psum(sum_loc, NODES_AXIS)
         return F_new, sumF_new, llh_cur.astype(F_loc.dtype), it + 1
 
+    def step_shard_tp(F_loc, srcl, dst, mask, bid, it):
+        srcl, dst, mask, bid = srcl[0], dst[0], mask[0], bid[0]
+        td = TilesDev(
+            src_local=srcl, dst=dst, mask=mask, block_id=bid,
+            block_b=block_b, tile_t=tile_t, n_blocks=tiles["n_blocks"],
+        )
+        adt = jnp.dtype(cfg.accum_dtype) if cfg.accum_dtype else F_loc.dtype
+        F_full = lax.all_gather(F_loc, NODES_AXIS, axis=0, tiled=True)
+        sumF = lax.psum(F_loc.sum(axis=0), NODES_AXIS)       # (K_loc,)
+        fd = jnp.take(F_full, td.dst, axis=0)                # K-local rows
+        x = lax.psum(
+            edge_dots_csr(F_loc, td, fd, interpret=interp), K_AXIS
+        )
+        grad_nbr, llh_nbr = grad_nbr_from_x_csr(
+            x, td, fd, cfg, interpret=interp
+        )
+        grad = grad_nbr - sumF[None, :] + F_loc
+        node_llh = llh_nbr.astype(adt) + (
+            -lax.psum(F_loc @ sumF, K_AXIS) + _rowdot(F_loc, F_loc)
+        ).astype(adt)
+        xc = lax.psum(
+            cand_dots_csr(F_loc, grad, td, fd, cfg, interpret=interp),
+            K_AXIS,
+        )
+        cand_nbr = cand_nbr_from_x_csr(xc, td, cfg, interpret=interp)
+        return finish(F_loc, grad, node_llh, cand_nbr.astype(adt), sumF, it)
+
+    def step_shard_grouped(F_loc, srcl, dst, mask, bid, it):
+        gt = GroupedTilesDev(
+            src_local=srcl[0], dst=dst[0], mask=mask[0], block_id=bid[0],
+            block_b=block_b, tile_t=tile_t, nb=tiles["nb"],
+            n_groups=tiles["n_groups"],
+        )
+        F_full = lax.all_gather(F_loc, NODES_AXIS, axis=0, tiled=True)
+        sumF = lax.psum(F_loc.sum(axis=0), NODES_AXIS)
+        grad, node_llh, cand_full = train_pass_csr_grouped(
+            F_loc, sumF, gt, cfg, interpret=interp, F_gather=F_full
+        )
+        llh_cur = lax.psum(node_llh.sum(), NODES_AXIS)
+        F_new, sum_loc = armijo_select(F_loc, grad, node_llh, cand_full, cfg)
+        sumF_new = lax.psum(sum_loc, NODES_AXIS)
+        return F_new, sumF_new, llh_cur.astype(F_loc.dtype), it + 1
+
+    if grouped:
+        step_shard = step_shard_grouped
+    elif tp > 1:
+        step_shard = step_shard_tp
+    else:
+        step_shard = step_shard_flat
+
+    def spec_for(arr) -> P:
+        return P(NODES_AXIS, *([None] * (arr.ndim - 1)))
+
     def step(state: TrainState) -> TrainState:
         # check_vma=False: pallas_call's interpret-mode lowering mixes
         # varying (scalar-prefetched block ids) and replicated operands in
@@ -155,10 +273,10 @@ def make_sharded_csr_train_step(
             mesh=mesh,
             in_specs=(
                 P(NODES_AXIS, K_AXIS),
-                P(NODES_AXIS, None, None, None),
-                P(NODES_AXIS, None, None),
-                P(NODES_AXIS, None, None, None),
-                P(NODES_AXIS, None),
+                spec_for(tiles["src_local"]),
+                spec_for(tiles["dst"]),
+                spec_for(tiles["mask"]),
+                spec_for(tiles["block_id"]),
                 P(),
             ),
             out_specs=(P(NODES_AXIS, K_AXIS), P(K_AXIS), P(), P()),
@@ -252,27 +370,11 @@ def make_sharded_train_step(
             (src, dst, mask),
         )
 
-        # Armijo acceptance + max-accepted-step update, all node-local
-        # (gg in accum dtype exactly as ops.linesearch.armijo_update, so the
-        # sharded acceptance decisions match single-chip bit-for-bit)
-        gg = _rowdot(grad, grad).astype(adt)
-
-        def tail_for(eta):
-            nf = jnp.clip(F_loc + eta * grad, cfg.min_f, cfg.max_f)
-            sf_adj = sumF[None, :] - F_loc + nf
-            return (-_rowdot(nf, sf_adj) + _rowdot(nf, nf)).astype(adt)
-
-        tails = lax.map(tail_for, etas)
-        cand_llh = cand_nbr + tails
-        ok = cand_llh >= node_llh[None, :] + cfg.alpha * etas[:, None] * gg[None, :]
-        best_eta = jnp.max(jnp.where(ok, etas[:, None], 0.0), axis=0)
-        accepted = jnp.any(ok, axis=0)
-        F_new = jnp.where(
-            accepted[:, None],
-            jnp.clip(F_loc + best_eta[:, None] * grad, cfg.min_f, cfg.max_f),
-            F_loc,
+        # Armijo acceptance + max-accepted-step update (shared helper)
+        F_new, sum_loc = armijo_tail_select_sharded(
+            F_loc, grad, node_llh, cand_nbr, sumF, cfg
         )
-        sumF_new = lax.psum(F_new.sum(axis=0), NODES_AXIS)   # (K_loc,)
+        sumF_new = lax.psum(sum_loc, NODES_AXIS)             # (K_loc,)
         return F_new, sumF_new, llh_cur.astype(F_loc.dtype), it + 1
 
     def step(state: TrainState) -> TrainState:
@@ -322,14 +424,16 @@ class ShardedBigClamModel:
         self.k_pad = _round_up(cfg.num_communities, tp)
         self._csr_wanted = self._csr_static_ok(tp) and self._csr_economy_ok(dp)
         if self._csr_wanted:
-            # blocked-CSR kernel layout: shards hold whole node blocks and
-            # K rides the 128-lane MXU tiling (padding rows/cols are inert).
-            # Committed only now — the economy probe above already accepted
-            # the layout, so the XLA fallback never sees inflated padding.
+            # blocked-CSR kernel layout: shards hold whole node blocks (and
+            # whole block GROUPS on the grouped path) and K_loc rides the
+            # 128-lane MXU tiling (padding rows/cols are inert). Committed
+            # only now — the economy probe above already accepted the
+            # layout, so the XLA fallback never sees inflated padding.
             self.n_pad = _round_up(
-                max(g.num_nodes, dp), dp * self._csr_shape[0]
+                max(g.num_nodes, dp),
+                dp * self._csr_shape[0] * (self._csr_nb or 1),
             )
-            self.k_pad = _round_up(self.k_pad, 128)
+            self.k_pad = self._csr_k_pad
         # degree-balanced relabeling (parallel/balance.py): the trainer runs
         # on the relabeled graph; F0 in / results out stay in original ids
         self._perm = None
@@ -349,7 +453,9 @@ class ShardedBigClamModel:
     def engaged_path(self) -> str:
         """Edge-sweep implementation this trainer compiled (see
         log_engaged_path); subclasses with more schedules override."""
-        return "csr" if self._csr_wanted else "xla"
+        if not self._csr_wanted:
+            return "xla"
+        return "csr_grouped" if getattr(self, "_csr_nb", None) else "csr"
 
     def _to_internal_rows(self, F0: np.ndarray) -> np.ndarray:
         """Original-id F rows -> the trainer's (possibly relabeled) row order."""
@@ -365,7 +471,11 @@ class ShardedBigClamModel:
 
     def _csr_static_ok(self, tp: int) -> bool:
         """Static engagement check for the blocked-CSR sharded step (the
-        economy checks that need the built tiles live in _build_csr_step)."""
+        economy checks that need the built tiles live in _csr_economy_ok).
+
+        tp > 1 is supported via the TP kernel suite (partial dots + psum
+        over "k", ops.pallas_csr); it needs K_loc = k_pad/tp to satisfy the
+        Mosaic lane alignment, so k_pad is rounded up to 128*tp."""
         from bigclam_tpu.ops.pallas_csr import (
             csr_tiles_supported,
             fit_tile_shape,
@@ -378,29 +488,33 @@ class ShardedBigClamModel:
         if not want:
             self._csr_reason = reason
             return False
-        k_pad = _round_up(self.k_pad, 128)
+        # per-device column count governs the kernels' VMEM working set
+        self._csr_k_pad = (
+            self.k_pad
+            if cfg.pallas_interpret
+            else _round_up(self.k_pad, 128 * tp)
+        )
+        k_loc = self._csr_k_pad // tp
         # shrink tiles to the kernels' VMEM budget, like the single-chip path
         self._csr_shape = (
             (cfg.csr_block_b, cfg.csr_tile_t)
             if cfg.pallas_interpret
-            else fit_tile_shape(cfg.csr_block_b, cfg.csr_tile_t, k_pad)
+            else fit_tile_shape(cfg.csr_block_b, cfg.csr_tile_t, k_loc)
         )
         ok = (
-            tp == 1
-            and self.dtype == jnp.float32
+            self.dtype == jnp.float32
             and cfg.accum_dtype in (None, "float32")
             and self._csr_shape is not None
             and csr_tiles_supported(
-                *self._csr_shape, k_pad, cfg.pallas_interpret
+                *self._csr_shape, k_loc, cfg.pallas_interpret
             )
         )
         if not ok and cfg.use_pallas_csr is True:
             raise ValueError(
-                "use_pallas_csr=True on the sharded trainer requires an "
-                "unsharded K axis (tp == 1), float32 F/accum, and 128-"
-                f"multiple block_b/tile_t/k_pad; got tp={tp}, "
-                f"dtype={self.dtype}, block_b={cfg.csr_block_b}, "
-                f"tile_t={cfg.csr_tile_t}"
+                "use_pallas_csr=True on the sharded trainer requires "
+                "float32 F/accum and 128-multiple block_b/tile_t/K_loc; "
+                f"got tp={tp}, dtype={self.dtype}, "
+                f"block_b={cfg.csr_block_b}, tile_t={cfg.csr_tile_t}"
             )
         if not ok:
             self._csr_reason = (
@@ -412,70 +526,174 @@ class ShardedBigClamModel:
     def _csr_economy_ok(self, dp: int) -> bool:
         """Probe the tile layout's padding/memory economy BEFORE committing
         the CSR paddings (runs on the pre-balance graph — balancing only
-        evens the layout further). Raises when use_pallas_csr=True."""
+        evens the layout further). Raises when use_pallas_csr=True.
+
+        When the flat per-shard fd gather exceeds FLAT_FD_BUDGET (large
+        N_loc*K), falls through to the grouped layout (tp == 1 only) —
+        exactly the regime where round 1 silently degraded to XLA."""
         from bigclam_tpu.ops.csr_tiles import (
             layout_economical,
             shard_block_tiles,
         )
 
         cfg = self.cfg
+        tp = self.mesh.shape[K_AXIS]
         block_b, tile_t = self._csr_shape
         n_pad = _round_up(
             max(self.g.num_nodes, dp), dp * block_b
         )
-        k_pad = _round_up(self.k_pad, 128)
+        k_loc = self._csr_k_pad // tp            # gathered fd column count
         sbt = shard_block_tiles(self.g, dp, n_pad, block_b, tile_t)
         slots = sbt.src_local.size               # dp * n_tiles * T
         e = max(self.g.num_directed_edges, 1)
-        fd_bytes = sbt.n_tiles * tile_t * k_pad * 4              # per shard
+        fd_bytes = sbt.n_tiles * tile_t * k_loc * 4              # per shard
         pad_ok = layout_economical(slots, e, dp * sbt.n_blocks, tile_t)
         if pad_ok and fd_bytes <= FLAT_FD_BUDGET:
             # reuse the probe's layout in _build_csr_step unless balancing
             # relabels the graph in between (the only thing that changes it)
             self._probe_tiles = sbt
+            self._csr_nb = None
+            return True
+        if pad_ok and tp == 1 and self._grouped_economy_ok(dp, sbt):
             return True
         if cfg.use_pallas_csr is True:
+            grouped_why = getattr(self, "_csr_reason", "")
             raise ValueError(
                 f"use_pallas_csr=True but sharded layout uneconomical: "
                 f"{slots - e} padded edge slots on {e}, per-shard fd "
                 f"gather {fd_bytes >> 20} MiB (power-law skew? try "
                 "balance=True, the ring trainer, or a sharded K axis)"
+                + (f"; {grouped_why}" if grouped_why else "")
             )
-        self._csr_reason = (
-            f"sharded layout uneconomical: {slots - e} padded edge slots on "
-            f"{e} edges, per-shard fd gather {fd_bytes >> 20} MiB"
-        )
+        if not (pad_ok and tp == 1):
+            # otherwise _grouped_economy_ok already recorded the grouped
+            # attempt's specific reason — keep it
+            self._csr_reason = (
+                f"sharded layout uneconomical: {slots - e} padded edge "
+                f"slots on {e} edges, per-shard fd gather "
+                f"{fd_bytes >> 20} MiB"
+                + (" (grouped fallback needs tp == 1)" if tp > 1 else "")
+            )
         return False
+
+    def _grouped_economy_ok(self, dp: int, sbt) -> bool:
+        """Try the grouped (large-K) sharded layout: block-group windows
+        scanned with per-group fd gathers bounded by GROUP_FD_BUDGET.
+        Mirrors the single-chip grouping policy (models.bigclam)."""
+        from bigclam_tpu.ops.csr_tiles import (
+            layout_economical,
+            shard_grouped_tiles,
+        )
+
+        block_b, tile_t = self._csr_shape
+        k_pad = self._csr_k_pad
+        e = max(self.g.num_directed_edges, 1)
+        tiles_per_group = max(GROUP_FD_BUDGET // (tile_t * k_pad * 4), 1)
+        avg_tiles = max(sbt.n_tiles / sbt.n_blocks, 1e-9)
+        # cap at the per-shard block count: a window larger than the shard
+        # only inflates n_pad with phantom groups
+        nb = min(max(int(tiles_per_group / avg_tiles), 1), sbt.n_blocks)
+
+        def build(nb_):
+            n_pad_g = _round_up(
+                max(self.g.num_nodes, dp), dp * nb_ * block_b
+            )
+            return shard_grouped_tiles(
+                self.g, dp, n_pad_g, block_b, tile_t, nb_
+            )
+
+        sgt = build(nb)
+        while (
+            nb > 1
+            and sgt.src_local.shape[2] * tile_t * k_pad * 4
+            > 2 * GROUP_FD_BUDGET
+        ):
+            nb = max(nb // 2, 1)
+            sgt = build(nb)
+        group_fd = sgt.src_local.shape[2] * tile_t * k_pad * 4
+        ok = (
+            layout_economical(
+                sgt.slots, e, dp * sgt.n_groups * sgt.nb, tile_t
+            )
+            # even at nb=1 a single hub block can exceed the budget: that
+            # gather would OOM at runtime, so refuse here
+            and group_fd <= 4 * GROUP_FD_BUDGET
+        )
+        if not ok:
+            self._csr_reason = (
+                f"grouped sharded layout uneconomical: {sgt.slots - e} "
+                f"padded slots on {e} edges (nb={nb}, group fd "
+                f"{group_fd >> 20} MiB)"
+            )
+            return False
+        self._probe_tiles = sgt
+        self._csr_nb = nb
+        return True
 
     def _build_csr_step(self, dp: int) -> None:
         """Build shard tiles + the CSR train step (engagement already
         decided by _csr_static_ok + _csr_economy_ok)."""
-        from bigclam_tpu.ops.csr_tiles import shard_block_tiles
+        from bigclam_tpu.ops.csr_tiles import (
+            shard_block_tiles,
+            shard_grouped_tiles,
+        )
 
-        cfg = self.cfg
+        def nspec(ndim: int) -> NamedSharding:
+            return NamedSharding(
+                self.mesh, P(NODES_AXIS, *([None] * (ndim - 1)))
+            )
+
         sbt = getattr(self, "_probe_tiles", None)
         self._probe_tiles = None
-        if sbt is None or self._perm is not None:
-            sbt = shard_block_tiles(
-                self.g, dp, self.n_pad, *self._csr_shape
-            )
-        dp_, nt, t = sbt.src_local.shape
-        spec4 = NamedSharding(self.mesh, P(NODES_AXIS, None, None, None))
-        spec3 = NamedSharding(self.mesh, P(NODES_AXIS, None, None))
-        spec2 = NamedSharding(self.mesh, P(NODES_AXIS, None))
-        tiles = {
-            "src_local": put_sharded(
-                sbt.src_local.reshape(dp_, nt, 1, t).astype(np.int32), spec4
-            ),
-            "dst": put_sharded(sbt.dst.astype(np.int32), spec3),
-            "mask": put_sharded(
-                sbt.mask.reshape(dp_, nt, 1, t).astype(self.dtype), spec4
-            ),
-            "block_id": put_sharded(sbt.block_id.astype(np.int32), spec2),
-            "block_b": sbt.block_b,
-            "tile_t": sbt.tile_t,
-            "n_blocks": sbt.n_blocks,
-        }
+        if self._csr_nb is not None:
+            if sbt is None or self._perm is not None:
+                sbt = shard_grouped_tiles(
+                    self.g, dp, self.n_pad, *self._csr_shape, self._csr_nb
+                )
+            dp_, ng, gmax, t = sbt.src_local.shape
+            tiles = {
+                "src_local": put_sharded(
+                    sbt.src_local.reshape(dp_, ng, gmax, 1, t).astype(
+                        np.int32
+                    ),
+                    nspec(5),
+                ),
+                "dst": put_sharded(sbt.dst.astype(np.int32), nspec(4)),
+                "mask": put_sharded(
+                    sbt.mask.reshape(dp_, ng, gmax, 1, t).astype(self.dtype),
+                    nspec(5),
+                ),
+                "block_id": put_sharded(
+                    sbt.block_id.astype(np.int32), nspec(3)
+                ),
+                "block_b": sbt.block_b,
+                "tile_t": sbt.tile_t,
+                "nb": sbt.nb,
+                "n_groups": sbt.n_groups,
+            }
+        else:
+            if sbt is None or self._perm is not None:
+                sbt = shard_block_tiles(
+                    self.g, dp, self.n_pad, *self._csr_shape
+                )
+            dp_, nt, t = sbt.src_local.shape
+            tiles = {
+                "src_local": put_sharded(
+                    sbt.src_local.reshape(dp_, nt, 1, t).astype(np.int32),
+                    nspec(4),
+                ),
+                "dst": put_sharded(sbt.dst.astype(np.int32), nspec(3)),
+                "mask": put_sharded(
+                    sbt.mask.reshape(dp_, nt, 1, t).astype(self.dtype),
+                    nspec(4),
+                ),
+                "block_id": put_sharded(
+                    sbt.block_id.astype(np.int32), nspec(2)
+                ),
+                "block_b": sbt.block_b,
+                "tile_t": sbt.tile_t,
+                "n_blocks": sbt.n_blocks,
+            }
         self.edges = None                        # not used by the CSR step
         self._step = make_sharded_csr_train_step(self.mesh, tiles, self.cfg)
 

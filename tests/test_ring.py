@@ -74,6 +74,109 @@ def test_ring_bucket_partition(agm_graph):
     np.testing.assert_array_equal(seen[order], ref)
 
 
+def _random_graph(seed, n=71, p=0.12):
+    from bigclam_tpu.graph.ingest import graph_from_edges
+
+    rng = np.random.default_rng(seed)
+    a = rng.random((n, n)) < p
+    edges = [(i, j) for i in range(n) for j in range(i + 1, n) if a[i, j]]
+    edges.append((0, n - 1))
+    return graph_from_edges(edges, num_nodes=n)
+
+
+class TestRingCSR:
+    """Ring schedule on the blocked-CSR MXU kernels: per-(shard, phase)
+    tile buckets, kernel outputs accumulated across rotations. Must match
+    the all-gather trainer and the XLA ring (round-1 deferral, VERDICT
+    item 2)."""
+
+    @pytest.mark.parametrize("dp", [2, 4])
+    def test_ring_csr_matches_allgather(self, dp):
+        import jax
+        from bigclam_tpu.parallel import ShardedBigClamModel
+
+        g = _random_graph(0)
+        k = 6
+        base = BigClamConfig(num_communities=k, edge_chunk=64)
+        mesh = make_mesh((dp, 1), jax.devices()[:dp])
+        ring = RingBigClamModel(
+            g,
+            base.replace(
+                use_pallas_csr=True, pallas_interpret=True,
+                csr_block_b=8, csr_tile_t=8,
+            ),
+            mesh,
+        )
+        assert ring.engaged_path == "csr"
+        assert ring.edges is None           # CSR step built, no EdgeChunks
+        xla = ShardedBigClamModel(
+            g, base.replace(use_pallas_csr=False), mesh
+        )
+        rng = np.random.default_rng(1)
+        F0 = rng.uniform(0.0, 1.0, size=(g.num_nodes, k))
+        s_r, s_x = ring.init_state(F0), xla.init_state(F0)
+        for _ in range(3):
+            s_r, s_x = ring._step(s_r), xla._step(s_x)
+        n = g.num_nodes
+        np.testing.assert_allclose(
+            np.asarray(s_r.F)[:n, :k], np.asarray(s_x.F)[:n, :k],
+            rtol=3e-5, atol=3e-5,
+        )
+        np.testing.assert_allclose(float(s_r.llh), float(s_x.llh), rtol=1e-5)
+
+    def test_ring_tile_bucket_partition(self):
+        """Every directed edge lands in exactly one (shard, phase) tile
+        bucket with correctly rebased src/dst local indices."""
+        from bigclam_tpu.ops.csr_tiles import ring_block_tiles
+
+        g = _random_graph(2, n=41)
+        dp, block_b, tile_t = 4, 4, 4
+        n_pad = 48
+        rbt = ring_block_tiles(g, dp, n_pad, block_b, tile_t)
+        shard_rows = n_pad // dp
+        seen = []
+        for i in range(dp):
+            for r in range(dp):
+                m = rbt.mask[i, r].astype(bool)
+                src_g = (
+                    rbt.src_local[i, r]
+                    + rbt.block_id[i, r][:, None] * block_b
+                    + i * shard_rows
+                )
+                dst_g = rbt.dst_local[i, r] + ((i + r) % dp) * shard_rows
+                seen.append(
+                    np.stack([src_g[m], dst_g[m]], axis=1)
+                )
+        seen = np.concatenate(seen, axis=0)
+        ref = np.stack([g.src, g.dst], axis=1)
+        order = np.lexsort((seen[:, 1], seen[:, 0]))
+        np.testing.assert_array_equal(seen[order], ref)
+
+    def test_ring_csr_fit_matches_xla_ring(self):
+        import jax
+
+        g = _random_graph(3)
+        k = 4
+        cfg = BigClamConfig(num_communities=k, max_iters=6, edge_chunk=64)
+        mesh = make_mesh((4, 1), jax.devices()[:4])
+        rng = np.random.default_rng(4)
+        F0 = rng.uniform(0.1, 1.0, size=(g.num_nodes, k))
+        res_csr = RingBigClamModel(
+            g,
+            cfg.replace(
+                use_pallas_csr=True, pallas_interpret=True,
+                csr_block_b=8, csr_tile_t=8,
+            ),
+            mesh,
+        ).fit(F0)
+        res_xla = RingBigClamModel(
+            g, cfg.replace(use_pallas_csr=False), mesh
+        ).fit(F0)
+        assert res_csr.num_iters == res_xla.num_iters
+        np.testing.assert_allclose(res_csr.llh, res_xla.llh, rtol=1e-5)
+        np.testing.assert_allclose(res_csr.F, res_xla.F, rtol=2e-4, atol=2e-4)
+
+
 def test_ring_fit_converges(toy_graphs):
     import jax
 
