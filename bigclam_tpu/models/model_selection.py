@@ -89,10 +89,14 @@ def sweep_k(
     When state_dir is given, per-K converged LLHs are journaled to
     state_dir/sweep_state.json and already-trained Ks are skipped on restart
     (SURVEY.md §5: a K-sweep on a large graph is hours; the reference could
-    only restart from scratch).
+    only restart from scratch). With cfg.checkpoint_every > 0, each K's fit
+    additionally checkpoints WITHIN the K (state_dir/k_<K>/), so a crash
+    hours into one K resumes inside that K instead of restarting it; a K's
+    checkpoints are deleted once its LLH is journaled.
     """
     import json
     import os
+    import shutil
 
     kset = build_kset(cfg.min_com, cfg.max_com, cfg.div_com)
     k_max = kset[-1]
@@ -120,10 +124,17 @@ def sweep_k(
         if k in llh_by_k:                           # journaled on a prior run
             res_llh = llh_by_k[k]
         else:
+            ckpt_k = None
+            ckpt_dir = None
+            if state_dir is not None and cfg.checkpoint_every > 0:
+                from bigclam_tpu.utils.checkpoint import CheckpointManager
+
+                ckpt_dir = os.path.join(state_dir, f"k_{k:06d}")
+                ckpt_k = CheckpointManager(ckpt_dir)
             F0k = seeding.init_F(g, seeds, cfg.replace(num_communities=k), rng)
             F0 = np.zeros((g.num_nodes, k_max))
             F0[:, :k] = F0k                         # columns >= k stay zero
-            res = model.fit(F0)
+            res = model.fit(F0, checkpoints=ckpt_k)
             res_llh = res.llh
             llh_by_k[k] = res_llh
             best_fit = res
@@ -131,6 +142,10 @@ def sweep_k(
                 with open(state_path + ".tmp", "w") as f:
                     json.dump({str(kk): v for kk, v in llh_by_k.items()}, f)
                 os.replace(state_path + ".tmp", state_path)
+            if ckpt_dir is not None:
+                # journaled: within-K checkpoints are spent (and must never
+                # leak into a later K, whose model shape they would match)
+                shutil.rmtree(ckpt_dir, ignore_errors=True)
         if callback is not None:
             callback(k, res_llh)
         if llh_old is not None and llh_old != 0.0:

@@ -199,7 +199,7 @@ def make_ring_train_step(
         sumF_new = lax.psum(sum_loc, NODES_AXIS)
         return F_new, sumF_new, llh_cur.astype(F_loc.dtype), it + 1
 
-    def step(state: TrainState) -> TrainState:
+    def step(state: TrainState, src, dst, mask) -> TrainState:
         F_new, sumF, llh, it = jax.shard_map(
             step_shard,
             mesh=mesh,
@@ -211,10 +211,13 @@ def make_ring_train_step(
                 P(),
             ),
             out_specs=(P(NODES_AXIS, K_AXIS), P(K_AXIS), P(), P()),
-        )(state.F, edges.src, edges.dst, edges.mask, state.it)
+        )(state.F, src, dst, mask, state.it)
         return TrainState(F=F_new, sumF=sumF, llh=llh, it=it)
 
-    return jax.jit(step)
+    # edge arrays as jit ARGUMENTS (multi-controller: no closing over
+    # non-addressable-device arrays; see parallel/sharded.py)
+    jitted = jax.jit(step)
+    return lambda state: jitted(state, edges.src, edges.dst, edges.mask)
 
 
 def make_ring_csr_train_step(
@@ -310,7 +313,7 @@ def make_ring_csr_train_step(
         sumF_new = lax.psum(sum_loc, NODES_AXIS)
         return F_new, sumF_new, llh_cur.astype(F_loc.dtype), it + 1
 
-    def step(state: TrainState) -> TrainState:
+    def step(state: TrainState, srcl, dstl, mask, bid) -> TrainState:
         F_new, sumF, llh, it = jax.shard_map(
             step_shard,
             mesh=mesh,
@@ -324,13 +327,16 @@ def make_ring_csr_train_step(
             ),
             out_specs=(P(NODES_AXIS, K_AXIS), P(K_AXIS), P(), P()),
             check_vma=False,       # pallas interpret + prefetch (see sharded)
-        )(
-            state.F, tiles["src_local"], tiles["dst_local"], tiles["mask"],
-            tiles["block_id"], state.it,
-        )
+        )(state.F, srcl, dstl, mask, bid, state.it)
         return TrainState(F=F_new, sumF=sumF, llh=llh, it=it)
 
-    return jax.jit(step)
+    # tile arrays as jit ARGUMENTS (multi-controller: no closing over
+    # non-addressable-device arrays; see parallel/sharded.py)
+    jitted = jax.jit(step)
+    return lambda state: jitted(
+        state, tiles["src_local"], tiles["dst_local"], tiles["mask"],
+        tiles["block_id"],
+    )
 
 
 class RingBigClamModel(ShardedBigClamModel):

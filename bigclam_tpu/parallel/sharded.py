@@ -262,7 +262,7 @@ def make_sharded_csr_train_step(
     def spec_for(arr) -> P:
         return P(NODES_AXIS, *([None] * (arr.ndim - 1)))
 
-    def step(state: TrainState) -> TrainState:
+    def step(state: TrainState, srcl, dst, mask, bid) -> TrainState:
         # check_vma=False: pallas_call's interpret-mode lowering mixes
         # varying (scalar-prefetched block ids) and replicated operands in
         # dynamic_slice, which the VMA type check cannot express yet; the
@@ -273,21 +273,26 @@ def make_sharded_csr_train_step(
             mesh=mesh,
             in_specs=(
                 P(NODES_AXIS, K_AXIS),
-                spec_for(tiles["src_local"]),
-                spec_for(tiles["dst"]),
-                spec_for(tiles["mask"]),
-                spec_for(tiles["block_id"]),
+                spec_for(srcl),
+                spec_for(dst),
+                spec_for(mask),
+                spec_for(bid),
                 P(),
             ),
             out_specs=(P(NODES_AXIS, K_AXIS), P(K_AXIS), P(), P()),
             check_vma=False,
-        )(
-            state.F, tiles["src_local"], tiles["dst"], tiles["mask"],
-            tiles["block_id"], state.it,
-        )
+        )(state.F, srcl, dst, mask, bid, state.it)
         return TrainState(F=F_new, sumF=sumF, llh=llh, it=it)
 
-    return jax.jit(step)
+    # tile arrays ride as jit ARGUMENTS, not closure constants: under
+    # multi-controller jax, closing over an array that spans non-addressable
+    # devices is an error (caught by tests/test_multihost.py's true
+    # two-process test)
+    jitted = jax.jit(step)
+    return lambda state: jitted(
+        state, tiles["src_local"], tiles["dst"], tiles["mask"],
+        tiles["block_id"],
+    )
 
 
 def make_sharded_train_step(
@@ -377,7 +382,7 @@ def make_sharded_train_step(
         sumF_new = lax.psum(sum_loc, NODES_AXIS)             # (K_loc,)
         return F_new, sumF_new, llh_cur.astype(F_loc.dtype), it + 1
 
-    def step(state: TrainState) -> TrainState:
+    def step(state: TrainState, src, dst, mask) -> TrainState:
         F_new, sumF, llh, it = jax.shard_map(
             step_shard,
             mesh=mesh,
@@ -389,10 +394,13 @@ def make_sharded_train_step(
                 P(),
             ),
             out_specs=(P(NODES_AXIS, K_AXIS), P(K_AXIS), P(), P()),
-        )(state.F, edges.src, edges.dst, edges.mask, state.it)
+        )(state.F, src, dst, mask, state.it)
         return TrainState(F=F_new, sumF=sumF, llh=llh, it=it)
 
-    return jax.jit(step)
+    # edge arrays as jit ARGUMENTS (multi-controller: no closing over
+    # non-addressable-device arrays; see make_sharded_csr_train_step)
+    jitted = jax.jit(step)
+    return lambda state: jitted(state, edges.src, edges.dst, edges.mask)
 
 
 class ShardedBigClamModel:

@@ -1,0 +1,83 @@
+"""Worker for the TRUE multi-process jax.distributed test (SURVEY.md §4.4).
+
+Launched as `python _multihost_worker.py <port> <process_id> <out.npz>` by
+tests/test_multihost.py, twice: each process contributes 2 CPU devices to a
+4-device (nodes=4, k=1) mesh, joins the process group through
+initialize_distributed's env-var resolution path, runs a short sharded fit
+(put_process_local placement, fetch_global readback), and process 0 writes
+the trajectory for the parent to compare against the single-process run.
+"""
+
+import os
+import sys
+
+import numpy as np
+
+# repo root on sys.path: the package is run from a checkout, not installed
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def problem():
+    """Deterministic (graph, cfg, F0) shared by worker and parent test."""
+    from bigclam_tpu.config import BigClamConfig
+    from bigclam_tpu.graph.ingest import graph_from_edges
+
+    edges = []
+    for base in (0, 12):                 # two 12-cliques + one bridge
+        for i in range(12):
+            for j in range(i + 1, 12):
+                edges.append((base + i, base + j))
+    edges.append((11, 12))
+    g = graph_from_edges(edges, num_nodes=24)
+    cfg = BigClamConfig(
+        num_communities=2, dtype="float64", max_iters=8, conv_tol=0.0
+    )
+    F0 = np.random.default_rng(5).uniform(0.1, 1.0, size=(24, 2))
+    return g, cfg, F0
+
+
+def main() -> None:
+    port, pid, out_path = sys.argv[1], sys.argv[2], sys.argv[3]
+    import jax
+
+    # the outer env may pin a TPU platform; config updates before first
+    # backend use are the reliable override (see tests/conftest.py)
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_enable_x64", True)
+    jax.config.update("jax_num_cpu_devices", 2)
+    os.environ["JAX_COORDINATOR_ADDRESS"] = f"127.0.0.1:{port}"
+    os.environ["JAX_NUM_PROCESSES"] = "2"
+    os.environ["JAX_PROCESS_ID"] = pid
+
+    from bigclam_tpu.parallel.multihost import (
+        fetch_global,
+        initialize_distributed,
+        make_multihost_mesh,
+    )
+
+    assert initialize_distributed() is True
+    assert jax.process_count() == 2, jax.process_count()
+    assert len(jax.devices()) == 4, jax.devices()
+
+    from bigclam_tpu.parallel import ShardedBigClamModel
+
+    g, cfg, F0 = problem()
+    mesh = make_multihost_mesh((4, 1))
+    model = ShardedBigClamModel(g, cfg, mesh)
+    res = model.fit(F0)
+
+    # exercise fetch_global on a live sharded array too (fit already used it
+    # for the result, but assert the round trip explicitly)
+    state = model.init_state(F0)
+    F_rt = fetch_global(state.F)[: g.num_nodes, : cfg.num_communities]
+    np.testing.assert_allclose(F_rt, F0, rtol=0, atol=0)
+
+    if jax.process_index() == 0:
+        np.savez(
+            out_path, F=res.F, llh_history=np.asarray(res.llh_history)
+        )
+    jax.distributed.shutdown()
+
+
+if __name__ == "__main__":
+    main()

