@@ -29,6 +29,7 @@ from bigclam_tpu.config import BigClamConfig
 from bigclam_tpu.graph.csr import Graph
 from bigclam_tpu.ops.linesearch import armijo_update, candidates_pass
 from bigclam_tpu.ops.objective import EdgeChunks, grad_llh
+from bigclam_tpu.utils.dist import is_primary
 
 
 def csr_want_reason(cfg: BigClamConfig) -> tuple[bool, str]:
@@ -208,11 +209,16 @@ def run_fit_loop(
             and int(state.it) <= cfg.max_iters   # never persist the final
             and state_to_arrays is not None      # speculative (unevaluated) F
         ):
-            checkpoints.save(
-                int(state.it),
-                state_to_arrays(state),
-                meta={"llh_history": hist, **(ckpt_meta or {})},
-            )
+            # state_to_arrays may be a COLLECTIVE (fetch_global allgathers
+            # across processes), so every process must enter it; only the
+            # file write itself is single-writer (utils.dist)
+            arrays = state_to_arrays(state)
+            if is_primary():
+                checkpoints.save(
+                    int(state.it),
+                    arrays,
+                    meta={"llh_history": hist, **(ckpt_meta or {})},
+                )
     else:
         # hit max_iters without converging; prev_state is the last state
         # whose LLH was actually evaluated (hist[-1])

@@ -34,6 +34,7 @@ from bigclam_tpu.config import BigClamConfig
 from bigclam_tpu.graph.csr import Graph
 from bigclam_tpu.models.bigclam import BigClamModel, FitResult
 from bigclam_tpu.ops import seeding
+from bigclam_tpu.utils.dist import is_primary
 
 
 def build_kset(min_com: int, max_com: int, div_com: int) -> List[int]:
@@ -105,7 +106,20 @@ def sweep_k(
         model_factory(cfg_max) if model_factory is not None
         else BigClamModel(g, cfg_max)
     )
-    rng = rng or np.random.default_rng(cfg.seed)
+    # Per-K PRNG streams, fixed UP FRONT for the whole grid: journaled Ks
+    # skip init_F on resume, so a single shared generator would sit at a
+    # different stream position than the uninterrupted run whenever any K
+    # pads F0 with Bernoulli columns (|seeds| < K) — silently changing
+    # llh_by_k / chosen_k across a restart. Seeding each K independently
+    # ([cfg.seed, k], or child seeds drawn once from a caller-supplied rng)
+    # makes F0(k) a pure function of the config regardless of resume point.
+    if rng is None:
+        k_rngs = {k: np.random.default_rng([cfg.seed, k]) for k in kset}
+    else:
+        child = rng.integers(2**63, size=len(kset))
+        k_rngs = {
+            k: np.random.default_rng(int(s)) for k, s in zip(kset, child)
+        }
     seeds = seeding.conductance_seeds(g, cfg)      # computed once (v4:75)
 
     llh_by_k: Dict[int, float] = {}
@@ -131,18 +145,20 @@ def sweep_k(
 
                 ckpt_dir = os.path.join(state_dir, f"k_{k:06d}")
                 ckpt_k = CheckpointManager(ckpt_dir)
-            F0k = seeding.init_F(g, seeds, cfg.replace(num_communities=k), rng)
+            F0k = seeding.init_F(
+                g, seeds, cfg.replace(num_communities=k), k_rngs[k]
+            )
             F0 = np.zeros((g.num_nodes, k_max))
             F0[:, :k] = F0k                         # columns >= k stay zero
             res = model.fit(F0, checkpoints=ckpt_k)
             res_llh = res.llh
             llh_by_k[k] = res_llh
             best_fit = res
-            if state_path is not None:
+            if state_path is not None and is_primary():
                 with open(state_path + ".tmp", "w") as f:
                     json.dump({str(kk): v for kk, v in llh_by_k.items()}, f)
                 os.replace(state_path + ".tmp", state_path)
-            if ckpt_dir is not None:
+            if ckpt_dir is not None and is_primary():
                 # journaled: within-K checkpoints are spent (and must never
                 # leak into a later K, whose model shape they would match)
                 shutil.rmtree(ckpt_dir, ignore_errors=True)

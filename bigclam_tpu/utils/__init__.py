@@ -1,4 +1,5 @@
 from bigclam_tpu.utils.checkpoint import CheckpointManager
+from bigclam_tpu.utils.dist import is_primary
 from bigclam_tpu.utils.metrics import MetricsLogger
 
-__all__ = ["CheckpointManager", "MetricsLogger"]
+__all__ = ["CheckpointManager", "MetricsLogger", "is_primary"]

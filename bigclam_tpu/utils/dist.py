@@ -1,0 +1,23 @@
+"""Single-writer gating for multi-controller runs.
+
+Under multi-controller JAX every process executes the same program, so any
+host-side file write (checkpoints, sweep journals, metrics JSONL) would be
+raced by N processes renaming onto the same shared-directory paths. The
+convention here (and in jax ecosystem tools generally) is that process 0 is
+the sole writer; every process still READS checkpoints on resume, which
+assumes the checkpoint directory is on a filesystem all hosts share (true
+for the GCS/NFS setups multi-host TPU jobs run on).
+"""
+
+from __future__ import annotations
+
+
+def is_primary() -> bool:
+    """True on the process that owns shared-filesystem writes (process 0).
+
+    Trivially True single-process; safe to call before jax.distributed
+    initialization (process_index is 0 then).
+    """
+    import jax
+
+    return jax.process_index() == 0

@@ -16,15 +16,46 @@ from typing import Any, Dict, Optional, TextIO
 
 
 class MetricsLogger:
-    def __init__(self, path: Optional[str] = None, echo: bool = True):
+    def __init__(
+        self,
+        path: Optional[str] = None,
+        echo: bool = True,
+        primary_only: bool = True,
+    ):
+        """primary_only (default): under multi-controller jax only process 0
+        writes the JSONL / echoes (N processes appending one shared file
+        would interleave; see utils.dist). Pass False for per-process logs
+        pointed at distinct paths.
+
+        The gate (and the file open) are deferred to the FIRST log call:
+        jax.process_index() initializes the jax backend, and loggers are
+        routinely constructed before jax.distributed.initialize (e.g. the
+        CLI builds the logger before the model factory joins the process
+        group) — checking at construction would both crash the later init
+        and read index 0 on every process."""
         self.path = path
         self.echo = echo
-        self._fh: Optional[TextIO] = open(path, "a") if path else None
+        self.primary_only = primary_only
+        self._fh: Optional[TextIO] = None
+        self._gated = False
         self._t0 = time.perf_counter()
         self._last_t: Optional[float] = None
         self._last_llh: Optional[float] = None
 
+    def _gate(self) -> None:
+        if self._gated:
+            return
+        self._gated = True
+        if self.primary_only:
+            from bigclam_tpu.utils.dist import is_primary
+
+            if not is_primary():
+                self.path, self.echo = None, False
+        if self.path:
+            self._fh = open(self.path, "a")
+
     def log(self, record: Dict[str, Any]) -> None:
+        self._gate()
         record = {"t": round(time.perf_counter() - self._t0, 4), **record}
         line = json.dumps(record)
         if self._fh:

@@ -1,11 +1,21 @@
-"""Worker for the TRUE multi-process jax.distributed test (SURVEY.md §4.4).
+"""Worker for the TRUE multi-process jax.distributed tests (SURVEY.md §4.4).
 
-Launched as `python _multihost_worker.py <port> <process_id> <out.npz>` by
-tests/test_multihost.py, twice: each process contributes 2 CPU devices to a
-4-device (nodes=4, k=1) mesh, joins the process group through
-initialize_distributed's env-var resolution path, runs a short sharded fit
-(put_process_local placement, fetch_global readback), and process 0 writes
-the trajectory for the parent to compare against the single-process run.
+Launched as `python _multihost_worker.py <port> <process_id> <out.npz>
+[mode] [ckpt_root]` by tests/test_multihost.py, twice per round: each
+process contributes 2 CPU devices to a 4-device (nodes=4, k=1) mesh, joins
+the process group through initialize_distributed's env-var resolution path,
+runs a short sharded fit (put_process_local placement, fetch_global
+readback), and process 0 writes the trajectory for the parent to compare
+against the single-process run.
+
+Modes:
+  fit (default)  full fit, process 0 writes F + llh_history to out.npz
+  ckpt-write     fit max_iters=4 with checkpoint_every=2, each process
+                 handed a CheckpointManager at ckpt_root/p<pid>; asserts
+                 the single-writer gate (only process 0's dir gets files)
+  ckpt-resume    fit max_iters=8 resuming from the SHARED ckpt_root/p0
+                 (all processes read; only process 0 keeps writing);
+                 process 0 writes the resumed trajectory to out.npz
 """
 
 import os
@@ -38,6 +48,8 @@ def problem():
 
 def main() -> None:
     port, pid, out_path = sys.argv[1], sys.argv[2], sys.argv[3]
+    mode = sys.argv[4] if len(sys.argv) > 4 else "fit"
+    ckpt_root = sys.argv[5] if len(sys.argv) > 5 else None
     import jax
 
     # the outer env may pin a TPU platform; config updates before first
@@ -63,6 +75,40 @@ def main() -> None:
 
     g, cfg, F0 = problem()
     mesh = make_multihost_mesh((4, 1))
+
+    if mode == "ckpt-write":
+        from bigclam_tpu.utils.checkpoint import CheckpointManager
+
+        cfg_w = cfg.replace(max_iters=4, checkpoint_every=2)
+        my_dir = os.path.join(ckpt_root, f"p{pid}")
+        model = ShardedBigClamModel(g, cfg_w, mesh)
+        model.fit(F0, checkpoints=CheckpointManager(my_dir))
+        files = [f for f in os.listdir(my_dir) if f.endswith(".npz")]
+        if jax.process_index() == 0:
+            assert files, "primary process wrote no checkpoints"
+        else:
+            assert not files, (
+                f"non-primary process wrote checkpoints: {files}"
+            )
+        jax.distributed.shutdown()
+        return
+
+    if mode == "ckpt-resume":
+        from bigclam_tpu.utils.checkpoint import CheckpointManager
+
+        cfg_r = cfg.replace(checkpoint_every=2)
+        shared = os.path.join(ckpt_root, "p0")   # every process READS p0's
+        model = ShardedBigClamModel(g, cfg_r, mesh)
+        ckpt = CheckpointManager(shared)
+        assert ckpt.latest_step() == 4, ckpt.steps()
+        res = model.fit(F0, checkpoints=ckpt)
+        if jax.process_index() == 0:
+            np.savez(
+                out_path, F=res.F, llh_history=np.asarray(res.llh_history)
+            )
+        jax.distributed.shutdown()
+        return
+
     model = ShardedBigClamModel(g, cfg, mesh)
     res = model.fit(F0)
 

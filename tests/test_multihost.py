@@ -147,6 +147,76 @@ def test_true_two_process_fit(tmp_path):
     )
 
 
+def test_true_two_process_checkpoint_single_writer_resume(tmp_path):
+    """Kill-and-resume THROUGH a checkpoint with process_count() == 2 and
+    exactly one writer (VERDICT round-3 item 3): round 1 writes checkpoints
+    under max_iters=4 — each process handed its OWN directory, and the
+    worker asserts only process 0's gets files (the is_primary gate);
+    round 2 is a fresh pair of processes resuming from process 0's
+    directory to max_iters=8. The resumed trajectory must equal the
+    uninterrupted single-process run exactly (float64)."""
+    import os
+    import socket
+    import subprocess
+    import sys
+
+    worker = os.path.join(os.path.dirname(__file__), "_multihost_worker.py")
+    out = tmp_path / "resumed.npz"
+    ckpt_root = tmp_path / "ckpts"
+    env = {
+        k: v
+        for k, v in os.environ.items()
+        if k not in ("JAX_COORDINATOR_ADDRESS", "JAX_NUM_PROCESSES",
+                     "JAX_PROCESS_ID")
+    }
+
+    def run_round(mode):
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            port = s.getsockname()[1]
+        procs = [
+            subprocess.Popen(
+                [sys.executable, worker, str(port), str(i), str(out),
+                 mode, str(ckpt_root)],
+                env=env,
+                stdout=subprocess.PIPE,
+                stderr=subprocess.PIPE,
+                text=True,
+            )
+            for i in range(2)
+        ]
+        outs = [p.communicate(timeout=300) for p in procs]
+        for p, (so, se) in zip(procs, outs):
+            assert p.returncode == 0, f"worker ({mode}) failed:\n{so}\n{se}"
+
+    run_round("ckpt-write")
+    # the single-writer gate: p1's manager made its dir but wrote nothing
+    assert any(
+        f.endswith(".npz") for f in os.listdir(ckpt_root / "p0")
+    )
+    assert not any(
+        f.endswith(".npz") for f in os.listdir(ckpt_root / "p1")
+    )
+
+    run_round("ckpt-resume")
+    assert out.exists()
+
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location("_mh_worker", worker)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    g, cfg, F0 = mod.problem()
+    from bigclam_tpu.models import BigClamModel
+
+    ref = BigClamModel(g, cfg).fit(F0)          # uninterrupted, max_iters=8
+    got = np.load(out)
+    np.testing.assert_allclose(got["F"], ref.F, rtol=1e-12)
+    np.testing.assert_allclose(
+        got["llh_history"], np.asarray(ref.llh_history), rtol=1e-12
+    )
+
+
 def test_sharded_trainer_still_exact_after_put_sharded(toy_graphs):
     """End-to-end guard: the put_sharded refactor keeps trainer trajectories
     identical to the single-chip model."""
