@@ -60,7 +60,11 @@ def log_engaged_path(model_name: str, path: str, reason: str = "") -> None:
 
     if os.environ.get("BIGCLAM_QUIET") == "1":
         return
-    why = f" ({reason})" if reason and path not in ("csr", "csr_grouped") else ""
+    why = (
+        f" ({reason})"
+        if reason and path not in ("csr", "csr_grouped", "csr_ring")
+        else ""
+    )
     print(
         f"[bigclam] {model_name}: edge-sweep path = {path}{why}",
         file=sys.stderr,
@@ -72,6 +76,12 @@ class TrainState(NamedTuple):
     sumF: jax.Array     # (K_pad,)
     llh: jax.Array      # scalar: LLH of the PREVIOUS F (see module docstring)
     it: jax.Array       # iteration counter
+    # (S+1,) int32 accepted-step histogram of the update that PRODUCED this
+    # state (ops.linesearch.accept_stats); zeros at init. SURVEY §5 names
+    # line-search health an observability requirement — without it a fit
+    # whose Armijo ladder collapses to 1e-15 steps is indistinguishable
+    # from a healthy one in the metrics.
+    accept_hist: Optional[jax.Array] = None
 
 
 @dataclasses.dataclass(frozen=True)
@@ -186,7 +196,35 @@ def run_fit_loop(
     saved every cfg.checkpoint_every iterations (SURVEY.md §5 — the
     reference had no checkpointing); initial_hist carries the restored LLH
     history on resume so convergence tests continue seamlessly.
+
+    Callbacks taking a third parameter additionally receive an extras dict
+    with the accepted-step histogram of the update applied this iteration
+    ({"accept_hist": [count per step_candidates entry..., rejected]});
+    2-parameter callbacks keep the (it, llh) protocol.
     """
+    import inspect
+
+    cb_arity = 0
+    if callback is not None:
+        try:
+            params = inspect.signature(callback).parameters.values()
+            # only parameters that can take a positional argument count:
+            # `def cb(it, llh, **tags)` must stay on the 2-arg protocol,
+            # while *args accepts the extras
+            cb_arity = sum(
+                p.kind
+                in (
+                    inspect.Parameter.POSITIONAL_ONLY,
+                    inspect.Parameter.POSITIONAL_OR_KEYWORD,
+                )
+                for p in params
+            )
+            if any(
+                p.kind == inspect.Parameter.VAR_POSITIONAL for p in params
+            ):
+                cb_arity = 3
+        except (TypeError, ValueError):
+            cb_arity = 2
     prev_state = state
     hist: list[float] = list(initial_hist)
     remaining = max(cfg.max_iters - int(state.it), 0)
@@ -194,7 +232,16 @@ def run_fit_loop(
         new_state = step_fn(state)
         llh_t = float(new_state.llh)           # LLH of state.F
         if callback is not None:
-            callback(int(state.it), llh_t)
+            if cb_arity >= 3:
+                ah = getattr(new_state, "accept_hist", None)
+                extras = (
+                    {"accept_hist": np.asarray(ah).tolist()}
+                    if ah is not None
+                    else None
+                )
+                callback(int(state.it), llh_t, extras)
+            else:
+                callback(int(state.it), llh_t)
         if hist and _rel_change(llh_t, hist[-1]) < cfg.conv_tol:
             final, final_llh, iters = state, llh_t, int(state.it)
             hist.append(llh_t)
@@ -354,9 +401,12 @@ def make_train_step(
                     F, grad, sumF, tiles, cfg, fd=fd, interpret=interp
                 )
             llh_cur = node_llh.sum()
-            F_new, sumF_new = armijo_select(F, grad, node_llh, cand_full, cfg)
+            F_new, sumF_new, hist = armijo_select(
+                F, grad, node_llh, cand_full, cfg, with_stats=True
+            )
             return TrainState(
-                F=F_new, sumF=sumF_new, llh=llh_cur, it=state.it + 1
+                F=F_new, sumF=sumF_new, llh=llh_cur, it=state.it + 1,
+                accept_hist=hist,
             )
 
         return jax.jit(csr_step), ("csr_grouped" if grouped else "csr")
@@ -370,8 +420,13 @@ def make_train_step(
         grad, node_llh = grad_llh(F, sumF, edges, cfg)
         llh_cur = node_llh.sum()               # LLH of current F
         cand_nbr = cand_impl(F, grad, edges, cfg)
-        F_new, sumF_new = armijo_update(F, sumF, grad, node_llh, cand_nbr, cfg)
-        return TrainState(F=F_new, sumF=sumF_new, llh=llh_cur, it=state.it + 1)
+        F_new, sumF_new, hist = armijo_update(
+            F, sumF, grad, node_llh, cand_nbr, cfg, with_stats=True
+        )
+        return TrainState(
+            F=F_new, sumF=sumF_new, llh=llh_cur, it=state.it + 1,
+            accept_hist=hist,
+        )
 
     return jax.jit(step), cand_path
 
@@ -611,6 +666,9 @@ class BigClamModel:
             sumF=F.sum(axis=0),
             llh=jnp.asarray(-jnp.inf, self.dtype),
             it=jnp.zeros((), jnp.int32),
+            accept_hist=jnp.zeros(
+                len(self.cfg.step_candidates) + 1, jnp.int32
+            ),
         )
 
     def _ckpt_meta(self) -> dict:
@@ -636,6 +694,9 @@ class BigClamModel:
             sumF=jnp.asarray(arrays["sumF"], self.dtype),
             llh=jnp.asarray(arrays["llh"], self.dtype),
             it=jnp.asarray(arrays["it"], jnp.int32),
+            accept_hist=jnp.zeros(
+                len(self.cfg.step_candidates) + 1, jnp.int32
+            ),
         )
 
     def fit(

@@ -84,18 +84,42 @@ def candidates_pass(
     return candidates_scan(F, grad, edges, cfg, terms_fn)
 
 
+def accept_stats(ok: jax.Array) -> jax.Array:
+    """(S+1,) int32 accepted-step histogram from the (S, N) acceptance mask:
+    slot s = #nodes whose CHOSEN (max-accepted) step is step_candidates[s],
+    slot S = #rows with no accepted candidate.
+
+    step_candidates is descending, so the chosen step is the first accepted
+    row (argmax of the boolean mask). Padding rows never accept (their grad
+    is -sumF <= 0, ops.objective padding conventions), so the accepted
+    slots count REAL nodes only; the rejected slot includes padding — the
+    metrics layer subtracts it out via the known node count (SURVEY.md §5
+    line-search observability)."""
+    num_s = ok.shape[0]
+    accepted = jnp.any(ok, axis=0)
+    chosen = jnp.argmax(ok, axis=0)            # first True (descending etas)
+    onehot = (
+        (chosen[None, :] == jnp.arange(num_s)[:, None]) & accepted[None, :]
+    )
+    counts = onehot.sum(axis=1).astype(jnp.int32)
+    rejected = (~accepted).sum().astype(jnp.int32)
+    return jnp.concatenate([counts, rejected[None]])
+
+
 def armijo_select(
     F: jax.Array,
     grad: jax.Array,
     node_llh: jax.Array,
     cand_llh: jax.Array,
     cfg: BigClamConfig,
-) -> Tuple[jax.Array, jax.Array]:
+    with_stats: bool = False,
+):
     """Acceptance test + max-accepted-step selection + Jacobi update, given
     the FULL per-candidate LLH (neighbor terms + Armijo tails), shape (S, N).
 
     Returns (F_new, sumF_new) with sumF recomputed as fresh column sums
-    (fixes the incremental-update float drift, SURVEY.md Q7).
+    (fixes the incremental-update float drift, SURVEY.md Q7); with
+    with_stats=True additionally returns the accept_stats histogram.
     """
     adt = jnp.dtype(cfg.accum_dtype) if cfg.accum_dtype else F.dtype
     etas = jnp.asarray(cfg.step_candidates, F.dtype)
@@ -109,6 +133,8 @@ def armijo_select(
         jnp.clip(F + best_eta[:, None] * grad, cfg.min_f, cfg.max_f),
         F,
     )
+    if with_stats:
+        return F_new, F_new.sum(axis=0), accept_stats(ok)
     return F_new, F_new.sum(axis=0)
 
 
@@ -119,7 +145,8 @@ def armijo_update(
     node_llh: jax.Array,
     cand_nbr: jax.Array,
     cfg: BigClamConfig,
-) -> Tuple[jax.Array, jax.Array]:
+    with_stats: bool = False,
+):
     """armijo_select for callers holding only the NEIGHBOR candidate terms
     (candidates_pass output): adds the Armijo tail terms
     -F'.(sumF - F_u + F') + F'.F' per candidate, then selects/updates."""
@@ -135,4 +162,6 @@ def armijo_update(
         ).astype(adt)
 
     tails = lax.map(tail_for, etas)            # (S, N)
-    return armijo_select(F, grad, node_llh, cand_nbr + tails, cfg)
+    return armijo_select(
+        F, grad, node_llh, cand_nbr + tails, cfg, with_stats=with_stats
+    )

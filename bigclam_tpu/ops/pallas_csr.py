@@ -749,6 +749,60 @@ def train_pass_csr_grouped(
     return grad, node_llh, cand_full
 
 
+def train_pass_csr_grouped_tp(
+    F: jax.Array,
+    sumF: jax.Array,
+    gt: GroupedTilesDev,
+    cfg: BigClamConfig,
+    k_axis: str,
+    interpret: bool = False,
+    F_gather: jax.Array = None,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """train_pass_csr_grouped under a SHARDED K axis: per group, the TP
+    kernel split — partial-dot kernel over this device's K_loc columns,
+    lax.psum of the per-edge partials over `k_axis`, consume kernels —
+    instead of the fused kernels (in-VMEM dots cannot psum mid-kernel).
+
+    F/sumF/F_gather hold K_loc columns; the returned candidate terms are
+    NEIGHBOR-only (S, n_pad) — the caller adds the Armijo tails with its
+    own psums (parallel.sharded.armijo_tail_select_sharded), exactly like
+    the flat TP path. Returns (grad (n_pad, K_loc), llh_nbr (n_pad,),
+    cand_nbr (S, n_pad))."""
+    n_pad, k = F.shape
+    assert n_pad == gt.n_pad, (n_pad, gt.n_pad)
+    rows = gt.nb * gt.block_b
+    num_s = len(cfg.step_candidates)
+    F_src = F if F_gather is None else F_gather
+
+    def body(_, xs):
+        gi, tile_xs = xs
+        td = _group_view(gt, tile_xs)
+        fd = jnp.take(F_src, td.dst, axis=0)     # (G, T, K_loc)
+        F_g = lax.dynamic_slice_in_dim(F, gi * rows, rows)
+        x = lax.psum(edge_dots_csr(F_g, td, fd, interpret=interpret), k_axis)
+        gn, ln = grad_nbr_from_x_csr(x, td, fd, cfg, interpret=interpret)
+        grad_g = gn - sumF[None, :] + F_g
+        xc = lax.psum(
+            cand_dots_csr(F_g, grad_g, td, fd, cfg, interpret=interpret),
+            k_axis,
+        )
+        cb = cand_nbr_from_x_csr(xc, td, cfg, interpret=interpret)  # (S, rows)
+        return None, (grad_g, ln, cb)
+
+    _, (gr, ln, cd) = lax.scan(
+        body,
+        None,
+        (
+            jnp.arange(gt.n_groups),
+            (gt.src_local, gt.dst, gt.mask, gt.block_id),
+        ),
+    )
+    grad = gr.reshape(n_pad, k)
+    llh_nbr = ln.reshape(n_pad)
+    cand_nbr = cd.transpose(1, 0, 2).reshape(num_s, n_pad)
+    return grad, llh_nbr, cand_nbr
+
+
 def candidates_csr_grouped(
     F: jax.Array,
     grad: jax.Array,

@@ -101,24 +101,53 @@ def triangle_counts_dense_device(g: Graph) -> np.ndarray:
     return np.asarray(jnp.round(tri)).astype(np.int64)
 
 
-def capped_csr(g: Graph, cap: int, rng: np.random.Generator):
+_M64 = (1 << 64) - 1
+
+
+def _splitmix64(x: int) -> int:
+    """The native sampler's PRNG (graph/native/native.cpp bc_splitmix64),
+    bit-exact in Python ints."""
+    x = (x + 0x9E3779B97F4A7C15) & _M64
+    x = ((x ^ (x >> 30)) * 0xBF58476D1CE4E5B9) & _M64
+    x = ((x ^ (x >> 27)) * 0x94D049BB133111EB) & _M64
+    return x ^ (x >> 31)
+
+
+def capped_csr(g: Graph, cap: int, seed: int):
     """Per-node uniform sample (without replacement) of at most `cap`
-    neighbors. Returns (indptr_c, indices_c) with each capped list sorted
-    ascending (so u*N + w keys are globally sorted for searchsorted).
-    Vectorized: one lexsort of the directed edges by (src, random key)."""
+    neighbors, bit-identical to the native backend's sampler (partial
+    Fisher-Yates on a per-node splitmix64 stream, native.cpp
+    bc_triangle_counts_capped) — so the NumPy and C++ estimators see the
+    SAME capped lists and produce backend-independent seed rankings
+    (ADVICE rounds 1-2). Returns (indptr_c, indices_c) with each capped
+    list sorted ascending (so u*N + w keys are globally sorted for
+    searchsorted; the hit SET is order-independent)."""
     n = g.num_nodes
     deg = g.degrees.astype(np.int64)
-    order = np.lexsort((rng.random(g.indices.size), g.src))
+    cdeg = np.minimum(deg, cap)
+    indptr_c = np.concatenate([[0], np.cumsum(cdeg)])
+    indices_c = np.empty(indptr_c[-1], dtype=g.indices.dtype)
+    # uncapped nodes: straight copy (already ascending in CSR)
     pos = np.arange(g.indices.size, dtype=np.int64) - np.repeat(
         g.indptr[:-1].astype(np.int64), deg
     )
-    keep = order[pos < cap]
-    cdeg = np.minimum(deg, cap)
-    indptr_c = np.concatenate([[0], np.cumsum(cdeg)])
-    src_kept = g.src[keep]
-    dst_kept = g.indices[keep]
-    resort = np.lexsort((dst_kept, src_kept))
-    return indptr_c, dst_kept[resort]
+    small_e = deg[g.src] <= cap
+    indices_c[indptr_c[g.src[small_e]] + pos[small_e]] = g.indices[small_e]
+    # capped (hub) nodes: replicate the native partial Fisher-Yates exactly
+    seed &= _M64
+    for u in np.flatnonzero(deg > cap):
+        lo, hi = int(g.indptr[u]), int(g.indptr[u + 1])
+        scratch = g.indices[lo:hi].copy()
+        d = scratch.size
+        s = _splitmix64(seed ^ ((int(u) * 0x2545F4914F6CDD1D) & _M64))
+        out_lo = int(indptr_c[u])
+        for i in range(cap):
+            s = _splitmix64(s)
+            j = i + s % (d - i)
+            scratch[i], scratch[j] = scratch[j], scratch[i]
+            indices_c[out_lo + i] = scratch[i]
+        indices_c[out_lo : out_lo + cap].sort()
+    return indptr_c, indices_c
 
 
 def triangle_counts_sampled(
@@ -141,22 +170,27 @@ def triangle_counts_sampled(
 
     Work is O(N * cap^2), processed in node chunks bounded by
     `chunk_entries` two-hop entries at a time.
+
+    Backend independence: ONE seed is drawn from `rng` regardless of which
+    backend runs (identical generator consumption), and the NumPy path's
+    sampler (capped_csr) replicates the native splitmix64 sampler
+    bit-exactly — so native and NumPy return the same estimates (up to
+    float summation order) and the same seed rankings.
     """
     rng = rng or np.random.default_rng(0)
     n = g.num_nodes
     deg = g.degrees.astype(np.int64)
+    seed = int(rng.integers(2**63))       # drawn on EVERY path (see above)
     if n == 0 or g.indices.size == 0:
         return np.zeros(n, dtype=np.float64)
     if use_native:
         try:
             from bigclam_tpu.graph.native import triangle_counts_capped
 
-            return triangle_counts_capped(
-                g, cap, seed=int(rng.integers(2**63))
-            )
+            return triangle_counts_capped(g, cap, seed=seed)
         except ImportError:
             pass
-    indptr_c, indices_c = capped_csr(g, cap, rng)
+    indptr_c, indices_c = capped_csr(g, cap, seed)
     cdeg = np.diff(indptr_c)
     # globally sorted ego keys u*n + w, one per capped edge
     ego_src = np.repeat(np.arange(n, dtype=np.int64), cdeg)

@@ -193,14 +193,15 @@ def make_ring_train_step(
         )
 
         # --- Armijo acceptance + Jacobi update (shared helper) ---
-        F_new, sum_loc = armijo_tail_select_sharded(
-            F_loc, grad, node_llh, cand_nbr, sumF, cfg
+        F_new, sum_loc, hist = armijo_tail_select_sharded(
+            F_loc, grad, node_llh, cand_nbr, sumF, cfg, with_stats=True
         )
         sumF_new = lax.psum(sum_loc, NODES_AXIS)
-        return F_new, sumF_new, llh_cur.astype(F_loc.dtype), it + 1
+        hist = lax.psum(hist, NODES_AXIS)
+        return F_new, sumF_new, llh_cur.astype(F_loc.dtype), it + 1, hist
 
     def step(state: TrainState, src, dst, mask) -> TrainState:
-        F_new, sumF, llh, it = jax.shard_map(
+        F_new, sumF, llh, it, hist = jax.shard_map(
             step_shard,
             mesh=mesh,
             in_specs=(
@@ -210,9 +211,11 @@ def make_ring_train_step(
                 P(NODES_AXIS, None, None, None),
                 P(),
             ),
-            out_specs=(P(NODES_AXIS, K_AXIS), P(K_AXIS), P(), P()),
+            out_specs=(P(NODES_AXIS, K_AXIS), P(K_AXIS), P(), P(), P()),
         )(state.F, src, dst, mask, state.it)
-        return TrainState(F=F_new, sumF=sumF, llh=llh, it=it)
+        return TrainState(
+            F=F_new, sumF=sumF, llh=llh, it=it, accept_hist=hist
+        )
 
     # edge arrays as jit ARGUMENTS (multi-controller: no closing over
     # non-addressable-device arrays; see parallel/sharded.py)
@@ -228,21 +231,107 @@ def make_ring_csr_train_step(
     Same two rotations as make_ring_train_step, but each phase runs the
     grad / candidate Pallas kernels (ops.pallas_csr) over that phase's
     pre-built block-tile bucket (ops.csr_tiles.ring_block_tiles) against
-    the resident rotating F shard: the per-phase (n_tiles, T, K) fd gather
-    reads only F_rot — peak HBM stays O(2 * N/dp * K) like the XLA ring.
-    Per-block kernel outputs accumulate across phases in the scan carry;
-    Armijo tails are added once at the end (shared helper — the candidate
-    kernels run with with_tails=False since each phase sees only a partial
-    edge set)."""
-    from bigclam_tpu.ops.pallas_csr import TilesDev, _cand_blocks, _grad_blocks
+    the resident rotating F shard: the per-phase (n_tiles, T, K_loc) fd
+    gather reads only F_rot — peak HBM stays O(2 * N/dp * K_loc) like the
+    XLA ring. Per-block kernel outputs accumulate across phases in the scan
+    carry; Armijo tails are added once at the end (shared helper — the
+    candidate kernels run with with_tails=False since each phase sees only
+    a partial edge set).
+
+    With the K axis ALSO sharded (tp > 1) each phase uses the TP kernel
+    split (ops.pallas_csr TP suite): partial-dot kernel over this device's
+    K_loc columns -> lax.psum of the per-edge partials over "k" (1 float
+    per edge per phase — tiny next to the rotating F shard) -> consume
+    kernels. This closes the schedule x kernel matrix at the Friendster
+    corner (SURVEY.md C21): ring memory profile + K sharding + MXU kernels
+    simultaneously."""
+    from bigclam_tpu.ops.pallas_csr import (
+        TilesDev,
+        _cand_blocks,
+        _grad_blocks,
+        cand_dots_csr,
+        cand_nbr_from_x_csr,
+        edge_dots_csr,
+        grad_nbr_from_x_csr,
+    )
 
     dp = mesh.shape[NODES_AXIS]
+    tp = mesh.shape[K_AXIS]
     perm = [(j, (j - 1) % dp) for j in range(dp)]
     interp = cfg.pallas_interpret
     block_b = tiles["block_b"]
     tile_t = tiles["tile_t"]
     n_blocks = tiles["n_blocks"]
     num_s = len(cfg.step_candidates)
+
+    def step_shard_tp(F_loc, srcl, dstl, mask, bid, it):
+        srcl, dstl, mask, bid = srcl[0], dstl[0], mask[0], bid[0]
+        n_loc, k_loc = F_loc.shape
+        adt = jnp.dtype(cfg.accum_dtype) if cfg.accum_dtype else F_loc.dtype
+        sumF = lax.psum(F_loc.sum(axis=0), NODES_AXIS)       # (K_loc,)
+
+        def td_of(xs):
+            s, d, m, b_ = xs
+            td = TilesDev(
+                src_local=s, dst=d, mask=m, block_id=b_,
+                block_b=block_b, tile_t=tile_t, n_blocks=n_blocks,
+            )
+            return td, d
+
+        # --- rotation 1: partial dots -> psum over "k" -> grad consume ---
+        def grad_phase(carry, xs):
+            F_rot, gn_acc, ln_acc = carry
+            td, d = td_of(xs)
+            fd = jnp.take(F_rot, d, axis=0)      # K_loc columns of F_rot
+            x = lax.psum(
+                edge_dots_csr(F_loc, td, fd, interpret=interp), K_AXIS
+            )
+            gn, ln = grad_nbr_from_x_csr(x, td, fd, cfg, interpret=interp)
+            F_rot = lax.ppermute(F_rot, NODES_AXIS, perm)
+            return (F_rot, gn_acc + gn, ln_acc + ln), None
+
+        init = (
+            F_loc,
+            _mark_varying(
+                jnp.zeros((n_loc, k_loc), F_loc.dtype), (NODES_AXIS, K_AXIS)
+            ),
+            _mark_varying(jnp.zeros(n_loc, F_loc.dtype), (NODES_AXIS,)),
+        )
+        (F_back, gn, ln), _ = lax.scan(
+            grad_phase, init, (srcl, dstl, mask, bid)
+        )
+        grad = gn - sumF[None, :] + F_loc
+        node_llh = ln.astype(adt) + (
+            -lax.psum(F_loc @ sumF, K_AXIS) + _rowdot(F_loc, F_loc)
+        ).astype(adt)
+        llh_cur = lax.psum(node_llh.sum(), NODES_AXIS)
+
+        # --- rotation 2: candidate partial dots -> psum -> consume ---
+        def cand_phase(carry, xs):
+            F_rot, cn_acc = carry
+            td, d = td_of(xs)
+            fd = jnp.take(F_rot, d, axis=0)
+            xc = lax.psum(
+                cand_dots_csr(F_loc, grad, td, fd, cfg, interpret=interp),
+                K_AXIS,
+            )
+            cb = cand_nbr_from_x_csr(xc, td, cfg, interpret=interp)
+            F_rot = lax.ppermute(F_rot, NODES_AXIS, perm)
+            return (F_rot, cn_acc + cb), None
+
+        initc = (
+            F_back,
+            _mark_varying(
+                jnp.zeros((num_s, n_loc), F_loc.dtype), (NODES_AXIS,)
+            ),
+        )
+        (_, cb), _ = lax.scan(cand_phase, initc, (srcl, dstl, mask, bid))
+        F_new, sum_loc, hist = armijo_tail_select_sharded(
+            F_loc, grad, node_llh, cb.astype(adt), sumF, cfg, with_stats=True
+        )
+        sumF_new = lax.psum(sum_loc, NODES_AXIS)
+        hist = lax.psum(hist, NODES_AXIS)
+        return F_new, sumF_new, llh_cur.astype(F_loc.dtype), it + 1, hist
 
     def step_shard(F_loc, srcl, dstl, mask, bid, it):
         srcl, dstl, mask, bid = srcl[0], dstl[0], mask[0], bid[0]
@@ -307,15 +396,16 @@ def make_ring_csr_train_step(
         )
         (_, cb), _ = lax.scan(cand_phase, initc, (srcl, dstl, mask, bid))
         cand_nbr = cb.transpose(1, 0, 2).reshape(num_s, n_loc).astype(adt)
-        F_new, sum_loc = armijo_tail_select_sharded(
-            F_loc, grad, node_llh, cand_nbr, sumF, cfg
+        F_new, sum_loc, hist = armijo_tail_select_sharded(
+            F_loc, grad, node_llh, cand_nbr, sumF, cfg, with_stats=True
         )
         sumF_new = lax.psum(sum_loc, NODES_AXIS)
-        return F_new, sumF_new, llh_cur.astype(F_loc.dtype), it + 1
+        hist = lax.psum(hist, NODES_AXIS)
+        return F_new, sumF_new, llh_cur.astype(F_loc.dtype), it + 1, hist
 
     def step(state: TrainState, srcl, dstl, mask, bid) -> TrainState:
-        F_new, sumF, llh, it = jax.shard_map(
-            step_shard,
+        F_new, sumF, llh, it, hist = jax.shard_map(
+            step_shard_tp if tp > 1 else step_shard,
             mesh=mesh,
             in_specs=(
                 P(NODES_AXIS, K_AXIS),
@@ -325,10 +415,12 @@ def make_ring_csr_train_step(
                 P(NODES_AXIS, None, None),
                 P(),
             ),
-            out_specs=(P(NODES_AXIS, K_AXIS), P(K_AXIS), P(), P()),
+            out_specs=(P(NODES_AXIS, K_AXIS), P(K_AXIS), P(), P(), P()),
             check_vma=False,       # pallas interpret + prefetch (see sharded)
         )(state.F, srcl, dstl, mask, bid, state.it)
-        return TrainState(F=F_new, sumF=sumF, llh=llh, it=it)
+        return TrainState(
+            F=F_new, sumF=sumF, llh=llh, it=it, accept_hist=hist
+        )
 
     # tile arrays as jit ARGUMENTS (multi-controller: no closing over
     # non-addressable-device arrays; see parallel/sharded.py)
@@ -343,26 +435,19 @@ class RingBigClamModel(ShardedBigClamModel):
     """Sharded trainer using the ring-pass schedule (same API/trajectories
     as ShardedBigClamModel; different memory/communication profile).
 
-    With the blocked-CSR kernels engaged (auto on TPU, tp == 1) each ring
-    phase runs the MXU kernels over its (shard, phase) tile bucket; the XLA
-    chunk-scan schedule remains the fallback and the tp > 1 path."""
+    With the blocked-CSR kernels engaged (auto on TPU) each ring phase runs
+    the MXU kernels over its (shard, phase) tile bucket; with the K axis
+    also sharded (tp > 1) each phase uses the TP kernel split (partial dots
+    + psum over "k"). The XLA chunk-scan schedule remains the fallback."""
 
-    def _csr_static_ok(self, tp: int) -> bool:
-        if tp > 1:
-            if self.cfg.use_pallas_csr is True:
-                raise ValueError(
-                    "use_pallas_csr=True on the ring schedule requires an "
-                    f"unsharded K axis (tp == 1); got tp={tp}"
-                )
-            from bigclam_tpu.models.bigclam import csr_want_reason
-
-            want, reason = csr_want_reason(self.cfg)
-            self._csr_reason = (
-                "ring schedule: CSR kernels need an unsharded K axis "
-                f"(tp={tp})" if want else reason
-            )
-            return False
-        return super()._csr_static_ok(tp)
+    @property
+    def engaged_path(self) -> str:
+        """Ring CSR reports a DISTINCT label: its comm/memory profile
+        (ppermute rotations, O(N/dp) peak HBM) is nothing like the
+        all-gather sharded "csr" schedule, and metrics/bench records must
+        tell them apart (ADVICE round-2)."""
+        path = super().engaged_path
+        return "csr_ring" if path == "csr" else path
 
     def _csr_economy_ok(self, dp: int) -> bool:
         """Probe the ring tile layout: dp*dp buckets padded to the max tile
@@ -379,7 +464,9 @@ class RingBigClamModel(ShardedBigClamModel):
         rbt = ring_block_tiles(self.g, dp, n_pad, block_b, tile_t)
         e = max(self.g.num_directed_edges, 1)
         n_tiles = rbt.src_local.shape[2]
-        phase_fd = n_tiles * tile_t * self._csr_k_pad * 4
+        # fd columns are per-device: K_loc under a sharded K axis
+        k_loc = self._csr_k_pad // self.mesh.shape[K_AXIS]
+        phase_fd = n_tiles * tile_t * k_loc * 4
         pad_ok = layout_economical(
             rbt.slots, e, dp * dp * rbt.n_blocks, tile_t
         )

@@ -107,7 +107,8 @@ def armijo_tail_select_sharded(
     cand_nbr: jax.Array,
     sumF: jax.Array,
     cfg: BigClamConfig,
-) -> tuple[jax.Array, jax.Array]:
+    with_stats: bool = False,
+):
     """Armijo tails (rowdot-psums over "k") + acceptance + max-accepted-step
     Jacobi update, K-shard aware. ONE implementation shared by the XLA
     sharded step, the ring step, and the CSR TP step — any tuning of the
@@ -116,7 +117,12 @@ def armijo_tail_select_sharded(
     gg is computed in accum dtype exactly as ops.linesearch.armijo_update,
     so sharded acceptance decisions match single-chip bit-for-bit. Returns
     (F_new, local column sums of F_new) — the caller psums the latter.
+    with_stats=True adds this shard's accept_stats histogram (the caller
+    psums it over "nodes"; it is replicated over "k" since every input to
+    the acceptance test is already psum'd over "k").
     """
+    from bigclam_tpu.ops.linesearch import accept_stats
+
     adt = jnp.dtype(cfg.accum_dtype) if cfg.accum_dtype else F_loc.dtype
     etas = jnp.asarray(cfg.step_candidates, F_loc.dtype)
     gg = _rowdot(grad, grad).astype(adt)
@@ -136,6 +142,8 @@ def armijo_tail_select_sharded(
         jnp.clip(F_loc + best_eta[:, None] * grad, cfg.min_f, cfg.max_f),
         F_loc,
     )
+    if with_stats:
+        return F_new, F_new.sum(axis=0), accept_stats(ok)
     return F_new, F_new.sum(axis=0)
 
 
@@ -172,6 +180,7 @@ def make_sharded_csr_train_step(
         grad_llh_csr,
         grad_nbr_from_x_csr,
         train_pass_csr_grouped,
+        train_pass_csr_grouped_tp,
     )
 
     interp = cfg.pallas_interpret
@@ -182,12 +191,13 @@ def make_sharded_csr_train_step(
 
     def finish(F_loc, grad, node_llh, cand_nbr, sumF, it):
         """Armijo tails + select + update (shared helper) + the psums."""
-        F_new, sum_loc = armijo_tail_select_sharded(
-            F_loc, grad, node_llh, cand_nbr, sumF, cfg
+        F_new, sum_loc, hist = armijo_tail_select_sharded(
+            F_loc, grad, node_llh, cand_nbr, sumF, cfg, with_stats=True
         )
         sumF_new = lax.psum(sum_loc, NODES_AXIS)
         llh_cur = lax.psum(node_llh.sum(), NODES_AXIS)
-        return F_new, sumF_new, llh_cur.astype(F_loc.dtype), it + 1
+        hist = lax.psum(hist, NODES_AXIS)
+        return F_new, sumF_new, llh_cur.astype(F_loc.dtype), it + 1, hist
 
     def step_shard_flat(F_loc, srcl, dst, mask, bid, it):
         srcl, dst, mask, bid = srcl[0], dst[0], mask[0], bid[0]
@@ -205,9 +215,12 @@ def make_sharded_csr_train_step(
         cand_full = candidates_csr(
             F_loc, grad, sumF, td, cfg, fd=fd, interpret=interp
         )
-        F_new, sum_loc = armijo_select(F_loc, grad, node_llh, cand_full, cfg)
+        F_new, sum_loc, hist = armijo_select(
+            F_loc, grad, node_llh, cand_full, cfg, with_stats=True
+        )
         sumF_new = lax.psum(sum_loc, NODES_AXIS)
-        return F_new, sumF_new, llh_cur.astype(F_loc.dtype), it + 1
+        hist = lax.psum(hist, NODES_AXIS)
+        return F_new, sumF_new, llh_cur.astype(F_loc.dtype), it + 1, hist
 
     def step_shard_tp(F_loc, srcl, dst, mask, bid, it):
         srcl, dst, mask, bid = srcl[0], dst[0], mask[0], bid[0]
@@ -248,11 +261,33 @@ def make_sharded_csr_train_step(
             F_loc, sumF, gt, cfg, interpret=interp, F_gather=F_full
         )
         llh_cur = lax.psum(node_llh.sum(), NODES_AXIS)
-        F_new, sum_loc = armijo_select(F_loc, grad, node_llh, cand_full, cfg)
+        F_new, sum_loc, hist = armijo_select(
+            F_loc, grad, node_llh, cand_full, cfg, with_stats=True
+        )
         sumF_new = lax.psum(sum_loc, NODES_AXIS)
-        return F_new, sumF_new, llh_cur.astype(F_loc.dtype), it + 1
+        hist = lax.psum(hist, NODES_AXIS)
+        return F_new, sumF_new, llh_cur.astype(F_loc.dtype), it + 1, hist
 
-    if grouped:
+    def step_shard_grouped_tp(F_loc, srcl, dst, mask, bid, it):
+        gt = GroupedTilesDev(
+            src_local=srcl[0], dst=dst[0], mask=mask[0], block_id=bid[0],
+            block_b=block_b, tile_t=tile_t, nb=tiles["nb"],
+            n_groups=tiles["n_groups"],
+        )
+        adt = jnp.dtype(cfg.accum_dtype) if cfg.accum_dtype else F_loc.dtype
+        F_full = lax.all_gather(F_loc, NODES_AXIS, axis=0, tiled=True)
+        sumF = lax.psum(F_loc.sum(axis=0), NODES_AXIS)       # (K_loc,)
+        grad, llh_nbr, cand_nbr = train_pass_csr_grouped_tp(
+            F_loc, sumF, gt, cfg, K_AXIS, interpret=interp, F_gather=F_full
+        )
+        node_llh = llh_nbr.astype(adt) + (
+            -lax.psum(F_loc @ sumF, K_AXIS) + _rowdot(F_loc, F_loc)
+        ).astype(adt)
+        return finish(F_loc, grad, node_llh, cand_nbr.astype(adt), sumF, it)
+
+    if grouped and tp > 1:
+        step_shard = step_shard_grouped_tp
+    elif grouped:
         step_shard = step_shard_grouped
     elif tp > 1:
         step_shard = step_shard_tp
@@ -268,7 +303,7 @@ def make_sharded_csr_train_step(
         # dynamic_slice, which the VMA type check cannot express yet; the
         # XLA sharded step keeps the checked path and the equivalence tests
         # (tests/test_pallas_csr.py::TestShardedCSR) pin the semantics
-        F_new, sumF, llh, it = jax.shard_map(
+        F_new, sumF, llh, it, hist = jax.shard_map(
             step_shard,
             mesh=mesh,
             in_specs=(
@@ -279,10 +314,12 @@ def make_sharded_csr_train_step(
                 spec_for(bid),
                 P(),
             ),
-            out_specs=(P(NODES_AXIS, K_AXIS), P(K_AXIS), P(), P()),
+            out_specs=(P(NODES_AXIS, K_AXIS), P(K_AXIS), P(), P(), P()),
             check_vma=False,
         )(state.F, srcl, dst, mask, bid, state.it)
-        return TrainState(F=F_new, sumF=sumF, llh=llh, it=it)
+        return TrainState(
+            F=F_new, sumF=sumF, llh=llh, it=it, accept_hist=hist
+        )
 
     # tile arrays ride as jit ARGUMENTS, not closure constants: under
     # multi-controller jax, closing over an array that spans non-addressable
@@ -376,14 +413,15 @@ def make_sharded_train_step(
         )
 
         # Armijo acceptance + max-accepted-step update (shared helper)
-        F_new, sum_loc = armijo_tail_select_sharded(
-            F_loc, grad, node_llh, cand_nbr, sumF, cfg
+        F_new, sum_loc, hist = armijo_tail_select_sharded(
+            F_loc, grad, node_llh, cand_nbr, sumF, cfg, with_stats=True
         )
         sumF_new = lax.psum(sum_loc, NODES_AXIS)             # (K_loc,)
-        return F_new, sumF_new, llh_cur.astype(F_loc.dtype), it + 1
+        hist = lax.psum(hist, NODES_AXIS)
+        return F_new, sumF_new, llh_cur.astype(F_loc.dtype), it + 1, hist
 
     def step(state: TrainState, src, dst, mask) -> TrainState:
-        F_new, sumF, llh, it = jax.shard_map(
+        F_new, sumF, llh, it, hist = jax.shard_map(
             step_shard,
             mesh=mesh,
             in_specs=(
@@ -393,9 +431,11 @@ def make_sharded_train_step(
                 P(NODES_AXIS, None, None),
                 P(),
             ),
-            out_specs=(P(NODES_AXIS, K_AXIS), P(K_AXIS), P(), P()),
+            out_specs=(P(NODES_AXIS, K_AXIS), P(K_AXIS), P(), P(), P()),
         )(state.F, src, dst, mask, state.it)
-        return TrainState(F=F_new, sumF=sumF, llh=llh, it=it)
+        return TrainState(
+            F=F_new, sumF=sumF, llh=llh, it=it, accept_hist=hist
+        )
 
     # edge arrays as jit ARGUMENTS (multi-controller: no closing over
     # non-addressable-device arrays; see make_sharded_csr_train_step)
@@ -562,7 +602,7 @@ class ShardedBigClamModel:
             self._probe_tiles = sbt
             self._csr_nb = None
             return True
-        if pad_ok and tp == 1 and self._grouped_economy_ok(dp, sbt):
+        if pad_ok and self._grouped_economy_ok(dp, sbt):
             return True
         if cfg.use_pallas_csr is True:
             grouped_why = getattr(self, "_csr_reason", "")
@@ -573,28 +613,30 @@ class ShardedBigClamModel:
                 "balance=True, the ring trainer, or a sharded K axis)"
                 + (f"; {grouped_why}" if grouped_why else "")
             )
-        if not (pad_ok and tp == 1):
+        if not pad_ok:
             # otherwise _grouped_economy_ok already recorded the grouped
             # attempt's specific reason — keep it
             self._csr_reason = (
                 f"sharded layout uneconomical: {slots - e} padded edge "
                 f"slots on {e} edges, per-shard fd gather "
                 f"{fd_bytes >> 20} MiB"
-                + (" (grouped fallback needs tp == 1)" if tp > 1 else "")
             )
         return False
 
     def _grouped_economy_ok(self, dp: int, sbt) -> bool:
         """Try the grouped (large-K) sharded layout: block-group windows
         scanned with per-group fd gathers bounded by GROUP_FD_BUDGET.
-        Mirrors the single-chip grouping policy (models.bigclam)."""
+        Mirrors the single-chip grouping policy (models.bigclam). Under a
+        sharded K axis the gathered fd holds K_loc columns, so the budgets
+        scale with K/tp (the grouped-TP step then runs the partial-dot +
+        psum-over-"k" kernel split per group)."""
         from bigclam_tpu.ops.csr_tiles import (
             layout_economical,
             shard_grouped_tiles,
         )
 
         block_b, tile_t = self._csr_shape
-        k_pad = self._csr_k_pad
+        k_pad = self._csr_k_pad // self.mesh.shape[K_AXIS]   # fd columns
         e = max(self.g.num_directed_edges, 1)
         tiles_per_group = max(GROUP_FD_BUDGET // (tile_t * k_pad * 4), 1)
         avg_tiles = max(sbt.n_tiles / sbt.n_blocks, 1e-9)
@@ -737,6 +779,9 @@ class ShardedBigClamModel:
             sumF=F.sum(axis=0),
             llh=jnp.asarray(-jnp.inf, self.dtype),
             it=jnp.zeros((), jnp.int32),
+            accept_hist=jnp.zeros(
+                len(self.cfg.step_candidates) + 1, jnp.int32
+            ),
         )
 
     def _ckpt_meta(self) -> dict:
@@ -771,6 +816,9 @@ class ShardedBigClamModel:
             sumF=F.sum(axis=0),
             llh=jnp.asarray(arrays["llh"], self.dtype),
             it=jnp.asarray(arrays["it"], jnp.int32),
+            accept_hist=jnp.zeros(
+                len(self.cfg.step_candidates) + 1, jnp.int32
+            ),
         )
 
     def fit(

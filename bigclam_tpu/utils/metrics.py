@@ -65,15 +65,26 @@ class MetricsLogger:
             print(line, file=sys.stderr)
 
     def step_callback(
-        self, num_directed_edges: int, chips: int = 1, path: str = ""
+        self,
+        num_directed_edges: int,
+        chips: int = 1,
+        path: str = "",
+        num_nodes: int = 0,
     ):
-        """A fit-loop callback(it, llh) that logs iter/LLH/dllh/edges-per-sec.
+        """A fit-loop callback(it, llh, extras) that logs iter/LLH/dllh/
+        edges-per-sec and — when the loop supplies it — the accepted-step
+        histogram + acceptance rate (SURVEY.md §5: a fit whose line search
+        collapses to 1e-15 steps or rejects everything must be visible in
+        the JSONL).
 
         `path` is the trainer's engaged edge-sweep implementation
-        (model.engaged_path: csr | csr_grouped | pallas_vmem | xla) so
-        production metrics record which kernels actually ran."""
+        (model.engaged_path: csr | csr_grouped | csr_ring | pallas_vmem |
+        xla) so production metrics record which kernels actually ran.
+        `num_nodes` (real, unpadded) turns the histogram into an exact
+        acceptance rate: padding rows can only ever land in the rejected
+        slot, so accepted counts are real-node counts by construction."""
 
-        def cb(it: int, llh: float) -> None:
+        def cb(it: int, llh: float, extras: Optional[Dict] = None) -> None:
             now = time.perf_counter()
             rec: Dict[str, Any] = {"iter": it, "llh": llh}
             if path:
@@ -87,6 +98,14 @@ class MetricsLogger:
                     rec["edges_per_sec_per_chip"] = round(
                         num_directed_edges / dt / chips, 1
                     )
+            if extras and extras.get("accept_hist") is not None:
+                hist = list(extras["accept_hist"])
+                accepted = int(sum(hist[:-1]))
+                # slot order: one count per cfg.step_candidates entry
+                # (descending eta), final slot = no-accepted-step rows
+                rec["accept_hist"] = hist
+                if num_nodes > 0:
+                    rec["accept_rate"] = round(accepted / num_nodes, 4)
             self._last_t = now
             self._last_llh = llh
             self.log(rec)

@@ -17,19 +17,31 @@ import numpy as np
 from bigclam_tpu.graph.csr import Graph
 
 
+DEFAULT_MAX_NODES = 100_000
+DEFAULT_MAX_EDGES = 1_000_000
+_CHUNK = 65536
+
+
 def export_gexf(
     path: str,
     g: Graph,
     communities: Optional[Dict[int, Iterable[int]]] = None,
     F: Optional[np.ndarray] = None,
-    max_edges: Optional[int] = None,
+    max_edges: Optional[int] = DEFAULT_MAX_EDGES,
+    max_nodes: Optional[int] = DEFAULT_MAX_NODES,
 ) -> None:
     """Write the graph (undirected, deduped) with community attributes.
 
     Per node: `community` = its primary community (argmax F when F given,
     else the first community containing it; -1 when none) and
-    `n_communities` = overlap count. `max_edges` caps output size for
-    viewer-friendly files (edges are kept in CSR order).
+    `n_communities` = overlap count.
+
+    GEXF is a per-element XML format for interactive viewers — useless (and
+    enormous) at the graph sizes this framework trains on — so output is
+    bounded by default: the first `max_nodes` node ids and the `max_edges`
+    first CSR-order edges among them (pass None to lift either bound
+    explicitly). Rows are rendered in chunked ''.join batches, not one
+    f-string write per element (round-1/2 perf finding).
     """
     n = g.num_nodes
     primary = np.full(n, -1, dtype=np.int64)
@@ -43,7 +55,8 @@ def export_gexf(
     if F is not None:
         has_mass = np.asarray(F).max(axis=1) > 0
         primary[has_mass] = np.asarray(F).argmax(axis=1)[has_mass]
-    und = g.src < g.dst                       # one direction per edge
+    n_out = n if max_nodes is None else min(n, max_nodes)
+    und = (g.src < g.dst) & (g.dst < n_out)   # one direction, kept nodes
     src, dst = g.src[und], g.dst[und]
     if max_edges is not None and src.size > max_edges:
         src, dst = src[:max_edges], dst[:max_edges]
@@ -57,14 +70,25 @@ def export_gexf(
             '      <attribute id="1" title="n_communities" type="long"/>\n'
             "    </attributes>\n    <nodes>\n"
         )
-        for u in range(n):
+        for lo in range(0, n_out, _CHUNK):
+            hi = min(lo + _CHUNK, n_out)
             f.write(
-                f'      <node id="{u}" label="{escape(str(u))}">'
-                f'<attvalues><attvalue for="0" value="{primary[u]}"/>'
-                f'<attvalue for="1" value="{overlap[u]}"/></attvalues>'
-                "</node>\n"
+                "".join(
+                    f'      <node id="{u}" label="{escape(str(u))}">'
+                    f'<attvalues><attvalue for="0" value="{primary[u]}"/>'
+                    f'<attvalue for="1" value="{overlap[u]}"/></attvalues>'
+                    "</node>\n"
+                    for u in range(lo, hi)
+                )
             )
         f.write("    </nodes>\n    <edges>\n")
-        for i in range(src.size):
-            f.write(f'      <edge id="{i}" source="{src[i]}" target="{dst[i]}"/>\n')
+        for lo in range(0, src.size, _CHUNK):
+            hi = min(lo + _CHUNK, src.size)
+            s_c, d_c = src[lo:hi].tolist(), dst[lo:hi].tolist()
+            f.write(
+                "".join(
+                    f'      <edge id="{i}" source="{s}" target="{d}"/>\n'
+                    for i, (s, d) in enumerate(zip(s_c, d_c), start=lo)
+                )
+            )
         f.write("    </edges>\n  </graph>\n</gexf>\n")

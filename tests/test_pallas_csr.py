@@ -314,6 +314,49 @@ class TestShardedCSR:
             )
 
 
+    @pytest.mark.parametrize("mesh_shape", [(2, 2), (4, 2)])
+    def test_sharded_csr_grouped_tp_matches_xla(
+        self, rng, monkeypatch, mesh_shape
+    ):
+        """Grouped (large-K) layout under a SHARDED K axis: per group, the
+        TP kernel split (VERDICT round-3 item 2 — the tp == 1 gate on the
+        grouped layout is lifted)."""
+        import jax
+        import bigclam_tpu.parallel.sharded as ps
+        from bigclam_tpu.parallel import ShardedBigClamModel, make_mesh
+
+        monkeypatch.setattr(ps, "FLAT_FD_BUDGET", 0)     # force grouping
+        monkeypatch.setattr(ps, "GROUP_FD_BUDGET", 40960)
+        dp, tp = mesh_shape
+        g = _random_graph(rng, n=71)
+        k = 6
+        base = BigClamConfig(num_communities=k, edge_chunk=64)
+        mesh = make_mesh(mesh_shape, jax.devices()[: dp * tp])
+        m_csr = ShardedBigClamModel(
+            g,
+            base.replace(
+                use_pallas_csr=True, pallas_interpret=True,
+                csr_block_b=8, csr_tile_t=8,
+            ),
+            mesh,
+        )
+        m_xla = ShardedBigClamModel(
+            g, base.replace(use_pallas_csr=False), mesh
+        )
+        assert m_csr.engaged_path == "csr_grouped"
+        assert m_csr._csr_nb is not None and m_csr._csr_nb >= 1
+        F0 = rng.uniform(0.0, 1.0, size=(g.num_nodes, k))
+        s_c, s_x = m_csr.init_state(F0), m_xla.init_state(F0)
+        for _ in range(3):
+            s_c, s_x = m_csr._step(s_c), m_xla._step(s_x)
+        n = g.num_nodes
+        np.testing.assert_allclose(
+            np.asarray(s_c.F)[:n, :k], np.asarray(s_x.F)[:n, :k],
+            rtol=3e-5, atol=3e-5,
+        )
+        np.testing.assert_allclose(float(s_c.llh), float(s_x.llh), rtol=1e-5)
+
+
 class TestGroupedCSR:
     """Large-K grouped layout: scan over block windows with per-group dst
     gathers. Must match the flat kernels (and therefore the XLA path)."""

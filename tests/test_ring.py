@@ -107,7 +107,7 @@ class TestRingCSR:
             ),
             mesh,
         )
-        assert ring.engaged_path == "csr"
+        assert ring.engaged_path == "csr_ring"
         assert ring.edges is None           # CSR step built, no EdgeChunks
         xla = ShardedBigClamModel(
             g, base.replace(use_pallas_csr=False), mesh
@@ -117,6 +117,42 @@ class TestRingCSR:
         s_r, s_x = ring.init_state(F0), xla.init_state(F0)
         for _ in range(3):
             s_r, s_x = ring._step(s_r), xla._step(s_x)
+        n = g.num_nodes
+        np.testing.assert_allclose(
+            np.asarray(s_r.F)[:n, :k], np.asarray(s_x.F)[:n, :k],
+            rtol=3e-5, atol=3e-5,
+        )
+        np.testing.assert_allclose(float(s_r.llh), float(s_x.llh), rtol=1e-5)
+
+    @pytest.mark.parametrize("mesh_shape", [(2, 2), (4, 2)])
+    def test_ring_csr_tp_matches_xla_ring(self, mesh_shape):
+        """Ring schedule x SHARDED K axis x CSR kernels — the last cell of
+        the schedule x kernel matrix (VERDICT round-3 item 2): per ring
+        phase, partial-dot kernels + psum over "k" + consume kernels."""
+        import jax
+
+        dp, tp = mesh_shape
+        g = _random_graph(0)
+        k = 6
+        base = BigClamConfig(num_communities=k, edge_chunk=64)
+        mesh = make_mesh(mesh_shape, jax.devices()[: dp * tp])
+        ring_csr = RingBigClamModel(
+            g,
+            base.replace(
+                use_pallas_csr=True, pallas_interpret=True,
+                csr_block_b=8, csr_tile_t=8,
+            ),
+            mesh,
+        )
+        assert ring_csr.engaged_path == "csr_ring"
+        ring_xla = RingBigClamModel(
+            g, base.replace(use_pallas_csr=False), mesh
+        )
+        rng = np.random.default_rng(1)
+        F0 = rng.uniform(0.0, 1.0, size=(g.num_nodes, k))
+        s_r, s_x = ring_csr.init_state(F0), ring_xla.init_state(F0)
+        for _ in range(3):
+            s_r, s_x = ring_csr._step(s_r), ring_xla._step(s_x)
         n = g.num_nodes
         np.testing.assert_allclose(
             np.asarray(s_r.F)[:n, :k], np.asarray(s_x.F)[:n, :k],

@@ -2,6 +2,7 @@
 locally-minimal ranking order, isolated-node sentinel, init_F structure."""
 
 import numpy as np
+import pytest
 
 from bigclam_tpu.config import BigClamConfig
 from bigclam_tpu.graph.ingest import graph_from_edges
@@ -179,6 +180,48 @@ class TestSampledTriangles:
                 rng=np.random.default_rng(4),
             )
             assert (phi >= 0).all(), (use_native, phi.min())
+
+    def test_native_and_numpy_backends_agree_under_cap(self, facebook_graph):
+        """Backend independence (ADVICE rounds 1-2): with the cap BINDING
+        (cap < max degree), the native and NumPy estimators must see the
+        same splitmix64-sampled capped lists and return the same estimates
+        — same config can never yield different seed rankings depending on
+        whether the .so built."""
+        pytest.importorskip("bigclam_tpu.graph.native")
+        from bigclam_tpu.graph import native as native_mod
+
+        if not hasattr(native_mod, "_lib") or native_mod._lib is None:
+            pytest.skip("native library not built")
+        g = facebook_graph
+        cap = 32
+        assert int(g.degrees.max()) > cap
+        a = seeding.triangle_counts_sampled(
+            g, cap, np.random.default_rng(7), use_native=True
+        )
+        b = seeding.triangle_counts_sampled(
+            g, cap, np.random.default_rng(7), use_native=False
+        )
+        # same multiset of hit weights, different summation order
+        np.testing.assert_allclose(a, b, rtol=1e-9, atol=1e-9)
+        # and therefore identical rankings
+        cfg = BigClamConfig(num_communities=10, seeding_degree_cap=cap)
+        phi_a = seeding.conductance(
+            g, backend="sampled", degree_cap=cap, rng=np.random.default_rng(7)
+        )
+        ra = seeding.rank_seeds(g, phi_a, cfg)
+        import bigclam_tpu.graph.native as nm
+
+        tc = nm.triangle_counts_capped
+        try:
+            del nm.triangle_counts_capped
+            phi_b = seeding.conductance(
+                g, backend="sampled", degree_cap=cap,
+                rng=np.random.default_rng(7),
+            )
+        finally:
+            nm.triangle_counts_capped = tc
+        rb = seeding.rank_seeds(g, phi_b, cfg)
+        np.testing.assert_array_equal(ra, rb)
 
     def test_chunk_of_isolated_tail_nodes(self):
         # chunk boundary landing after the last edge-bearing node (NumPy path)

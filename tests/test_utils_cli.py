@@ -60,6 +60,57 @@ def test_metrics_logger(tmp_path):
     assert "rel_dllh" in lines[1] and "edges_per_sec_per_chip" in lines[1]
 
 
+def test_metrics_accept_histogram(toy_graphs, tmp_path):
+    """SURVEY §5 line-search observability: a real fit's metrics JSONL must
+    carry the accepted-step histogram and acceptance rate each iteration,
+    with accepted counts over real nodes only (padding rows can only land
+    in the rejected slot)."""
+    g = toy_graphs["two_cliques"]
+    cfg = BigClamConfig(
+        num_communities=2, dtype="float64", max_iters=5, conv_tol=0.0,
+    )
+    rng = np.random.default_rng(5)
+    F0 = rng.uniform(0.1, 1.0, size=(g.num_nodes, 2))
+    model = BigClamModel(g, cfg)
+    p = tmp_path / "m.jsonl"
+    with MetricsLogger(str(p), echo=False) as ml:
+        cb = ml.step_callback(
+            g.num_directed_edges, num_nodes=g.num_nodes,
+        )
+        model.fit(F0, callback=cb)
+    lines = [json.loads(x) for x in p.read_text().splitlines()]
+    num_s = len(cfg.step_candidates)
+    for rec in lines:
+        hist = rec["accept_hist"]
+        assert len(hist) == num_s + 1
+        accepted = sum(hist[:-1])
+        assert 0 <= accepted <= g.num_nodes
+        assert sum(hist) == model.n_pad     # every padded row counted once
+        assert rec["accept_rate"] == round(accepted / g.num_nodes, 4)
+    # a healthy early fit accepts steps for most nodes
+    assert sum(lines[0]["accept_hist"][:-1]) > 0
+
+
+def test_accept_stats_hand_mask():
+    import jax.numpy as jnp
+
+    from bigclam_tpu.ops.linesearch import accept_stats
+
+    # 3 candidates (descending eta), 4 nodes: node0 accepts cand 0 and 2
+    # (chosen = 0), node1 accepts cand 1, node2 rejects all, node3 accepts
+    # cand 2 only
+    ok = jnp.asarray(
+        [
+            [True, False, False, False],
+            [False, True, False, False],
+            [True, False, False, True],
+        ]
+    )
+    np.testing.assert_array_equal(
+        np.asarray(accept_stats(ok)), [1, 1, 1, 1]
+    )
+
+
 def _run_cli(*argv):
     return subprocess.run(
         [sys.executable, "-m", "bigclam_tpu.cli", *argv],
