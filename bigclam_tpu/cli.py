@@ -168,6 +168,13 @@ def cmd_fit(args) -> int:
     from bigclam_tpu.utils.profiling import trace
 
     g, cfg = _build(args, args.k)
+    if getattr(args, "quality", False):
+        cfg = cfg.replace(
+            quality_mode=True,
+            init_noise=args.init_noise,
+            restart_cycles=args.restart_cycles,
+            restart_tol=args.restart_tol,
+        )
     if args.checkpoint_dir and cfg.checkpoint_every <= 0:
         # a checkpoint dir without a cadence would restore but never save
         cfg = cfg.replace(checkpoint_every=50)
@@ -188,9 +195,17 @@ def cmd_fit(args) -> int:
             g.num_directed_edges,
             chips=n_chips,
             path=getattr(model, "engaged_path", ""),
+            num_nodes=g.num_nodes,
         )
         with trace(args.profile_dir):
-            res = model.fit(F0, callback=cb, checkpoints=ckpt)
+            if cfg.quality_mode:
+                from bigclam_tpu.models.quality import fit_quality
+
+                qres = fit_quality(model, F0, callback=cb, checkpoints=ckpt)
+                res = qres.fit
+            else:
+                qres = None
+                res = model.fit(F0, callback=cb, checkpoints=ckpt)
     out = {
         "llh": res.llh,
         "iters": res.num_iters,
@@ -198,6 +213,10 @@ def cmd_fit(args) -> int:
         "edges": g.num_edges,
         "k": cfg.num_communities,
     }
+    if qres is not None:
+        out["quality_cycles"] = qres.num_cycles
+        out["quality_total_iters"] = qres.total_iters
+        out["cycles_llh"] = [round(v, 2) for v in qres.cycles_llh]
     com = (
         extraction.extract_communities(res.F, g)
         if (args.out or args.export_gexf)
@@ -281,6 +300,20 @@ def main(argv=None) -> int:
     p_fit = sub.add_parser("fit", help="train at a fixed K and extract communities")
     _add_common(p_fit)
     p_fit.add_argument("--k", type=int, default=100)
+    p_fit.add_argument(
+        "--quality", action="store_true",
+        help="quality mode (NOT reference semantics): noise-floor init + "
+             "restart annealing — recovers community structure at large K "
+             "where the faithful dynamics freeze all-zero rows "
+             "(models/quality.py)",
+    )
+    p_fit.add_argument(
+        "--init-noise", type=float, default=None,
+        help="noise-kick scale (default: auto, ~120/N — see config)",
+    )
+    # defaults mirror config.py so the CLI and the Python API agree
+    p_fit.add_argument("--restart-cycles", type=int, default=40)
+    p_fit.add_argument("--restart-tol", type=float, default=1e-4)
     p_fit.add_argument("--out", default=None, help="write SNAP cmty file")
     p_fit.add_argument("--save-f", default=None, help="write F as .npy")
     p_fit.add_argument(

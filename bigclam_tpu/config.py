@@ -56,6 +56,40 @@ class BigClamConfig:
                                         # quadratic on hubs); None = exact.
                                         # Exact anyway when cap >= max degree.
 
+    # --- quality mode (models/quality.py; NOT reference behavior) ---
+    quality_mode: bool = False          # default off = exact reference parity.
+                                        # On: noise-floor init + restart
+                                        # annealing (fit_quality) — unfreezes
+                                        # the all-zero F rows that the
+                                        # reference's clamp-at-0 dynamics can
+                                        # never move (see PARITY.md)
+    init_noise: Optional[float] = None  # U(0, eps) added to F0 and to each
+                                        # restart kick. None = auto:
+                                        # min(0.02, init_noise_mass / N) —
+                                        # the kick's contribution to each
+                                        # column's sumF (~eps*N/2) must stay
+                                        # comparable to a community's column
+                                        # mass, NOT scale with N (measured:
+                                        # eps*N ~ 120 recovers F1 0.84-0.88
+                                        # from 6K to 60K nodes; eps*N ~ 600
+                                        # at N=60K drowns the signal and
+                                        # fails entirely)
+    init_noise_mass: float = 120.0      # auto rule numerator (see above)
+    restart_cycles: int = 40            # max annealing cycles (cycles are
+                                        # short — ~5-10 iterations once
+                                        # annealing sets in; restart_tol is
+                                        # the real stop rule)
+    restart_tol: float = 1e-4           # a cycle "gains" when the kept LLH's
+                                        # relative improvement >= tol
+    restart_patience: int = 3           # stop after this many consecutive
+                                        # gainless cycles (a single bad kick
+                                        # must not end the annealing)
+    quality_conv_tol: float = 1e-6      # within-cycle convergence tolerance:
+                                        # |LLH| grows with N*K, so the
+                                        # reference's relative 1e-4 stops
+                                        # large fits after a handful of
+                                        # iterations — far from converged
+
     # --- numerics ---
     dtype: str = "float32"              # F / gradient dtype on device
     accum_dtype: Optional[str] = None   # LLH accumulation dtype; None = dtype

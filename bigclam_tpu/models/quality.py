@@ -1,0 +1,165 @@
+"""Large-K quality mode: noise-floor init + restart annealing.
+
+NOT reference behavior — a flag-gated extension (cfg.quality_mode, default
+off = exact parity). Why it exists (PARITY.md "Known reference-algorithm
+behavior"): with faithful semantics, BASELINE config 3 (com-Amazon K=5000 +
+F1) is unreachable — on planted AGM graphs at larger K the fit lands at
+F1 ~ 0.1 while the planted optimum is a fixed point with far higher LLH.
+
+Round-4 diagnosis (verified on planted N=6000 K=30, p_in=0.15):
+
+  * The optimizer is NOT the problem: initialized with one seed per planted
+    block, the faithful fit recovers F1 = 1.000 in 5 iterations.
+  * The failure is seed coverage + frozen rows: the conductance top-K seeds
+    cover only ~17/30 blocks (nominee ranking inside near-uniform blocks is
+    arbitrary), and every node with an all-zero F row is FROZEN forever —
+    its gradient is -sumF <= 0, which clips back to the zero row. This is
+    the same property that makes node/K padding inert (ops.objective
+    padding conventions), applied to real nodes: unseeded blocks can never
+    acquire mass, and the reference behaves identically by construction
+    (Bigclamv2.scala:99-102 clamps at MIN_F_=0).
+
+Fix, in two parts (both only meaningful together):
+
+  1. Noise floor: add U(0, init_noise) to the seeded F0. Every row becomes
+     live; nodes in the same block share neighbors, so the first gradient
+     sweeps amplify the correlated noise toward block indicators (the
+     spectral alignment of A's top eigenvectors with community structure).
+     The scale matters: 0.01 recovers F1 = 0.80 where 0.1 drowns the seed
+     signal (F1 = 0.15) and 0 freezes (F1 = 0.13).
+  2. Restart annealing: re-kick the CONVERGED F with the same small noise
+     and refit. Each converged state has rows clipped to 0 that the kick
+     revives; measured on the probe, cycles improve monotonically
+     (F1 0.78 -> 0.83 over 5 cycles, LLH -642K -> -575K toward the planted
+     -480K). Cycles whose converged LLH does not improve are reverted, so
+     across cycles the kept LLH is non-decreasing; the loop stops when the
+     relative gain falls below restart_tol.
+
+Works with every trainer (single-chip / all-gather sharded / ring): only
+`model.fit` is called, so the schedule and kernels are whatever the model
+compiled. The noise kick is host-side O(N*K) — fine through com-Orkut
+scale; a device-side kick is a pod-scale follow-up.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, List, Optional, Tuple
+
+import numpy as np
+
+from bigclam_tpu.models.bigclam import FitResult
+from bigclam_tpu.utils.dist import is_primary
+
+
+@dataclasses.dataclass(frozen=True)
+class QualityResult:
+    fit: FitResult            # best-LLH cycle's result
+    cycles_llh: Tuple[float, ...]   # converged LLH per cycle (as run)
+    num_cycles: int
+    total_iters: int
+
+
+def fit_quality(
+    model,
+    F0: np.ndarray,
+    callback: Optional[Callable[[int, float], None]] = None,
+    checkpoints=None,
+) -> QualityResult:
+    """Train with the quality-mode schedule (see module docstring).
+
+    model: any trainer exposing .cfg and .fit(F0, callback=) ->
+    FitResult (BigClamModel / ShardedBigClamModel / RingBigClamModel).
+
+    `checkpoints` (utils.checkpoint.CheckpointManager) is used at CYCLE
+    granularity: after each cycle the kept F is saved under step=cycle and
+    a restart resumes from the newest cycle (within-cycle checkpointing is
+    not combined with quality mode — a cycle is one bounded fit). Noise is
+    drawn from per-cycle streams ([cfg.seed, 0x5EED, cycle]) so resume
+    reproduces the uninterrupted schedule exactly.
+    """
+    cfg = model.cfg
+    n, k = F0.shape
+    F_cur = np.asarray(F0, np.float64)
+    cycles_llh: List[float] = []
+    best: Optional[FitResult] = None
+    total_iters = 0
+    start_cycle = 0
+    restored_gainless = 0
+
+    if checkpoints is not None:
+        restored = checkpoints.restore()
+        if restored is not None:
+            cyc, arrays, meta = restored
+            if meta.get("quality_nk") != [n, k]:
+                raise ValueError(
+                    f"quality checkpoint incompatible: nk={meta.get('quality_nk')} "
+                    f"vs ({n}, {k}) (dir: {checkpoints.directory})"
+                )
+            F_cur = np.asarray(arrays["F"])
+            cycles_llh = list(meta.get("cycles_llh", []))
+            best_llh = float(meta["best_llh"])
+            best = FitResult(
+                F=F_cur, sumF=F_cur.sum(axis=0), llh=best_llh,
+                num_iters=int(meta.get("total_iters", 0)), llh_history=(),
+            )
+            total_iters = int(meta.get("total_iters", 0))
+            start_cycle = cyc + 1
+            restored_gainless = int(meta.get("gainless", 0))
+
+    max_cycles = max(cfg.restart_cycles, 1)
+    # within-cycle fits use the TIGHTER quality_conv_tol: the cfg swap is
+    # host-side only (the compiled step never reads conv_tol), so the
+    # kernel semantics stay byte-identical to the parity path
+    cfg_saved = model.cfg
+    # patience state survives resume (persisted in the checkpoint meta) so
+    # the resumed schedule stops exactly where the uninterrupted one would
+    gainless = restored_gainless
+    try:
+        model.cfg = cfg.replace(conv_tol=cfg.quality_conv_tol)
+        # auto noise scale: the kick's per-column sumF contribution
+        # (~eps*N/2) must stay comparable to one community's column mass
+        # regardless of N (see config.init_noise)
+        eps = (
+            cfg.init_noise
+            if cfg.init_noise is not None
+            else min(0.02, cfg.init_noise_mass / max(n, 1))
+        )
+        for cycle in range(start_cycle, max_cycles):
+            crng = np.random.default_rng([cfg.seed, 0x5EED, cycle])
+            kick = crng.uniform(0.0, eps, size=(n, k))
+            F_try = np.clip(F_cur + kick, cfg.min_f, cfg.max_f)
+            res = model.fit(F_try, callback=callback)
+            total_iters += res.num_iters
+            cycles_llh.append(res.llh)
+            prev_best = best.llh if best is not None else None
+            if best is None or res.llh > best.llh:
+                best = res
+                F_cur = res.F              # kick accepted: anneal from here
+            # else: converged worse than the kept state — revert the kick
+            if prev_best is not None and prev_best != 0.0:
+                gain = (best.llh - prev_best) / abs(prev_best)
+                gainless = gainless + 1 if gain < cfg.restart_tol else 0
+            if checkpoints is not None:
+                if is_primary():
+                    checkpoints.save(
+                        cycle,
+                        {"F": F_cur},
+                        meta={
+                            "best_llh": best.llh,
+                            "cycles_llh": cycles_llh,
+                            "total_iters": total_iters,
+                            "gainless": gainless,
+                            "quality_nk": [n, k],
+                        },
+                    )
+            if gainless >= cfg.restart_patience:
+                break
+    finally:
+        model.cfg = cfg_saved
+    return QualityResult(
+        fit=best,
+        cycles_llh=tuple(cycles_llh),
+        num_cycles=len(cycles_llh),
+        total_iters=total_iters,
+    )

@@ -1,0 +1,90 @@
+"""Quality-mode recovery gate (VERDICT round-3 item 1).
+
+Plants an equal-block AGM at the requested scale (default N=60000, K=300 —
+the PARITY.md regime where faithful semantics land at F1 ~ 0.1), runs the
+faithful fit AND the quality-mode schedule from the same conductance-seeded
+init on the default backend (TPU when available; blocked-CSR kernels
+engage), and prints one JSON line with both F1 scores.
+
+    python scripts/quality_gate.py [N] [K] [out.json]
+
+Gate: quality F1 >= 0.8 (exit 1 otherwise).
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def main() -> int:
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 60000
+    k = int(sys.argv[2]) if len(sys.argv) > 2 else 300
+    out_path = sys.argv[3] if len(sys.argv) > 3 else None
+
+    import jax
+
+    from bigclam_tpu.config import BigClamConfig
+    from bigclam_tpu.evaluation import avg_f1
+    from bigclam_tpu.models import BigClamModel
+    from bigclam_tpu.models.agm import sample_planted_graph
+    from bigclam_tpu.models.quality import fit_quality
+    from bigclam_tpu.ops import extraction, seeding
+
+    rng = np.random.default_rng(7)
+    g, truth = sample_planted_graph(n, k, p_in=0.15, rng=rng)
+    cfg = BigClamConfig(num_communities=k, quality_mode=True)
+    t0 = time.time()
+    seeds = seeding.conductance_seeds(g, cfg)
+    F0 = seeding.init_F(g, seeds, cfg, np.random.default_rng(0))
+    t_seed = time.time() - t0
+
+    model = BigClamModel(g, cfg, k_multiple=128)
+
+    def score(F):
+        com = extraction.extract_communities(np.asarray(F), g)
+        return avg_f1(list(com.values()), truth)
+
+    t0 = time.time()
+    res_f = model.fit(F0)
+    t_faithful = time.time() - t0
+    f1_f = score(res_f.F)
+
+    t0 = time.time()
+    qres = fit_quality(model, F0)
+    t_quality = time.time() - t0
+    f1_q = score(qres.fit.F)
+
+    rec = {
+        "gate": "planted-recovery",
+        "config": f"planted AGM N={n} K={k} p_in=0.15 "
+                  f"2E={g.num_directed_edges}",
+        "f1_faithful": round(f1_f, 4),
+        "llh_faithful": res_f.llh,
+        "f1_quality": round(f1_q, 4),
+        "llh_quality": qres.fit.llh,
+        "quality_cycles": qres.num_cycles,
+        "quality_total_iters": qres.total_iters,
+        "seconds": {
+            "seeding": round(t_seed, 1),
+            "faithful": round(t_faithful, 1),
+            "quality": round(t_quality, 1),
+        },
+        "engaged_path": model.engaged_path,
+        "device": str(jax.devices()[0]),
+        "pass": bool(f1_q >= 0.8),
+    }
+    line = json.dumps(rec)
+    print(line)
+    if out_path:
+        with open(out_path, "w") as f:
+            f.write(line + "\n")
+    return 0 if rec["pass"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

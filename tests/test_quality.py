@@ -1,0 +1,114 @@
+"""Quality-mode tests (models/quality.py): planted recovery at a K where
+the faithful dynamics freeze, resume exactness, and the parity guarantee
+(flag off = byte-identical schedule; covered by every existing trajectory
+test since quality_mode defaults to False and touches no kernel)."""
+
+import numpy as np
+import pytest
+
+from bigclam_tpu.config import BigClamConfig
+from bigclam_tpu.evaluation import avg_f1
+from bigclam_tpu.models import BigClamModel
+from bigclam_tpu.models.agm import sample_planted_graph
+from bigclam_tpu.models.quality import fit_quality
+from bigclam_tpu.ops import extraction, seeding
+
+
+@pytest.fixture(scope="module")
+def planted():
+    """Planted-partition AGM big enough for the coverage failure: the
+    conductance top-K seeds cover only a subset of blocks, and unseeded
+    blocks' all-zero rows are frozen under faithful dynamics."""
+    rng = np.random.default_rng(7)
+    g, truth = sample_planted_graph(2400, 12, p_in=0.15, rng=rng)
+    return g, truth
+
+
+def _score(F, g, truth):
+    com = extraction.extract_communities(np.asarray(F), g)
+    return avg_f1(list(com.values()), truth)
+
+
+def test_quality_mode_recovers_planted(planted):
+    g, truth = planted
+    k = len(truth)
+    cfg = BigClamConfig(
+        num_communities=k, quality_mode=True, restart_cycles=8,
+        use_pallas=False, use_pallas_csr=False,
+    )
+    seeds = seeding.conductance_seeds(g, cfg)
+    F0 = seeding.init_F(g, seeds, cfg, np.random.default_rng(0))
+    model = BigClamModel(g, cfg)
+
+    res_faithful = model.fit(F0)
+    f1_faithful = _score(res_faithful.F, g, truth)
+
+    qres = fit_quality(model, F0)
+    f1_quality = _score(qres.fit.F, g, truth)
+
+    # the quality schedule must clear the recovery gate AND beat faithful
+    # semantics by a wide margin (the whole point of the flag)
+    assert f1_quality >= 0.8, (f1_quality, f1_faithful)
+    assert f1_quality > f1_faithful + 0.2, (f1_quality, f1_faithful)
+    assert qres.fit.llh > res_faithful.llh
+    # kept LLH is non-decreasing across cycles by construction
+    kept = np.maximum.accumulate(qres.cycles_llh)
+    assert qres.fit.llh == pytest.approx(kept[-1])
+
+
+def test_quality_resume_exact(planted, tmp_path):
+    """Kill-and-resume at cycle granularity: per-cycle noise streams make
+    the resumed schedule reproduce the uninterrupted one exactly."""
+    from bigclam_tpu.utils.checkpoint import CheckpointManager
+
+    g, truth = planted
+    k = len(truth)
+
+    def make(cycles):
+        cfg = BigClamConfig(
+            num_communities=k, quality_mode=True, restart_cycles=cycles,
+            restart_tol=0.0,               # run every cycle deterministically
+            use_pallas=False, use_pallas_csr=False,
+        )
+        return BigClamModel(g, cfg), cfg
+
+    seeds = seeding.conductance_seeds(g, BigClamConfig(num_communities=k))
+    F0 = seeding.init_F(
+        g, seeds, BigClamConfig(num_communities=k), np.random.default_rng(0)
+    )
+
+    model4, _ = make(4)
+    ref = fit_quality(model4, F0)
+    assert ref.num_cycles == 4
+
+    # interrupted: run 2 cycles with a checkpoint manager, then resume
+    model2, _ = make(2)
+    cm = CheckpointManager(str(tmp_path / "q"))
+    part = fit_quality(model2, F0, checkpoints=cm)
+    assert part.num_cycles == 2
+    resumed = fit_quality(model4, F0, checkpoints=cm)
+
+    assert resumed.num_cycles == 4
+    np.testing.assert_allclose(resumed.cycles_llh, ref.cycles_llh, rtol=0)
+    np.testing.assert_allclose(resumed.fit.F, ref.fit.F, rtol=0, atol=0)
+
+
+def test_quality_checkpoint_shape_mismatch_refused(planted, tmp_path):
+    from bigclam_tpu.utils.checkpoint import CheckpointManager
+
+    g, truth = planted
+    k = len(truth)
+    cfg = BigClamConfig(
+        num_communities=k, quality_mode=True, restart_cycles=1,
+        use_pallas=False, use_pallas_csr=False,
+    )
+    model = BigClamModel(g, cfg)
+    cm = CheckpointManager(str(tmp_path / "q"))
+    F0 = np.zeros((g.num_nodes, k))
+    fit_quality(model, F0, checkpoints=cm)
+    cfg2 = cfg.replace(num_communities=k - 1)
+    model2 = BigClamModel(g, cfg2)
+    with pytest.raises(ValueError, match="incompatible"):
+        fit_quality(
+            model2, np.zeros((g.num_nodes, k - 1)), checkpoints=cm
+        )
