@@ -168,12 +168,22 @@ def cmd_fit(args) -> int:
     from bigclam_tpu.utils.profiling import trace
 
     g, cfg = _build(args, args.k)
+    quality_kw = {
+        key: val
+        for key, val in (
+            ("init_noise", args.init_noise),
+            ("restart_cycles", args.restart_cycles),
+            ("restart_tol", args.restart_tol),
+        )
+        if val is not None
+    }
     if getattr(args, "quality", False):
-        cfg = cfg.replace(
-            quality_mode=True,
-            init_noise=args.init_noise,
-            restart_cycles=args.restart_cycles,
-            restart_tol=args.restart_tol,
+        cfg = cfg.replace(quality_mode=True, **quality_kw)
+    elif quality_kw:
+        print(
+            f"warning: {sorted(quality_kw)} have no effect without "
+            "--quality",
+            file=sys.stderr,
         )
     if args.checkpoint_dir and cfg.checkpoint_every <= 0:
         # a checkpoint dir without a cadence would restore but never save
@@ -311,9 +321,9 @@ def main(argv=None) -> int:
         "--init-noise", type=float, default=None,
         help="noise-kick scale (default: auto, ~120/N — see config)",
     )
-    # defaults mirror config.py so the CLI and the Python API agree
-    p_fit.add_argument("--restart-cycles", type=int, default=40)
-    p_fit.add_argument("--restart-tol", type=float, default=1e-4)
+    # None = keep the config.py default (single source of truth)
+    p_fit.add_argument("--restart-cycles", type=int, default=None)
+    p_fit.add_argument("--restart-tol", type=float, default=None)
     p_fit.add_argument("--out", default=None, help="write SNAP cmty file")
     p_fit.add_argument("--save-f", default=None, help="write F as .npy")
     p_fit.add_argument(

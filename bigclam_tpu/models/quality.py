@@ -126,6 +126,9 @@ def fit_quality(
             else min(0.02, cfg.init_noise_mass / max(n, 1))
         )
         for cycle in range(start_cycle, max_cycles):
+            if gainless >= cfg.restart_patience:
+                break          # a restored run that already tripped
+                # patience must not anneal further (resume-exactness)
             crng = np.random.default_rng([cfg.seed, 0x5EED, cycle])
             kick = crng.uniform(0.0, eps, size=(n, k))
             F_try = np.clip(F_cur + kick, cfg.min_f, cfg.max_f)

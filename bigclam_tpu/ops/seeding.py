@@ -237,13 +237,15 @@ def triangle_counts_sampled(
 def conductance(
     g: Graph, backend: str = "auto", degree_cap: Optional[int] = None,
     rng: Optional[np.random.Generator] = None,
+    tri: Optional[np.ndarray] = None,
 ) -> np.ndarray:
     """Ego-net conductance phi(u) for every node (float64).
 
     backends: "numpy" (exact host pass), "dense" (A@A on the MXU, small
     graphs), "sampled" (degree-capped estimator, Friendster-scale), "auto"
     (dense if it fits; sampled when degree_cap is set and some node exceeds
-    it; exact host pass otherwise).
+    it; exact host pass otherwise). A precomputed per-node triangle-count
+    array `tri` skips the (dominant) counting stage entirely.
     """
     deg = g.degrees
     two_e = float(g.num_directed_edges)
@@ -253,7 +255,9 @@ def conductance(
         and deg.size > 0
         and int(deg.max()) > degree_cap
     )
-    if use_sampled:
+    if tri is not None:
+        pass
+    elif use_sampled:
         tri = triangle_counts_sampled(g, degree_cap or 128, rng)
     elif backend == "dense" or (
         backend == "auto"

@@ -1,0 +1,101 @@
+"""Weak-scaling harness on the CPU device fake (VERDICT round-3 item 6a).
+
+Multi-chip hardware is not available in this environment, so the only
+scaling signal is RELATIVE: grow the graph with the shard count (per-shard
+node count constant) and time one compiled step of the all-gather and ring
+schedules at dp = 1/2/4/8 over the 8-device CPU fake. Absolute numbers are
+CPU noise (all "devices" share the host's cores — per-device compute does
+NOT stay constant the way it would on real chips); what the journal
+catches is collective-schedule regressions: an accidental per-phase
+all-gather, a psum moved inside a scan, or edge-bucket blowup all show up
+as a step-time ratio jump between rounds.
+
+    python scripts/weak_scaling.py [per_shard_nodes] [steps] [out.json]
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def run(per_shard: int = 2048, steps: int = 5, out_path=None) -> dict:
+    import jax
+
+    try:
+        jax.config.update("jax_platforms", "cpu")
+        jax.config.update("jax_num_cpu_devices", 8)
+    except RuntimeError:
+        pass
+    if len(jax.devices()) < 8:
+        raise RuntimeError("need 8 CPU devices (run before other jax use)")
+
+    from bigclam_tpu.config import BigClamConfig
+    from bigclam_tpu.models.agm import sample_planted_graph
+    from bigclam_tpu.parallel import (
+        RingBigClamModel,
+        ShardedBigClamModel,
+        make_mesh,
+    )
+
+    k = 8
+    cfg = BigClamConfig(num_communities=k, use_pallas=False,
+                        use_pallas_csr=False)
+    results = {}
+    for dp in (1, 2, 4, 8):
+        n = per_shard * dp
+        g, _ = sample_planted_graph(
+            n, max(n // 256, 2), p_in=0.15, rng=np.random.default_rng(dp)
+        )
+        F0 = np.random.default_rng(0).uniform(0.1, 1.0, size=(n, k))
+        mesh = make_mesh((dp, 1), jax.devices()[:dp])
+        row = {"n": n, "directed_edges": g.num_directed_edges}
+        for name, cls in (
+            ("allgather", ShardedBigClamModel),
+            ("ring", RingBigClamModel),
+        ):
+            model = cls(g, cfg, mesh)
+            state = model.init_state(F0)
+            state = model._step(state)         # compile
+            jax.block_until_ready(state.F)
+            t0 = time.perf_counter()
+            for _ in range(steps):
+                state = model._step(state)
+            jax.block_until_ready(state.F)
+            row[name] = round((time.perf_counter() - t0) / steps, 4)
+        results[dp] = row
+    base = {s: results[1][s] for s in ("allgather", "ring")}
+    rec = {
+        "bench": "weak-scaling-cpu-fake",
+        "per_shard_nodes": per_shard,
+        "k": k,
+        "steps_timed": steps,
+        "sec_per_step": results,
+        # ideal weak scaling = 1.0 on real chips; on the shared-core CPU
+        # fake expect > 1 growth — track the TREND across rounds, not the
+        # absolute value
+        "rel_step_time": {
+            str(dp): {
+                s: round(results[dp][s] / base[s], 2)
+                for s in ("allgather", "ring")
+            }
+            for dp in results
+        },
+    }
+    line = json.dumps(rec)
+    print(line)
+    if out_path:
+        with open(out_path, "w") as f:
+            f.write(line + "\n")
+    return rec
+
+
+if __name__ == "__main__":
+    per_shard = int(sys.argv[1]) if len(sys.argv) > 1 else 2048
+    steps = int(sys.argv[2]) if len(sys.argv) > 2 else 5
+    out = sys.argv[3] if len(sys.argv) > 3 else None
+    run(per_shard, steps, out)

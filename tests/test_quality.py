@@ -93,6 +93,30 @@ def test_quality_resume_exact(planted, tmp_path):
     np.testing.assert_allclose(resumed.fit.F, ref.fit.F, rtol=0, atol=0)
 
 
+def test_quality_resume_after_patience_stop(planted, tmp_path):
+    """A run that ended via restart_patience must not anneal further when
+    re-invoked on its checkpoint — the restored patience state stops the
+    loop before any new cycle runs."""
+    from bigclam_tpu.utils.checkpoint import CheckpointManager
+
+    g, truth = planted
+    k = len(truth)
+    cfg = BigClamConfig(
+        num_communities=k, quality_mode=True, restart_cycles=20,
+        restart_tol=1.0, restart_patience=2,   # every cycle is "gainless"
+        use_pallas=False, use_pallas_csr=False,
+    )
+    model = BigClamModel(g, cfg)
+    F0 = np.zeros((g.num_nodes, k))
+    cm = CheckpointManager(str(tmp_path / "q"))
+    ref = fit_quality(model, F0, checkpoints=cm)
+    assert ref.num_cycles == 3                  # cycle 0 + 2 gainless
+    rerun = fit_quality(model, F0, checkpoints=cm)
+    assert rerun.num_cycles == ref.num_cycles
+    np.testing.assert_allclose(rerun.fit.F, ref.fit.F, rtol=0, atol=0)
+    np.testing.assert_allclose(rerun.cycles_llh, ref.cycles_llh, rtol=0)
+
+
 def test_quality_checkpoint_shape_mismatch_refused(planted, tmp_path):
     from bigclam_tpu.utils.checkpoint import CheckpointManager
 
