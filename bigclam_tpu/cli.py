@@ -319,7 +319,8 @@ def main(argv=None) -> int:
     )
     p_fit.add_argument(
         "--init-noise", type=float, default=None,
-        help="noise-kick scale (default: auto, ~120/N — see config)",
+        help="noise-kick scale (default: auto, "
+             "min(0.02, 4*(avg_degree+1)/N) — see config.init_noise)",
     )
     # None = keep the config.py default (single source of truth)
     p_fit.add_argument("--restart-cycles", type=int, default=None)

@@ -65,16 +65,21 @@ class BigClamConfig:
                                         # never move (see PARITY.md)
     init_noise: Optional[float] = None  # U(0, eps) added to F0 and to each
                                         # restart kick. None = auto:
-                                        # min(0.02, init_noise_mass / N) —
+                                        # min(0.02, init_noise_mass *
+                                        # (avg_degree + 1) / N). Invariant:
                                         # the kick's contribution to each
                                         # column's sumF (~eps*N/2) must stay
-                                        # comparable to a community's column
-                                        # mass, NOT scale with N (measured:
-                                        # eps*N ~ 120 recovers F1 0.84-0.88
-                                        # from 6K to 60K nodes; eps*N ~ 600
-                                        # at N=60K drowns the signal and
-                                        # fails entirely)
-    init_noise_mass: float = 120.0      # auto rule numerator (see above)
+                                        # comparable to a seeded ego-net
+                                        # column's mass (~avg_degree + 1) —
+                                        # NOT scale with N. Measured best
+                                        # eps: 0.01 at N=6K deg 28, 0.002
+                                        # at N=60K deg 28 (both = 4(d+1)/N);
+                                        # a flat 120/N rule matched only
+                                        # because those graphs shared
+                                        # deg ~ 28 and failed at low-degree
+                                        # small-block regimes
+    init_noise_mass: float = 4.0        # kick column mass in units of the
+                                        # seeded ego-net column mass
     restart_cycles: int = 40            # max annealing cycles (cycles are
                                         # short — ~5-10 iterations once
                                         # annealing sets in; restart_tol is
@@ -116,6 +121,14 @@ class BigClamConfig:
                                         # (256/512 tuned fastest on v5e:
                                         # one-hot matmul cost scales with B)
     csr_tile_t: int = 512               # edges per kernel tile
+    csr_k_block: int = 0                # K columns per kernel invocation on
+                                        # the single-chip K-blocked path
+                                        # (train_pass_csr_grouped_kblocked).
+                                        # 0 = auto: whole K when it fits
+                                        # VMEM, else the largest 128-multiple
+                                        # divisor of k_pad that does — the
+                                        # single-chip large-K mode (K ≳ 2500
+                                        # otherwise falls back to XLA)
     pallas_interpret: bool = False      # run Pallas kernels in interpret mode
                                         # (CPU testing of the kernel paths)
 

@@ -62,7 +62,8 @@ def log_engaged_path(model_name: str, path: str, reason: str = "") -> None:
         return
     why = (
         f" ({reason})"
-        if reason and path not in ("csr", "csr_grouped", "csr_ring")
+        if reason
+        and path not in ("csr", "csr_grouped", "csr_grouped_kb", "csr_ring")
         else ""
     )
     print(
@@ -373,16 +374,42 @@ def make_train_step(
     overrides that auto choice."""
     if tiles is not None:
         from bigclam_tpu.ops.linesearch import armijo_select
+        from bigclam_tpu.ops.objective import node_tail
         from bigclam_tpu.ops.pallas_csr import (
             GroupedTilesDev,
             candidates_csr,
             gather_dst_rows,
             grad_llh_csr,
             train_pass_csr_grouped,
+            train_pass_csr_grouped_kblocked,
         )
 
         interp = cfg.pallas_interpret
         grouped = isinstance(tiles, GroupedTilesDev)
+        kblocked = grouped and tiles.kc > 0
+
+        def csr_step_kblocked(state: TrainState) -> TrainState:
+            # single-chip large K: grouped layout + K-column-blocked
+            # kernels; candidate terms are neighbor-only, so the Armijo
+            # tails ride the XLA armijo_update path
+            F, sumF = state.F, state.sumF
+            adt = jnp.dtype(cfg.accum_dtype) if cfg.accum_dtype else F.dtype
+            grad, llh_nbr, cand_nbr = train_pass_csr_grouped_kblocked(
+                F, sumF, tiles, cfg, interpret=interp
+            )
+            node_llh = llh_nbr.astype(adt) + node_tail(F, sumF).astype(adt)
+            llh_cur = node_llh.sum()
+            F_new, sumF_new, hist = armijo_update(
+                F, sumF, grad, node_llh, cand_nbr.astype(adt), cfg,
+                with_stats=True,
+            )
+            return TrainState(
+                F=F_new, sumF=sumF_new, llh=llh_cur, it=state.it + 1,
+                accept_hist=hist,
+            )
+
+        if kblocked:
+            return jax.jit(csr_step_kblocked), "csr_grouped_kb"
 
         def csr_step(state: TrainState) -> TrainState:
             F, sumF = state.F, state.sumF
@@ -536,11 +563,36 @@ class BigClamModel:
         n = self.g.num_nodes
         from bigclam_tpu.ops.pallas_csr import fit_tile_shape
 
-        shape = (
-            fit_tile_shape(cfg.csr_block_b, cfg.csr_tile_t, k_pad)
-            if not cfg.pallas_interpret
-            else (cfg.csr_block_b, cfg.csr_tile_t)
-        )
+        kc = 0
+        if cfg.csr_k_block:
+            # explicit K-blocked mode (also the interpret-mode test hook)
+            kc = cfg.csr_k_block
+            k_pad = _round_up(k_pad, kc)
+            shape = (
+                fit_tile_shape(cfg.csr_block_b, cfg.csr_tile_t, kc)
+                if not cfg.pallas_interpret
+                else (cfg.csr_block_b, cfg.csr_tile_t)
+            )
+        else:
+            shape = (
+                fit_tile_shape(cfg.csr_block_b, cfg.csr_tile_t, k_pad)
+                if not cfg.pallas_interpret
+                else (cfg.csr_block_b, cfg.csr_tile_t)
+            )
+            if shape is None:
+                # whole-K rows exceed VMEM: single-chip large-K mode — the
+                # largest 128-multiple divisor of k_pad whose rows fit
+                # (kernels then scan K blocks; train_pass_csr_grouped_kblocked)
+                m = k_pad // 128
+                for d in sorted(
+                    (d for d in range(1, m) if m % d == 0), reverse=True
+                ):
+                    s = fit_tile_shape(
+                        cfg.csr_block_b, cfg.csr_tile_t, 128 * d
+                    )
+                    if s is not None:
+                        kc, shape = 128 * d, s
+                        break
         if shape is None:
             # kernels cannot fit VMEM at this K — XLA path (or shard K)
             if explicit:
@@ -552,7 +604,7 @@ class BigClamModel:
             return None
         block_b, tile_t = shape
         if not csr_tiles_supported(
-            block_b, tile_t, k_pad, cfg.pallas_interpret
+            block_b, tile_t, kc or k_pad, cfg.pallas_interpret
         ):
             if explicit:
                 raise ValueError(
@@ -611,27 +663,30 @@ class BigClamModel:
                 f"slots on {e} edges"
             )
             return None
-        if fd_bytes <= FLAT_FD_BUDGET:
+        if fd_bytes <= FLAT_FD_BUDGET and not kc:
             self.k_pad = k_pad
             self._node_multiple_csr = bt.n_blocks * bt.block_b
             return device_tiles(bt, self.dtype)
         # large K: one whole-graph dst gather would blow HBM — regroup into
-        # block windows scanned with per-group gathers (GROUP_FD_BUDGET each)
+        # block windows scanned with per-group gathers (GROUP_FD_BUDGET
+        # each). K-blocked mode always grouped; its live gather per scan
+        # step holds kc columns, so budgets scale with kc
+        group_cols = kc or k_pad
         group_budget = GROUP_FD_BUDGET
         tiles_per_group = max(
-            group_budget // (tile_t * k_pad * 4), 1
+            group_budget // (tile_t * group_cols * 4), 1
         )
         avg_tiles = max(bt.src_local.shape[0] / bt.n_blocks, 1e-9)
         nb = max(int(tiles_per_group / avg_tiles), 1)
         gbt = group_tiles(bt, nb)
         while (
             nb > 1
-            and gbt.src_local.shape[1] * tile_t * k_pad * 4
+            and gbt.src_local.shape[1] * tile_t * group_cols * 4
             > 2 * group_budget
         ):
             nb = max(nb // 2, 1)
             gbt = group_tiles(bt, nb)
-        group_fd = gbt.src_local.shape[1] * tile_t * k_pad * 4
+        group_fd = gbt.src_local.shape[1] * tile_t * group_cols * 4
         ok = (
             layout_economical(gbt.slots, e, gbt.n_groups * gbt.nb, tile_t)
             and gbt.n_pad % max(node_multiple, 1) == 0
@@ -654,7 +709,7 @@ class BigClamModel:
 
         self.k_pad = k_pad
         self._node_multiple_csr = gbt.n_pad
-        return device_grouped_tiles(gbt, self.dtype)
+        return device_grouped_tiles(gbt, self.dtype, kc=kc)
 
     def init_state(self, F0: np.ndarray) -> TrainState:
         n, k = self.g.num_nodes, self.cfg.num_communities

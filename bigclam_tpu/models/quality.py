@@ -118,12 +118,16 @@ def fit_quality(
     try:
         model.cfg = cfg.replace(conv_tol=cfg.quality_conv_tol)
         # auto noise scale: the kick's per-column sumF contribution
-        # (~eps*N/2) must stay comparable to one community's column mass
-        # regardless of N (see config.init_noise)
+        # (~eps*N/2) must stay comparable to one seeded ego-net column's
+        # mass (~avg_degree + 1) regardless of N (see config.init_noise)
+        # model.g is part of the trainer contract (all three trainers have
+        # it); read it directly so a wrapper without a graph fails loudly
+        # instead of silently collapsing the kick to eps ~ 4/N
+        avg_deg = model.g.num_directed_edges / max(model.g.num_nodes, 1)
         eps = (
             cfg.init_noise
             if cfg.init_noise is not None
-            else min(0.02, cfg.init_noise_mass / max(n, 1))
+            else min(0.02, cfg.init_noise_mass * (avg_deg + 1.0) / max(n, 1))
         )
         for cycle in range(start_cycle, max_cycles):
             if gainless >= cfg.restart_patience:

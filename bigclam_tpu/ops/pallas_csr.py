@@ -617,7 +617,11 @@ def cand_nbr_from_x_csr(
 
 
 class GroupedTilesDev(NamedTuple):
-    """Device-resident ops.csr_tiles.GroupedBlockTiles (large-K layout)."""
+    """Device-resident ops.csr_tiles.GroupedBlockTiles (large-K layout).
+
+    kc > 0 additionally processes the K axis in kc-column blocks inside
+    each group (single-chip large-K mode — see
+    train_pass_csr_grouped_kblocked)."""
 
     src_local: jax.Array   # (n_groups, G, 1, T)
     dst: jax.Array         # (n_groups, G, T)
@@ -627,13 +631,14 @@ class GroupedTilesDev(NamedTuple):
     tile_t: int
     nb: int
     n_groups: int
+    kc: int = 0
 
     @property
     def n_pad(self) -> int:
         return self.n_groups * self.nb * self.block_b
 
 
-def device_grouped_tiles(gbt, dtype=jnp.float32) -> GroupedTilesDev:
+def device_grouped_tiles(gbt, dtype=jnp.float32, kc: int = 0) -> GroupedTilesDev:
     ng, g, t = gbt.src_local.shape
     return GroupedTilesDev(
         src_local=jnp.asarray(gbt.src_local, jnp.int32).reshape(ng, g, 1, t),
@@ -644,6 +649,7 @@ def device_grouped_tiles(gbt, dtype=jnp.float32) -> GroupedTilesDev:
         tile_t=gbt.tile_t,
         nb=gbt.nb,
         n_groups=gbt.n_groups,
+        kc=kc,
     )
 
 
@@ -788,6 +794,99 @@ def train_pass_csr_grouped_tp(
         )
         cb = cand_nbr_from_x_csr(xc, td, cfg, interpret=interpret)  # (S, rows)
         return None, (grad_g, ln, cb)
+
+    _, (gr, ln, cd) = lax.scan(
+        body,
+        None,
+        (
+            jnp.arange(gt.n_groups),
+            (gt.src_local, gt.dst, gt.mask, gt.block_id),
+        ),
+    )
+    grad = gr.reshape(n_pad, k)
+    llh_nbr = ln.reshape(n_pad)
+    cand_nbr = cd.transpose(1, 0, 2).reshape(num_s, n_pad)
+    return grad, llh_nbr, cand_nbr
+
+
+def train_pass_csr_grouped_kblocked(
+    F: jax.Array,
+    sumF: jax.Array,
+    gt: GroupedTilesDev,
+    cfg: BigClamConfig,
+    interpret: bool = False,
+    F_gather: jax.Array = None,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Grouped train pass with the K axis processed in gt.kc-column blocks
+    — single-chip large K, where whole (T, K)/(B, K) rows no longer fit
+    VMEM (fit_tile_shape refuses at K ≳ 2500 and round-3 fell back to XLA).
+
+    Same two-stage shape as the TP kernel split, with a lax.scan over K
+    blocks in place of the psum over "k": per group, (1) accumulate the
+    full per-edge dots x across K blocks (partial-dot kernel per block),
+    then (2) per K block, consume x into that block's gradient columns and
+    accumulate the candidate partial dots, (3) one candidate-consume kernel
+    per group. Each fd row is gathered TWICE (once by the dots stage, once
+    by the consume stage — the two scans cannot share the gather without
+    holding a full-K fd, which is exactly what doesn't fit), so gather
+    traffic is 2x the plain grouped pass; the VMEM win is what buys the
+    path its existence at K ≳ 2500.
+
+    Returns (grad (n_pad, K), llh_nbr (n_pad,), cand_nbr (S, n_pad)) —
+    candidate terms are NEIGHBOR-only; feed armijo_update (which adds the
+    Armijo tails in XLA, where full-K row ops are cheap)."""
+    n_pad, k = F.shape
+    assert n_pad == gt.n_pad, (n_pad, gt.n_pad)
+    kc = gt.kc
+    assert kc > 0 and k % kc == 0, (k, kc)
+    n_kb = k // kc
+    rows = gt.nb * gt.block_b
+    num_s = len(cfg.step_candidates)
+    F_src = F if F_gather is None else F_gather
+
+    def body(_, xs):
+        gi, tile_xs = xs
+        td = _group_view(gt, tile_xs)
+        F_g = lax.dynamic_slice_in_dim(F, gi * rows, rows)
+        gmax, t = td.src_local.shape[0], td.tile_t
+
+        def fd_of(kb):
+            cols = lax.dynamic_slice_in_dim(F_src, kb * kc, kc, axis=1)
+            return jnp.take(cols, td.dst, axis=0)        # (G, T, kc)
+
+        # stage 1: full edge dots, accumulated over K blocks
+        def dots_kb(x_acc, kb):
+            F_g_kb = lax.dynamic_slice_in_dim(F_g, kb * kc, kc, axis=1)
+            x_kb = edge_dots_csr(F_g_kb, td, fd_of(kb), interpret=interpret)
+            return x_acc + x_kb, None
+
+        x, _ = lax.scan(
+            dots_kb, jnp.zeros((gmax, 1, t), F.dtype), jnp.arange(n_kb)
+        )
+
+        # stage 2: per K block, gradient columns + candidate partial dots
+        def consume_kb(xc_acc, kb):
+            fd = fd_of(kb)
+            F_g_kb = lax.dynamic_slice_in_dim(F_g, kb * kc, kc, axis=1)
+            sumF_kb = lax.dynamic_slice_in_dim(sumF, kb * kc, kc)
+            gn_kb, ln_kb = grad_nbr_from_x_csr(
+                x, td, fd, cfg, interpret=interpret
+            )
+            grad_kb = gn_kb - sumF_kb[None, :] + F_g_kb
+            xc_kb = cand_dots_csr(
+                F_g_kb, grad_kb, td, fd, cfg, interpret=interpret
+            )
+            return xc_acc + xc_kb, (grad_kb, ln_kb)
+
+        xc, (grads, lns) = lax.scan(
+            consume_kb,
+            jnp.zeros((gmax, num_s, t), F.dtype),
+            jnp.arange(n_kb),
+        )
+        grad_g = grads.transpose(1, 0, 2).reshape(rows, k)
+        cb = cand_nbr_from_x_csr(xc, td, cfg, interpret=interpret)
+        # llh_nbr depends only on x and the mask — identical across blocks
+        return None, (grad_g, lns[0], cb)
 
     _, (gr, ln, cd) = lax.scan(
         body,

@@ -234,6 +234,86 @@ def triangle_counts_sampled(
     return tri_w / 2.0 * scale
 
 
+def triangle_counts_sampled_device(
+    g: Graph,
+    cap: int,
+    seed: int,
+    chunk_nodes: Optional[int] = None,
+) -> np.ndarray:
+    """Device backend of the degree-capped estimator — the C5 path past the
+    16,384-node dense-A@A bound (SURVEY.md §7 "Seeding at Friendster
+    scale").
+
+    Same math and SAME capped lists as the host estimators (capped_csr's
+    splitmix64 sampler, shared with native.cpp), evaluated as a chunked
+    two-hop membership sweep on device: per node chunk, gather the (C, cap)
+    capped neighbor rows, expand to the (C, cap, cap) two-hop candidates,
+    and test membership in the (sorted) ego row by vmapped binary search —
+    O(N * cap^2 * log cap) VPU compares with an O(chunk * cap^2) working
+    set, no (N, N) anything. Weights/scales identical to
+    triangle_counts_sampled; accumulation in float32 (counts <= cap^2 are
+    exact; the deg/|S_v| weight ratios round at 1e-7 relative).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    n = g.num_nodes
+    deg = g.degrees.astype(np.int64)
+    if n == 0 or g.indices.size == 0:
+        return np.zeros(n, dtype=np.float64)
+    if chunk_nodes is None:
+        # bound the (chunk, cap, cap) two-hop working set to ~256 MiB of
+        # int32 (large caps — e.g. the cap >= max_degree exactness mode —
+        # would otherwise blow HBM); never beyond the graph itself
+        chunk_nodes = max(64, min(n, (1 << 26) // max(cap * cap, 1)))
+    indptr_c, indices_c = capped_csr(g, cap, seed)
+    cdeg = np.diff(indptr_c)
+    # dense (N, cap) padded rows, ascending with sentinel n (sorts last)
+    S = np.full((n, cap), n, dtype=np.int32)
+    pos = np.arange(indices_c.size, dtype=np.int64) - np.repeat(
+        indptr_c[:-1], cdeg
+    )
+    S[np.repeat(np.arange(n, dtype=np.int64), cdeg), pos] = indices_c
+    inner_w = (deg / np.maximum(cdeg, 1)).astype(np.float32)
+    Sd = jnp.asarray(S)
+    wd = jnp.asarray(inner_w)
+    n_pad = -(-n // chunk_nodes) * chunk_nodes
+
+    @jax.jit
+    def chunk_tri(u0):
+        u = u0 + jnp.arange(chunk_nodes)
+        ego = jnp.take(Sd, u, axis=0, mode="fill", fill_value=n)  # (C, cap)
+        v = ego                                                  # (C, cap)
+        two = jnp.take(Sd, v.reshape(-1), axis=0, mode="fill",
+                       fill_value=n).reshape(chunk_nodes, cap, cap)
+        w = jnp.take(wd, v.reshape(-1), mode="fill",
+                     fill_value=0.0).reshape(chunk_nodes, cap)
+        idx = jax.vmap(
+            lambda row, cands: jnp.searchsorted(row, cands)
+        )(ego, two.reshape(chunk_nodes, cap * cap))
+        idx = jnp.minimum(idx, cap - 1)
+        found = jnp.take_along_axis(
+            ego, idx, axis=1
+        ) == two.reshape(chunk_nodes, cap * cap)
+        # sentinel two-hop entries (== n) can never equal a real ego entry;
+        # ego sentinel rows only "match" sentinel candidates — exclude both
+        valid = two.reshape(chunk_nodes, cap * cap) < n
+        hits = (found & valid).astype(jnp.float32).reshape(
+            chunk_nodes, cap, cap
+        )
+        return (hits.sum(axis=2) * w).sum(axis=1)                # (C,)
+
+    tri_w = np.zeros(n_pad, dtype=np.float64)
+    for lo in range(0, n_pad, chunk_nodes):
+        tri_w[lo : lo + chunk_nodes] = np.asarray(chunk_tri(lo))
+    tri_w = tri_w[:n]
+    pairs = cdeg * (cdeg - 1)
+    scale = np.where(
+        pairs > 0, deg * (deg - 1) / np.maximum(pairs, 1), 0.0
+    )
+    return tri_w / 2.0 * scale
+
+
 def conductance(
     g: Graph, backend: str = "auto", degree_cap: Optional[int] = None,
     rng: Optional[np.random.Generator] = None,
@@ -242,10 +322,13 @@ def conductance(
     """Ego-net conductance phi(u) for every node (float64).
 
     backends: "numpy" (exact host pass), "dense" (A@A on the MXU, small
-    graphs), "sampled" (degree-capped estimator, Friendster-scale), "auto"
-    (dense if it fits; sampled when degree_cap is set and some node exceeds
-    it; exact host pass otherwise). A precomputed per-node triangle-count
-    array `tri` skips the (dominant) counting stage entirely.
+    graphs), "sampled" (degree-capped host estimator, Friendster-scale),
+    "sampled_device" (the same estimator's chunked two-hop sweep on the
+    accelerator — C5 past the dense bound), "auto" (dense if it fits;
+    sampled when degree_cap is set and some node exceeds it; exact host
+    pass otherwise). A precomputed per-node triangle-count array `tri`
+    skips the (dominant) counting stage entirely. All capped backends
+    share one splitmix64 sampler, so rankings are backend-independent.
     """
     deg = g.degrees
     two_e = float(g.num_directed_edges)
@@ -257,6 +340,9 @@ def conductance(
     )
     if tri is not None:
         pass
+    elif backend == "sampled_device":
+        seed = int((rng or np.random.default_rng(0)).integers(2**63))
+        tri = triangle_counts_sampled_device(g, degree_cap or 128, seed)
     elif use_sampled:
         tri = triangle_counts_sampled(g, degree_cap or 128, rng)
     elif backend == "dense" or (

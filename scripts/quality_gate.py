@@ -6,9 +6,14 @@ faithful fit AND the quality-mode schedule from the same conductance-seeded
 init on the default backend (TPU when available; blocked-CSR kernels
 engage), and prints one JSON line with both F1 scores.
 
-    python scripts/quality_gate.py [N] [K] [out.json]
+    python scripts/quality_gate.py [N] [K] [out.json] [p_in]
 
 Gate: quality F1 >= 0.8 (exit 1 otherwise).
+
+Note on single-chip sizing: the train step holds three (N_pad, K_pad) f32
+arrays at peak (F, grad, F_new), so N*K is bounded by ~HBM/12B on one
+chip — at K=5120 that is ~280K nodes on a 16 GB v5e. Larger N at this K
+is exactly the sharded-trainer regime (BASELINE configs 3-5).
 """
 
 import json
@@ -25,6 +30,7 @@ def main() -> int:
     n = int(sys.argv[1]) if len(sys.argv) > 1 else 60000
     k = int(sys.argv[2]) if len(sys.argv) > 2 else 300
     out_path = sys.argv[3] if len(sys.argv) > 3 else None
+    p_in = float(sys.argv[4]) if len(sys.argv) > 4 else 0.15
 
     import jax
 
@@ -36,7 +42,7 @@ def main() -> int:
     from bigclam_tpu.ops import extraction, seeding
 
     rng = np.random.default_rng(7)
-    g, truth = sample_planted_graph(n, k, p_in=0.15, rng=rng)
+    g, truth = sample_planted_graph(n, k, p_in=p_in, rng=rng)
     cfg = BigClamConfig(num_communities=k, quality_mode=True)
     t0 = time.time()
     seeds = seeding.conductance_seeds(g, cfg)
@@ -61,7 +67,7 @@ def main() -> int:
 
     rec = {
         "gate": "planted-recovery",
-        "config": f"planted AGM N={n} K={k} p_in=0.15 "
+        "config": f"planted AGM N={n} K={k} p_in={p_in} "
                   f"2E={g.num_directed_edges}",
         "f1_faithful": round(f1_f, 4),
         "llh_faithful": res_f.llh,

@@ -67,8 +67,8 @@ def run(per_shard: int = 2048, steps: int = 5, out_path=None) -> dict:
                 state = model._step(state)
             jax.block_until_ready(state.F)
             row[name] = round((time.perf_counter() - t0) / steps, 4)
-        results[dp] = row
-    base = {s: results[1][s] for s in ("allgather", "ring")}
+        results[str(dp)] = row                 # str keys: match the JSON
+    base = {s: results["1"][s] for s in ("allgather", "ring")}
     rec = {
         "bench": "weak-scaling-cpu-fake",
         "per_shard_nodes": per_shard,
@@ -79,7 +79,7 @@ def run(per_shard: int = 2048, steps: int = 5, out_path=None) -> dict:
         # fake expect > 1 growth — track the TREND across rounds, not the
         # absolute value
         "rel_step_time": {
-            str(dp): {
+            dp: {
                 s: round(results[dp][s] / base[s], 2)
                 for s in ("allgather", "ring")
             }

@@ -413,6 +413,83 @@ class TestGroupedCSR:
             cand_g[:, :n], cand_f[:, :n], rtol=2e-5, atol=2e-5
         )
 
+    def test_kblocked_matches_grouped(self, rng):
+        """Single-chip large-K mode: the K-column-blocked grouped pass must
+        reproduce the plain grouped pass (same kernels, K scanned in
+        blocks; candidate terms neighbor-only + XLA tails)."""
+        from bigclam_tpu.ops.csr_tiles import group_tiles
+        from bigclam_tpu.ops.linesearch import armijo_select, armijo_update
+        from bigclam_tpu.ops.pallas_csr import (
+            device_grouped_tiles,
+            train_pass_csr_grouped,
+            train_pass_csr_grouped_kblocked,
+        )
+
+        g = _random_graph(rng, n=53)
+        k_pad = 8
+        cfg = BigClamConfig(num_communities=k_pad, dtype="float32")
+        bt = build_block_tiles(g, block_b=8, tile_t=8)
+        gbt = group_tiles(bt, nb=3)
+        grp = device_grouped_tiles(gbt)
+        grp_kb = device_grouped_tiles(gbt, kc=4)       # 2 K blocks
+        F = np.zeros((gbt.n_pad, k_pad), np.float32)
+        F[: g.num_nodes] = rng.uniform(0.0, 1.5, (g.num_nodes, k_pad))
+        F = jnp.asarray(F)
+        sumF = F.sum(axis=0)
+        grad_g, llh_g, cand_full = train_pass_csr_grouped(
+            F, sumF, grp, cfg, interpret=True
+        )
+        grad_b, llh_nbr_b, cand_nbr_b = train_pass_csr_grouped_kblocked(
+            F, sumF, grp_kb, cfg, interpret=True
+        )
+        from bigclam_tpu.ops.objective import node_tail
+
+        n = g.num_nodes
+        np.testing.assert_allclose(
+            grad_b[:n], grad_g[:n], rtol=2e-5, atol=2e-5
+        )
+        llh_b = llh_nbr_b + node_tail(F, sumF)
+        np.testing.assert_allclose(llh_b[:n], llh_g[:n], rtol=2e-5, atol=2e-5)
+        # end-to-end update equality: full-cands path vs nbr-cands + tails
+        F1_g, s1_g = armijo_select(F, grad_g, llh_g, cand_full, cfg)
+        F1_b, s1_b = armijo_update(F, sumF, grad_b, llh_b, cand_nbr_b, cfg)
+        np.testing.assert_allclose(
+            np.asarray(F1_b)[:n], np.asarray(F1_g)[:n], rtol=2e-5, atol=2e-5
+        )
+
+    def test_model_kblocked_step_matches_xla(self, rng, monkeypatch):
+        """Model-level engagement of the K-blocked path (csr_k_block +
+        interpret on CPU) against the XLA reference."""
+        import bigclam_tpu.models.bigclam as mb
+
+        monkeypatch.setattr(mb, "FLAT_FD_BUDGET", 0)
+        monkeypatch.setattr(mb, "GROUP_FD_BUDGET", 40960)
+        g = _random_graph(rng, n=37)
+        k = 6
+        cfg = BigClamConfig(num_communities=k, dtype="float32", edge_chunk=64)
+        F0 = rng.uniform(0.0, 1.0, size=(g.num_nodes, k))
+        ref = BigClamModel(g, cfg.replace(use_pallas_csr=False))
+        kb = BigClamModel(
+            g,
+            cfg.replace(
+                use_pallas_csr=True, pallas_interpret=True,
+                csr_block_b=8, csr_tile_t=8, csr_k_block=3,
+            ),
+        )
+        assert kb.engaged_path == "csr_grouped_kb"
+        assert kb.k_pad % 3 == 0
+        s_ref, s_kb = ref.init_state(F0), kb.init_state(F0)
+        for _ in range(3):
+            s_ref, s_kb = ref._step(s_ref), kb._step(s_kb)
+        n = g.num_nodes
+        np.testing.assert_allclose(
+            np.asarray(s_kb.F)[:n, :k], np.asarray(s_ref.F)[:n, :k],
+            rtol=3e-5, atol=3e-5,
+        )
+        np.testing.assert_allclose(
+            float(s_kb.llh), float(s_ref.llh), rtol=1e-5
+        )
+
     def test_model_grouped_step_matches_xla(self, rng, monkeypatch):
         import bigclam_tpu.models.bigclam as mb
         from bigclam_tpu.ops.pallas_csr import GroupedTilesDev

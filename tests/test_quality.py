@@ -117,6 +117,37 @@ def test_quality_resume_after_patience_stop(planted, tmp_path):
     np.testing.assert_allclose(rerun.cycles_llh, ref.cycles_llh, rtol=0)
 
 
+def test_quality_composes_with_sharded_trainer(planted):
+    """fit_quality only calls model.fit, so the annealing schedule must
+    work unchanged over a sharded trainer — and reproduce the single-chip
+    quality trajectory exactly in float64 (shard-count invariance)."""
+    import jax
+
+    from bigclam_tpu.parallel import ShardedBigClamModel, make_mesh
+
+    g, truth = planted
+    k = len(truth)
+    cfg = BigClamConfig(
+        num_communities=k, quality_mode=True, restart_cycles=3,
+        restart_tol=0.0, dtype="float64",
+        use_pallas=False, use_pallas_csr=False,
+    )
+    seeds = seeding.conductance_seeds(g, cfg)
+    F0 = seeding.init_F(g, seeds, cfg, np.random.default_rng(0))
+    mesh = make_mesh((4, 1), jax.devices()[:4])
+    q_sharded = fit_quality(ShardedBigClamModel(g, cfg, mesh), F0)
+    q_single = fit_quality(BigClamModel(g, cfg), F0)
+    np.testing.assert_allclose(
+        q_sharded.cycles_llh, q_single.cycles_llh, rtol=1e-12
+    )
+    # F agreement is not bitwise: 1e-15-level psum-order differences can
+    # flip an Armijo acceptance exactly at threshold, diverging single rows
+    # discretely. The LLH trail pins the trajectory; here we bound the
+    # fraction of discretely-diverged entries.
+    frac = (np.abs(q_sharded.fit.F - q_single.fit.F) > 1e-8).mean()
+    assert frac < 0.01, frac
+
+
 def test_quality_checkpoint_shape_mismatch_refused(planted, tmp_path):
     from bigclam_tpu.utils.checkpoint import CheckpointManager
 

@@ -8,14 +8,17 @@ import pytest
 
 @pytest.mark.slow
 def test_weak_scaling_harness(tmp_path):
+    import os
     import sys
 
-    sys.path.insert(0, "/root/repo")
+    sys.path.insert(
+        0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    )
     from scripts.weak_scaling import run
 
     rec = run(per_shard=512, steps=2, out_path=str(tmp_path / "w.json"))
-    assert set(rec["sec_per_step"]) == {1, 2, 4, 8}
-    for dp in (1, 2, 4, 8):
+    assert set(rec["sec_per_step"]) == {"1", "2", "4", "8"}
+    for dp in ("1", "2", "4", "8"):
         for sched in ("allgather", "ring"):
             assert rec["sec_per_step"][dp][sched] > 0
     # loose bound: per-shard work is constant, so even on the shared-core

@@ -223,6 +223,46 @@ class TestSampledTriangles:
         rb = seeding.rank_seeds(g, phi_b, cfg)
         np.testing.assert_array_equal(ra, rb)
 
+    def test_device_backend_matches_host(self, facebook_graph):
+        """The device two-hop sweep (C5 past the 16K dense bound) shares
+        the host estimator's capped lists and weights: same estimates (to
+        f32 weight rounding), same rankings; exact when cap >= max deg."""
+        g = facebook_graph
+        cap = 32
+        host = seeding.triangle_counts_sampled(
+            g, cap, np.random.default_rng(7), use_native=False
+        )
+        seed = int(np.random.default_rng(7).integers(2**63))
+        dev = seeding.triangle_counts_sampled_device(g, cap, seed)
+        np.testing.assert_allclose(dev, host, rtol=2e-5, atol=2e-5)
+        phi_h = seeding.conductance(
+            g, backend="sampled", degree_cap=cap,
+            rng=np.random.default_rng(7),
+        )
+        phi_d = seeding.conductance(
+            g, backend="sampled_device", degree_cap=cap,
+            rng=np.random.default_rng(7),
+        )
+        cfg = BigClamConfig(num_communities=10)
+        np.testing.assert_array_equal(
+            seeding.rank_seeds(g, phi_h, cfg),
+            seeding.rank_seeds(g, phi_d, cfg),
+        )
+        # exactness flag: cap >= max degree reduces to the exact counts
+        # (small graph — the facebook hub degree of 1045 makes this leg
+        # O(N * maxdeg^2) and minutes-slow on the CPU fake)
+        rng = np.random.default_rng(3)
+        ns = 300
+        a = rng.random((ns, ns)) < 0.08
+        gs = graph_from_edges(
+            [(i, j) for i in range(ns) for j in range(i + 1, ns) if a[i, j]],
+            num_nodes=ns,
+        )
+        cap_full = int(gs.degrees.max())
+        exact = seeding.triangle_counts(gs)
+        dev_full = seeding.triangle_counts_sampled_device(gs, cap_full, 0)
+        np.testing.assert_allclose(dev_full, exact.astype(float), atol=1e-6)
+
     def test_chunk_of_isolated_tail_nodes(self):
         # chunk boundary landing after the last edge-bearing node (NumPy path)
         g = graph_from_edges(
