@@ -55,13 +55,23 @@ def main() -> int:
         com = extraction.extract_communities(np.asarray(F), g)
         return avg_f1(list(com.values()), truth)
 
+    def progress(stage):
+        print(f"[gate] {stage}", file=sys.stderr, flush=True)
+
+    def cb(it, llh, extras=None):
+        if it % 10 == 0:
+            progress(f"iter {it} llh {llh:.4g}")
+
+    progress(f"seeded in {t_seed:.1f}s; fitting faithful "
+             f"(path={model.engaged_path})")
     t0 = time.time()
-    res_f = model.fit(F0)
+    res_f = model.fit(F0, callback=cb)
     t_faithful = time.time() - t0
     f1_f = score(res_f.F)
+    progress(f"faithful done in {t_faithful:.0f}s; quality annealing")
 
     t0 = time.time()
-    qres = fit_quality(model, F0)
+    qres = fit_quality(model, F0, callback=cb)
     t_quality = time.time() - t0
     f1_q = score(qres.fit.F)
 
