@@ -393,6 +393,10 @@ def rank_seeds(g: Graph, phi: np.ndarray, cfg: Optional[BigClamConfig] = None
     # over all directed edges (the lexsort was the slowest seeding stage at
     # 100M edges — 127s in SEEDING_r04.json): first the per-segment min
     # phi, then the min id among the neighbors attaining it.
+    # NaN phi would propagate through reduceat and nominate the
+    # out-of-range id n (the old lexsort sorted NaN last); +inf keeps the
+    # degraded-but-valid behavior for caller-supplied phi
+    phi = np.where(np.isnan(phi), np.inf, np.asarray(phi, np.float64))
     phi_nbr = phi[indices]
     has_nbrs = g.degrees > 0
     # one +inf/n sentinel element keeps every indptr start a valid reduceat
