@@ -60,6 +60,25 @@ def test_metrics_logger(tmp_path):
     assert "rel_dllh" in lines[1] and "edges_per_sec_per_chip" in lines[1]
 
 
+def test_metrics_logger_non_primary_writes_nothing(tmp_path, monkeypatch):
+    """Single-writer gating: on a non-primary process the logger must not
+    open the shared JSONL (gated lazily at first log, so constructing the
+    logger before jax.distributed init stays safe)."""
+    import bigclam_tpu.utils.metrics as um
+
+    monkeypatch.setattr(
+        "bigclam_tpu.utils.dist.is_primary", lambda: False
+    )
+    p = tmp_path / "m.jsonl"
+    with MetricsLogger(str(p), echo=True) as ml:
+        ml.log({"x": 1})
+    assert not p.exists()
+    # primary_only=False opts out (per-process logs at distinct paths)
+    with MetricsLogger(str(p), echo=False, primary_only=False) as ml:
+        ml.log({"x": 1})
+    assert p.exists()
+
+
 def test_metrics_accept_histogram(toy_graphs, tmp_path):
     """SURVEY §5 line-search observability: a real fit's metrics JSONL must
     carry the accepted-step histogram and acceptance rate each iteration,
