@@ -4,5 +4,17 @@ from bigclam_tpu.models.bigclam import (
     FitResult,
     prepare_graph,
 )
+from bigclam_tpu.models.model_selection import SweepResult, build_kset, sweep_k
+from bigclam_tpu.models.quality import QualityResult, fit_quality
 
-__all__ = ["BigClamModel", "TrainState", "FitResult", "prepare_graph"]
+__all__ = [
+    "BigClamModel",
+    "TrainState",
+    "FitResult",
+    "prepare_graph",
+    "SweepResult",
+    "build_kset",
+    "sweep_k",
+    "QualityResult",
+    "fit_quality",
+]

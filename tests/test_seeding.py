@@ -263,6 +263,13 @@ class TestSampledTriangles:
         dev_full = seeding.triangle_counts_sampled_device(gs, cap_full, 0)
         np.testing.assert_allclose(dev_full, exact.astype(float), atol=1e-6)
 
+    def test_conductance_accepts_precomputed_tri(self, toy_graphs):
+        g = toy_graphs["two_cliques"]
+        tri = seeding.triangle_counts(g)
+        a = seeding.conductance(g, backend="numpy")
+        b = seeding.conductance(g, tri=tri.astype(np.float64))
+        np.testing.assert_allclose(a, b, rtol=0, atol=0)
+
     def test_chunk_of_isolated_tail_nodes(self):
         # chunk boundary landing after the last edge-bearing node (NumPy path)
         g = graph_from_edges(

@@ -168,6 +168,41 @@ def test_cli_fit_and_eval(tmp_path):
     assert scores["f1"] > 0.85, scores
 
 
+def test_cli_quality_fit(tmp_path):
+    """--quality end to end through the CLI (small planted graph): the JSON
+    reports cycle info; quality knobs without --quality warn and are
+    ignored. (LLH-quality itself is asserted in tests/test_quality.py.)"""
+    import numpy as np
+
+    from bigclam_tpu.models.agm import sample_planted_graph
+
+    g, _ = sample_planted_graph(240, 4, p_in=0.3, rng=np.random.default_rng(0))
+    graph = tmp_path / "g.txt"
+    graph.write_text(
+        "\n".join(f"{u} {v}" for u, v in zip(g.src.tolist(), g.dst.tolist())
+                  if u < v)
+    )
+    r = _run_cli(
+        "fit", "--graph", str(graph), "--k", "4", "--max-iters", "40",
+        "--quality", "--restart-cycles", "4", "--quiet", "--platform", "cpu",
+    )
+    assert r.returncode == 0, r.stderr
+    rec = json.loads(r.stdout.strip().splitlines()[-1])
+    assert rec["quality_cycles"] >= 1
+    assert len(rec["cycles_llh"]) == rec["quality_cycles"]
+
+    # quality knobs without --quality warn and change nothing
+    r2 = _run_cli(
+        "fit", "--graph", str(graph), "--k", "4", "--max-iters", "5",
+        "--restart-cycles", "4", "--quiet", "--platform", "cpu",
+    )
+    assert r2.returncode == 0, r2.stderr
+    assert "no effect without" in r2.stderr
+    assert "quality_cycles" not in json.loads(
+        r2.stdout.strip().splitlines()[-1]
+    )
+
+
 def test_cli_sweep(tmp_path):
     graph = tmp_path / "g.txt"
     edges = []
