@@ -168,12 +168,17 @@ def cmd_fit(args) -> int:
     from bigclam_tpu.utils.profiling import trace
 
     g, cfg = _build(args, args.k)
+    if getattr(args, "seed_exclusion", None) is not None:
+        # orthogonal to --quality: an explicit True engages the covering
+        # walk even for parity fits (the auto rule is on-iff-quality)
+        cfg = cfg.replace(seed_exclusion=bool(args.seed_exclusion))
     quality_kw = {
         key: val
         for key, val in (
             ("init_noise", args.init_noise),
             ("restart_cycles", args.restart_cycles),
             ("restart_tol", args.restart_tol),
+            ("quality_max_p", getattr(args, "quality_max_p", None)),
         )
         if val is not None
     }
@@ -325,6 +330,16 @@ def main(argv=None) -> int:
     # None = keep the config.py default (single source of truth)
     p_fit.add_argument("--restart-cycles", type=int, default=None)
     p_fit.add_argument("--restart-tol", type=float, default=None)
+    p_fit.add_argument(
+        "--quality-max-p", type=float, default=None,
+        help="pin the annealing-cycle MAX_P_ clip (default: auto, "
+             "1 - avg_deg/(16 N) — see config.quality_max_p)",
+    )
+    p_fit.add_argument(
+        "--seed-exclusion", type=int, choices=(0, 1), default=None,
+        help="coverage-aware seed selection (default: auto, on iff "
+             "--quality; see config.seed_exclusion)",
+    )
     p_fit.add_argument("--out", default=None, help="write SNAP cmty file")
     p_fit.add_argument("--save-f", default=None, help="write F as .npy")
     p_fit.add_argument(

@@ -89,6 +89,30 @@ class BigClamConfig:
     restart_patience: int = 3           # stop after this many consecutive
                                         # gainless cycles (a single bad kick
                                         # must not end the annealing)
+    seed_exclusion: Optional[bool] = None  # coverage-aware seed selection
+                                        # (ops.seeding.select_seeds_covering):
+                                        # greedily skip candidates already
+                                        # covered by a chosen seed's ego-net,
+                                        # so K seeds spread over ~K distinct
+                                        # regions instead of piling into the
+                                        # lowest-phi one. None = auto (on iff
+                                        # quality_mode); False = reference
+                                        # ranking (Bigclamv2.scala:56 takes
+                                        # the top-K nominees as-is)
+    quality_max_p: Optional[float] = None  # quality-mode MAX_P_ override.
+                                        # The clip bounds the gradient's
+                                        # 1/(1-p) neighbor amplification at
+                                        # 1/(1-max_p); a noise-level column
+                                        # entry at node u only grows when
+                                        # deg(u) * amp > N (its neighbor term
+                                        # must beat -sumF), so the parity
+                                        # 0.9999 (amp 1e4) freezes annealing
+                                        # outright once N > 1e4 * avg_deg —
+                                        # measured: max_p=0.99 collapses the
+                                        # N=2400 probe to faithful-F1 while
+                                        # 0.9999 recovers it. None = auto:
+                                        # 1 - 1/(16 N / avg_deg) clamped to
+                                        # [max_p, 0.999999] (f32 floor)
     quality_conv_tol: float = 1e-6      # within-cycle convergence tolerance:
                                         # |LLH| grows with N*K, so the
                                         # reference's relative 1e-4 stops

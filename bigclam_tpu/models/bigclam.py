@@ -513,6 +513,17 @@ class BigClamModel:
         self.path_reason = getattr(self, "_csr_reason", "")
         log_engaged_path("BigClamModel", self.engaged_path, self.path_reason)
 
+    def rebuild_step(self) -> None:
+        """Recompile the train step from the CURRENT self.cfg.
+
+        Device tile/edge buffers are reused — only step-baked constants
+        (clip bounds, Armijo candidates) change. Path selection is NOT
+        re-run: quality mode's max_p relaxation (models.quality) must not
+        flip the engaged kernels mid-schedule."""
+        self._step, self.engaged_path = make_train_step(
+            self._edges, self.cfg, tiles=self._tiles, k_pad=self.k_pad
+        )
+
     @property
     def edges(self) -> EdgeChunks:
         """Chunked edge arrays (built lazily on the CSR-kernel path, where

@@ -35,10 +35,33 @@ Fix, in two parts (both only meaningful together):
      across cycles the kept LLH is non-decreasing; the loop stops when the
      relative gain falls below restart_tol.
 
-Works with every trainer (single-chip / all-gather sharded / ring): only
-`model.fit` is called, so the schedule and kernels are whatever the model
-compiled. The noise kick is host-side O(N*K) — fine through com-Orkut
-scale; a device-side kick is a pod-scale follow-up.
+Round-4 additions (both measured on planted N=2400 K=100 p_in=0.3,
+24-node blocks — the com-Amazon-class small-community regime):
+
+  3. Coverage-aware seeding (ops.seeding.select_seeds_covering,
+     auto-engaged by conductance_seeds when quality_mode is on): the raw
+     top-K nominee ranking piles seeds into a fraction of the communities
+     (58/100 blocks covered); the greedy exclusion walk tiles the graph
+     (92/100 at hops=2) and lifts quality F1 0.742 -> 0.894.
+  4. MAX_P_ relaxation during annealing cycles: the probability clip
+     bounds the gradient's 1/(1-p) neighbor amplification at
+     amp = 1/(1-max_p), and a noise-level column entry at node u grows
+     only when deg(u)*amp > N (its neighbor term must beat -sumF). The
+     parity 0.9999 (amp=1e4) therefore freezes EVERY kick once
+     N > 1e4*avg_deg — exactly the K=5000 gate failure
+     (QUALITY_K5000_r04.json: N=120000, avg_deg 5.7, 4 gainless cycles,
+     F1 0.001); measured the other way, pinning amp=100 at N=2400
+     collapses quality F1 to the faithful 0.045. fit_quality relaxes
+     max_p to 1 - avg_deg/(16*N) (>= parity, <= 1-1e-6, the f32 floor),
+     rebuilds the train step (model.rebuild_step — same kernels, new
+     clip constant), and restores the parity step afterwards.
+
+Works with every trainer (single-chip / all-gather sharded / ring). The
+required trainer surface is `.cfg`, `.g`, `.fit(F0, callback=)`, and
+`.rebuild_step()` (invoked whenever the max_p relaxation engages — the
+common case at real graph sizes); the schedule and kernels stay whatever
+the model compiled. The noise kick is host-side O(N*K) — fine through
+com-Orkut scale; a device-side kick is a pod-scale follow-up.
 """
 
 from __future__ import annotations
@@ -68,8 +91,9 @@ def fit_quality(
 ) -> QualityResult:
     """Train with the quality-mode schedule (see module docstring).
 
-    model: any trainer exposing .cfg and .fit(F0, callback=) ->
-    FitResult (BigClamModel / ShardedBigClamModel / RingBigClamModel).
+    model: any trainer exposing .cfg, .g, .rebuild_step(), and
+    .fit(F0, callback=) -> FitResult (BigClamModel / ShardedBigClamModel /
+    RingBigClamModel all do).
 
     `checkpoints` (utils.checkpoint.CheckpointManager) is used at CYCLE
     granularity: after each cycle the kept F is saved under step=cycle and
@@ -108,22 +132,47 @@ def fit_quality(
             restored_gainless = int(meta.get("gainless", 0))
 
     max_cycles = max(cfg.restart_cycles, 1)
-    # within-cycle fits use the TIGHTER quality_conv_tol: the cfg swap is
-    # host-side only (the compiled step never reads conv_tol), so the
-    # kernel semantics stay byte-identical to the parity path
     cfg_saved = model.cfg
     # patience state survives resume (persisted in the checkpoint meta) so
     # the resumed schedule stops exactly where the uninterrupted one would
     gainless = restored_gainless
+    # model.g is part of the trainer contract (all three trainers have it)
+    avg_deg = model.g.num_directed_edges / max(model.g.num_nodes, 1)
+    # MAX_P_ relaxation: the clip caps the gradient's 1/(1-p) neighbor
+    # amplification; a noise-level column entry at node u grows only when
+    # deg(u)*amp > N (neighbor term vs -sumF), so the parity 0.9999 freezes
+    # every kick dead once N > 1e4*avg_deg (the K=5000 gate's exact failure:
+    # 4 gainless cycles, F1 0.001). Auto rule: amp = 16*N/avg_deg (16x
+    # headroom covers deg down to avg/16), floored at the parity max_p,
+    # ceilinged at 1 - 1e-6 — the smallest 1-p still exactly representable
+    # around f32 1.0 (~8 ulps), which bounds quality mode at
+    # N <~ 1e6*avg_deg until the kernels take an f64 clip path
+    max_p_q = cfg.quality_max_p
+    if max_p_q is None:
+        amp = 16.0 * model.g.num_nodes / max(avg_deg, 1.0)
+        max_p_q = min(max(cfg.max_p, 1.0 - 1.0 / amp), 1.0 - 1e-6)
+    elif not (0.0 < max_p_q <= 1.0 - 1e-6):
+        # beyond 1-1e-6 the f32 clip collapses 1-p to 0: log(1-p) = -inf
+        # poisons every cycle's LLH and NaN defeats the patience stop —
+        # fail fast instead of burning restart_cycles of chip time
+        raise ValueError(
+            f"quality_max_p={max_p_q} out of range (need 0 < p <= 1-1e-6, "
+            "the smallest 1-p exactly representable around f32 1.0)"
+        )
+    rebuilt = False
     try:
-        model.cfg = cfg.replace(conv_tol=cfg.quality_conv_tol)
+        # within-cycle fits use the TIGHTER quality_conv_tol (host-side
+        # only); the max_p swap changes step-baked constants, so the step
+        # is recompiled — same kernels/schedule, different clip bound
+        model.cfg = cfg.replace(
+            conv_tol=cfg.quality_conv_tol, max_p=max_p_q
+        )
+        if max_p_q != cfg.max_p:
+            model.rebuild_step()
+            rebuilt = True
         # auto noise scale: the kick's per-column sumF contribution
         # (~eps*N/2) must stay comparable to one seeded ego-net column's
         # mass (~avg_degree + 1) regardless of N (see config.init_noise)
-        # model.g is part of the trainer contract (all three trainers have
-        # it); read it directly so a wrapper without a graph fails loudly
-        # instead of silently collapsing the kick to eps ~ 4/N
-        avg_deg = model.g.num_directed_edges / max(model.g.num_nodes, 1)
         eps = (
             cfg.init_noise
             if cfg.init_noise is not None
@@ -164,6 +213,8 @@ def fit_quality(
                 break
     finally:
         model.cfg = cfg_saved
+        if rebuilt:
+            model.rebuild_step()           # restore the parity-clip step
     return QualityResult(
         fit=best,
         cycles_llh=tuple(cycles_llh),

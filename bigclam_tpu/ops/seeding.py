@@ -418,6 +418,67 @@ def rank_seeds(g: Graph, phi: np.ndarray, cfg: Optional[BigClamConfig] = None
     return cand[rank]
 
 
+def select_seeds_covering(
+    g: Graph,
+    phi: np.ndarray,
+    k: int,
+    cfg: Optional[BigClamConfig] = None,
+    hops: int = 1,
+) -> np.ndarray:
+    """Coverage-aware seed selection (quality mode's seeding rule).
+
+    The reference ranking (Bigclamv2.scala:56) takes the K lowest-phi
+    locally-minimal nominees as-is; on graphs with many similar communities
+    the nominee order inside near-uniform regions is arbitrary and the top-K
+    pile into a fraction of them (measured: 58 of 100 planted blocks covered
+    at N=2400). Here candidates are walked in the same (phi, id) order —
+    locally-minimal nominees first, then every remaining node — but a
+    candidate already covered by a chosen seed's `hops`-neighborhood is
+    skipped, so the K chosen ego-nets tile the graph. Measured on the
+    N=2400/K=100 probe: hops=1 covers 81/100 blocks (quality F1 0.836),
+    hops=2 covers 92/100 (F1 0.894) — one ego-net reaches only ~p_in of a
+    sparse block, so 1-hop exclusion still lets two seeds land in one
+    block. Marking cost: O(E) at hops=1; hops=2 adds sum_{v in N(s)} deg(v)
+    per seed, capped per node at cfg.seeding_degree_cap (default 256 when
+    unset — the 2-hop walk always bounds hub fans, both for cost and so one
+    hub-adjacent seed's blanket cannot exclude a hub's entire neighborhood
+    from later seeding).
+    """
+    cfg = cfg or BigClamConfig()
+    cap = cfg.seeding_degree_cap or 256
+    n = g.num_nodes
+    ranked = rank_seeds(g, phi, cfg)
+    rest = np.setdiff1d(
+        np.arange(n, dtype=np.int64), ranked, assume_unique=False
+    )
+    phi_fb = np.where(np.isnan(phi), np.inf, np.asarray(phi, np.float64))
+    rest = rest[np.lexsort((rest, phi_fb[rest]))]
+    covered = np.zeros(n, dtype=bool)
+    indptr, indices = g.indptr, g.indices
+    out = []
+    for cand in (ranked, rest):
+        for s in cand:
+            s = int(s)
+            if covered[s]:
+                continue
+            out.append(s)
+            covered[s] = True
+            nbrs = indices[indptr[s] : indptr[s + 1]]
+            covered[nbrs] = True
+            if hops >= 2:
+                # hub guard: the 2-hop marking of one seed costs
+                # sum_{v in N(s)} deg(v); cap both fans like the sampled
+                # conductance scorer does
+                if cap is not None and nbrs.size > cap:
+                    nbrs = nbrs[:: max(nbrs.size // cap, 1)][:cap]
+                for v in nbrs:
+                    row = indices[indptr[v] : indptr[v + 1]]
+                    covered[row if cap is None else row[:cap]] = True
+            if len(out) >= k:
+                return np.asarray(out, dtype=np.int64)
+    return np.asarray(out, dtype=np.int64)   # graph fully covered before K
+
+
 def init_F(
     g: Graph,
     seeds: np.ndarray,
@@ -449,7 +510,12 @@ def init_F(
 def conductance_seeds(
     g: Graph, cfg: Optional[BigClamConfig] = None, backend: str = "auto"
 ) -> np.ndarray:
-    """conductanceLocalMin (Bigclamv2.scala:42-59): phi + ranking in one call."""
+    """conductanceLocalMin (Bigclamv2.scala:42-59): phi + ranking in one call.
+
+    With cfg.seed_exclusion (auto-on in quality mode) the ranking is the
+    coverage-aware greedy walk (select_seeds_covering) instead of the
+    reference's raw top-K nominee order.
+    """
     cfg = cfg or BigClamConfig()
     phi = conductance(
         g,
@@ -457,4 +523,11 @@ def conductance_seeds(
         degree_cap=cfg.seeding_degree_cap,
         rng=np.random.default_rng(cfg.seed),
     )
+    exclude = (
+        cfg.quality_mode if cfg.seed_exclusion is None else cfg.seed_exclusion
+    )
+    if exclude:
+        return select_seeds_covering(
+            g, phi, cfg.num_communities, cfg, hops=2
+        )
     return rank_seeds(g, phi, cfg)

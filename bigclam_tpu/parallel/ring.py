@@ -521,7 +521,20 @@ class RingBigClamModel(ShardedBigClamModel):
             "n_blocks": rbt.n_blocks,
         }
         self.edges = None
+        self._tiles_dev = tiles                  # kept for rebuild_step
         self._step = make_ring_csr_train_step(self.mesh, tiles, self.cfg)
+
+    def rebuild_step(self) -> None:
+        """Recompile the train step from the CURRENT self.cfg, reusing the
+        device buffers (same contract as ShardedBigClamModel.rebuild_step)."""
+        if self._csr_wanted:
+            self._step = make_ring_csr_train_step(
+                self.mesh, self._tiles_dev, self.cfg
+            )
+        else:
+            self._step = make_ring_train_step(
+                self.mesh, self.edges, self.cfg
+            )
 
     def _build_edges_and_step(self) -> None:
         dp = self.mesh.shape[NODES_AXIS]

@@ -745,6 +745,7 @@ class ShardedBigClamModel:
                 "n_blocks": sbt.n_blocks,
             }
         self.edges = None                        # not used by the CSR step
+        self._tiles_dev = tiles                  # kept for rebuild_step
         self._step = make_sharded_csr_train_step(self.mesh, tiles, self.cfg)
 
     def _build_edges_and_step(self) -> None:
@@ -766,6 +767,20 @@ class ShardedBigClamModel:
             mask=put_sharded(edges_host.mask.astype(self.dtype), espec),
         )
         self._step = make_sharded_train_step(self.mesh, self.edges, self.cfg)
+
+    def rebuild_step(self) -> None:
+        """Recompile the train step from the CURRENT self.cfg, reusing the
+        device tile/edge buffers (see models.bigclam.BigClamModel
+        .rebuild_step — same contract, used by quality mode's max_p
+        relaxation; the engaged schedule/kernels never change)."""
+        if self._csr_wanted:
+            self._step = make_sharded_csr_train_step(
+                self.mesh, self._tiles_dev, self.cfg
+            )
+        else:
+            self._step = make_sharded_train_step(
+                self.mesh, self.edges, self.cfg
+            )
 
     def init_state(self, F0: np.ndarray) -> TrainState:
         n, k = self.g.num_nodes, self.cfg.num_communities

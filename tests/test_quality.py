@@ -36,13 +36,18 @@ def test_quality_mode_recovers_planted(planted):
         num_communities=k, quality_mode=True, restart_cycles=8,
         use_pallas=False, use_pallas_csr=False,
     )
-    seeds = seeding.conductance_seeds(g, cfg)
-    F0 = seeding.init_F(g, seeds, cfg, np.random.default_rng(0))
+    # PARITY baseline: reference seeding (raw top-K nominees) + faithful
+    # dynamics — the documented coverage failure
+    cfg_ref = cfg.replace(quality_mode=False, seed_exclusion=False)
+    seeds_ref = seeding.conductance_seeds(g, cfg_ref)
+    F0_ref = seeding.init_F(g, seeds_ref, cfg_ref, np.random.default_rng(0))
     model = BigClamModel(g, cfg)
-
-    res_faithful = model.fit(F0)
+    res_faithful = model.fit(F0_ref)
     f1_faithful = _score(res_faithful.F, g, truth)
 
+    # quality mode: coverage-aware seeds + noise annealing
+    seeds = seeding.conductance_seeds(g, cfg)
+    F0 = seeding.init_F(g, seeds, cfg, np.random.default_rng(0))
     qres = fit_quality(model, F0)
     f1_quality = _score(qres.fit.F, g, truth)
 
@@ -167,3 +172,34 @@ def test_quality_checkpoint_shape_mismatch_refused(planted, tmp_path):
         fit_quality(
             model2, np.zeros((g.num_nodes, k - 1)), checkpoints=cm
         )
+
+
+def test_max_p_relaxation_rescues_frozen_annealing():
+    """The MAX_P_ clip bounds the gradient's 1/(1-p) amplification; a
+    noise-level column entry grows only when deg(u)*amp > N. With amp
+    pinned at 10 every kick is frozen dead (the K=5000 gate's failure mode,
+    QUALITY_K5000_r04.json: 4 gainless cycles, F1 0.001); the auto
+    relaxation (amp = 16*N/avg_deg) recovers the planted partition."""
+    g, truth = sample_planted_graph(
+        600, 25, p_in=0.3, rng=np.random.default_rng(7)
+    )
+    k = len(truth)
+
+    def run(**kw):
+        cfg = BigClamConfig(
+            num_communities=k, quality_mode=True,
+            use_pallas=False, use_pallas_csr=False, **kw,
+        )
+        seeds = seeding.conductance_seeds(g, cfg)
+        F0 = seeding.init_F(g, seeds, cfg, np.random.default_rng(0))
+        model = BigClamModel(g, cfg)
+        qres = fit_quality(model, F0)
+        # the parity cfg (and its step) must be restored afterwards
+        assert model.cfg.max_p == cfg.max_p
+        assert model.cfg.conv_tol == cfg.conv_tol
+        return _score(qres.fit.F, g, truth)
+
+    f1_pinned = run(quality_max_p=0.9)
+    f1_auto = run()
+    assert f1_auto >= 0.8, (f1_auto, f1_pinned)
+    assert f1_auto > f1_pinned + 0.3, (f1_auto, f1_pinned)

@@ -280,3 +280,78 @@ class TestSampledTriangles:
         )
         np.testing.assert_allclose(out[:4], 3.0)
         np.testing.assert_allclose(out[4:], 0.0)
+
+
+class TestSeedExclusion:
+    """Coverage-aware seed selection (select_seeds_covering; quality mode's
+    seeding rule — not reference behavior, which takes the raw top-K
+    nominee ranking, Bigclamv2.scala:56)."""
+
+    @pytest.fixture(scope="class")
+    def planted(self):
+        from bigclam_tpu.models.agm import sample_planted_graph
+
+        rng = np.random.default_rng(7)
+        n, k = 1200, 50                       # 24-node blocks, p_in=0.3
+        g, truth = sample_planted_graph(n, k, p_in=0.3, rng=rng)
+        return g, truth, n, k
+
+    def _coverage(self, seeds, k, size):
+        return len(set(int(s) // size for s in np.asarray(seeds)[:k]))
+
+    def test_covers_more_blocks_than_raw_ranking(self, planted):
+        g, truth, n, k = planted
+        phi = seeding.conductance(g, backend="numpy")
+        raw = seeding.rank_seeds(g, phi, CFG)
+        cov = seeding.select_seeds_covering(g, phi, k, CFG, hops=2)
+        size = n // k
+        c_raw = self._coverage(raw, k, size)
+        c_cov = self._coverage(cov, k, size)
+        assert len(cov) == k
+        assert c_cov > c_raw, (c_cov, c_raw)
+        assert c_cov >= int(0.85 * k), (c_cov, k)
+
+    def test_hops1_exclusion_invariant(self, planted):
+        # at hops=1 no chosen seed may lie inside an earlier seed's ego-net
+        g, truth, n, k = planted
+        phi = seeding.conductance(g, backend="numpy")
+        sel = seeding.select_seeds_covering(g, phi, k, CFG, hops=1)
+        covered = np.zeros(n, dtype=bool)
+        for s in sel:
+            assert not covered[s]
+            covered[s] = True
+            covered[g.neighbors(int(s))] = True
+
+    def test_falls_back_past_nominees(self):
+        # a path graph nominates few locally-minimal nodes; the covering
+        # walk must continue over non-nominees to reach k seeds
+        g = graph_from_edges([(i, i + 1) for i in range(11)], num_nodes=12)
+        phi = seeding.conductance(g, backend="numpy")
+        sel = seeding.select_seeds_covering(g, phi, 4, CFG, hops=1)
+        assert len(sel) == 4
+        assert len(set(sel.tolist())) == 4
+
+    def test_auto_on_iff_quality_mode(self, planted):
+        g, truth, n, k = planted
+        cfg_q = BigClamConfig(num_communities=k, quality_mode=True)
+        cfg_p = BigClamConfig(num_communities=k)
+        phi = seeding.conductance(
+            g, degree_cap=cfg_q.seeding_degree_cap,
+            rng=np.random.default_rng(cfg_q.seed),
+        )
+        np.testing.assert_array_equal(
+            seeding.conductance_seeds(g, cfg_q),
+            seeding.select_seeds_covering(g, phi, k, cfg_q, hops=2),
+        )
+        np.testing.assert_array_equal(
+            seeding.conductance_seeds(g, cfg_p), seeding.rank_seeds(g, phi, cfg_p)
+        )
+        # and the flag overrides the auto rule in both directions
+        np.testing.assert_array_equal(
+            seeding.conductance_seeds(g, cfg_p.replace(seed_exclusion=True)),
+            seeding.select_seeds_covering(g, phi, k, cfg_p, hops=2),
+        )
+        np.testing.assert_array_equal(
+            seeding.conductance_seeds(g, cfg_q.replace(seed_exclusion=False)),
+            seeding.rank_seeds(g, phi, cfg_q),
+        )
