@@ -258,6 +258,8 @@ def cmd_sweep(args) -> int:
     from bigclam_tpu.utils.profiling import trace
 
     g, cfg = _build(args, getattr(args, "max_com"))
+    if getattr(args, "quality", False):
+        cfg = cfg.replace(quality_mode=True)
     if args.checkpoint_dir:
         print(
             "note: checkpointing is per-fit; the sweep records progress in "
@@ -354,6 +356,11 @@ def main(argv=None) -> int:
     p_sweep.add_argument("--max-com", type=int, default=9000)
     p_sweep.add_argument("--div-com", type=int, default=100)
     p_sweep.add_argument("--ksweep-tol", type=float, default=1e-3)
+    p_sweep.add_argument(
+        "--quality", action="store_true",
+        help="train each K with the quality-mode annealing schedule "
+             "(models/quality.py; NOT reference semantics)",
+    )
     p_sweep.set_defaults(fn=cmd_sweep)
 
     p_eval = sub.add_parser("eval", help="score predicted vs ground-truth communities")

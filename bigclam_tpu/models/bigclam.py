@@ -48,6 +48,29 @@ def csr_want_reason(cfg: BigClamConfig) -> tuple[bool, str]:
     return False, reason
 
 
+# Fields that only the HOST-side loops read (never baked into the compiled
+# step): normalized away by step_cfg_key so rebuild_step can cache compiled
+# steps across host-only cfg swaps (quality mode toggles conv_tol + max_p
+# around every annealing schedule — without the cache that is two fresh
+# compiles per fit_quality call, per K in a sweep).
+_HOST_ONLY_FIELDS = dict(
+    conv_tol=0.0, max_iters=0,
+    min_com=1, max_com=1, div_com=1, ksweep_tol=0.0,
+    seed=0, seed_include_self=True, isolated_phi_sentinel=0.0,
+    seeding_degree_cap=None, seed_exclusion=None,
+    quality_mode=False, init_noise=None, init_noise_mass=0.0,
+    restart_cycles=0, restart_tol=0.0, restart_patience=0,
+    quality_conv_tol=0.0, quality_max_p=None,
+    checkpoint_dir=None, checkpoint_every=0, metrics_path=None,
+)
+
+
+def step_cfg_key(cfg: BigClamConfig) -> BigClamConfig:
+    """Step-baked identity of a config (hashable — the frozen dataclass):
+    two configs with equal keys compile byte-identical train steps."""
+    return cfg.replace(**_HOST_ONLY_FIELDS)
+
+
 def log_engaged_path(model_name: str, path: str, reason: str = "") -> None:
     """One-line kernel-path engagement report at model build.
 
@@ -510,19 +533,25 @@ class BigClamModel:
         self._step, self.engaged_path = make_train_step(
             self._edges, cfg, tiles=self._tiles, k_pad=self.k_pad
         )
+        self._step_cache = {step_cfg_key(cfg): (self._step, self.engaged_path)}
         self.path_reason = getattr(self, "_csr_reason", "")
         log_engaged_path("BigClamModel", self.engaged_path, self.path_reason)
 
     def rebuild_step(self) -> None:
-        """Recompile the train step from the CURRENT self.cfg.
+        """Swap in the train step for the CURRENT self.cfg.
 
         Device tile/edge buffers are reused — only step-baked constants
         (clip bounds, Armijo candidates) change. Path selection is NOT
         re-run: quality mode's max_p relaxation (models.quality) must not
-        flip the engaged kernels mid-schedule."""
-        self._step, self.engaged_path = make_train_step(
-            self._edges, self.cfg, tiles=self._tiles, k_pad=self.k_pad
-        )
+        flip the engaged kernels mid-schedule. Steps are cached by
+        step_cfg_key, so toggling between a pair of configs (quality's
+        relax/restore around every schedule) compiles each step once."""
+        key = step_cfg_key(self.cfg)
+        if key not in self._step_cache:
+            self._step_cache[key] = make_train_step(
+                self._edges, self.cfg, tiles=self._tiles, k_pad=self.k_pad
+            )
+        self._step, self.engaged_path = self._step_cache[key]
 
     @property
     def edges(self) -> EdgeChunks:

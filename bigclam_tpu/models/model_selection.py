@@ -120,7 +120,9 @@ def sweep_k(
         k_rngs = {
             k: np.random.default_rng(int(s)) for k, s in zip(kset, child)
         }
-    seeds = seeding.conductance_seeds(g, cfg)      # computed once (v4:75)
+    # computed once (v4:75); at k_max so the covering walk (quality mode's
+    # seed_exclusion) yields enough seeds for every K in the grid
+    seeds = seeding.conductance_seeds(g, cfg_max)
 
     llh_by_k: Dict[int, float] = {}
     state_path = None
@@ -150,7 +152,20 @@ def sweep_k(
             )
             F0 = np.zeros((g.num_nodes, k_max))
             F0[:, :k] = F0k                         # columns >= k stay zero
-            res = model.fit(F0, checkpoints=ckpt_k)
+            if cfg.quality_mode:
+                # quality sweep: each K trains with the annealing schedule
+                # (models.quality); the kick is restricted to the active K
+                # columns so the >= k padding stays on its inert zeros. The
+                # relax/restore step swap is cached (step_cfg_key), so the
+                # whole sweep still compiles each step exactly once.
+                from bigclam_tpu.models.quality import fit_quality
+
+                qres = fit_quality(
+                    model, F0, checkpoints=ckpt_k, kick_cols=k
+                )
+                res = qres.fit
+            else:
+                res = model.fit(F0, checkpoints=ckpt_k)
             res_llh = res.llh
             llh_by_k[k] = res_llh
             best_fit = res

@@ -75,6 +75,14 @@ from bigclam_tpu.models.bigclam import FitResult
 from bigclam_tpu.utils.dist import is_primary
 
 
+def auto_quality_max_p(num_nodes: int, avg_deg: float) -> float:
+    """The auto MAX_P_ relaxation rule (single source — quality_gate.py
+    records it too): amp = 16*N/avg_deg covers node degrees down to
+    avg/16, ceilinged at 1-1e-6 (the f32 floor; see config.quality_max_p)."""
+    amp = 16.0 * num_nodes / max(avg_deg, 1.0)
+    return min(1.0 - 1.0 / amp, 1.0 - 1e-6)
+
+
 @dataclasses.dataclass(frozen=True)
 class QualityResult:
     fit: FitResult            # best-LLH cycle's result
@@ -88,6 +96,7 @@ def fit_quality(
     F0: np.ndarray,
     callback: Optional[Callable[[int, float], None]] = None,
     checkpoints=None,
+    kick_cols: Optional[int] = None,
 ) -> QualityResult:
     """Train with the quality-mode schedule (see module docstring).
 
@@ -101,9 +110,17 @@ def fit_quality(
     not combined with quality mode — a cycle is one bounded fit). Noise is
     drawn from per-cycle streams ([cfg.seed, 0x5EED, cycle]) so resume
     reproduces the uninterrupted schedule exactly.
+
+    `kick_cols` restricts the noise kick to F[:, :kick_cols] (default: all
+    columns). The K-sweep passes the active K here — its F buffer is sized
+    to the grid max with columns >= K masked to zero, and an unrestricted
+    kick would lift those padding columns off their inert zeros.
     """
     cfg = model.cfg
     n, k = F0.shape
+    kc = k if kick_cols is None else int(kick_cols)
+    if not (0 < kc <= k):
+        raise ValueError(f"kick_cols={kick_cols} out of range for K={k}")
     F_cur = np.asarray(F0, np.float64)
     cycles_llh: List[float] = []
     best: Optional[FitResult] = None
@@ -119,6 +136,12 @@ def fit_quality(
                 raise ValueError(
                     f"quality checkpoint incompatible: nk={meta.get('quality_nk')} "
                     f"vs ({n}, {k}) (dir: {checkpoints.directory})"
+                )
+            if int(meta.get("kick_cols", k)) != kc:
+                raise ValueError(
+                    f"quality checkpoint incompatible: kick_cols="
+                    f"{meta.get('kick_cols')} vs {kc} "
+                    f"(dir: {checkpoints.directory})"
                 )
             F_cur = np.asarray(arrays["F"])
             cycles_llh = list(meta.get("cycles_llh", []))
@@ -149,8 +172,9 @@ def fit_quality(
     # N <~ 1e6*avg_deg until the kernels take an f64 clip path
     max_p_q = cfg.quality_max_p
     if max_p_q is None:
-        amp = 16.0 * model.g.num_nodes / max(avg_deg, 1.0)
-        max_p_q = min(max(cfg.max_p, 1.0 - 1.0 / amp), 1.0 - 1e-6)
+        max_p_q = max(
+            cfg.max_p, auto_quality_max_p(model.g.num_nodes, avg_deg)
+        )
     elif not (0.0 < max_p_q <= 1.0 - 1e-6):
         # beyond 1-1e-6 the f32 clip collapses 1-p to 0: log(1-p) = -inf
         # poisons every cycle's LLH and NaN defeats the patience stop —
@@ -183,8 +207,11 @@ def fit_quality(
                 break          # a restored run that already tripped
                 # patience must not anneal further (resume-exactness)
             crng = np.random.default_rng([cfg.seed, 0x5EED, cycle])
-            kick = crng.uniform(0.0, eps, size=(n, k))
-            F_try = np.clip(F_cur + kick, cfg.min_f, cfg.max_f)
+            kick = crng.uniform(0.0, eps, size=(n, kc))
+            F_try = np.asarray(F_cur, np.float64).copy()
+            F_try[:, :kc] = np.clip(
+                F_try[:, :kc] + kick, cfg.min_f, cfg.max_f
+            )
             res = model.fit(F_try, callback=callback)
             total_iters += res.num_iters
             cycles_llh.append(res.llh)
@@ -207,6 +234,7 @@ def fit_quality(
                             "total_iters": total_iters,
                             "gainless": gainless,
                             "quality_nk": [n, k],
+                            "kick_cols": kc,
                         },
                     )
             if gainless >= cfg.restart_patience:

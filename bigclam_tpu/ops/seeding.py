@@ -445,7 +445,7 @@ def select_seeds_covering(
     from later seeding).
     """
     cfg = cfg or BigClamConfig()
-    cap = cfg.seeding_degree_cap or 256
+    cap = 256 if cfg.seeding_degree_cap is None else cfg.seeding_degree_cap
     n = g.num_nodes
     ranked = rank_seeds(g, phi, cfg)
     rest = np.setdiff1d(
@@ -469,11 +469,10 @@ def select_seeds_covering(
                 # hub guard: the 2-hop marking of one seed costs
                 # sum_{v in N(s)} deg(v); cap both fans like the sampled
                 # conductance scorer does
-                if cap is not None and nbrs.size > cap:
+                if nbrs.size > cap:
                     nbrs = nbrs[:: max(nbrs.size // cap, 1)][:cap]
                 for v in nbrs:
-                    row = indices[indptr[v] : indptr[v + 1]]
-                    covered[row if cap is None else row[:cap]] = True
+                    covered[indices[indptr[v] : indptr[v + 1]][:cap]] = True
             if len(out) >= k:
                 return np.asarray(out, dtype=np.int64)
     return np.asarray(out, dtype=np.int64)   # graph fully covered before K

@@ -525,16 +525,22 @@ class RingBigClamModel(ShardedBigClamModel):
         self._step = make_ring_csr_train_step(self.mesh, tiles, self.cfg)
 
     def rebuild_step(self) -> None:
-        """Recompile the train step from the CURRENT self.cfg, reusing the
-        device buffers (same contract as ShardedBigClamModel.rebuild_step)."""
-        if self._csr_wanted:
-            self._step = make_ring_csr_train_step(
-                self.mesh, self._tiles_dev, self.cfg
-            )
-        else:
-            self._step = make_ring_train_step(
-                self.mesh, self.edges, self.cfg
-            )
+        """Swap in the train step for the CURRENT self.cfg, reusing the
+        device buffers (same contract and step cache as
+        ShardedBigClamModel.rebuild_step)."""
+        from bigclam_tpu.models.bigclam import step_cfg_key
+
+        key = step_cfg_key(self.cfg)
+        if key not in self._step_cache:
+            if self._csr_wanted:
+                self._step_cache[key] = make_ring_csr_train_step(
+                    self.mesh, self._tiles_dev, self.cfg
+                )
+            else:
+                self._step_cache[key] = make_ring_train_step(
+                    self.mesh, self.edges, self.cfg
+                )
+        self._step = self._step_cache[key]
 
     def _build_edges_and_step(self) -> None:
         dp = self.mesh.shape[NODES_AXIS]

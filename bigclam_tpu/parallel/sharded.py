@@ -490,6 +490,9 @@ class ShardedBigClamModel:
 
             self.g, self._perm = balance_graph(g, dp, self.n_pad)
         self._build_edges_and_step()    # hook: subclasses swap the schedule
+        from bigclam_tpu.models.bigclam import step_cfg_key
+
+        self._step_cache = {step_cfg_key(self.cfg): self._step}
         self.path_reason = getattr(self, "_csr_reason", "")
         from bigclam_tpu.models.bigclam import log_engaged_path
 
@@ -769,18 +772,25 @@ class ShardedBigClamModel:
         self._step = make_sharded_train_step(self.mesh, self.edges, self.cfg)
 
     def rebuild_step(self) -> None:
-        """Recompile the train step from the CURRENT self.cfg, reusing the
+        """Swap in the train step for the CURRENT self.cfg, reusing the
         device tile/edge buffers (see models.bigclam.BigClamModel
-        .rebuild_step — same contract, used by quality mode's max_p
-        relaxation; the engaged schedule/kernels never change)."""
-        if self._csr_wanted:
-            self._step = make_sharded_csr_train_step(
-                self.mesh, self._tiles_dev, self.cfg
-            )
-        else:
-            self._step = make_sharded_train_step(
-                self.mesh, self.edges, self.cfg
-            )
+        .rebuild_step — same contract and step cache, used by quality
+        mode's max_p relaxation; the engaged schedule/kernels never
+        change)."""
+        from bigclam_tpu.models.bigclam import step_cfg_key
+
+        key = step_cfg_key(self.cfg)
+        cache = self._step_cache
+        if key not in cache:
+            if self._csr_wanted:
+                cache[key] = make_sharded_csr_train_step(
+                    self.mesh, self._tiles_dev, self.cfg
+                )
+            else:
+                cache[key] = make_sharded_train_step(
+                    self.mesh, self.edges, self.cfg
+                )
+        self._step = cache[key]
 
     def init_state(self, F0: np.ndarray) -> TrainState:
         n, k = self.g.num_nodes, self.cfg.num_communities

@@ -38,7 +38,7 @@ def main() -> int:
     from bigclam_tpu.evaluation import avg_f1
     from bigclam_tpu.models import BigClamModel
     from bigclam_tpu.models.agm import sample_planted_graph
-    from bigclam_tpu.models.quality import fit_quality
+    from bigclam_tpu.models.quality import auto_quality_max_p, fit_quality
     from bigclam_tpu.ops import extraction, seeding
 
     rng = np.random.default_rng(7)
@@ -75,6 +75,7 @@ def main() -> int:
     t_quality = time.time() - t0
     f1_q = score(qres.fit.F)
 
+    avg_deg = g.num_directed_edges / max(n, 1)
     rec = {
         "gate": "planted-recovery",
         "config": f"planted AGM N={n} K={k} p_in={p_in} "
@@ -91,6 +92,13 @@ def main() -> int:
             "quality": round(t_quality, 1),
         },
         "engaged_path": model.engaged_path,
+        "path_reason": model.path_reason,
+        "num_seeds": int(len(seeds)),
+        # the relaxed clip fit_quality ran with (shared rule — see
+        # models.quality.auto_quality_max_p)
+        "quality_max_p_auto": max(
+            cfg.max_p, auto_quality_max_p(n, avg_deg)
+        ),
         "device": str(jax.devices()[0]),
         "pass": bool(f1_q >= 0.8),
     }

@@ -203,3 +203,46 @@ def test_max_p_relaxation_rescues_frozen_annealing():
     f1_auto = run()
     assert f1_auto >= 0.8, (f1_auto, f1_pinned)
     assert f1_auto > f1_pinned + 0.3, (f1_auto, f1_pinned)
+
+
+def test_step_cache_reused_across_quality_calls(planted):
+    """fit_quality swaps conv_tol/max_p around every schedule; the step
+    cache (models.bigclam.step_cfg_key) must make the relax/restore pair
+    compile once — repeated fit_quality calls reuse both steps."""
+    g, truth = planted
+    k = len(truth)
+    cfg = BigClamConfig(
+        num_communities=k, quality_mode=True, restart_cycles=2,
+        # force a real relaxation at this small N so BOTH steps exist
+        quality_max_p=1.0 - 1e-6,
+        use_pallas=False, use_pallas_csr=False,
+    )
+    model = BigClamModel(g, cfg)
+    F0 = np.zeros((g.num_nodes, k))
+    fit_quality(model, F0)
+    assert len(model._step_cache) == 2, model._step_cache.keys()
+    steps = {id(s) for s, _ in model._step_cache.values()}
+    fit_quality(model, F0)
+    assert len(model._step_cache) == 2
+    assert {id(s) for s, _ in model._step_cache.values()} == steps
+
+
+def test_quality_kick_cols_keeps_padding_inert(planted):
+    """With kick_cols=k0 < K, columns >= k0 must stay identically zero all
+    the way through the annealing schedule (the K-sweep's masking
+    contract)."""
+    g, truth = planted
+    k = len(truth)
+    k0 = k - 4
+    cfg = BigClamConfig(
+        num_communities=k, quality_mode=True, restart_cycles=3,
+        use_pallas=False, use_pallas_csr=False,
+    )
+    model = BigClamModel(g, cfg)
+    F0 = np.zeros((g.num_nodes, k))
+    qres = fit_quality(model, F0, kick_cols=k0)
+    F = np.asarray(qres.fit.F)
+    assert np.all(F[:, k0:] == 0.0)
+    assert np.any(F[:, :k0] > 0.0)
+    with pytest.raises(ValueError, match="kick_cols"):
+        fit_quality(model, F0, kick_cols=k + 1)
