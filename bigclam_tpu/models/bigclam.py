@@ -620,19 +620,17 @@ class BigClamModel:
                 else (cfg.csr_block_b, cfg.csr_tile_t)
             )
             if shape is None:
-                # whole-K rows exceed VMEM: single-chip large-K mode — the
-                # largest 128-multiple divisor of k_pad whose rows fit
-                # (kernels then scan K blocks; train_pass_csr_grouped_kblocked)
-                m = k_pad // 128
-                for d in sorted(
-                    (d for d in range(1, m) if m % d == 0), reverse=True
-                ):
-                    s = fit_tile_shape(
-                        cfg.csr_block_b, cfg.csr_tile_t, 128 * d
-                    )
-                    if s is not None:
-                        kc, shape = 128 * d, s
-                        break
+                # whole-K rows exceed VMEM: single-chip large-K mode
+                # (kernels then scan K blocks;
+                # train_pass_csr_grouped_kblocked); policy shared with the
+                # sharded trainer
+                from bigclam_tpu.ops.pallas_csr import largest_fitting_kblock
+
+                found = largest_fitting_kblock(
+                    cfg.csr_block_b, cfg.csr_tile_t, k_pad
+                )
+                if found is not None:
+                    kc, shape = found
         if shape is None:
             # kernels cannot fit VMEM at this K — XLA path (or shard K)
             if explicit:

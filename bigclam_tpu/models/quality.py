@@ -75,12 +75,17 @@ from bigclam_tpu.models.bigclam import FitResult
 from bigclam_tpu.utils.dist import is_primary
 
 
-def auto_quality_max_p(num_nodes: int, avg_deg: float) -> float:
+def auto_quality_max_p(
+    num_nodes: int, avg_deg: float, floor: float = 0.0
+) -> float:
     """The auto MAX_P_ relaxation rule (single source — quality_gate.py
     records it too): amp = 16*N/avg_deg covers node degrees down to
-    avg/16, ceilinged at 1-1e-6 (the f32 floor; see config.quality_max_p)."""
+    avg/16. `floor` is the parity max_p (never relax BELOW it); the 1-1e-6
+    ceiling applies to the combined value — even a floor above it is
+    clamped, because past that point the f32 clip collapses 1-p to 0 and
+    log(1-p) = -inf poisons every cycle (see config.quality_max_p)."""
     amp = 16.0 * num_nodes / max(avg_deg, 1.0)
-    return min(1.0 - 1.0 / amp, 1.0 - 1e-6)
+    return min(max(floor, 1.0 - 1.0 / amp), 1.0 - 1e-6)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -172,8 +177,8 @@ def fit_quality(
     # N <~ 1e6*avg_deg until the kernels take an f64 clip path
     max_p_q = cfg.quality_max_p
     if max_p_q is None:
-        max_p_q = max(
-            cfg.max_p, auto_quality_max_p(model.g.num_nodes, avg_deg)
+        max_p_q = auto_quality_max_p(
+            model.g.num_nodes, avg_deg, floor=cfg.max_p
         )
     elif not (0.0 < max_p_q <= 1.0 - 1e-6):
         # beyond 1-1e-6 the f32 clip collapses 1-p to 0: log(1-p) = -inf

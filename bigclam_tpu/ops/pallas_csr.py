@@ -113,6 +113,21 @@ def fit_tile_shape(
     return (b, t) if est(b, t) <= VMEM_BUDGET else None
 
 
+def largest_fitting_kblock(
+    block_b: int, tile_t: int, k_pad: int
+) -> Optional[Tuple[int, Tuple[int, int]]]:
+    """Large-K fallback policy shared by the single-chip and sharded
+    trainers: the largest 128-multiple divisor kc of k_pad whose tile
+    shape fits VMEM. Returns (kc, (block_b, tile_t)) or None — the K axis
+    is then processed kc columns at a time by the kblocked passes."""
+    m = k_pad // 128
+    for d in sorted((d for d in range(1, m) if m % d == 0), reverse=True):
+        s = fit_tile_shape(block_b, tile_t, 128 * d)
+        if s is not None:
+            return 128 * d, s
+    return None
+
+
 def csr_tiles_supported(
     block_b: int, tile_t: int, k_pad: int, interpret: bool = False
 ) -> bool:
@@ -886,6 +901,104 @@ def train_pass_csr_grouped_kblocked(
         grad_g = grads.transpose(1, 0, 2).reshape(rows, k)
         cb = cand_nbr_from_x_csr(xc, td, cfg, interpret=interpret)
         # llh_nbr depends only on x and the mask — identical across blocks
+        return None, (grad_g, lns[0], cb)
+
+    _, (gr, ln, cd) = lax.scan(
+        body,
+        None,
+        (
+            jnp.arange(gt.n_groups),
+            (gt.src_local, gt.dst, gt.mask, gt.block_id),
+        ),
+    )
+    grad = gr.reshape(n_pad, k)
+    llh_nbr = ln.reshape(n_pad)
+    cand_nbr = cd.transpose(1, 0, 2).reshape(num_s, n_pad)
+    return grad, llh_nbr, cand_nbr
+
+
+def train_pass_csr_grouped_kblocked_tp(
+    F: jax.Array,
+    sumF: jax.Array,
+    gt: GroupedTilesDev,
+    cfg: BigClamConfig,
+    k_axis: str,
+    interpret: bool = False,
+    F_gather: jax.Array = None,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """train_pass_csr_grouped_kblocked under a SHARDED K axis — the last
+    layout cell: K so large that even K_loc = K/tp exceeds the kernels'
+    VMEM bound (e.g. K=25600 at tp=8 -> K_loc=3200, refused by
+    fit_tile_shape; round-4 PARITY.md deferred item).
+
+    Composition of the two existing schedules: per group, (1) accumulate
+    per-edge partial dots over this device's LOCAL kc-column K blocks
+    (lax.scan), then ONE lax.psum over `k_axis` completes the global dots
+    (still 1 float/edge — the K-block scan adds no collective traffic);
+    (2) per local K block, consume the global x into that block's gradient
+    columns and accumulate candidate partial dots; (3) psum the candidate
+    partials over `k_axis`, one candidate-consume kernel per group.
+
+    With tp == 1 the psums are identity and this is exactly the
+    single-chip kblocked pass — the sharded trainer uses it for BOTH, so
+    the DP-only large-K path and the TP path share one step.
+
+    F/sumF/F_gather hold K_loc columns, gt.kc | K_loc. Returns
+    (grad (n_pad, K_loc), llh_nbr (n_pad,), cand_nbr (S, n_pad)) —
+    candidate terms NEIGHBOR-only, Armijo tails are the caller's psums
+    (parallel.sharded.armijo_tail_select_sharded)."""
+    n_pad, k = F.shape
+    assert n_pad == gt.n_pad, (n_pad, gt.n_pad)
+    kc = gt.kc
+    assert kc > 0 and k % kc == 0, (k, kc)
+    n_kb = k // kc
+    rows = gt.nb * gt.block_b
+    num_s = len(cfg.step_candidates)
+    F_src = F if F_gather is None else F_gather
+
+    def body(_, xs):
+        gi, tile_xs = xs
+        td = _group_view(gt, tile_xs)
+        F_g = lax.dynamic_slice_in_dim(F, gi * rows, rows)
+        gmax, t = td.src_local.shape[0], td.tile_t
+
+        def fd_of(kb):
+            cols = lax.dynamic_slice_in_dim(F_src, kb * kc, kc, axis=1)
+            return jnp.take(cols, td.dst, axis=0)        # (G, T, kc)
+
+        def dots_kb(x_acc, kb):
+            F_g_kb = lax.dynamic_slice_in_dim(F_g, kb * kc, kc, axis=1)
+            x_kb = edge_dots_csr(F_g_kb, td, fd_of(kb), interpret=interpret)
+            return x_acc + x_kb, None
+
+        x_loc, _ = lax.scan(
+            dots_kb, jnp.zeros((gmax, 1, t), F.dtype), jnp.arange(n_kb)
+        )
+        x = lax.psum(x_loc, k_axis)                      # global edge dots
+
+        def consume_kb(xc_acc, kb):
+            fd = fd_of(kb)
+            F_g_kb = lax.dynamic_slice_in_dim(F_g, kb * kc, kc, axis=1)
+            sumF_kb = lax.dynamic_slice_in_dim(sumF, kb * kc, kc)
+            gn_kb, ln_kb = grad_nbr_from_x_csr(
+                x, td, fd, cfg, interpret=interpret
+            )
+            grad_kb = gn_kb - sumF_kb[None, :] + F_g_kb
+            xc_kb = cand_dots_csr(
+                F_g_kb, grad_kb, td, fd, cfg, interpret=interpret
+            )
+            return xc_acc + xc_kb, (grad_kb, ln_kb)
+
+        xc_loc, (grads, lns) = lax.scan(
+            consume_kb,
+            jnp.zeros((gmax, num_s, t), F.dtype),
+            jnp.arange(n_kb),
+        )
+        xc = lax.psum(xc_loc, k_axis)
+        grad_g = grads.transpose(1, 0, 2).reshape(rows, k)
+        cb = cand_nbr_from_x_csr(xc, td, cfg, interpret=interpret)
+        # ln depends only on the (already global) x and the mask —
+        # identical across local K blocks and across K shards
         return None, (grad_g, lns[0], cb)
 
     _, (gr, ln, cd) = lax.scan(

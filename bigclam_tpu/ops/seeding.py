@@ -445,7 +445,11 @@ def select_seeds_covering(
     from later seeding).
     """
     cfg = cfg or BigClamConfig()
-    cap = 256 if cfg.seeding_degree_cap is None else cfg.seeding_degree_cap
+    # non-positive caps are meaningless for the 2-hop fan bound (and 0
+    # would divide by zero below) — fall back to the built-in default
+    cap = cfg.seeding_degree_cap
+    if not cap or cap <= 0:
+        cap = 256
     n = g.num_nodes
     ranked = rank_seeds(g, phi, cfg)
     rest = np.setdiff1d(

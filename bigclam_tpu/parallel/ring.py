@@ -459,6 +459,23 @@ class RingBigClamModel(ShardedBigClamModel):
             ring_block_tiles,
         )
 
+        if getattr(self, "_csr_kc", 0):
+            # K_loc beyond the VMEM bound engages the K-blocked pass on the
+            # all-gather trainer; the ring step has no K-blocked variant
+            # yet (PARITY.md deferred) — refuse rather than mis-build
+            if self.cfg.use_pallas_csr is True:
+                raise ValueError(
+                    "use_pallas_csr=True on the ring trainer requires "
+                    f"K_loc <= the VMEM bound (K-blocked ring not "
+                    f"implemented; K_loc={self._csr_k_pad // self.mesh.shape[K_AXIS]}); "
+                    "raise tp, or use the all-gather trainer"
+                )
+            self._csr_reason = (
+                f"K-blocked ring pass not implemented (kc={self._csr_kc}); "
+                "the all-gather trainer covers this K"
+            )
+            return False
+
         block_b, tile_t = self._csr_shape
         n_pad = _round_up(max(self.g.num_nodes, dp), dp * block_b)
         rbt = ring_block_tiles(self.g, dp, n_pad, block_b, tile_t)

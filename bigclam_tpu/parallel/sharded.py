@@ -38,6 +38,7 @@ from bigclam_tpu.models.bigclam import (
     GROUP_FD_BUDGET,
     FitResult,
     TrainState,
+    _lcm,
     _round_up,
     edge_chunk_bound,
     restore_checkpoint,
@@ -152,7 +153,9 @@ def make_sharded_csr_train_step(
 ) -> Callable[[TrainState], TrainState]:
     """Sharded iteration on the blocked-CSR MXU kernels (ops.pallas_csr).
 
-    Three schedules, chosen by the tile layout + mesh:
+    Five schedules, chosen by the tile layout + mesh (the grouped ones
+    also come K-blocked — tiles["kc"] > 0 — when even the per-device
+    column count exceeds the kernels' VMEM bound):
 
     * tp == 1, flat: each shard all-gathers F over "nodes", gathers its
       tiles' dst rows ONCE (shared by both kernels), runs the same two
@@ -180,6 +183,7 @@ def make_sharded_csr_train_step(
         grad_llh_csr,
         grad_nbr_from_x_csr,
         train_pass_csr_grouped,
+        train_pass_csr_grouped_kblocked_tp,
         train_pass_csr_grouped_tp,
     )
 
@@ -188,6 +192,7 @@ def make_sharded_csr_train_step(
     block_b = tiles["block_b"]
     tile_t = tiles["tile_t"]
     grouped = tiles.get("nb") is not None
+    kc = tiles.get("kc", 0)
 
     def finish(F_loc, grad, node_llh, cand_nbr, sumF, it):
         """Armijo tails + select + update (shared helper) + the psums."""
@@ -285,7 +290,30 @@ def make_sharded_csr_train_step(
         ).astype(adt)
         return finish(F_loc, grad, node_llh, cand_nbr.astype(adt), sumF, it)
 
-    if grouped and tp > 1:
+    def step_shard_grouped_kb(F_loc, srcl, dst, mask, bid, it):
+        # K-blocked grouped pass (K_loc > VMEM bound): identical shape to
+        # the grouped-TP step, the K-block scan lives inside the pass; at
+        # tp == 1 its psums over "k" are identity and this is the sharded
+        # twin of the single-chip csr_grouped_kb step
+        gt = GroupedTilesDev(
+            src_local=srcl[0], dst=dst[0], mask=mask[0], block_id=bid[0],
+            block_b=block_b, tile_t=tile_t, nb=tiles["nb"],
+            n_groups=tiles["n_groups"], kc=kc,
+        )
+        adt = jnp.dtype(cfg.accum_dtype) if cfg.accum_dtype else F_loc.dtype
+        F_full = lax.all_gather(F_loc, NODES_AXIS, axis=0, tiled=True)
+        sumF = lax.psum(F_loc.sum(axis=0), NODES_AXIS)       # (K_loc,)
+        grad, llh_nbr, cand_nbr = train_pass_csr_grouped_kblocked_tp(
+            F_loc, sumF, gt, cfg, K_AXIS, interpret=interp, F_gather=F_full
+        )
+        node_llh = llh_nbr.astype(adt) + (
+            -lax.psum(F_loc @ sumF, K_AXIS) + _rowdot(F_loc, F_loc)
+        ).astype(adt)
+        return finish(F_loc, grad, node_llh, cand_nbr.astype(adt), sumF, it)
+
+    if grouped and kc:
+        step_shard = step_shard_grouped_kb
+    elif grouped and tp > 1:
         step_shard = step_shard_grouped_tp
     elif grouped:
         step_shard = step_shard_grouped
@@ -506,7 +534,13 @@ class ShardedBigClamModel:
         log_engaged_path); subclasses with more schedules override."""
         if not self._csr_wanted:
             return "xla"
-        return "csr_grouped" if getattr(self, "_csr_nb", None) else "csr"
+        if getattr(self, "_csr_nb", None):
+            return (
+                "csr_grouped_kb"
+                if getattr(self, "_csr_kc", 0)
+                else "csr_grouped"
+            )
+        return "csr"
 
     def _to_internal_rows(self, F0: np.ndarray) -> np.ndarray:
         """Original-id F rows -> the trainer's (possibly relabeled) row order."""
@@ -540,24 +574,48 @@ class ShardedBigClamModel:
             self._csr_reason = reason
             return False
         # per-device column count governs the kernels' VMEM working set
-        self._csr_k_pad = (
-            self.k_pad
-            if cfg.pallas_interpret
-            else _round_up(self.k_pad, 128 * tp)
-        )
+        self._csr_kc = 0
+        if cfg.csr_k_block:
+            # explicit K-blocked mode (also the interpret-mode test hook):
+            # per-device columns processed kc at a time
+            self._csr_kc = cfg.csr_k_block
+            self._csr_k_pad = _round_up(
+                self.k_pad,
+                self._csr_kc * tp if cfg.pallas_interpret
+                else _lcm(self._csr_kc, 128) * tp,
+            )
+        else:
+            self._csr_k_pad = (
+                self.k_pad
+                if cfg.pallas_interpret
+                else _round_up(self.k_pad, 128 * tp)
+            )
         k_loc = self._csr_k_pad // tp
         # shrink tiles to the kernels' VMEM budget, like the single-chip path
-        self._csr_shape = (
-            (cfg.csr_block_b, cfg.csr_tile_t)
-            if cfg.pallas_interpret
-            else fit_tile_shape(cfg.csr_block_b, cfg.csr_tile_t, k_loc)
-        )
+        if cfg.pallas_interpret:
+            self._csr_shape = (cfg.csr_block_b, cfg.csr_tile_t)
+        else:
+            self._csr_shape = fit_tile_shape(
+                cfg.csr_block_b, cfg.csr_tile_t, self._csr_kc or k_loc
+            )
+            if self._csr_shape is None and not self._csr_kc:
+                # K_loc itself exceeds VMEM (extreme K / small tp):
+                # K-blocked sharded mode, same policy as the single-chip
+                # trainer; the step then runs
+                # train_pass_csr_grouped_kblocked_tp
+                from bigclam_tpu.ops.pallas_csr import largest_fitting_kblock
+
+                found = largest_fitting_kblock(
+                    cfg.csr_block_b, cfg.csr_tile_t, k_loc
+                )
+                if found is not None:
+                    self._csr_kc, self._csr_shape = found
         ok = (
             self.dtype == jnp.float32
             and cfg.accum_dtype in (None, "float32")
             and self._csr_shape is not None
             and csr_tiles_supported(
-                *self._csr_shape, k_loc, cfg.pallas_interpret
+                *self._csr_shape, self._csr_kc or k_loc, cfg.pallas_interpret
             )
         )
         if not ok and cfg.use_pallas_csr is True:
@@ -599,9 +657,12 @@ class ShardedBigClamModel:
         e = max(self.g.num_directed_edges, 1)
         fd_bytes = sbt.n_tiles * tile_t * k_loc * 4              # per shard
         pad_ok = layout_economical(slots, e, dp * sbt.n_blocks, tile_t)
-        if pad_ok and fd_bytes <= FLAT_FD_BUDGET:
+        if pad_ok and not self._csr_kc and fd_bytes <= FLAT_FD_BUDGET:
             # reuse the probe's layout in _build_csr_step unless balancing
             # relabels the graph in between (the only thing that changes it)
+            # (K-blocked mode never takes the flat layout: the kblocked
+            # pass is defined on grouped tiles, whose per-group fd is what
+            # keeps the kc-column gathers bounded)
             self._probe_tiles = sbt
             self._csr_nb = None
             return True
@@ -639,7 +700,9 @@ class ShardedBigClamModel:
         )
 
         block_b, tile_t = self._csr_shape
-        k_pad = self._csr_k_pad // self.mesh.shape[K_AXIS]   # fd columns
+        # fd columns materialized per scan step: kc when K-blocked (the
+        # kblocked pass gathers one K block at a time), else K_loc
+        k_pad = self._csr_kc or (self._csr_k_pad // self.mesh.shape[K_AXIS])
         e = max(self.g.num_directed_edges, 1)
         tiles_per_group = max(GROUP_FD_BUDGET // (tile_t * k_pad * 4), 1)
         avg_tiles = max(sbt.n_tiles / sbt.n_blocks, 1e-9)
@@ -723,6 +786,7 @@ class ShardedBigClamModel:
                 "tile_t": sbt.tile_t,
                 "nb": sbt.nb,
                 "n_groups": sbt.n_groups,
+                "kc": self._csr_kc,
             }
         else:
             if sbt is None or self._perm is not None:

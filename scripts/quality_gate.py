@@ -96,8 +96,8 @@ def main() -> int:
         "num_seeds": int(len(seeds)),
         # the relaxed clip fit_quality ran with (shared rule — see
         # models.quality.auto_quality_max_p)
-        "quality_max_p_auto": max(
-            cfg.max_p, auto_quality_max_p(n, avg_deg)
+        "quality_max_p_auto": auto_quality_max_p(
+            n, avg_deg, floor=cfg.max_p
         ),
         "device": str(jax.devices()[0]),
         "pass": bool(f1_q >= 0.8),
