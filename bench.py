@@ -36,6 +36,10 @@ import numpy as np
 ENRON = "/root/reference/data/Email-Enron.txt"
 K_ENRON = 100
 LARGE_N, LARGE_K, LARGE_P_IN = 300_000, 1000, 0.1
+# K-blocked single-chip regime: K large enough that whole-K rows are
+# refused by fit_tile_shape (~2500 at the default tile shape) and the
+# csr_grouped_kb path must engage
+XLK_N, XLK_K, XLK_P_IN = 60_000, 3000, 0.5
 WINDOWS = 5
 ITERS_PER_WINDOW = 10
 WARMUP_ITERS = 3
@@ -153,6 +157,40 @@ def main() -> None:
         "xla": {"eps": large_xla_eps, "path": xla_l.engaged_path,
                 "windows": large_xla_windows},
         "csr_over_xla": round(large_eps / large_xla_eps, 2),
+    }
+
+    # --- K-blocked regime: AGM N=60K K=3000 (csr_grouped_kb vs XLA) ---
+    gk, _ = sample_planted_graph(
+        XLK_N, XLK_K, p_in=XLK_P_IN, rng=np.random.default_rng(3)
+    )
+    cfg_k = BigClamConfig(num_communities=XLK_K)
+    Fk = np.random.default_rng(4).integers(
+        0, 2, size=(gk.num_nodes, XLK_K)
+    ).astype(np.float64)
+    model_k = BigClamModel(gk, cfg_k, k_multiple=128)
+    if on_tpu and model_k.engaged_path != "csr_grouped_kb":
+        raise RuntimeError(
+            "benchmark invalid: K-blocked config fell back to "
+            f"{model_k.engaged_path} ({model_k.path_reason})"
+        )
+    xlk_eps, xlk_windows, _ = time_windows(
+        model_k, Fk, 2, LARGE_ITERS_PER_WINDOW, warmup=1
+    )
+    xla_k = BigClamModel(
+        gk, cfg_k.replace(use_pallas_csr=False, use_pallas=False),
+        k_multiple=128,
+    )
+    xlk_xla_eps, xlk_xla_windows, _ = time_windows(
+        xla_k, Fk, 2, LARGE_ITERS_PER_WINDOW, warmup=1
+    )
+    configs["xl_k"] = {
+        "config": f"AGM planted N={gk.num_nodes} "
+                  f"2E={gk.num_directed_edges} K={XLK_K}",
+        "csr": {"eps": xlk_eps, "path": model_k.engaged_path,
+                "windows": xlk_windows},
+        "xla": {"eps": xlk_xla_eps, "path": xla_k.engaged_path,
+                "windows": xlk_xla_windows},
+        "csr_over_xla": round(xlk_eps / xlk_xla_eps, 2),
     }
 
     # --- oracle baseline: exact-semantics iterations on host CPU ---
