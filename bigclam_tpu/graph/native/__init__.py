@@ -81,6 +81,18 @@ def _register(lib: ctypes.CDLL) -> ctypes.CDLL:
         ctypes.c_uint64,
         ctypes.POINTER(ctypes.c_double),
     ]
+    lib.bc_select_seeds_covering.restype = ctypes.c_int64
+    lib.bc_select_seeds_covering.argtypes = [
+        ctypes.POINTER(ctypes.c_int64),
+        ctypes.POINTER(ctypes.c_int32),
+        ctypes.c_int64,
+        ctypes.POINTER(ctypes.c_int64),
+        ctypes.c_int64,
+        ctypes.c_int64,
+        ctypes.c_int64,
+        ctypes.c_int64,
+        ctypes.POINTER(ctypes.c_int64),
+    ]
     return lib
 
 
@@ -120,6 +132,30 @@ def triangle_counts(g) -> np.ndarray:
         out.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
     )
     return out
+
+
+def select_seeds_covering(
+    g, order: np.ndarray, k: int, hops: int, cap: int
+) -> np.ndarray:
+    """Greedy covering walk over the prepared candidate `order` (semantics
+    and slicing bit-identical to ops.seeding.select_seeds_covering's NumPy
+    loop — backend-independent seed choices)."""
+    indptr = np.ascontiguousarray(g.indptr, dtype=np.int64)
+    indices = np.ascontiguousarray(g.indices, dtype=np.int32)
+    order = np.ascontiguousarray(order, dtype=np.int64)
+    out = np.empty(max(int(k), 1), dtype=np.int64)
+    cnt = _lib.bc_select_seeds_covering(
+        indptr.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        indices.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        ctypes.c_int64(g.num_nodes),
+        order.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        ctypes.c_int64(order.size),
+        ctypes.c_int64(int(k)),
+        ctypes.c_int64(int(hops)),
+        ctypes.c_int64(int(cap)),
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+    )
+    return out[:cnt].copy()
 
 
 def triangle_counts_capped(g, cap: int, seed: int = 0) -> np.ndarray:

@@ -200,4 +200,46 @@ void bc_triangle_counts_capped(const int64_t* indptr, const int32_t* indices,
   }
 }
 
+// Greedy coverage-aware seed selection (quality mode's seeding rule;
+// Python reference implementation: ops/seeding.select_seeds_covering).
+// `order` is the caller-prepared candidate ranking (locally-minimal
+// nominees first, then the remaining nodes by ascending phi); the walk
+// skips candidates already covered by a chosen seed's hops-neighborhood.
+// The hops=2 fan caps (stride subsample of N(s), first-`cap` prefix of
+// each N(v)) replicate the NumPy slicing bit-exactly so both backends
+// choose identical seeds. Returns the number of seeds written (<= k).
+int64_t bc_select_seeds_covering(const int64_t* indptr,
+                                 const int32_t* indices, int64_t n,
+                                 const int64_t* order, int64_t n_order,
+                                 int64_t k, int64_t hops, int64_t cap,
+                                 int64_t* seeds_out) {
+  std::vector<uint8_t> covered(n, 0);
+  int64_t cnt = 0;
+  for (int64_t oi = 0; oi < n_order && cnt < k; ++oi) {
+    int64_t s = order[oi];
+    if (s < 0 || s >= n || covered[s]) continue;
+    seeds_out[cnt++] = s;
+    covered[s] = 1;
+    int64_t lo = indptr[s], hi = indptr[s + 1], deg = hi - lo;
+    for (int64_t e = lo; e < hi; ++e) covered[indices[e]] = 1;
+    if (hops >= 2) {
+      // nbrs[::max(deg//cap, 1)][:cap] when deg > cap, else all of N(s)
+      int64_t step = 1, limit = deg;
+      if (deg > cap) {
+        step = deg / cap;
+        if (step < 1) step = 1;
+        limit = cap;
+      }
+      int64_t taken = 0;
+      for (int64_t e = lo; e < hi && taken < limit; e += step, ++taken) {
+        int64_t v = indices[e];
+        int64_t vlo = indptr[v], vcnt = indptr[v + 1] - vlo;
+        if (vcnt > cap) vcnt = cap;                  // row[:cap]
+        for (int64_t f = vlo; f < vlo + vcnt; ++f) covered[indices[f]] = 1;
+      }
+    }
+  }
+  return cnt;
+}
+
 }  // extern "C"

@@ -445,6 +445,8 @@ def select_seeds_covering(
     from later seeding).
     """
     cfg = cfg or BigClamConfig()
+    if k <= 0:
+        return np.empty(0, dtype=np.int64)
     # non-positive caps are meaningless for the 2-hop fan bound (and 0
     # would divide by zero below) — fall back to the built-in default
     cap = cfg.seeding_degree_cap
@@ -457,29 +459,49 @@ def select_seeds_covering(
     )
     phi_fb = np.where(np.isnan(phi), np.inf, np.asarray(phi, np.float64))
     rest = rest[np.lexsort((rest, phi_fb[rest]))]
-    covered = np.zeros(n, dtype=bool)
+    order = np.concatenate([ranked, rest])
+    try:
+        # the candidate walk is a sequential Python loop over up to N
+        # nodes — at Friendster-class N the native walk (same slicing,
+        # bit-identical choices) is the difference between ms and minutes
+        from bigclam_tpu.graph.native import (
+            select_seeds_covering as _native_walk,
+        )
+
+        return _native_walk(g, order, k, hops, cap)
+    except ImportError:
+        pass
+    return _covering_walk_numpy(g, order, k, hops, cap)
+
+
+def _covering_walk_numpy(
+    g: Graph, order: np.ndarray, k: int, hops: int, cap: int
+) -> np.ndarray:
+    """NumPy reference of the covering walk — the native walk
+    (graph/native bc_select_seeds_covering) must stay bit-identical to
+    this loop (tests/test_native.py compares them on this function)."""
+    covered = np.zeros(g.num_nodes, dtype=bool)
     indptr, indices = g.indptr, g.indices
     out = []
-    for cand in (ranked, rest):
-        for s in cand:
-            s = int(s)
-            if covered[s]:
-                continue
-            out.append(s)
-            covered[s] = True
-            nbrs = indices[indptr[s] : indptr[s + 1]]
-            covered[nbrs] = True
-            if hops >= 2:
-                # hub guard: the 2-hop marking of one seed costs
-                # sum_{v in N(s)} deg(v); cap both fans like the sampled
-                # conductance scorer does
-                if nbrs.size > cap:
-                    nbrs = nbrs[:: max(nbrs.size // cap, 1)][:cap]
-                for v in nbrs:
-                    covered[indices[indptr[v] : indptr[v + 1]][:cap]] = True
-            if len(out) >= k:
-                return np.asarray(out, dtype=np.int64)
-    return np.asarray(out, dtype=np.int64)   # graph fully covered before K
+    for s in order:
+        s = int(s)
+        if covered[s]:
+            continue
+        out.append(s)
+        covered[s] = True
+        nbrs = indices[indptr[s] : indptr[s + 1]]
+        covered[nbrs] = True
+        if hops >= 2:
+            # hub guard: the 2-hop marking of one seed costs
+            # sum_{v in N(s)} deg(v); cap both fans like the sampled
+            # conductance scorer does
+            if nbrs.size > cap:
+                nbrs = nbrs[:: max(nbrs.size // cap, 1)][:cap]
+            for v in nbrs:
+                covered[indices[indptr[v] : indptr[v + 1]][:cap]] = True
+        if len(out) >= k:
+            break
+    return np.asarray(out, dtype=np.int64)   # may be < k: fully covered
 
 
 def init_F(

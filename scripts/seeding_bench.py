@@ -78,6 +78,13 @@ def main() -> int:
     seeds = seeding.rank_seeds(g, phi, cfg)
     t_rank = time.time() - t0
 
+    # quality mode's covering walk (select_seeds_covering, native when the
+    # .so built) at a Friendster-class K
+    k_cover = 25_000
+    t0 = time.time()
+    cover = seeding.select_seeds_covering(g, phi, k_cover, cfg, hops=2)
+    t_cover = time.time() - t0
+
     # device backend (C5 past the dense bound): same splitmix sampler, so
     # the estimates must agree with the host backends
     import jax
@@ -104,10 +111,12 @@ def main() -> int:
             "triangle_counts_capped": round(t_tri, 1),
             "conductance_total": round(t_phi, 1),
             "rank_seeds": round(t_rank, 1),
+            "covering_walk_k25000": round(t_cover, 1),
         },
         "tri_edges_per_sec": round(e / t_tri, 1),
         "seeding_edges_per_sec": round(e / (t_phi + t_rank), 1),
         "num_seeds": int(seeds.size),
+        "num_covering_seeds": int(cover.size),
         "tri_mean": float(np.mean(tri)),
     }
     if t_dev is not None:

@@ -624,3 +624,47 @@ class TestGroupedCSR:
         np.testing.assert_allclose(
             float(s_grp.llh), float(s_ref.llh), rtol=1e-5
         )
+
+
+def test_largest_fitting_kblock_policy():
+    """The shared large-K policy: kc divides k_pad, is a 128-multiple, its
+    shape fits VMEM, and no larger qualifying divisor exists."""
+    from bigclam_tpu.ops.pallas_csr import (
+        fit_tile_shape,
+        largest_fitting_kblock,
+    )
+
+    for k_pad in (2560, 3072, 5120, 25600):
+        if fit_tile_shape(256, 512, k_pad) is not None:
+            continue                      # whole-K fits; policy not needed
+        kc, shape = largest_fitting_kblock(256, 512, k_pad)
+        assert kc % 128 == 0 and k_pad % kc == 0 and kc < k_pad
+        assert fit_tile_shape(256, 512, kc) == shape
+        for d in range(kc // 128 + 1, k_pad // 128):
+            if (k_pad // 128) % d == 0:
+                assert fit_tile_shape(256, 512, 128 * d) is None, (k_pad, d)
+
+
+def test_sharded_auto_kblock_engagement(rng):
+    """K_loc beyond the VMEM bound auto-engages csr_grouped_kb on the
+    sharded trainer (construction-time decision; kernels run on TPU)."""
+    import jax
+    from bigclam_tpu.parallel import ShardedBigClamModel, make_mesh
+
+    g = _random_graph(rng, n=71)
+    for tp, expect_kloc in ((1, 3072), (2, 1536)):
+        mesh = make_mesh((2, tp), jax.devices()[: 2 * tp])
+        m = ShardedBigClamModel(
+            g,
+            BigClamConfig(num_communities=3000, use_pallas_csr=True),
+            mesh,
+        )
+        k_loc = m.k_pad // tp
+        assert k_loc == expect_kloc
+        if tp == 1:
+            # K_loc 3072 exceeds the VMEM bound -> K-blocked
+            assert m.engaged_path == "csr_grouped_kb"
+            assert m._csr_kc == 1536
+        else:
+            # K_loc 1536 fits whole -> plain grouped/flat TP, no K blocks
+            assert m._csr_kc == 0
