@@ -418,12 +418,31 @@ def rank_seeds(g: Graph, phi: np.ndarray, cfg: Optional[BigClamConfig] = None
     return cand[rank]
 
 
+def covering_order(
+    g: Graph, phi: np.ndarray, cfg: Optional[BigClamConfig] = None
+) -> np.ndarray:
+    """Candidate order for the covering walk: locally-minimal nominees
+    first (rank_seeds), then every remaining node by ascending (phi, id)
+    with NaN phi sorted last. The single source for both walk backends
+    and the seeding bench."""
+    cfg = cfg or BigClamConfig()
+    n = g.num_nodes
+    ranked = rank_seeds(g, phi, cfg)
+    rest = np.setdiff1d(
+        np.arange(n, dtype=np.int64), ranked, assume_unique=False
+    )
+    phi_fb = np.where(np.isnan(phi), np.inf, np.asarray(phi, np.float64))
+    rest = rest[np.lexsort((rest, phi_fb[rest]))]
+    return np.concatenate([ranked, rest])
+
+
 def select_seeds_covering(
     g: Graph,
     phi: np.ndarray,
     k: int,
     cfg: Optional[BigClamConfig] = None,
     hops: int = 1,
+    order: Optional[np.ndarray] = None,
 ) -> np.ndarray:
     """Coverage-aware seed selection (quality mode's seeding rule).
 
@@ -452,14 +471,8 @@ def select_seeds_covering(
     cap = cfg.seeding_degree_cap
     if not cap or cap <= 0:
         cap = 256
-    n = g.num_nodes
-    ranked = rank_seeds(g, phi, cfg)
-    rest = np.setdiff1d(
-        np.arange(n, dtype=np.int64), ranked, assume_unique=False
-    )
-    phi_fb = np.where(np.isnan(phi), np.inf, np.asarray(phi, np.float64))
-    rest = rest[np.lexsort((rest, phi_fb[rest]))]
-    order = np.concatenate([ranked, rest])
+    if order is None:
+        order = covering_order(g, phi, cfg)
     try:
         # the candidate walk is a sequential Python loop over up to N
         # nodes — at Friendster-class N the native walk (same slicing,

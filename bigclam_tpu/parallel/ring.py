@@ -262,7 +262,120 @@ def make_ring_csr_train_step(
     block_b = tiles["block_b"]
     tile_t = tiles["tile_t"]
     n_blocks = tiles["n_blocks"]
+    kc = tiles.get("kc", 0)
     num_s = len(cfg.step_candidates)
+
+    def step_shard_kb(F_loc, srcl, dstl, mask, bid, it):
+        # K-BLOCKED ring phases (K_loc > the kernels' VMEM bound): inside
+        # each phase, a lax.scan over this device's kc-column K blocks
+        # accumulates the partial edge dots against the ROTATING F shard,
+        # one psum over "k" completes them (identity at tp == 1), and a
+        # per-K-block consume stage builds that phase's gradient columns.
+        # Same composition as ops.pallas_csr
+        # .train_pass_csr_grouped_kblocked_tp, with ring buckets in place
+        # of block groups.
+        srcl, dstl, mask, bid = srcl[0], dstl[0], mask[0], bid[0]
+        n_loc, k_loc = F_loc.shape
+        n_kb = k_loc // kc
+        nt = srcl.shape[1]                   # tiles per phase bucket
+        adt = jnp.dtype(cfg.accum_dtype) if cfg.accum_dtype else F_loc.dtype
+        sumF = lax.psum(F_loc.sum(axis=0), NODES_AXIS)       # (K_loc,)
+
+        def td_of(xs):
+            s, d, m, b_ = xs
+            td = TilesDev(
+                src_local=s, dst=d, mask=m, block_id=b_,
+                block_b=block_b, tile_t=tile_t, n_blocks=n_blocks,
+            )
+            return td, d
+
+        def fd_of(F_rot, d, kb):
+            cols = lax.dynamic_slice_in_dim(F_rot, kb * kc, kc, axis=1)
+            return jnp.take(cols, d, axis=0)             # (nt, T, kc)
+
+        # --- rotation 1: K-block dots -> psum -> per-K-block consume ---
+        def grad_phase(carry, xs):
+            F_rot, gn_acc, ln_acc = carry
+            td, d = td_of(xs)
+
+            def dots_kb(x_acc, kb):
+                F_kb = lax.dynamic_slice_in_dim(F_loc, kb * kc, kc, axis=1)
+                x_kb = edge_dots_csr(
+                    F_kb, td, fd_of(F_rot, d, kb), interpret=interp
+                )
+                return x_acc + x_kb, None
+
+            x_loc, _ = lax.scan(
+                dots_kb, jnp.zeros((nt, 1, tile_t), F_loc.dtype),
+                jnp.arange(n_kb),
+            )
+            x = lax.psum(x_loc, K_AXIS)
+
+            def consume_kb(_, kb):
+                gn_kb, ln_kb = grad_nbr_from_x_csr(
+                    x, td, fd_of(F_rot, d, kb), cfg, interpret=interp
+                )
+                return None, (gn_kb, ln_kb)
+
+            _, (gns, lns) = lax.scan(consume_kb, None, jnp.arange(n_kb))
+            gn = gns.transpose(1, 0, 2).reshape(n_loc, k_loc)
+            F_rot = lax.ppermute(F_rot, NODES_AXIS, perm)
+            # ln depends only on the (already global) x — identical across
+            # K blocks
+            return (F_rot, gn_acc + gn, ln_acc + lns[0]), None
+
+        init = (
+            F_loc,
+            _mark_varying(
+                jnp.zeros((n_loc, k_loc), F_loc.dtype), (NODES_AXIS, K_AXIS)
+            ),
+            _mark_varying(jnp.zeros(n_loc, F_loc.dtype), (NODES_AXIS,)),
+        )
+        (F_back, gn, ln), _ = lax.scan(
+            grad_phase, init, (srcl, dstl, mask, bid)
+        )
+        grad = gn - sumF[None, :] + F_loc
+        node_llh = ln.astype(adt) + (
+            -lax.psum(F_loc @ sumF, K_AXIS) + _rowdot(F_loc, F_loc)
+        ).astype(adt)
+        llh_cur = lax.psum(node_llh.sum(), NODES_AXIS)
+
+        # --- rotation 2: candidate K-block dots -> psum -> consume ---
+        def cand_phase(carry, xs):
+            F_rot, cn_acc = carry
+            td, d = td_of(xs)
+
+            def cdots_kb(xc_acc, kb):
+                F_kb = lax.dynamic_slice_in_dim(F_loc, kb * kc, kc, axis=1)
+                g_kb = lax.dynamic_slice_in_dim(grad, kb * kc, kc, axis=1)
+                xc_kb = cand_dots_csr(
+                    F_kb, g_kb, td, fd_of(F_rot, d, kb), cfg,
+                    interpret=interp,
+                )
+                return xc_acc + xc_kb, None
+
+            xc_loc, _ = lax.scan(
+                cdots_kb, jnp.zeros((nt, num_s, tile_t), F_loc.dtype),
+                jnp.arange(n_kb),
+            )
+            xc = lax.psum(xc_loc, K_AXIS)
+            cb = cand_nbr_from_x_csr(xc, td, cfg, interpret=interp)
+            F_rot = lax.ppermute(F_rot, NODES_AXIS, perm)
+            return (F_rot, cn_acc + cb), None
+
+        initc = (
+            F_back,
+            _mark_varying(
+                jnp.zeros((num_s, n_loc), F_loc.dtype), (NODES_AXIS,)
+            ),
+        )
+        (_, cb), _ = lax.scan(cand_phase, initc, (srcl, dstl, mask, bid))
+        F_new, sum_loc, hist = armijo_tail_select_sharded(
+            F_loc, grad, node_llh, cb.astype(adt), sumF, cfg, with_stats=True
+        )
+        sumF_new = lax.psum(sum_loc, NODES_AXIS)
+        hist = lax.psum(hist, NODES_AXIS)
+        return F_new, sumF_new, llh_cur.astype(F_loc.dtype), it + 1, hist
 
     def step_shard_tp(F_loc, srcl, dstl, mask, bid, it):
         srcl, dstl, mask, bid = srcl[0], dstl[0], mask[0], bid[0]
@@ -405,7 +518,9 @@ def make_ring_csr_train_step(
 
     def step(state: TrainState, srcl, dstl, mask, bid) -> TrainState:
         F_new, sumF, llh, it, hist = jax.shard_map(
-            step_shard_tp if tp > 1 else step_shard,
+            step_shard_kb
+            if kc
+            else (step_shard_tp if tp > 1 else step_shard),
             mesh=mesh,
             in_specs=(
                 P(NODES_AXIS, K_AXIS),
@@ -442,12 +557,14 @@ class RingBigClamModel(ShardedBigClamModel):
 
     @property
     def engaged_path(self) -> str:
-        """Ring CSR reports a DISTINCT label: its comm/memory profile
+        """Ring CSR reports DISTINCT labels: its comm/memory profile
         (ppermute rotations, O(N/dp) peak HBM) is nothing like the
         all-gather sharded "csr" schedule, and metrics/bench records must
-        tell them apart (ADVICE round-2)."""
-        path = super().engaged_path
-        return "csr_ring" if path == "csr" else path
+        tell them apart (ADVICE round-2). csr_ring_kb = K-blocked phases
+        (K_loc beyond the kernels' VMEM bound)."""
+        if not self._csr_wanted:
+            return "xla"
+        return "csr_ring_kb" if getattr(self, "_csr_kc", 0) else "csr_ring"
 
     def _csr_economy_ok(self, dp: int) -> bool:
         """Probe the ring tile layout: dp*dp buckets padded to the max tile
@@ -459,30 +576,17 @@ class RingBigClamModel(ShardedBigClamModel):
             ring_block_tiles,
         )
 
-        if getattr(self, "_csr_kc", 0):
-            # K_loc beyond the VMEM bound engages the K-blocked pass on the
-            # all-gather trainer; the ring step has no K-blocked variant
-            # yet (PARITY.md deferred) — refuse rather than mis-build
-            if self.cfg.use_pallas_csr is True:
-                raise ValueError(
-                    "use_pallas_csr=True on the ring trainer requires "
-                    f"K_loc <= the VMEM bound (K-blocked ring not "
-                    f"implemented; K_loc={self._csr_k_pad // self.mesh.shape[K_AXIS]}); "
-                    "raise tp, or use the all-gather trainer"
-                )
-            self._csr_reason = (
-                f"K-blocked ring pass not implemented (kc={self._csr_kc}); "
-                "the all-gather trainer covers this K"
-            )
-            return False
-
         block_b, tile_t = self._csr_shape
         n_pad = _round_up(max(self.g.num_nodes, dp), dp * block_b)
         rbt = ring_block_tiles(self.g, dp, n_pad, block_b, tile_t)
         e = max(self.g.num_directed_edges, 1)
         n_tiles = rbt.src_local.shape[2]
-        # fd columns are per-device: K_loc under a sharded K axis
-        k_loc = self._csr_k_pad // self.mesh.shape[K_AXIS]
+        # fd columns materialized per phase: kc when the K axis is
+        # processed in blocks (step_shard_kb gathers one K block at a
+        # time), else K_loc
+        k_loc = getattr(self, "_csr_kc", 0) or (
+            self._csr_k_pad // self.mesh.shape[K_AXIS]
+        )
         phase_fd = n_tiles * tile_t * k_loc * 4
         pad_ok = layout_economical(
             rbt.slots, e, dp * dp * rbt.n_blocks, tile_t
@@ -536,6 +640,7 @@ class RingBigClamModel(ShardedBigClamModel):
             "block_b": rbt.block_b,
             "tile_t": rbt.tile_t,
             "n_blocks": rbt.n_blocks,
+            "kc": getattr(self, "_csr_kc", 0),
         }
         self.edges = None
         self._tiles_dev = tiles                  # kept for rebuild_step

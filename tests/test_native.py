@@ -88,11 +88,7 @@ def test_select_seeds_covering_matches_numpy(facebook_graph):
     g = facebook_graph
     cfg = BigClamConfig(num_communities=50, seeding_degree_cap=16)
     phi = seeding.conductance(g, backend="numpy")
-    ranked = seeding.rank_seeds(g, phi, cfg)
-    rest = np.setdiff1d(np.arange(g.num_nodes, dtype=np.int64), ranked)
-    phi_fb = np.where(np.isnan(phi), np.inf, phi)
-    rest = rest[np.lexsort((rest, phi_fb[rest]))]
-    order = np.concatenate([ranked, rest])
+    order = seeding.covering_order(g, phi, cfg)   # the production prep
     for hops in (1, 2):
         # facebook has hub nodes, so the cap/stride paths are exercised
         got = native.select_seeds_covering(g, order, 50, hops, 16)
