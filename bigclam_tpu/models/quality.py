@@ -67,6 +67,8 @@ com-Orkut scale; a device-side kick is a pod-scale follow-up.
 from __future__ import annotations
 
 import dataclasses
+import os
+import shutil
 from typing import Callable, List, Optional, Tuple
 
 import numpy as np
@@ -111,10 +113,13 @@ def fit_quality(
 
     `checkpoints` (utils.checkpoint.CheckpointManager) is used at CYCLE
     granularity: after each cycle the kept F is saved under step=cycle and
-    a restart resumes from the newest cycle (within-cycle checkpointing is
-    not combined with quality mode — a cycle is one bounded fit). Noise is
+    a restart resumes from the newest cycle. With cfg.checkpoint_every > 0
+    each cycle's fit ADDITIONALLY checkpoints within the cycle (under
+    checkpoints.directory/cycle_<c>/, deleted once the cycle is
+    journaled — the sweep's per-K pattern), so a crash deep inside a long
+    cycle resumes inside it instead of restarting the cycle. Noise is
     drawn from per-cycle streams ([cfg.seed, 0x5EED, cycle]) so resume
-    reproduces the uninterrupted schedule exactly.
+    reproduces the uninterrupted schedule exactly either way.
 
     `kick_cols` restricts the noise kick to F[:, :kick_cols] (default: all
     columns). The K-sweep passes the active K here — its F buffer is sized
@@ -217,7 +222,15 @@ def fit_quality(
             F_try[:, :kc] = np.clip(
                 F_try[:, :kc] + kick, cfg.min_f, cfg.max_f
             )
-            res = model.fit(F_try, callback=callback)
+            cyc_ckpt = cyc_dir = None
+            if checkpoints is not None and cfg.checkpoint_every > 0:
+                from bigclam_tpu.utils.checkpoint import CheckpointManager
+
+                cyc_dir = os.path.join(
+                    checkpoints.directory, f"cycle_{cycle:05d}"
+                )
+                cyc_ckpt = CheckpointManager(cyc_dir)
+            res = model.fit(F_try, callback=callback, checkpoints=cyc_ckpt)
             total_iters += res.num_iters
             cycles_llh.append(res.llh)
             prev_best = best.llh if best is not None else None
@@ -242,6 +255,10 @@ def fit_quality(
                             "kick_cols": kc,
                         },
                     )
+                    if cyc_dir is not None:
+                        # journaled: the cycle's within-fit checkpoints are
+                        # spent (and must not leak into a later cycle)
+                        shutil.rmtree(cyc_dir, ignore_errors=True)
             if gainless >= cfg.restart_patience:
                 break
     finally:

@@ -246,3 +246,53 @@ def test_quality_kick_cols_keeps_padding_inert(planted):
     assert np.any(F[:, :k0] > 0.0)
     with pytest.raises(ValueError, match="kick_cols"):
         fit_quality(model, F0, kick_cols=k + 1)
+
+
+def test_quality_within_cycle_checkpoint_resume(planted, tmp_path):
+    """With cfg.checkpoint_every > 0, a crash DEEP INSIDE a cycle resumes
+    inside that cycle (checkpoints.directory/cycle_<c>/) and reproduces
+    the uninterrupted schedule exactly; journaled cycles delete their
+    within-cycle dirs."""
+    import os
+
+    from bigclam_tpu.utils.checkpoint import CheckpointManager
+
+    g, truth = planted
+    k = len(truth)
+    cfg = BigClamConfig(
+        num_communities=k, quality_mode=True, restart_cycles=3,
+        restart_tol=0.0, checkpoint_every=2,
+        # pin the relaxed clip at parity so the manual partial-cycle fit
+        # below (plain model.fit) runs the identical step
+        quality_max_p=0.9999,
+        use_pallas=False, use_pallas_csr=False,
+    )
+    seeds = seeding.conductance_seeds(g, cfg)
+    F0 = seeding.init_F(g, seeds, cfg, np.random.default_rng(0))
+    model = BigClamModel(g, cfg)
+
+    ref = fit_quality(model, F0, checkpoints=CheckpointManager(
+        str(tmp_path / "ref")))
+
+    # simulate a crash 3 iterations into cycle 0: run the cycle's fit by
+    # hand with a small max_iters, leaving its within-cycle checkpoint
+    cm = CheckpointManager(str(tmp_path / "q"))
+    avg_deg = g.num_directed_edges / g.num_nodes
+    eps = min(0.02, cfg.init_noise_mass * (avg_deg + 1.0) / g.num_nodes)
+    kick = np.random.default_rng([cfg.seed, 0x5EED, 0]).uniform(
+        0.0, eps, size=F0.shape
+    )
+    F_try = np.clip(F0 + kick, cfg.min_f, cfg.max_f)
+    partial = BigClamModel(
+        g, cfg.replace(conv_tol=cfg.quality_conv_tol, max_iters=3)
+    )
+    partial.fit(F_try, checkpoints=CheckpointManager(
+        str(tmp_path / "q" / "cycle_00000")))
+    assert os.path.exists(str(tmp_path / "q" / "cycle_00000"))
+
+    resumed = fit_quality(model, F0, checkpoints=cm)
+    np.testing.assert_allclose(resumed.cycles_llh, ref.cycles_llh, rtol=0)
+    np.testing.assert_allclose(resumed.fit.F, ref.fit.F, rtol=0, atol=0)
+    # journaled cycles cleaned their within-cycle dirs
+    assert not os.path.exists(str(tmp_path / "q" / "cycle_00000"))
+    assert not os.path.exists(str(tmp_path / "q" / "cycle_00002"))
