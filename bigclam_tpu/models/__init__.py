@@ -5,7 +5,11 @@ from bigclam_tpu.models.bigclam import (
     prepare_graph,
 )
 from bigclam_tpu.models.model_selection import SweepResult, build_kset, sweep_k
-from bigclam_tpu.models.quality import QualityResult, fit_quality
+from bigclam_tpu.models.quality import (
+    QualityResult,
+    fit_quality,
+    fit_quality_device,
+)
 
 __all__ = [
     "BigClamModel",
@@ -17,4 +21,5 @@ __all__ = [
     "sweep_k",
     "QualityResult",
     "fit_quality",
+    "fit_quality_device",
 ]

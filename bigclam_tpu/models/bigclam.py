@@ -86,7 +86,11 @@ def log_engaged_path(model_name: str, path: str, reason: str = "") -> None:
     why = (
         f" ({reason})"
         if reason
-        and path not in ("csr", "csr_grouped", "csr_grouped_kb", "csr_ring")
+        and path
+        not in (
+            "csr", "csr_grouped", "csr_grouped_kb", "csr_ring",
+            "csr_ring_kb",
+        )
         else ""
     )
     print(
@@ -202,12 +206,12 @@ def run_fit_loop(
     state: TrainState,
     cfg: BigClamConfig,
     callback: Optional[Callable[[int, float], None]],
-    extract_F: Callable[[TrainState], np.ndarray],
+    extract_F: Optional[Callable[[TrainState], np.ndarray]],
     checkpoints=None,
     state_to_arrays: Optional[Callable[[TrainState], dict]] = None,
     initial_hist: tuple = (),
     ckpt_meta: Optional[dict] = None,
-) -> FitResult:
+):
     """Shared convergence loop (MBSGD semantics, Bigclamv2.scala:203-219),
     used by both the single-chip and the sharded trainer.
 
@@ -225,6 +229,11 @@ def run_fit_loop(
     with the accepted-step histogram of the update applied this iteration
     ({"accept_hist": [count per step_candidates entry..., rejected]});
     2-parameter callbacks keep the (it, llh) protocol.
+
+    With extract_F=None the loop runs STATE-RESIDENT: it returns
+    (final_state, final_llh, num_iters, llh_history) and never fetches F
+    to the host — the trainers' fit_state and the device-resident quality
+    annealing (models.quality.fit_quality_device) build on this.
     """
     import inspect
 
@@ -294,6 +303,11 @@ def run_fit_loop(
         # hit max_iters without converging; prev_state is the last state
         # whose LLH was actually evaluated (hist[-1])
         final, final_llh, iters = prev_state, hist[-1], int(prev_state.it)
+    if extract_F is None:
+        # state-resident mode (fit_state / device annealing): hand back the
+        # converged TrainState with NO host F fetch — the only scalars
+        # crossing the host boundary were the per-iteration LLHs
+        return final, final_llh, iters, tuple(hist)
     F = extract_F(final)
     return FitResult(
         F=F, sumF=F.sum(axis=0), llh=final_llh,
@@ -764,6 +778,11 @@ class BigClamModel:
             ),
         )
 
+    def extract_F(self, state: TrainState) -> np.ndarray:
+        """Fetch the live (num_nodes, K) F block to the host."""
+        n, k = self.g.num_nodes, self.cfg.num_communities
+        return np.asarray(state.F[:n, :k])
+
     def _ckpt_meta(self) -> dict:
         return {
             "num_nodes": self.g.num_nodes,
@@ -801,7 +820,6 @@ class BigClamModel:
         """Train to convergence (see run_fit_loop). If `checkpoints` (a
         utils.checkpoint.CheckpointManager) holds a saved state, training
         resumes from it; F0 is only the cold-start init."""
-        n, k = self.g.num_nodes, self.cfg.num_communities
         state, hist = self.init_state(F0), ()
         if checkpoints is not None:
             restored, hist = restore_checkpoint(
@@ -814,11 +832,24 @@ class BigClamModel:
             state,
             self.cfg,
             callback,
-            lambda st: np.asarray(st.F[:n, :k]),
+            self.extract_F,
             checkpoints=checkpoints,
             state_to_arrays=self._state_to_arrays,
             initial_hist=hist,
             ckpt_meta=self._ckpt_meta(),
+        )
+
+    def fit_state(
+        self,
+        state: TrainState,
+        callback: Optional[Callable[[int, float], None]] = None,
+    ):
+        """Train to convergence from a DEVICE-RESIDENT TrainState, returning
+        (final_state, final_llh, num_iters, llh_history) without fetching F
+        to the host — the pod-scale entry point (fit() wraps init_state +
+        host extraction around the same loop)."""
+        return run_fit_loop(
+            self._step, state, self.cfg, callback, None
         )
 
     def random_init(self, seed: Optional[int] = None) -> np.ndarray:

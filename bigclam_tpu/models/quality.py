@@ -90,6 +90,45 @@ def auto_quality_max_p(
     return min(max(floor, 1.0 - 1.0 / amp), 1.0 - 1e-6)
 
 
+def _relax_params(model, n_live: int) -> Tuple[float, float]:
+    """(relaxed MAX_P_, kick scale eps) for this model's graph — shared by
+    the host (fit_quality) and device (fit_quality_device) annealing loops.
+
+    MAX_P_ relaxation: the clip caps the gradient's 1/(1-p) neighbor
+    amplification; a noise-level column entry at node u only grows when
+    deg(u)*amp > N (its neighbor term must beat -sumF), so the parity
+    0.9999 freezes every kick dead once N > 1e4*avg_deg (the K=5000
+    gate's original failure: 4 gainless cycles, F1 0.001). Auto rule in
+    auto_quality_max_p; explicit overrides validated against the f32
+    floor here. Kick scale: the kick's per-column sumF contribution
+    (~eps*N/2) must stay comparable to one seeded ego-net column's mass
+    (~avg_degree + 1) regardless of N (see config.init_noise).
+    """
+    cfg = model.cfg
+    avg_deg = model.g.num_directed_edges / max(model.g.num_nodes, 1)
+    max_p_q = cfg.quality_max_p
+    if max_p_q is None:
+        max_p_q = auto_quality_max_p(
+            model.g.num_nodes, avg_deg, floor=cfg.max_p
+        )
+    elif not (0.0 < max_p_q <= 1.0 - 1e-6):
+        # beyond 1-1e-6 the f32 clip collapses 1-p to 0: log(1-p) = -inf
+        # poisons every cycle's LLH and NaN defeats the patience stop —
+        # fail fast instead of burning restart_cycles of chip time
+        raise ValueError(
+            f"quality_max_p={max_p_q} out of range (need 0 < p <= 1-1e-6, "
+            "the smallest 1-p exactly representable around f32 1.0)"
+        )
+    eps = (
+        cfg.init_noise
+        if cfg.init_noise is not None
+        else min(
+            0.02, cfg.init_noise_mass * (avg_deg + 1.0) / max(n_live, 1)
+        )
+    )
+    return max_p_q, eps
+
+
 @dataclasses.dataclass(frozen=True)
 class QualityResult:
     fit: FitResult            # best-LLH cycle's result
@@ -169,49 +208,19 @@ def fit_quality(
     # patience state survives resume (persisted in the checkpoint meta) so
     # the resumed schedule stops exactly where the uninterrupted one would
     gainless = restored_gainless
-    # model.g is part of the trainer contract (all three trainers have it)
-    avg_deg = model.g.num_directed_edges / max(model.g.num_nodes, 1)
-    # MAX_P_ relaxation: the clip caps the gradient's 1/(1-p) neighbor
-    # amplification; a noise-level column entry at node u grows only when
-    # deg(u)*amp > N (neighbor term vs -sumF), so the parity 0.9999 freezes
-    # every kick dead once N > 1e4*avg_deg (the K=5000 gate's exact failure:
-    # 4 gainless cycles, F1 0.001). Auto rule: amp = 16*N/avg_deg (16x
-    # headroom covers deg down to avg/16), floored at the parity max_p,
-    # ceilinged at 1 - 1e-6 — the smallest 1-p still exactly representable
-    # around f32 1.0 (~8 ulps), which bounds quality mode at
-    # N <~ 1e6*avg_deg until the kernels take an f64 clip path
-    max_p_q = cfg.quality_max_p
-    if max_p_q is None:
-        max_p_q = auto_quality_max_p(
-            model.g.num_nodes, avg_deg, floor=cfg.max_p
-        )
-    elif not (0.0 < max_p_q <= 1.0 - 1e-6):
-        # beyond 1-1e-6 the f32 clip collapses 1-p to 0: log(1-p) = -inf
-        # poisons every cycle's LLH and NaN defeats the patience stop —
-        # fail fast instead of burning restart_cycles of chip time
-        raise ValueError(
-            f"quality_max_p={max_p_q} out of range (need 0 < p <= 1-1e-6, "
-            "the smallest 1-p exactly representable around f32 1.0)"
-        )
+    max_p_q, eps = _relax_params(model, n)
     rebuilt = False
     try:
         # within-cycle fits use the TIGHTER quality_conv_tol (host-side
         # only); the max_p swap changes step-baked constants, so the step
         # is recompiled — same kernels/schedule, different clip bound
+        # (cached by step_cfg_key)
         model.cfg = cfg.replace(
             conv_tol=cfg.quality_conv_tol, max_p=max_p_q
         )
         if max_p_q != cfg.max_p:
             model.rebuild_step()
             rebuilt = True
-        # auto noise scale: the kick's per-column sumF contribution
-        # (~eps*N/2) must stay comparable to one seeded ego-net column's
-        # mass (~avg_degree + 1) regardless of N (see config.init_noise)
-        eps = (
-            cfg.init_noise
-            if cfg.init_noise is not None
-            else min(0.02, cfg.init_noise_mass * (avg_deg + 1.0) / max(n, 1))
-        )
         for cycle in range(start_cycle, max_cycles):
             if gainless >= cfg.restart_patience:
                 break          # a restored run that already tripped
@@ -267,6 +276,133 @@ def fit_quality(
             model.rebuild_step()           # restore the parity-clip step
     return QualityResult(
         fit=best,
+        cycles_llh=tuple(cycles_llh),
+        num_cycles=len(cycles_llh),
+        total_iters=total_iters,
+    )
+
+
+def fit_quality_device(
+    model,
+    F0: np.ndarray,
+    callback: Optional[Callable[[int, float], None]] = None,
+    kick_cols: Optional[int] = None,
+) -> QualityResult:
+    """DEVICE-RESIDENT annealing: the pod-scale variant of fit_quality.
+
+    The host loop round-trips the full (N, K) F to the host every cycle
+    (res.F out, kicked F_try back in) — at com-Orkut scale (N=3.07M,
+    K=15000, 184 GB global F) that F does not even fit one host. Here the
+    state stays sharded on the devices for the WHOLE schedule: one
+    init_state upload, then per cycle a jitted on-device kick (uniform
+    noise masked to the live (num_nodes, kick_cols) region — padding rows
+    and columns stay on their inert zeros) and the trainers' state-resident
+    loop (fit_state); only per-iteration LLH scalars cross the host
+    boundary. The final best F is fetched once at the end.
+
+    Differences from fit_quality, by design: the kick noise comes from
+    jax.random (threefry, folded per cycle) instead of the host NumPy
+    streams — deterministic for a fixed seed/mesh but NOT bit-identical to
+    the host schedule; checkpointing is not wired (a checkpoint IS a host
+    fetch — use the host loop where checkpointing matters more than
+    transfer cost). Stop rule, patience, MAX_P_ relaxation, and the kept-
+    LLH semantics are identical (shared _relax_params).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from bigclam_tpu.models.bigclam import TrainState
+
+    cfg = model.cfg
+    n, k = F0.shape
+    kc = k if kick_cols is None else int(kick_cols)
+    if not (0 < kc <= k):
+        raise ValueError(f"kick_cols={kick_cols} out of range for K={k}")
+    max_cycles = max(cfg.restart_cycles, 1)
+    max_p_q, eps = _relax_params(model, n)
+
+    state0 = model.init_state(F0)          # the ONE host->device upload
+    n_pad, k_pad = state0.F.shape
+    num_hist = len(cfg.step_candidates) + 1
+
+    @jax.jit
+    def kick_fn(F, key):
+        # full-shape uniform noise, masked to the live region: shards with
+        # F under whatever mesh the trainer compiled (threefry is
+        # partitionable), and the phantom rows/columns stay exactly zero
+        live = (jnp.arange(n_pad) < n)[:, None] & (
+            jnp.arange(k_pad) < kc
+        )[None, :]
+        noise = jax.random.uniform(
+            key, F.shape, F.dtype, 0.0, eps
+        )
+        return jnp.clip(
+            F + jnp.where(live, noise, 0.0), cfg.min_f, cfg.max_f
+        )
+
+    def fresh_state(F):
+        return TrainState(
+            F=F,
+            sumF=F.sum(axis=0),
+            llh=jnp.asarray(-jnp.inf, F.dtype),
+            it=jnp.zeros((), jnp.int32),
+            accept_hist=jnp.zeros(num_hist, jnp.int32),
+        )
+
+    cfg_saved = model.cfg
+    rebuilt = False
+    cycles_llh: List[float] = []
+    best_state = None
+    best_llh = None
+    total_iters = 0
+    gainless = 0
+    F_cur = state0.F
+    base_key = jax.random.key(
+        np.uint32(cfg.seed ^ 0x5EED).item()
+    )
+    try:
+        model.cfg = cfg.replace(
+            conv_tol=cfg.quality_conv_tol, max_p=max_p_q
+        )
+        if max_p_q != cfg.max_p:
+            model.rebuild_step()
+            rebuilt = True
+        best_iters, best_hist = 0, ()
+        for cycle in range(max_cycles):
+            F_try = kick_fn(F_cur, jax.random.fold_in(base_key, cycle))
+            final, llh, iters, hist = model.fit_state(
+                fresh_state(F_try), callback=callback
+            )
+            del F_try                      # free the kicked input buffer
+            total_iters += iters
+            cycles_llh.append(llh)
+            prev_best = best_llh
+            if best_llh is None or llh > best_llh:
+                best_state, best_llh = final, llh
+                best_iters, best_hist = iters, hist
+                F_cur = final.F            # kick accepted: anneal from here
+            # a rejected cycle's converged state must not stay live through
+            # the next cycle — at pod scale that extra F-sized buffer is
+            # the difference between fitting and OOM
+            del final
+            if prev_best is not None and prev_best != 0.0:
+                gain = (best_llh - prev_best) / abs(prev_best)
+                gainless = gainless + 1 if gain < cfg.restart_tol else 0
+            if gainless >= cfg.restart_patience:
+                break
+    finally:
+        model.cfg = cfg_saved
+        if rebuilt:
+            model.rebuild_step()
+    F_best = model.extract_F(best_state)   # the ONE device->host fetch
+    # same FitResult contract as the host loop: the BEST cycle's iteration
+    # count and LLH trace (total_iters lives on the QualityResult)
+    fit = FitResult(
+        F=F_best, sumF=F_best.sum(axis=0), llh=best_llh,
+        num_iters=best_iters, llh_history=best_hist,
+    )
+    return QualityResult(
+        fit=fit,
         cycles_llh=tuple(cycles_llh),
         num_cycles=len(cycles_llh),
         total_iters=total_iters,

@@ -873,6 +873,12 @@ class ShardedBigClamModel:
             ),
         )
 
+    def extract_F(self, state: TrainState) -> np.ndarray:
+        """All-gather + fetch the live (num_nodes, K) F block in ORIGINAL
+        node ids (inverts the balance relabeling)."""
+        n, k = self.g.num_nodes, self.cfg.num_communities
+        return self._from_internal_rows(fetch_global(state.F)[:n])[:, :k]
+
     def _ckpt_meta(self) -> dict:
         return {
             "num_nodes": self.g.num_nodes,
@@ -918,7 +924,6 @@ class ShardedBigClamModel:
     ) -> FitResult:
         """Train to convergence (shared loop: models.bigclam.run_fit_loop);
         resumes from `checkpoints` when it holds a saved state."""
-        n, k = self.g.num_nodes, self.cfg.num_communities
         state, hist = self.init_state(F0), ()
         if checkpoints is not None:
             restored, hist = restore_checkpoint(
@@ -931,9 +936,21 @@ class ShardedBigClamModel:
             state,
             self.cfg,
             callback,
-            lambda st: self._from_internal_rows(fetch_global(st.F)[:n])[:, :k],
+            self.extract_F,
             checkpoints=checkpoints,
             state_to_arrays=self._state_to_arrays,
             initial_hist=hist,
             ckpt_meta=self._ckpt_meta(),
+        )
+
+    def fit_state(
+        self,
+        state: TrainState,
+        callback: Optional[Callable[[int, float], None]] = None,
+    ):
+        """State-resident convergence loop (same contract as
+        models.bigclam.BigClamModel.fit_state): no all-gather of F to the
+        host; only per-iteration LLH scalars cross the boundary."""
+        return run_fit_loop(
+            self._step, state, self.cfg, callback, None
         )

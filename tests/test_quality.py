@@ -296,3 +296,69 @@ def test_quality_within_cycle_checkpoint_resume(planted, tmp_path):
     # journaled cycles cleaned their within-cycle dirs
     assert not os.path.exists(str(tmp_path / "q" / "cycle_00000"))
     assert not os.path.exists(str(tmp_path / "q" / "cycle_00002"))
+
+
+def test_fit_state_matches_fit(planted):
+    """The state-resident loop (fit_state) must converge to the same F and
+    LLH as fit() from the same init — it IS fit() minus the host fetch."""
+    g, truth = planted
+    k = len(truth)
+    cfg = BigClamConfig(
+        num_communities=k, use_pallas=False, use_pallas_csr=False,
+    )
+    model = BigClamModel(g, cfg)
+    F0 = np.random.default_rng(0).uniform(0.0, 1.0, (g.num_nodes, k))
+    res = model.fit(F0)
+    final, llh, iters, hist = model.fit_state(model.init_state(F0))
+    assert llh == res.llh
+    assert iters == res.num_iters
+    assert hist == res.llh_history
+    np.testing.assert_array_equal(model.extract_F(final), res.F)
+
+
+def test_quality_device_recovers_planted(planted):
+    """Device-resident annealing (fit_quality_device): state never leaves
+    the devices between cycles; recovery quality must match the host
+    schedule's (same stop rule/relaxation, different noise stream)."""
+    g, truth = planted
+    k = len(truth)
+    cfg = BigClamConfig(
+        num_communities=k, quality_mode=True, restart_cycles=8,
+        use_pallas=False, use_pallas_csr=False,
+    )
+    seeds = seeding.conductance_seeds(g, cfg)
+    F0 = seeding.init_F(g, seeds, cfg, np.random.default_rng(0))
+    model = BigClamModel(g, cfg)
+    from bigclam_tpu.models.quality import fit_quality_device
+
+    qres = fit_quality_device(model, F0)
+    assert model.cfg.max_p == cfg.max_p          # parity cfg restored
+    f1 = _score(qres.fit.F, g, truth)
+    assert f1 >= 0.8, f1
+    kept = np.maximum.accumulate(qres.cycles_llh)
+    assert qres.fit.llh == pytest.approx(kept[-1])
+
+
+def test_quality_device_sharded_padding_inert(planted):
+    """On a sharded mesh the on-device kick must leave padding rows and
+    columns exactly zero (mask correctness under sharding) and K-sweep
+    style kick_cols masking must hold."""
+    import jax
+
+    from bigclam_tpu.models.quality import fit_quality_device
+    from bigclam_tpu.parallel import ShardedBigClamModel, make_mesh
+
+    g, truth = planted
+    k = len(truth)
+    k0 = k - 4
+    cfg = BigClamConfig(
+        num_communities=k, quality_mode=True, restart_cycles=3,
+        restart_tol=0.0, use_pallas=False, use_pallas_csr=False,
+    )
+    mesh = make_mesh((4, 1), jax.devices()[:4])
+    model = ShardedBigClamModel(g, cfg, mesh)
+    F0 = np.zeros((g.num_nodes, k))
+    qres = fit_quality_device(model, F0, kick_cols=k0)
+    F = np.asarray(qres.fit.F)
+    assert np.all(F[:, k0:] == 0.0)
+    assert np.any(F[:, :k0] > 0.0)
