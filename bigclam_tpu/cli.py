@@ -184,10 +184,14 @@ def cmd_fit(args) -> int:
     }
     if getattr(args, "quality", False):
         cfg = cfg.replace(quality_mode=True, **quality_kw)
-    elif quality_kw:
+    elif quality_kw or getattr(args, "device_annealing", False):
+        noop = sorted(quality_kw) + (
+            ["device_annealing"]
+            if getattr(args, "device_annealing", False)
+            else []
+        )
         print(
-            f"warning: {sorted(quality_kw)} have no effect without "
-            "--quality",
+            f"warning: {noop} have no effect without --quality",
             file=sys.stderr,
         )
     if args.checkpoint_dir and cfg.checkpoint_every <= 0:
@@ -213,7 +217,19 @@ def cmd_fit(args) -> int:
             num_nodes=g.num_nodes,
         )
         with trace(args.profile_dir):
-            if cfg.quality_mode:
+            if cfg.quality_mode and getattr(args, "device_annealing", False):
+                from bigclam_tpu.models.quality import fit_quality_device
+
+                if ckpt is not None:
+                    print(
+                        "warning: --device-annealing ignores "
+                        "--checkpoint-dir (a checkpoint is a host fetch; "
+                        "use the host loop where checkpointing matters)",
+                        file=sys.stderr,
+                    )
+                qres = fit_quality_device(model, F0, callback=cb)
+                res = qres.fit
+            elif cfg.quality_mode:
                 from bigclam_tpu.models.quality import fit_quality
 
                 qres = fit_quality(model, F0, callback=cb, checkpoints=ckpt)
@@ -341,6 +357,12 @@ def main(argv=None) -> int:
         "--seed-exclusion", type=int, choices=(0, 1), default=None,
         help="coverage-aware seed selection (default: auto, on iff "
              "--quality; see config.seed_exclusion)",
+    )
+    p_fit.add_argument(
+        "--device-annealing", action="store_true",
+        help="with --quality: keep the annealing schedule device-resident "
+             "(models.quality.fit_quality_device — no per-cycle host F "
+             "round trip; pod-scale)",
     )
     p_fit.add_argument("--out", default=None, help="write SNAP cmty file")
     p_fit.add_argument("--save-f", default=None, help="write F as .npy")

@@ -768,10 +768,16 @@ class BigClamModel:
         assert F0.shape == (n, k), (F0.shape, (n, k))
         F = jnp.zeros((self.n_pad, self.k_pad), self.dtype)
         F = F.at[:n, :k].set(jnp.asarray(F0, self.dtype))
+        return self.reset_state(F)
+
+    def reset_state(self, F: jax.Array) -> TrainState:
+        """TrainState from an already-device-resident PADDED F — init_state
+        minus the host upload (the device annealing loop's per-cycle state;
+        single source of the state-field construction)."""
         return TrainState(
             F=F,
             sumF=F.sum(axis=0),
-            llh=jnp.asarray(-jnp.inf, self.dtype),
+            llh=jnp.asarray(-jnp.inf, F.dtype),
             it=jnp.zeros((), jnp.int32),
             accept_hist=jnp.zeros(
                 len(self.cfg.step_candidates) + 1, jnp.int32

@@ -60,8 +60,11 @@ Works with every trainer (single-chip / all-gather sharded / ring). The
 required trainer surface is `.cfg`, `.g`, `.fit(F0, callback=)`, and
 `.rebuild_step()` (invoked whenever the max_p relaxation engages — the
 common case at real graph sizes); the schedule and kernels stay whatever
-the model compiled. The noise kick is host-side O(N*K) — fine through
-com-Orkut scale; a device-side kick is a pod-scale follow-up.
+the model compiled. fit_quality's noise kick is host-side O(N*K) — fine
+up to com-Amazon scale; past that, `fit_quality_device` (below) keeps
+the whole schedule device-resident (adds `.init_state`/`.reset_state`/
+`.fit_state`/`.extract_F` to the trainer surface) with an on-device
+jax.random kick, so F never leaves the chips between cycles.
 """
 
 from __future__ import annotations
@@ -311,8 +314,6 @@ def fit_quality_device(
     import jax
     import jax.numpy as jnp
 
-    from bigclam_tpu.models.bigclam import TrainState
-
     cfg = model.cfg
     n, k = F0.shape
     kc = k if kick_cols is None else int(kick_cols)
@@ -323,7 +324,6 @@ def fit_quality_device(
 
     state0 = model.init_state(F0)          # the ONE host->device upload
     n_pad, k_pad = state0.F.shape
-    num_hist = len(cfg.step_candidates) + 1
 
     @jax.jit
     def kick_fn(F, key):
@@ -340,15 +340,6 @@ def fit_quality_device(
             F + jnp.where(live, noise, 0.0), cfg.min_f, cfg.max_f
         )
 
-    def fresh_state(F):
-        return TrainState(
-            F=F,
-            sumF=F.sum(axis=0),
-            llh=jnp.asarray(-jnp.inf, F.dtype),
-            it=jnp.zeros((), jnp.int32),
-            accept_hist=jnp.zeros(num_hist, jnp.int32),
-        )
-
     cfg_saved = model.cfg
     rebuilt = False
     cycles_llh: List[float] = []
@@ -357,9 +348,9 @@ def fit_quality_device(
     total_iters = 0
     gainless = 0
     F_cur = state0.F
-    base_key = jax.random.key(
-        np.uint32(cfg.seed ^ 0x5EED).item()
-    )
+    del state0          # only F is needed; the state tuple must not pin an
+    # extra F-sized buffer through the schedule (see the rejected-cycle del)
+    base_key = jax.random.key((cfg.seed ^ 0x5EED) & 0xFFFFFFFF)
     try:
         model.cfg = cfg.replace(
             conv_tol=cfg.quality_conv_tol, max_p=max_p_q
@@ -371,7 +362,7 @@ def fit_quality_device(
         for cycle in range(max_cycles):
             F_try = kick_fn(F_cur, jax.random.fold_in(base_key, cycle))
             final, llh, iters, hist = model.fit_state(
-                fresh_state(F_try), callback=callback
+                model.reset_state(F_try), callback=callback
             )
             del F_try                      # free the kicked input buffer
             total_iters += iters

@@ -863,10 +863,15 @@ class ShardedBigClamModel:
         F_host[:n, :k] = self._to_internal_rows(F0)
         fspec = NamedSharding(self.mesh, P(NODES_AXIS, K_AXIS))
         F = put_sharded(F_host.astype(self.dtype), fspec)
+        return self.reset_state(F)
+
+    def reset_state(self, F: jax.Array) -> TrainState:
+        """TrainState from an already-sharded PADDED F (init_state minus the
+        host upload; same contract as BigClamModel.reset_state)."""
         return TrainState(
             F=F,
             sumF=F.sum(axis=0),
-            llh=jnp.asarray(-jnp.inf, self.dtype),
+            llh=jnp.asarray(-jnp.inf, F.dtype),
             it=jnp.zeros((), jnp.int32),
             accept_hist=jnp.zeros(
                 len(self.cfg.step_candidates) + 1, jnp.int32
