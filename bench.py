@@ -80,7 +80,54 @@ def time_windows(model, F0, windows, iters_per_window, warmup=WARMUP_ITERS):
     return med, recs, float(state.llh)
 
 
+def _backend_or_die(timeout_s: float = 180.0) -> str:
+    """Initialize the JAX backend with a watchdog: a down accelerator
+    tunnel makes jax.devices() hang FOREVER (observed: the axon relay),
+    which would hang the whole scoreboard run. Emit a diagnostic JSON line
+    and exit instead."""
+    import threading
+
+    out = {}
+
+    def init():
+        try:
+            import jax
+
+            out["backend"] = jax.default_backend()
+        except BaseException as e:          # report crash distinctly below
+            out["crash"] = repr(e)
+
+    t = threading.Thread(target=init, daemon=True)
+    t.start()
+    t.join(timeout_s)
+    if "backend" not in out:
+        import os
+        import sys
+
+        err = out.get(
+            "crash",
+            f"backend init hung > {timeout_s:.0f}s "
+            "(accelerator tunnel down?)",
+        )
+        print(
+            json.dumps(
+                {
+                    "metric": "edges/sec/chip",
+                    "value": 0,
+                    "unit": "edges/sec/chip",
+                    "vs_baseline": 0,
+                    "error": err,
+                }
+            ),
+            flush=True,       # os._exit skips stdio flush; a piped run
+        )                     # would otherwise lose the diagnostic line
+        sys.stderr.flush()
+        os._exit(3)
+    return out["backend"]
+
+
 def main() -> None:
+    _backend_or_die()
     import jax
 
     from bigclam_tpu.config import BigClamConfig
