@@ -95,7 +95,10 @@ def _backend_or_die(timeout_s: float = 180.0) -> str:
 
             out["backend"] = jax.default_backend()
         except BaseException as e:          # report crash distinctly below
+            import traceback
+
             out["crash"] = repr(e)
+            out["crash_tb"] = traceback.format_exc()
 
     t = threading.Thread(target=init, daemon=True)
     t.start()
@@ -109,6 +112,8 @@ def _backend_or_die(timeout_s: float = 180.0) -> str:
             f"backend init hung > {timeout_s:.0f}s "
             "(accelerator tunnel down?)",
         )
+        if "crash_tb" in out:     # full traceback for the run log
+            print(out["crash_tb"], file=sys.stderr)
         print(
             json.dumps(
                 {

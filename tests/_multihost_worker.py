@@ -46,6 +46,14 @@ def problem():
     return g, cfg, F0
 
 
+def quality_cfg(cfg):
+    """The quality-device schedule — single source for worker AND parent
+    (the test compares the two runs' annealing trajectories)."""
+    return cfg.replace(
+        quality_mode=True, restart_cycles=3, restart_tol=0.0, max_iters=6
+    )
+
+
 def main() -> None:
     port, pid, out_path = sys.argv[1], sys.argv[2], sys.argv[3]
     mode = sys.argv[4] if len(sys.argv) > 4 else "fit"
@@ -105,6 +113,19 @@ def main() -> None:
         if jax.process_index() == 0:
             np.savez(
                 out_path, F=res.F, llh_history=np.asarray(res.llh_history)
+            )
+        jax.distributed.shutdown()
+        return
+
+    if mode == "quality-device":
+        from bigclam_tpu.models.quality import fit_quality_device
+
+        model = ShardedBigClamModel(g, quality_cfg(cfg), mesh)
+        qres = fit_quality_device(model, F0)
+        if jax.process_index() == 0:
+            np.savez(
+                out_path, F=qres.fit.F,
+                cycles=np.asarray(qres.cycles_llh),
             )
         jax.distributed.shutdown()
         return
