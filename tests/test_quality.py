@@ -362,3 +362,41 @@ def test_quality_device_sharded_padding_inert(planted):
     F = np.asarray(qres.fit.F)
     assert np.all(F[:, k0:] == 0.0)
     assert np.any(F[:, :k0] > 0.0)
+
+
+def test_quality_recovers_overlapping_communities():
+    """The AGM's defining capability: OVERLAPPING membership. Planted
+    blocks sharing `overlap` nodes with the next block; quality mode must
+    recover both the communities (F1) and the dual-membership structure
+    (overlap node count in the right ballpark). Calibration at the larger
+    N=2400/K=100 probe: F1 0.867, 600 true / 628 predicted dual members;
+    this CI-sized config (N=1200/K=50, 300 true dual members) recovers
+    F1 ~ 0.87 as well."""
+    g, truth = sample_planted_graph(
+        1200, 50, p_in=0.3, overlap=6, rng=np.random.default_rng(7)
+    )
+    k = len(truth)
+    cfg = BigClamConfig(
+        num_communities=k, quality_mode=True,
+        use_pallas=False, use_pallas_csr=False,
+    )
+    seeds = seeding.conductance_seeds(g, cfg)
+    F0 = seeding.init_F(g, seeds, cfg, np.random.default_rng(0))
+    qres = fit_quality(BigClamModel(g, cfg), F0)
+    com = extraction.extract_communities(np.asarray(qres.fit.F), g)
+    f1 = avg_f1(list(com.values()), truth)
+    assert f1 >= 0.75, f1
+    n = g.num_nodes
+    pred_member = np.zeros(n)
+    for c in com.values():
+        for u in c:
+            pred_member[u] += 1
+    true_member = np.zeros(n)
+    for t in truth:
+        for u in t:
+            true_member[u] += 1
+    n_true = int((true_member >= 2).sum())
+    n_pred = int((pred_member >= 2).sum())
+    # dual membership must be detected at roughly the right rate (not
+    # collapsed to disjoint, not blanket-overlapped)
+    assert 0.5 * n_true <= n_pred <= 2.0 * n_true, (n_true, n_pred)
