@@ -49,8 +49,15 @@ def extract_communities(F: np.ndarray, g: Graph, delta: float | None = None
         delta = delta_threshold(g.num_nodes, g.num_edges)
     mask = membership_mask(F, delta)
     nodes, comms = np.nonzero(mask)
-    raw = g.raw_ids[nodes]
     # single linear pass: group members by community via sort + split
+    return _group_pairs(nodes, comms, g.raw_ids)
+
+
+def _group_pairs(
+    nodes: np.ndarray, comms: np.ndarray, raw_ids: np.ndarray
+) -> Dict[int, List[int]]:
+    """(node, community) pairs -> {community: sorted raw member ids}."""
+    raw = raw_ids[nodes]
     order = np.argsort(comms, kind="stable")
     comms_sorted, raw_sorted = comms[order], raw[order]
     uniq, starts = np.unique(comms_sorted, return_index=True)
@@ -58,6 +65,85 @@ def extract_communities(F: np.ndarray, g: Graph, delta: float | None = None
     for c, members in zip(uniq, np.split(raw_sorted, starts[1:])):
         out[int(c)] = sorted(members.tolist())
     return out
+
+
+def extract_communities_device(
+    F_dev,
+    g: Graph,
+    delta: float | None = None,
+    num_communities: int | None = None,
+    chunk_rows: int = 1 << 16,
+    row_to_node=None,
+) -> Dict[int, List[int]]:
+    """extract_communities for a DEVICE-RESIDENT (possibly sharded) F —
+    the C18 path composing with fit_quality_device / fit_state, where F
+    never fits (or never visits) the host.
+
+    Thresholding runs on device in row chunks; only the (node, community)
+    membership PAIRS come back — a jitted nonzero with a power-of-two
+    static size per chunk (one scalar count round trip picks the size, so
+    at most log2 distinct compilations), total transfer O(#memberships)
+    instead of the O(N*K) float fetch. Semantics identical to
+    extract_communities (including the argmax-tie fallback, Q13) —
+    pinned by tests/test_extraction_eval.py equality tests.
+
+    `F_dev` may be padded: rows >= g.num_nodes and columns >=
+    num_communities (default: all columns) are ignored — the row loop
+    never slices past g.num_nodes, so padding rows never reach the kernel.
+
+    Relabeled trainers (balance=True): pass the TRAINER's graph
+    (`model.g`) — Graph.permute carries raw_ids, so device row order and
+    raw ids already agree. Callers holding only the ORIGINAL graph must
+    pass `row_to_node` (device row -> original node index;
+    ShardedBigClamModel.internal_row_to_node()); None = identity.
+    """
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+
+    if delta is None:
+        delta = delta_threshold(g.num_nodes, g.num_edges)
+    n = g.num_nodes
+    k = num_communities or int(F_dev.shape[1])
+    # bound the on-device mask (and its int32 count) per chunk: a boolean
+    # sum over > 2^31 elements would silently wrap
+    chunk_rows = max(1, min(chunk_rows, (1 << 27) // max(k, 1)))
+
+    @jax.jit
+    def chunk_mask(F_c):
+        F_c = F_c[:, :k]               # native dtype: boundary decisions
+        above = F_c >= delta           # must match the host path exactly
+        row_max = F_c.max(axis=1, keepdims=True)
+        fallback = (row_max < delta) & (F_c == row_max)
+        mask = above | fallback
+        return mask, mask.sum()
+
+    @functools.partial(jax.jit, static_argnums=1)
+    def gather_pairs(mask, size):
+        return jnp.nonzero(mask, size=size, fill_value=-1)
+
+    all_nodes: list = []
+    all_comms: list = []
+    for lo in range(0, n, chunk_rows):
+        hi = min(lo + chunk_rows, n)
+        F_c = jax.lax.slice_in_dim(F_dev, lo, hi, axis=0)
+        mask, cnt = chunk_mask(F_c)
+        cnt = int(cnt)
+        if cnt == 0:
+            continue
+        size = 1 << (cnt - 1).bit_length()     # pow-2 pad: few recompiles
+        r, c = gather_pairs(mask, size)
+        r = np.asarray(r)[:cnt]
+        c = np.asarray(c)[:cnt]
+        all_nodes.append(r + lo)
+        all_comms.append(c)
+    if not all_nodes:
+        return {}
+    nodes = np.concatenate(all_nodes)
+    if row_to_node is not None:
+        nodes = np.asarray(row_to_node)[nodes]
+    return _group_pairs(nodes, np.concatenate(all_comms), g.raw_ids)
 
 
 def save_communities(path: str, communities: Dict[int, List[int]]) -> None:

@@ -884,6 +884,17 @@ class ShardedBigClamModel:
         n, k = self.g.num_nodes, self.cfg.num_communities
         return self._from_internal_rows(fetch_global(state.F)[:n])[:, :k]
 
+    def internal_row_to_node(self) -> Optional[np.ndarray]:
+        """Device row index -> ORIGINAL node index, or None when rows were
+        never relabeled. For ops.extraction.extract_communities_device
+        callers holding the original graph (with the trainer's own
+        `model.g`, raw ids already agree and this is unnecessary)."""
+        if self._perm is None:
+            return None
+        inv = np.empty_like(self._perm)
+        inv[self._perm] = np.arange(self._perm.size)
+        return inv
+
     def _ckpt_meta(self) -> dict:
         return {
             "num_nodes": self.g.num_nodes,

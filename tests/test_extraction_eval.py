@@ -100,3 +100,116 @@ def test_agm_recovery_end_to_end():
     nmi = overlapping_nmi(pred, truth)
     assert f1 > 0.85, (f1, nmi)
     assert nmi > 0.7, (f1, nmi)
+
+
+class TestDeviceExtraction:
+    """extract_communities_device: identical output to the host path from
+    a device-resident (padded / sharded) F, fetching only membership
+    pairs."""
+
+    def _graph(self, n):
+        rng = np.random.default_rng(3)
+        a = rng.random((n, n)) < 0.05
+        edges = [
+            (i, j) for i in range(n) for j in range(i + 1, n) if a[i, j]
+        ]
+        edges.append((0, n - 1))
+        from bigclam_tpu.graph.ingest import graph_from_edges
+
+        return graph_from_edges(edges, num_nodes=n)
+
+    def test_matches_host_padded(self):
+        import jax.numpy as jnp
+
+        from bigclam_tpu.ops.extraction import (
+            extract_communities,
+            extract_communities_device,
+        )
+
+        g = self._graph(97)
+        k = 7
+        rng = np.random.default_rng(0)
+        F = rng.uniform(0.0, 0.3, size=(g.num_nodes, k))
+        F[5] = 0.0                      # all-zero row: Q13 every-community
+        F[11] = 0.2                     # uniform row below delta: all ties
+        host = extract_communities(F, g)
+        # padded device array (rows AND columns), odd chunk size so the
+        # last chunk is ragged
+        F_pad = np.zeros((128, 16))
+        F_pad[: g.num_nodes, :k] = F
+        dev = extract_communities_device(
+            jnp.asarray(F_pad), g, num_communities=k, chunk_rows=13
+        )
+        assert dev == host
+
+    def test_matches_host_from_sharded_state(self):
+        import jax
+
+        from bigclam_tpu.config import BigClamConfig
+        from bigclam_tpu.ops.extraction import (
+            extract_communities,
+            extract_communities_device,
+        )
+        from bigclam_tpu.parallel import ShardedBigClamModel, make_mesh
+
+        g = self._graph(96)
+        k = 6
+        cfg = BigClamConfig(
+            num_communities=k, use_pallas=False, use_pallas_csr=False,
+        )
+        mesh = make_mesh((4, 1), jax.devices()[:4])
+        model = ShardedBigClamModel(g, cfg, mesh)
+        F0 = np.random.default_rng(1).uniform(0.0, 1.0, (g.num_nodes, k))
+        final, _llh, _it, _h = model.fit_state(model.init_state(F0))
+        host = extract_communities(model.extract_F(final), g)
+        dev = extract_communities_device(
+            final.F, g, num_communities=k, chunk_rows=17
+        )
+        assert dev == host
+
+    def test_empty_f_no_pairs(self):
+        import jax.numpy as jnp
+
+        from bigclam_tpu.ops.extraction import extract_communities_device
+
+        g = self._graph(8)
+        # delta > everything and no zero rows -> fallback ties only
+        F = jnp.full((8, 3), 0.5)
+        out = extract_communities_device(F, g, delta=2.0)
+        # uniform rows below delta tie on the row max -> every community
+        assert set(out) == {0, 1, 2}
+
+    def test_matches_host_with_balance_relabeling(self):
+        """balance=True permutes device row order; BOTH supported routes
+        must agree with the host path: (a) the trainer's own relabeled
+        graph (raw_ids carried by Graph.permute), (b) the original graph
+        plus internal_row_to_node()."""
+        import jax
+
+        from bigclam_tpu.config import BigClamConfig
+        from bigclam_tpu.ops.extraction import (
+            extract_communities,
+            extract_communities_device,
+        )
+        from bigclam_tpu.parallel import ShardedBigClamModel, make_mesh
+
+        g = self._graph(96)
+        k = 6
+        cfg = BigClamConfig(
+            num_communities=k, use_pallas=False, use_pallas_csr=False,
+        )
+        mesh = make_mesh((4, 1), jax.devices()[:4])
+        model = ShardedBigClamModel(g, cfg, mesh, balance=True)
+        assert model._perm is not None      # relabeling actually happened
+        F0 = np.random.default_rng(1).uniform(0.0, 1.0, (g.num_nodes, k))
+        final, _llh, _it, _h = model.fit_state(model.init_state(F0))
+        host = extract_communities(model.extract_F(final), g)
+        via_trainer_graph = extract_communities_device(
+            final.F, model.g, num_communities=k, chunk_rows=17
+        )
+        via_row_map = extract_communities_device(
+            final.F, g, num_communities=k, chunk_rows=17,
+            row_to_node=model.internal_row_to_node(),
+        )
+        assert via_trainer_graph == host
+        assert via_row_map == host
