@@ -300,6 +300,7 @@ def cmd_sweep(args) -> int:
                 model_factory=factory,
                 callback=cb,
                 state_dir=args.checkpoint_dir,
+                device_annealing=getattr(args, "device_annealing", False),
             )
     print(
         json.dumps(
@@ -382,6 +383,11 @@ def main(argv=None) -> int:
         "--quality", action="store_true",
         help="train each K with the quality-mode annealing schedule "
              "(models/quality.py; NOT reference semantics)",
+    )
+    p_sweep.add_argument(
+        "--device-annealing", action="store_true",
+        help="with --quality: device-resident annealing per K "
+             "(fit_quality_device; no per-cycle host F round trips)",
     )
     p_sweep.set_defaults(fn=cmd_sweep)
 

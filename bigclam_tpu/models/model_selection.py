@@ -80,6 +80,7 @@ def sweep_k(
     callback: Optional[Callable[[int, float], None]] = None,
     rng: Optional[np.random.Generator] = None,
     state_dir: Optional[str] = None,
+    device_annealing: bool = False,
 ) -> SweepResult:
     """Train across the K grid and pick KforC (bigclam4-7.scala:244-266).
 
@@ -142,7 +143,13 @@ def sweep_k(
         else:
             ckpt_k = None
             ckpt_dir = None
-            if state_dir is not None and cfg.checkpoint_every > 0:
+            if (
+                state_dir is not None
+                and cfg.checkpoint_every > 0
+                # the device-annealing path is checkpoint-free by design —
+                # don't create a k_<K> dir that nothing will ever write
+                and not (cfg.quality_mode and device_annealing)
+            ):
                 from bigclam_tpu.utils.checkpoint import CheckpointManager
 
                 ckpt_dir = os.path.join(state_dir, f"k_{k:06d}")
@@ -152,7 +159,18 @@ def sweep_k(
             )
             F0 = np.zeros((g.num_nodes, k_max))
             F0[:, :k] = F0k                         # columns >= k stay zero
-            if cfg.quality_mode:
+            if cfg.quality_mode and device_annealing:
+                # per-K device-resident annealing: one upload per K (the
+                # seeded F0 is host-built), no per-cycle round trips; the
+                # within-K checkpointing of the host path does not apply
+                # (fit_quality_device is checkpoint-free by design)
+                from bigclam_tpu.models.quality import fit_quality_device
+
+                qres = fit_quality_device(
+                    model, F0, kick_cols=k, key_salt=k
+                )
+                res = qres.fit
+            elif cfg.quality_mode:
                 # quality sweep: each K trains with the annealing schedule
                 # (models.quality); the kick is restricted to the active K
                 # columns so the >= k padding stays on its inert zeros. The

@@ -290,6 +290,7 @@ def fit_quality_device(
     F0: np.ndarray,
     callback: Optional[Callable[[int, float], None]] = None,
     kick_cols: Optional[int] = None,
+    key_salt: int = 0,
 ) -> QualityResult:
     """DEVICE-RESIDENT annealing: the pod-scale variant of fit_quality.
 
@@ -350,7 +351,12 @@ def fit_quality_device(
     F_cur = state0.F
     del state0          # only F is needed; the state tuple must not pin an
     # extra F-sized buffer through the schedule (see the rejected-cycle del)
-    base_key = jax.random.key((cfg.seed ^ 0x5EED) & 0xFFFFFFFF)
+    # key_salt makes callers' schedules independent restarts — the K-sweep
+    # salts with K so grid points do not share one noise stream (the host
+    # path's per-K RNG streams, model_selection.py, for the same reason)
+    base_key = jax.random.fold_in(
+        jax.random.key((cfg.seed ^ 0x5EED) & 0xFFFFFFFF), key_salt
+    )
     try:
         model.cfg = cfg.replace(
             conv_tol=cfg.quality_conv_tol, max_p=max_p_q

@@ -134,8 +134,12 @@ def extract_communities_device(
             continue
         size = 1 << (cnt - 1).bit_length()     # pow-2 pad: few recompiles
         r, c = gather_pairs(mask, size)
-        r = np.asarray(r)[:cnt]
-        c = np.asarray(c)[:cnt]
+        # multi-controller safe: a pair array derived from a globally
+        # sharded F may span non-addressable devices (parallel.multihost)
+        from bigclam_tpu.parallel.multihost import fetch_global
+
+        r = fetch_global(r)[:cnt]
+        c = fetch_global(c)[:cnt]
         all_nodes.append(r + lo)
         all_comms.append(c)
     if not all_nodes:

@@ -119,9 +119,23 @@ def main() -> None:
 
     if mode == "quality-device":
         from bigclam_tpu.models.quality import fit_quality_device
+        from bigclam_tpu.ops.extraction import (
+            extract_communities,
+            extract_communities_device,
+        )
 
         model = ShardedBigClamModel(g, quality_cfg(cfg), mesh)
         qres = fit_quality_device(model, F0)
+        # device-side extraction must survive process_count() == 2: the
+        # membership pairs come off a globally sharded state (fetch_global
+        # inside), identical to the host extraction of the fetched F
+        final, _llh, _it, _hist = model.fit_state(model.init_state(F0))
+        dev = extract_communities_device(
+            final.F, model.g,
+            num_communities=model.cfg.num_communities, chunk_rows=7,
+        )
+        host = extract_communities(model.extract_F(final), g)
+        assert dev == host, (dev, host)
         if jax.process_index() == 0:
             np.savez(
                 out_path, F=qres.fit.F,

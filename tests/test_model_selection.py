@@ -185,3 +185,27 @@ def test_quality_sweep(tmp_path):
     res2 = sweep_k(g, cfg, state_dir=str(tmp_path / "s"))
     assert res2.llh_by_k == res.llh_by_k
     assert res2.chosen_k == res.chosen_k
+
+
+def test_quality_sweep_device_annealing():
+    """sweep_k(device_annealing=True): per-K device-resident annealing,
+    padding columns >= k stay inert (kick_cols), same grid walk."""
+    from bigclam_tpu.models.agm import sample_planted_graph
+    from bigclam_tpu.models.model_selection import sweep_k
+
+    g, truth = sample_planted_graph(
+        600, 25, p_in=0.3, rng=np.random.default_rng(7)
+    )
+    cfg = BigClamConfig(
+        num_communities=25, quality_mode=True, restart_cycles=3,
+        restart_tol=0.0, min_com=10, max_com=25, div_com=2,
+        use_pallas=False, use_pallas_csr=False,
+    )
+    res = sweep_k(g, cfg, device_annealing=True)
+    assert res.kset[-1] == 25
+    ks = sorted(res.llh_by_k)
+    assert res.llh_by_k[ks[-1]] > res.llh_by_k[ks[0]]
+    # grid-max F buffer: columns beyond the last trained K stayed zero
+    assert res.best_fit is not None
+    F = np.asarray(res.best_fit.F)
+    assert F.shape[1] == 25
