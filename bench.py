@@ -212,38 +212,43 @@ def main() -> None:
     }
 
     # --- K-blocked regime: AGM N=60K K=3000 (csr_grouped_kb vs XLA) ---
-    gk, _ = sample_planted_graph(
-        XLK_N, XLK_K, p_in=XLK_P_IN, rng=np.random.default_rng(3)
-    )
-    cfg_k = BigClamConfig(num_communities=XLK_K)
-    Fk = np.random.default_rng(4).integers(
-        0, 2, size=(gk.num_nodes, XLK_K)
-    ).astype(np.float64)
-    model_k = BigClamModel(gk, cfg_k, k_multiple=128)
-    if on_tpu and model_k.engaged_path != "csr_grouped_kb":
-        raise RuntimeError(
-            "benchmark invalid: K-blocked config fell back to "
-            f"{model_k.engaged_path} ({model_k.path_reason})"
+    # newest kernel path (round 4): contained — a Mosaic refusal here is
+    # RECORDED in the artifact instead of taking down the headline configs
+    try:
+        gk, _ = sample_planted_graph(
+            XLK_N, XLK_K, p_in=XLK_P_IN, rng=np.random.default_rng(3)
         )
-    xlk_eps, xlk_windows, _ = time_windows(
-        model_k, Fk, 2, LARGE_ITERS_PER_WINDOW, warmup=1
-    )
-    xla_k = BigClamModel(
-        gk, cfg_k.replace(use_pallas_csr=False, use_pallas=False),
-        k_multiple=128,
-    )
-    xlk_xla_eps, xlk_xla_windows, _ = time_windows(
-        xla_k, Fk, 2, LARGE_ITERS_PER_WINDOW, warmup=1
-    )
-    configs["xl_k"] = {
-        "config": f"AGM planted N={gk.num_nodes} "
-                  f"2E={gk.num_directed_edges} K={XLK_K}",
-        "csr": {"eps": xlk_eps, "path": model_k.engaged_path,
-                "windows": xlk_windows},
-        "xla": {"eps": xlk_xla_eps, "path": xla_k.engaged_path,
-                "windows": xlk_xla_windows},
-        "csr_over_xla": round(xlk_eps / xlk_xla_eps, 2),
-    }
+        cfg_k = BigClamConfig(num_communities=XLK_K)
+        Fk = np.random.default_rng(4).integers(
+            0, 2, size=(gk.num_nodes, XLK_K)
+        ).astype(np.float64)
+        model_k = BigClamModel(gk, cfg_k, k_multiple=128)
+        if on_tpu and model_k.engaged_path != "csr_grouped_kb":
+            raise RuntimeError(
+                "K-blocked config fell back to "
+                f"{model_k.engaged_path} ({model_k.path_reason})"
+            )
+        xlk_eps, xlk_windows, _ = time_windows(
+            model_k, Fk, 2, LARGE_ITERS_PER_WINDOW, warmup=1
+        )
+        xla_k = BigClamModel(
+            gk, cfg_k.replace(use_pallas_csr=False, use_pallas=False),
+            k_multiple=128,
+        )
+        xlk_xla_eps, xlk_xla_windows, _ = time_windows(
+            xla_k, Fk, 2, LARGE_ITERS_PER_WINDOW, warmup=1
+        )
+        configs["xl_k"] = {
+            "config": f"AGM planted N={gk.num_nodes} "
+                      f"2E={gk.num_directed_edges} K={XLK_K}",
+            "csr": {"eps": xlk_eps, "path": model_k.engaged_path,
+                    "windows": xlk_windows},
+            "xla": {"eps": xlk_xla_eps, "path": xla_k.engaged_path,
+                    "windows": xlk_xla_windows},
+            "csr_over_xla": round(xlk_eps / xlk_xla_eps, 2),
+        }
+    except Exception as e:           # noqa: BLE001 — recorded, not silent
+        configs["xl_k"] = {"error": f"{type(e).__name__}: {e}"}
 
     # --- oracle baseline: exact-semantics iterations on host CPU ---
     base_times = []
