@@ -56,6 +56,17 @@ Round-4 additions (both measured on planted N=2400 K=100 p_in=0.3,
      rebuilds the train step (model.rebuild_step — same kernels, new
      clip constant), and restores the parity step afterwards.
 
+Round-4 addition, part 5 — discrete repair (cfg.quality_repair, default
+on with quality mode): two defect classes are STABLE under the continuous
+dynamics because gradients cannot move a whole column across the graph —
+a fat column merged over disconnected regions, and a pair of columns
+fragmenting one dense region. After the annealing loop,
+repair_communities merges dense fragment pairs (freeing columns) and
+re-seeds the freed columns on fat columns' extra components; a short
+re-annealing polish follows and the result is kept only if LLH improves.
+Measured on the N=2400 probe: F1 0.894 -> 0.914, LLH -32037 -> -31692
+(planted optimum -31429).
+
 Works with every trainer (single-chip / all-gather sharded / ring). The
 required trainer surface is `.cfg`, `.g`, `.fit(F0, callback=)`, and
 `.rebuild_step()` (invoked whenever the max_p relaxation engages — the
@@ -132,12 +143,160 @@ def _relax_params(model, n_live: int) -> Tuple[float, float]:
     return max_p_q, eps
 
 
+def repair_communities(
+    F: np.ndarray,
+    g,
+    delta: float,
+    k_active: int,
+    min_comp: int = 5,
+    strength: float = 1.0,
+) -> Tuple[np.ndarray, int]:
+    """One merge+split repair pass over the thresholded communities.
+
+    Gradient dynamics cannot move a whole column across the graph, so two
+    stable defect classes survive annealing (diagnosed on the planted
+    probe): (a) a FAT column whose threshold members span multiple
+    graph components (a merged community — its pieces share no edges),
+    and (b) a PAIR of columns tiling one densely-connected region (two
+    fragments of one community). The fix is one discrete move: merge each
+    dense fragment pair into one column (freeing the other) and re-seed
+    every freed column on an extra component of a fat column. The caller
+    refits and accepts on LLH.
+
+    Detection is O(E + N + sum fat-column sizes): cross/within column
+    edge counts use each node's top-2 above-threshold columns (vectorized
+    bincount over combined keys — exact for <= 2 memberships, a subsample
+    for more), components by BFS over each fat column's induced subgraph.
+    Only columns < k_active are touched (the K-sweep's padding columns
+    must stay zero). Returns (repaired F, number of repairs).
+    """
+    F = np.asarray(F, np.float64).copy()
+    n = g.num_nodes
+    ka = int(k_active)
+    Fa = F[:n, :ka]
+    mask = Fa >= delta
+    sizes = mask.sum(axis=0)
+    if not sizes.any():
+        return F, 0
+    # top-2 above-threshold columns per node
+    if ka >= 2:
+        top2 = np.argpartition(-Fa, 1, axis=1)[:, :2]
+    else:
+        top2 = np.zeros((n, 2), np.int64)
+    valid = np.take_along_axis(Fa, top2, axis=1) >= delta
+    # cross/within edge counts over the 4 (slot_u, slot_v) combos
+    keys = []
+    for su in range(2):
+        for sv in range(2):
+            m = valid[g.src, su] & valid[g.dst, sv]
+            keys.append(
+                top2[g.src[m], su].astype(np.int64) * ka
+                + top2[g.dst[m], sv]
+            )
+    uk, uc = np.unique(np.concatenate(keys), return_counts=True)
+    ca, cb = uk // ka, uk % ka
+    within = np.zeros(ka)
+    within[ca[ca == cb]] = uc[ca == cb]
+    # within counts are DIRECTED (each undirected edge twice), normalized
+    # by ordered pairs; cross pairs below are unordered, so their directed
+    # edge counts divide by 2*|a\b|*|b\a| to stay on the same scale
+    dens_w = within / np.maximum(sizes * (sizes - 1), 1)
+    cross: dict = {}
+    for a, b, e in zip(ca, cb, uc):
+        if a != b:
+            key = (min(int(a), int(b)), max(int(a), int(b)))
+            cross[key] = cross.get(key, 0) + int(e)
+    members = [np.flatnonzero(mask[:, c]) for c in range(ka)]
+    msets = [set(m.tolist()) for m in members]
+    # merge candidates, calibrated on the planted probes (top cross-pair
+    # stats: true fragments show inter/min 0.6-0.7 OR near-disjoint
+    # exclusives with cross density ~ within density; genuinely
+    # OVERLAPPING planted communities sit at inter/min ~ 0.2 with sparse
+    # exclusive-to-exclusive edges — those must never merge):
+    #   rule 1: near-duplicates/straddling fragments, inter/min >= 0.5
+    #   rule 2: disjoint fragments (inter/min <= 0.2) whose exclusive
+    #           parts are densely connected
+    merges, used = [], set()
+    for (a, b), e in sorted(cross.items(), key=lambda kv: -kv[1]):
+        la, lb = len(msets[a]), len(msets[b])
+        if not la or not lb:
+            continue
+        inter_frac = len(msets[a] & msets[b]) / min(la, lb)
+        ab = len(msets[a] - msets[b]) * len(msets[b] - msets[a])
+        d = e / (2.0 * ab) if ab else 0.0
+        dup = inter_frac >= 0.5
+        frag = (
+            inter_frac <= 0.2
+            and ab > 0
+            and d >= 0.25 * min(dens_w[a], dens_w[b])
+            and d > 0.025
+        )
+        if dup or frag:
+            if a in used or b in used:
+                continue
+            merges.append((a, b))
+            used.update((a, b))
+    if not merges:
+        # repairs = min(#merges, #splits): without a freed column the
+        # split BFS below would be a guaranteed host-side no-op
+        return F, 0
+    # split candidates: extra components of fat columns
+    indptr, indices = g.indptr, g.indices
+
+    def components(mem):
+        mset = set(mem.tolist())
+        seen, comps = set(), []
+        for s in mem.tolist():
+            if s in seen:
+                continue
+            stack, comp = [int(s)], []
+            seen.add(s)
+            while stack:
+                u = stack.pop()
+                comp.append(u)
+                for v in indices[indptr[u] : indptr[u + 1]]:
+                    v = int(v)
+                    if v in mset and v not in seen:
+                        seen.add(v)
+                        stack.append(v)
+            comps.append(comp)
+        return comps
+
+    splits = []
+    for c in np.argsort(-sizes):
+        c = int(c)
+        if c in used or sizes[c] < 2 * min_comp:
+            continue
+        comps = [cc for cc in components(members[c]) if len(cc) >= min_comp]
+        if len(comps) <= 1:
+            continue
+        comps.sort(key=len, reverse=True)
+        for comp in comps[1:]:
+            splits.append((c, comp))
+        used.add(c)
+    repairs = 0
+    freed = []
+    for a, b in merges:
+        if repairs >= len(splits):
+            break
+        F[list(msets[b] - msets[a]), a] = strength
+        F[:n, b] = 0.0
+        freed.append(b)
+        repairs += 1
+    for (c, comp), v in zip(splits, freed):
+        F[comp, v] = strength
+        F[comp, c] = 0.0
+    return F, repairs
+
+
 @dataclasses.dataclass(frozen=True)
 class QualityResult:
     fit: FitResult            # best-LLH cycle's result
     cycles_llh: Tuple[float, ...]   # converged LLH per cycle (as run)
     num_cycles: int
     total_iters: int
+    num_repairs: int = 0      # accepted merge+split repair rounds (the
+    # repair stage can push fit.llh ABOVE max(cycles_llh))
 
 
 def fit_quality(
@@ -208,6 +367,7 @@ def fit_quality(
 
     max_cycles = max(cfg.restart_cycles, 1)
     cfg_saved = model.cfg
+    accepted_repairs = 0
     # patience state survives resume (persisted in the checkpoint meta) so
     # the resumed schedule stops exactly where the uninterrupted one would
     gainless = restored_gainless
@@ -273,6 +433,49 @@ def fit_quality(
                         shutil.rmtree(cyc_dir, ignore_errors=True)
             if gainless >= cfg.restart_patience:
                 break
+        # --- discrete repair stage (cfg.quality_repair): merge fragment
+        # column pairs + split fat multi-component columns, re-anneal
+        # briefly, keep only on LLH improvement. Runs after (and outside)
+        # the checkpointed cycle loop — a resumed run redoes it
+        # deterministically (fixed kick streams). Repairs use the
+        # ORIGINAL-id graph: FitResult.F is in original ids even when a
+        # balanced sharded trainer relabeled rows internally.
+        if cfg.quality_repair and best is not None:
+            from bigclam_tpu.ops.extraction import delta_threshold
+
+
+            g_orig = getattr(model, "g_original", model.g)
+            delta = delta_threshold(
+                g_orig.num_nodes, g_orig.num_edges
+            )
+            for rr in range(max(cfg.repair_rounds, 0)):
+                F_rep, nrep = repair_communities(
+                    best.F, g_orig, delta, kc
+                )
+                if nrep == 0:
+                    break
+                cand = None
+                F_c = F_rep
+                for pc in range(6):       # polish: short re-annealing
+                    prng = np.random.default_rng(
+                        [cfg.seed, 0xF17, rr, pc]
+                    )
+                    F_try = np.asarray(F_c, np.float64).copy()
+                    F_try[:, :kc] = np.clip(
+                        F_try[:, :kc]
+                        + prng.uniform(0.0, eps, size=(n, kc)),
+                        cfg.min_f, cfg.max_f,
+                    )
+                    res = model.fit(F_try, callback=callback)
+                    total_iters += res.num_iters
+                    if cand is None or res.llh > cand.llh:
+                        cand = res
+                        F_c = res.F
+                if cand.llh > best.llh:
+                    best = cand
+                    accepted_repairs += 1
+                else:
+                    break
     finally:
         model.cfg = cfg_saved
         if rebuilt:
@@ -282,6 +485,7 @@ def fit_quality(
         cycles_llh=tuple(cycles_llh),
         num_cycles=len(cycles_llh),
         total_iters=total_iters,
+        num_repairs=accepted_repairs,
     )
 
 
@@ -307,10 +511,12 @@ def fit_quality_device(
     Differences from fit_quality, by design: the kick noise comes from
     jax.random (threefry, folded per cycle) instead of the host NumPy
     streams — deterministic for a fixed seed/mesh but NOT bit-identical to
-    the host schedule; checkpointing is not wired (a checkpoint IS a host
-    fetch — use the host loop where checkpointing matters more than
-    transfer cost). Stop rule, patience, MAX_P_ relaxation, and the kept-
-    LLH semantics are identical (shared _relax_params).
+    the host schedule; checkpointing is not wired, and neither is the
+    cfg.quality_repair merge+split stage (both are host-F passes — use
+    the host loop where they matter more than transfer cost;
+    num_repairs is always 0 here). Stop rule, patience, MAX_P_
+    relaxation, and the kept-LLH semantics are identical (shared
+    _relax_params).
     """
     import jax
     import jax.numpy as jnp

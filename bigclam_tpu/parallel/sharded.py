@@ -512,7 +512,10 @@ class ShardedBigClamModel:
             self.k_pad = self._csr_k_pad
         # degree-balanced relabeling (parallel/balance.py): the trainer runs
         # on the relabeled graph; F0 in / results out stay in original ids
+        # (g_original keeps the caller's id space for host-side passes that
+        # consume FitResult.F, e.g. quality repair)
         self._perm = None
+        self.g_original = g
         if balance and dp > 1:
             from bigclam_tpu.parallel.balance import balance_graph
 
