@@ -363,7 +363,8 @@ def main(argv=None) -> int:
         "--device-annealing", action="store_true",
         help="with --quality: keep the annealing schedule device-resident "
              "(models.quality.fit_quality_device — no per-cycle host F "
-             "round trip; pod-scale)",
+             "round trip; pod-scale). The quality_repair stage still runs "
+             "host-side on the final fetched F",
     )
     p_fit.add_argument("--out", default=None, help="write SNAP cmty file")
     p_fit.add_argument("--save-f", default=None, help="write F as .npy")

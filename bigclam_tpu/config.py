@@ -112,7 +112,16 @@ class BigClamConfig:
                                         # N=2400 probe to faithful-F1 while
                                         # 0.9999 recovers it. None = auto:
                                         # 1 - 1/(16 N / avg_deg) clamped to
-                                        # [max_p, 0.999999] (f32 floor)
+                                        # [max_p, 1 - 1e-15]. The ceiling
+                                        # is the f64 representability of
+                                        # max_p itself (eps(1.0)/2 ~ 1e-16)
+                                        # — NOT an f32 kernel limit: the
+                                        # kernels form 1-p as -expm1(-x)
+                                        # (ops.objective.edge_terms), exact
+                                        # to f32 RELATIVE eps at any
+                                        # amplification, so amp scales to
+                                        # 1e15 — past Friendster-class
+                                        # N = 1e6 * avg_deg (BASELINE 5)
     quality_conv_tol: float = 1e-6      # within-cycle convergence tolerance:
                                         # |LLH| grows with N*K, so the
                                         # reference's relative 1e-4 stops
@@ -134,8 +143,26 @@ class BigClamConfig:
                                         # improves (measured: F1
                                         # 0.894 -> 0.914, LLH -32037 ->
                                         # -31692 on the N=2400 probe)
-    repair_rounds: int = 3              # max repair passes (the detector
-                                        # usually runs dry after one)
+    repair_rounds: int = 3              # max discrete rounds (each round =
+                                        # one atomize attempt + one
+                                        # merge/split attempt; the loop
+                                        # stops early once neither accepts)
+    quality_reassign: bool = True       # atomize re-tiling inside the
+                                        # discrete stage (models.quality
+                                        # .atomize_reassign): shatter
+                                        # thresholded columns into graph
+                                        # components, re-seed K columns on
+                                        # the largest deduped atoms, refit,
+                                        # keep on LLH gain. Reaches the
+                                        # likelihood-optimum band annealing
+                                        # cannot (measured at N=12K K=500
+                                        # p_in=0.3: LLH -173.8K -> -156.3K,
+                                        # the band the round-5 planted
+                                        # anchor proved 7-10% above the
+                                        # plateau); at sub-identifiability
+                                        # p_in the F1 of the re-tiling is
+                                        # degenerate and may move either
+                                        # way (PARITY.md)
 
     # --- numerics ---
     dtype: str = "float32"              # F / gradient dtype on device

@@ -52,7 +52,9 @@ Round-4 additions (both measured on planted N=2400 K=100 p_in=0.3,
      (QUALITY_K5000_r04.json: N=120000, avg_deg 5.7, 4 gainless cycles,
      F1 0.001); measured the other way, pinning amp=100 at N=2400
      collapses quality F1 to the faithful 0.045. fit_quality relaxes
-     max_p to 1 - avg_deg/(16*N) (>= parity, <= 1-1e-6, the f32 floor),
+     max_p to 1 - avg_deg/(16*N) (>= parity, <= 1-1e-15 — the f64
+     representability of max_p; the kernels' -expm1(-x) form of 1-p has
+     no f32 floor, ops.objective.edge_terms),
      rebuilds the train step (model.rebuild_step — same kernels, new
      clip constant), and restores the parity step afterwards.
 
@@ -66,6 +68,21 @@ re-seeds the freed columns on fat columns' extra components; a short
 re-annealing polish follows and the result is kept only if LLH improves.
 Measured on the N=2400 probe: F1 0.894 -> 0.914, LLH -32037 -> -31692
 (planted optimum -31429).
+
+Round-5 addition, part 6 — atomize re-tiling (cfg.quality_reassign,
+default on; atomize_reassign): the round-5 planted anchor
+(MIDSCALE_ANCHOR_r05.json) proved the annealing plateau at 24-node
+blocks sits 7-10% of LLH BELOW a stable optimum band (planted F refits
+to itself at -156.59K while the quality run plateaus at -173.8K), so
+the plateau is an optimizer gap, not a model-family property. The
+plateau's defect class is SHIFTED partitions (each column = one block +
+a shard of a neighbor), which merge/split repair cannot unshift. The
+atomize move shatters every thresholded column into its graph
+components, dedupes majority-overlapping atoms, re-seeds the K columns
+on the largest atoms at their measured-density AGM strength, refits,
+and keeps on LLH gain (measured: -173.8K -> -156.26K in 2 accepted
+rounds at N=12K K=500 p_in=0.3). Runs inside the discrete stage
+(_repair_stage) interleaved with merge/split, every round LLH-gated.
 
 Works with every trainer (single-chip / all-gather sharded / ring). The
 required trainer surface is `.cfg`, `.g`, `.fit(F0, callback=)`, and
@@ -83,6 +100,7 @@ from __future__ import annotations
 import dataclasses
 import os
 import shutil
+import warnings
 from typing import Callable, List, Optional, Tuple
 
 import numpy as np
@@ -96,12 +114,15 @@ def auto_quality_max_p(
 ) -> float:
     """The auto MAX_P_ relaxation rule (single source — quality_gate.py
     records it too): amp = 16*N/avg_deg covers node degrees down to
-    avg/16. `floor` is the parity max_p (never relax BELOW it); the 1-1e-6
-    ceiling applies to the combined value — even a floor above it is
-    clamped, because past that point the f32 clip collapses 1-p to 0 and
-    log(1-p) = -inf poisons every cycle (see config.quality_max_p)."""
+    avg/16. `floor` is the parity max_p (never relax BELOW it); the
+    1-1e-15 ceiling applies to the combined value — even a floor above it
+    is clamped. The ceiling is where max_p itself stops being f64-
+    representable (1 - 1e-16 rounds to 1.0 and 1-max_p = 0 poisons the
+    clip); the KERNELS no longer impose a floor at all — edge_terms forms
+    1-p as -expm1(-x), exact to f32 relative eps at any amplification
+    (see config.quality_max_p)."""
     amp = 16.0 * num_nodes / max(avg_deg, 1.0)
-    return min(max(floor, 1.0 - 1.0 / amp), 1.0 - 1e-6)
+    return min(max(floor, 1.0 - 1.0 / amp), 1.0 - 1e-15)
 
 
 def _relax_params(model, n_live: int) -> Tuple[float, float]:
@@ -113,8 +134,9 @@ def _relax_params(model, n_live: int) -> Tuple[float, float]:
     deg(u)*amp > N (its neighbor term must beat -sumF), so the parity
     0.9999 freezes every kick dead once N > 1e4*avg_deg (the K=5000
     gate's original failure: 4 gainless cycles, F1 0.001). Auto rule in
-    auto_quality_max_p; explicit overrides validated against the f32
-    floor here. Kick scale: the kick's per-column sumF contribution
+    auto_quality_max_p; explicit overrides validated against the f64
+    representability ceiling here. Kick scale: the kick's per-column
+    sumF contribution
     (~eps*N/2) must stay comparable to one seeded ego-net column's mass
     (~avg_degree + 1) regardless of N (see config.init_noise).
     """
@@ -125,13 +147,26 @@ def _relax_params(model, n_live: int) -> Tuple[float, float]:
         max_p_q = auto_quality_max_p(
             model.g.num_nodes, avg_deg, floor=cfg.max_p
         )
-    elif not (0.0 < max_p_q <= 1.0 - 1e-6):
-        # beyond 1-1e-6 the f32 clip collapses 1-p to 0: log(1-p) = -inf
+    elif not (0.0 < max_p_q <= 1.0 - 1e-15):
+        # beyond 1-1e-15 the f64 value of max_p rounds toward 1.0 and the
+        # host-computed clip floor 1-max_p collapses to 0: log(0) = -inf
         # poisons every cycle's LLH and NaN defeats the patience stop —
         # fail fast instead of burning restart_cycles of chip time
         raise ValueError(
-            f"quality_max_p={max_p_q} out of range (need 0 < p <= 1-1e-6, "
-            "the smallest 1-p exactly representable around f32 1.0)"
+            f"quality_max_p={max_p_q} out of range (need 0 < p <= 1-1e-15, "
+            "the f64 representability floor of 1-max_p)"
+        )
+    elif max_p_q < cfg.max_p:
+        # sub-floor pinning TIGHTENS the clip mid-quality-run; measured to
+        # collapse recovery (F1 0.045 at amp=100, N=2400). Legal as an
+        # explicit measurement hook, but never what a production run wants.
+        warnings.warn(
+            f"quality_max_p={max_p_q} is BELOW the parity clip "
+            f"max_p={cfg.max_p}: the quality run will use a TIGHTER clip "
+            "than the faithful fit (gradient amplification capped at "
+            f"{1.0 / (1.0 - max_p_q):.3g}). This collapses recovery except "
+            "as a deliberate measurement hook.",
+            stacklevel=2,
         )
     eps = (
         cfg.init_noise
@@ -141,6 +176,121 @@ def _relax_params(model, n_live: int) -> Tuple[float, float]:
         )
     )
     return max_p_q, eps
+
+
+def _graph_components(mem: np.ndarray, indptr, indices) -> List[List[int]]:
+    """Connected components of the subgraph induced by `mem` (iterative
+    BFS over CSR adjacency; shared by repair_communities and
+    atomize_reassign)."""
+    mset = set(mem.tolist())
+    seen, comps = set(), []
+    for s0 in mem.tolist():
+        if s0 in seen:
+            continue
+        stack, comp = [int(s0)], []
+        seen.add(s0)
+        while stack:
+            u = stack.pop()
+            comp.append(u)
+            for v in indices[indptr[u] : indptr[u + 1]]:
+                v = int(v)
+                if v in mset and v not in seen:
+                    seen.add(v)
+                    stack.append(v)
+        comps.append(comp)
+    return comps
+
+
+def _gather_neighbors(nodes: np.ndarray, indptr, indices) -> np.ndarray:
+    """Concatenated CSR adjacency of `nodes` in one flat fancy-index
+    (position arange offset by each row's start) — the shared gather under
+    both density counters below."""
+    starts, ends = indptr[nodes], indptr[nodes + 1]
+    deg = ends - starts
+    total = int(deg.sum())
+    if total == 0:
+        return indices[:0]
+    off = np.repeat(np.cumsum(deg) - deg, deg)
+    return indices[np.repeat(starts, deg) + (np.arange(total) - off)]
+
+
+def _internal_density(members: np.ndarray, indptr, indices) -> float:
+    """Directed within-set edge density cnt/(s(s-1)) via one flat
+    neighbor gather + sort-based isin."""
+    m = np.asarray(members)
+    if m.size < 2:
+        return 0.0
+    nbr = _gather_neighbors(m, indptr, indices)
+    if nbr.size == 0:
+        return 0.0
+    cnt = int(np.isin(nbr, m).sum())
+    return cnt / (m.size * (m.size - 1))
+
+
+def atomize_reassign(
+    F: np.ndarray,
+    g,
+    delta: float,
+    k_active: int,
+    min_comp: int = 5,
+) -> Tuple[np.ndarray, int]:
+    """Discrete re-tiling move (cfg.quality_reassign): shatter every
+    thresholded column into its graph components ("atoms"), dedupe atoms
+    that majority-overlap an already-kept one (largest first), and
+    re-seed the K columns on the kept atoms at their AGM-consistent
+    strength s = sqrt(-log(1-d)) (d = atom's internal edge density — for
+    a planted p_in=0.3 block this is the 0.597 the prototype validated).
+
+    Why it exists (PARITY.md small-community account + the round-5
+    planted anchor, MIDSCALE_ANCHOR_r05.json): annealing's plateau at
+    24-node blocks consists of SHIFTED partitions — each column one
+    block plus a shard of a neighbor — and gradient dynamics cannot
+    unshift them, while the likelihood optimum band (planted F and its
+    near-degenerate re-tilings) sits 7-10% of LLH above. Shattering to
+    components + refit reaches that band (measured: -173.8K -> -156.26K
+    at N=12K K=500 p_in=0.3, 2 accepted rounds). The caller refits and
+    LLH-gates, so the move can only improve the model's own objective;
+    at sub-identifiability p_in the extracted F1 may move either way
+    (documented in PARITY.md) because the band is F1-degenerate.
+
+    Returns (reassigned F, number of kept atoms); num_atoms == 0 means
+    nothing to do (no thresholded structure).
+    """
+    F = np.asarray(F, np.float64)
+    n = g.num_nodes
+    ka = int(k_active)
+    mask = F[:n, :ka] >= delta
+    indptr, indices = g.indptr, g.indices
+    atoms: List[np.ndarray] = []
+    for c in range(ka):
+        mem = np.flatnonzero(mask[:, c])
+        if mem.size < min_comp:
+            continue
+        for comp in _graph_components(mem, indptr, indices):
+            if len(comp) >= min_comp:
+                atoms.append(np.sort(np.asarray(comp, np.int64)))
+    if not atoms:
+        return F.copy(), 0
+    atoms.sort(key=len, reverse=True)
+    kept: List[np.ndarray] = []
+    owner = np.full(n, -1, np.int64)
+    for at in atoms:
+        if len(kept) >= ka:
+            break
+        owners = owner[at]
+        hit = owners[owners >= 0]
+        if hit.size:
+            _, counts = np.unique(hit, return_counts=True)
+            if counts.max() >= 0.5 * at.size:
+                continue          # majority-duplicate of a kept atom
+        unowned = at[owners < 0]
+        owner[unowned] = len(kept)
+        kept.append(at)
+    F_new = np.zeros_like(F)
+    for c, at in enumerate(kept):
+        d = min(max(_internal_density(at, indptr, indices), 0.05), 0.95)
+        F_new[at, c] = float(np.sqrt(-np.log1p(-d)))
+    return F_new, len(kept)
 
 
 def repair_communities(
@@ -223,17 +373,22 @@ def repair_communities(
     indptr, indices = g.indptr, g.indices
 
     def excl_cross_density(a: int, b: int) -> float:
-        ea = msets[a] - msets[b]
-        eb = msets[b] - msets[a]
-        if not ea or not eb:
+        # vectorized exact count: gather the concatenated adjacency of the
+        # smaller exclusive side in one fancy-index, membership-test it
+        # against the other side with one sort-based np.isin — O((deg_sum
+        # + |other|) log) instead of a per-edge Python set scan (which at
+        # com-Amazon K~5k grew the detector's worst case to minutes)
+        ma, mb = members[a], members[b]          # sorted unique
+        ea = np.setdiff1d(ma, mb, assume_unique=True)
+        eb = np.setdiff1d(mb, ma, assume_unique=True)
+        if ea.size == 0 or eb.size == 0:
             return 0.0
-        small, other = (ea, eb) if len(ea) <= len(eb) else (eb, ea)
-        cnt = 0
-        for u in small:
-            for v in indices[indptr[u] : indptr[u + 1]]:
-                if int(v) in other:
-                    cnt += 1
-        return cnt / (len(ea) * len(eb))
+        small, other = (ea, eb) if ea.size <= eb.size else (eb, ea)
+        nbr = _gather_neighbors(small, indptr, indices)
+        if nbr.size == 0:
+            return 0.0
+        cnt = int(np.isin(nbr, other, assume_unique=False).sum())
+        return cnt / (ea.size * eb.size)
 
     merges, used = [], set()
     nominees = sorted(cross.items(), key=lambda kv: -kv[1])[: 4 * ka]
@@ -267,23 +422,7 @@ def repair_communities(
         return F, 0
     # split candidates: extra components of fat columns
     def components(mem):
-        mset = set(mem.tolist())
-        seen, comps = set(), []
-        for s in mem.tolist():
-            if s in seen:
-                continue
-            stack, comp = [int(s)], []
-            seen.add(s)
-            while stack:
-                u = stack.pop()
-                comp.append(u)
-                for v in indices[indptr[u] : indptr[u + 1]]:
-                    v = int(v)
-                    if v in mset and v not in seen:
-                        seen.add(v)
-                        stack.append(v)
-            comps.append(comp)
-        return comps
+        return _graph_components(mem, indptr, indices)
 
     splits = []
     for c in np.argsort(-sizes):
@@ -320,6 +459,145 @@ class QualityResult:
     total_iters: int
     num_repairs: int = 0      # accepted merge+split repair rounds (the
     # repair stage can push fit.llh ABOVE max(cycles_llh))
+
+
+def _repair_stage(
+    model,
+    best: FitResult,
+    kc: int,
+    eps: float,
+    callback,
+    checkpoints=None,
+    min_comp: int = 5,
+) -> Tuple[FitResult, int, int]:
+    """The DISCRETE improvement stage shared by fit_quality and
+    fit_quality_device. Each round tries (a) the atomize re-tiling
+    (atomize_reassign; cfg.quality_reassign) and (b) the merge/split
+    repair (repair_communities), each refit and kept only on LLH
+    improvement; the loop stops when a round accepts neither. Runs with model.cfg already swapped to the RELAXED
+    quality config (the polish fits anneal under the same clip the cycles
+    did); reads schedule knobs (repair_rounds, seed, min_f, max_f) off the
+    live cfg — identical values to the caller's saved cfg since the swap
+    touches only conv_tol/max_p.
+
+    Returns (best, accepted_repairs, extra_iters).
+
+    Checkpointing (SURVEY §5; VERDICT r4 item 7): with `checkpoints`, each
+    completed repair round saves under <dir>/repair/ with the
+    POST-ANNEALING best LLH stamped in the meta. A crash mid-repair
+    resumes from the last completed round instead of redoing hours of
+    polish fits. The stamp is also the invalidation rule that preserves
+    resume-extension exactness: a restart with a larger restart_cycles
+    changes the post-annealing best, the stamp mismatches, and the stale
+    repair checkpoint is discarded — repair restarts from the NEW
+    annealed state, exactly as an uninterrupted run would. Repair kick
+    streams are fixed per (round, polish) so a resumed round reproduces
+    the uninterrupted schedule.
+    """
+    from bigclam_tpu.ops.extraction import delta_threshold
+
+    cfg = model.cfg
+    n = best.F.shape[0]
+    accepted_repairs = 0
+    extra_iters = 0
+    anneal_llh = float(best.llh)       # the post-annealing stamp
+    start_round = 0
+    rep_ckpt = None
+    if checkpoints is not None:
+        from bigclam_tpu.utils.checkpoint import CheckpointManager
+
+        rep_ckpt = CheckpointManager(
+            os.path.join(checkpoints.directory, "repair")
+        )
+        restored = rep_ckpt.restore()
+        if restored is not None:
+            rr_done, arrays, meta = restored
+            if (
+                meta.get("anneal_llh") == anneal_llh
+                and int(meta.get("kick_cols", -1)) == kc
+                and meta.get("reassign") == bool(cfg.quality_reassign)
+                and meta.get("seed") == cfg.seed
+            ):
+                best = FitResult(
+                    F=np.asarray(arrays["F"]),
+                    sumF=np.asarray(arrays["F"]).sum(axis=0),
+                    llh=float(meta["best_llh"]),
+                    num_iters=best.num_iters,
+                    llh_history=(),
+                )
+                accepted_repairs = int(meta.get("accepted_repairs", 0))
+                extra_iters = int(meta.get("extra_iters", 0))
+                start_round = rr_done + 1
+                if meta.get("done"):
+                    return best, accepted_repairs, extra_iters
+            else:
+                # stale: written against a different annealing outcome
+                shutil.rmtree(rep_ckpt.directory, ignore_errors=True)
+                rep_ckpt = CheckpointManager(
+                    os.path.join(checkpoints.directory, "repair")
+                )
+
+    g_orig = getattr(model, "g_original", model.g)
+    delta = delta_threshold(g_orig.num_nodes, g_orig.num_edges)
+
+    def _save(rr: int, done: bool) -> None:
+        if rep_ckpt is not None and is_primary():
+            rep_ckpt.save(
+                rr,
+                {"F": np.asarray(best.F)},
+                meta={
+                    "best_llh": float(best.llh),
+                    "anneal_llh": anneal_llh,
+                    "kick_cols": kc,
+                    "reassign": bool(cfg.quality_reassign),
+                    "seed": cfg.seed,
+                    "accepted_repairs": accepted_repairs,
+                    "extra_iters": extra_iters,
+                    "done": done,
+                },
+            )
+
+    for rr in range(start_round, max(cfg.repair_rounds, 0)):
+        changed = False
+        # -- atomize re-tiling attempt (cfg.quality_reassign): one plain
+        # refit from the shattered seeding, no polish kicks (the validated
+        # prototype schedule) --
+        if cfg.quality_reassign:
+            F_at, n_atoms = atomize_reassign(
+                best.F, g_orig, delta, kc, min_comp=min_comp
+            )
+            if n_atoms:
+                res = model.fit(F_at, callback=callback)
+                extra_iters += res.num_iters
+                if res.llh > best.llh:
+                    best = res
+                    accepted_repairs += 1
+                    changed = True
+        # -- merge/split attempt with the round-4 kick-polish schedule --
+        F_rep, nrep = repair_communities(best.F, g_orig, delta, kc)
+        if nrep:
+            cand = None
+            F_c = F_rep
+            for pc in range(6):        # polish: short re-annealing
+                prng = np.random.default_rng([cfg.seed, 0xF17, rr, pc])
+                F_try = np.asarray(F_c, np.float64).copy()
+                F_try[:, :kc] = np.clip(
+                    F_try[:, :kc] + prng.uniform(0.0, eps, size=(n, kc)),
+                    cfg.min_f, cfg.max_f,
+                )
+                res = model.fit(F_try, callback=callback)
+                extra_iters += res.num_iters
+                if cand is None or res.llh > cand.llh:
+                    cand = res
+                    F_c = res.F
+            if cand.llh > best.llh:
+                best = cand
+                accepted_repairs += 1
+                changed = True
+        _save(rr, not changed)
+        if not changed:
+            break
+    return best, accepted_repairs, extra_iters
 
 
 def fit_quality(
@@ -361,6 +639,7 @@ def fit_quality(
     total_iters = 0
     start_cycle = 0
     restored_gainless = 0
+    max_p_q, eps = _relax_params(model, n)
 
     if checkpoints is not None:
         restored = checkpoints.restore()
@@ -376,6 +655,19 @@ def fit_quality(
                     f"quality checkpoint incompatible: kick_cols="
                     f"{meta.get('kick_cols')} vs {kc} "
                     f"(dir: {checkpoints.directory})"
+                )
+            # LLHs are computed under the step's clip bound: a checkpoint
+            # written under a different effective max_p carries best_llh /
+            # cycles_llh on a systematically different scale, silently
+            # skewing acceptance and patience on resume. A meta WITHOUT
+            # the stamp predates the MAX_P_ relaxation (its LLHs are
+            # parity-clip) — only compatible when no relaxation applies.
+            ck_max_p = meta.get("quality_max_p", cfg.max_p)
+            if ck_max_p != max_p_q:
+                raise ValueError(
+                    f"quality checkpoint incompatible: written under "
+                    f"max_p={ck_max_p}, this run relaxes to {max_p_q} — "
+                    f"LLH scales differ (dir: {checkpoints.directory})"
                 )
             F_cur = np.asarray(arrays["F"])
             cycles_llh = list(meta.get("cycles_llh", []))
@@ -394,7 +686,6 @@ def fit_quality(
     # patience state survives resume (persisted in the checkpoint meta) so
     # the resumed schedule stops exactly where the uninterrupted one would
     gainless = restored_gainless
-    max_p_q, eps = _relax_params(model, n)
     rebuilt = False
     try:
         # within-cycle fits use the TIGHTER quality_conv_tol (host-side
@@ -425,7 +716,15 @@ def fit_quality(
                     checkpoints.directory, f"cycle_{cycle:05d}"
                 )
                 cyc_ckpt = CheckpointManager(cyc_dir)
-            res = model.fit(F_try, callback=callback, checkpoints=cyc_ckpt)
+            # checkpoints= only when active: the documented trainer surface
+            # (.cfg, .g, .fit(F0, callback=), .rebuild_step()) stays
+            # sufficient for duck-typed trainers unless within-cycle
+            # checkpointing was explicitly requested
+            res = (
+                model.fit(F_try, callback=callback, checkpoints=cyc_ckpt)
+                if cyc_ckpt is not None
+                else model.fit(F_try, callback=callback)
+            )
             total_iters += res.num_iters
             cycles_llh.append(res.llh)
             prev_best = best.llh if best is not None else None
@@ -448,6 +747,7 @@ def fit_quality(
                             "gainless": gainless,
                             "quality_nk": [n, k],
                             "kick_cols": kc,
+                            "quality_max_p": max_p_q,
                         },
                     )
                     if cyc_dir is not None:
@@ -456,53 +756,19 @@ def fit_quality(
                         shutil.rmtree(cyc_dir, ignore_errors=True)
             if gainless >= cfg.restart_patience:
                 break
-        # --- discrete repair stage (cfg.quality_repair): merge fragment
-        # column pairs + split fat multi-component columns, re-anneal
-        # briefly, keep only on LLH improvement. Runs after (and outside)
-        # the checkpointed cycle loop: deliberately NOT checkpointed — a
-        # repair checkpoint would shadow the cycle checkpoints and break
-        # resume-extension exactness (a restart with a larger
-        # restart_cycles must continue from the PRE-repair kept F). The
-        # cost is that a resume after a completed run redoes the repair
-        # fits; the redo is deterministic (fixed kick streams). Repairs
-        # use the ORIGINAL-id graph: FitResult.F is in original ids even
-        # when a balanced sharded trainer relabeled rows internally.
+        # --- discrete repair stage (cfg.quality_repair; _repair_stage):
+        # runs after the cycle loop, checkpointed under <dir>/repair/ with
+        # the post-annealing best LLH as its invalidation stamp — a
+        # restart with a larger restart_cycles changes that stamp, the
+        # stale repair checkpoint is discarded, and repair restarts from
+        # the NEW annealed state (resume-extension exactness preserved).
+        # Repairs use the ORIGINAL-id graph: FitResult.F is in original
+        # ids even when a balanced sharded trainer relabeled rows.
         if cfg.quality_repair and best is not None:
-            from bigclam_tpu.ops.extraction import delta_threshold
-
-
-            g_orig = getattr(model, "g_original", model.g)
-            delta = delta_threshold(
-                g_orig.num_nodes, g_orig.num_edges
+            best, accepted_repairs, rep_iters = _repair_stage(
+                model, best, kc, eps, callback, checkpoints=checkpoints
             )
-            for rr in range(max(cfg.repair_rounds, 0)):
-                F_rep, nrep = repair_communities(
-                    best.F, g_orig, delta, kc
-                )
-                if nrep == 0:
-                    break
-                cand = None
-                F_c = F_rep
-                for pc in range(6):       # polish: short re-annealing
-                    prng = np.random.default_rng(
-                        [cfg.seed, 0xF17, rr, pc]
-                    )
-                    F_try = np.asarray(F_c, np.float64).copy()
-                    F_try[:, :kc] = np.clip(
-                        F_try[:, :kc]
-                        + prng.uniform(0.0, eps, size=(n, kc)),
-                        cfg.min_f, cfg.max_f,
-                    )
-                    res = model.fit(F_try, callback=callback)
-                    total_iters += res.num_iters
-                    if cand is None or res.llh > cand.llh:
-                        cand = res
-                        F_c = res.F
-                if cand.llh > best.llh:
-                    best = cand
-                    accepted_repairs += 1
-                else:
-                    break
+            total_iters += rep_iters
     finally:
         model.cfg = cfg_saved
         if rebuilt:
@@ -538,10 +804,11 @@ def fit_quality_device(
     Differences from fit_quality, by design: the kick noise comes from
     jax.random (threefry, folded per cycle) instead of the host NumPy
     streams — deterministic for a fixed seed/mesh but NOT bit-identical to
-    the host schedule; checkpointing is not wired, and neither is the
-    cfg.quality_repair merge+split stage (both are host-F passes — use
-    the host loop where they matter more than transfer cost;
-    num_repairs is always 0 here). Stop rule, patience, MAX_P_
+    the host schedule; checkpointing is not wired (a host-F pass — use
+    the host loop where it matters more than transfer cost). The
+    cfg.quality_repair merge+split stage DOES run (host-side, on the
+    final fetched F — the one fetch proves F fits the host; each polish
+    fit re-uploads F on sharded trainers). Stop rule, patience, MAX_P_
     relaxation, and the kept-LLH semantics are identical (shared
     _relax_params).
     """
@@ -620,20 +887,37 @@ def fit_quality_device(
                 gainless = gainless + 1 if gain < cfg.restart_tol else 0
             if gainless >= cfg.restart_patience:
                 break
+        # still under the RELAXED cfg: the fetch does not depend on it, and
+        # the discrete stage's refits must anneal under the same clip the
+        # cycles did — one swap/rebuild round-trip for the whole schedule
+        F_best = model.extract_F(best_state)   # the ONE device->host fetch
+        # same FitResult contract as the host loop: the BEST cycle's
+        # iteration count and LLH trace (total_iters on the QualityResult)
+        fit = FitResult(
+            F=F_best, sumF=F_best.sum(axis=0), llh=best_llh,
+            num_iters=best_iters, llh_history=best_hist,
+        )
+        accepted_repairs = 0
+        if cfg.quality_repair:
+            # the discrete stage is a host-F pass; the fetch above just
+            # proved F fits the host, so run it here instead of silently
+            # dropping a default-on stage (the device path then matches
+            # the host loop's quality). Each refit re-uploads F (sharded
+            # trainers) — transfer cost traded for schedule parity.
+            # Un-checkpointed on this path (checkpointing is not wired
+            # here at all).
+            fit, accepted_repairs, rep_iters = _repair_stage(
+                model, fit, kc, eps, callback
+            )
+            total_iters += rep_iters
     finally:
         model.cfg = cfg_saved
         if rebuilt:
             model.rebuild_step()
-    F_best = model.extract_F(best_state)   # the ONE device->host fetch
-    # same FitResult contract as the host loop: the BEST cycle's iteration
-    # count and LLH trace (total_iters lives on the QualityResult)
-    fit = FitResult(
-        F=F_best, sumF=F_best.sum(axis=0), llh=best_llh,
-        num_iters=best_iters, llh_history=best_hist,
-    )
     return QualityResult(
         fit=fit,
         cycles_llh=tuple(cycles_llh),
         num_cycles=len(cycles_llh),
         total_iters=total_iters,
+        num_repairs=accepted_repairs,
     )

@@ -43,9 +43,23 @@ class EdgeChunks(NamedTuple):
 
 
 def edge_terms(x: jax.Array, cfg: BigClamConfig) -> Tuple[jax.Array, jax.Array]:
-    """Per-edge clipped probability p = clip(exp(-x)) and LLH term log(1-p)+x."""
-    p = jnp.clip(jnp.exp(-x), cfg.min_p, cfg.max_p)
-    return p, jnp.log1p(-p) + x
+    """Per-edge clipped survival 1-p (p = exp(-x)) and LLH term log(1-p)+x.
+
+    1-p is formed DIRECTLY as -expm1(-x), then clipped: p in [min_p, max_p]
+    <=> 1-p in [1-max_p, 1-min_p] (bounds computed on the host in f64).
+    The naive 1 - clip(exp(-x)) loses all relative precision near p=1 —
+    in f32, exp(-x) rounds to 1.0 once x < 2^-24, collapsing 1-p to 0 and
+    capping the gradient's 1/(1-p) neighbor amplification at ~1.7e7; with
+    expm1 the small-x branch is exact to f32 eps RELATIVE error down to
+    denormals, so the MAX_P_ relaxation (models/quality.py) scales to the
+    f64 representability floor of max_p itself (1 - ~1e-15) instead of the
+    old f32 ceiling of 1e6. Identical math in every path: XLA edge sweep,
+    both Pallas kernel families, and the ring/sharded phase bodies all
+    call this function.
+    Returns (one_minus_p, ell); gradient coefficient = mask / one_minus_p.
+    """
+    omp = jnp.clip(-jnp.expm1(-x), 1.0 - cfg.max_p, 1.0 - cfg.min_p)
+    return omp, jnp.log(omp) + x
 
 
 def node_tail(F: jax.Array, sumF: jax.Array) -> jax.Array:
@@ -68,8 +82,8 @@ def grad_llh(
         s, d, m = sdm
         fd = F[d]
         x = jnp.einsum("ek,ek->e", F[s], fd)
-        p, ell = edge_terms(x, cfg)
-        coeff = m / (1.0 - p)              # folds the +sum_N F_v term
+        omp, ell = edge_terms(x, cfg)
+        coeff = m / omp                    # folds the +sum_N F_v term
         nbr_llh = nbr_llh + jax.ops.segment_sum(
             (ell * m).astype(adt), s, num_segments=n, indices_are_sorted=True
         )

@@ -179,9 +179,9 @@ def _grad_kernel(bid_ref, srcl_ref, mask_ref, fd_ref, f_blk_ref,
         precision=_PREC, preferred_element_type=fd.dtype,
     )
     x = jnp.sum(fs * fd, axis=1)            # (T,) edge dots, VPU f32
-    p, ell_raw = edge_terms(x, cfg)         # same clipping as the XLA path
+    omp, ell_raw = edge_terms(x, cfg)       # same clipping as the XLA path
     ell = ell_raw * m
-    coeff = m / (1.0 - p)                   # folds the +sum_N F_v term
+    coeff = m / omp                         # folds the +sum_N F_v term
     contrib = lax.dot_general(              # scatter: (B, K) block partial
         one, fd * coeff[:, None], (((1,), (0,)), ((), ())),
         precision=_PREC, preferred_element_type=fd.dtype,
@@ -457,9 +457,9 @@ def _grad_from_x_kernel(bid_ref, srcl_ref, mask_ref, x_ref, fd_ref,
     x = x_ref[0, 0]                         # (T,) FULL edge dots (post-psum)
     fd = fd_ref[0]                          # (T, K_loc)
     one = _expand_onehot(srcl, block_b, fd.dtype)
-    p, ell_raw = edge_terms(x, cfg)
+    omp, ell_raw = edge_terms(x, cfg)
     ell = ell_raw * m
-    coeff = m / (1.0 - p)
+    coeff = m / omp
     contrib = lax.dot_general(
         one, fd * coeff[:, None], (((1,), (0,)), ((), ())),
         precision=_PREC, preferred_element_type=fd.dtype,

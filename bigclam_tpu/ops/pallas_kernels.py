@@ -14,8 +14,8 @@ semantics are bit-identical to ops.linesearch.candidates_pass's inner body:
 
     nf  = clip(F_src + eta * grad_src, min_f, max_f)
     x   = sum(nf * F_dst, axis=-1)
-    p   = clip(exp(-x), min_p, max_p)
-    ell = log1p(-p) + x        (masked)
+    omp = clip(-expm1(-x), 1-max_p, 1-min_p)   # ops.objective.edge_terms
+    ell = log(omp) + x         (masked)
 
 Layout: edge tiles (BLOCK_E, K_pad) with K_pad a multiple of 128 lanes;
 the eta loop is unrolled at trace time (16 candidates). Correctness vs the
@@ -33,6 +33,7 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from bigclam_tpu.config import BigClamConfig
+from bigclam_tpu.ops.objective import edge_terms
 
 BLOCK_E = 1024          # edges per tile: 3 * 1024 * 128 * 4B = 1.5 MB at K=128
 VMEM_BUDGET_BYTES = 10 * 1024 * 1024   # input tiles must fit well under ~16 MB
@@ -63,8 +64,8 @@ def _cand_kernel(fs_ref, gs_ref, fd_ref, m_ref, out_ref, *, etas, cfg):
     for i, eta in enumerate(etas):
         nf = jnp.clip(fs + eta * gs, cfg.min_f, cfg.max_f)
         x = jnp.sum(nf * fd, axis=1)
-        p = jnp.clip(jnp.exp(-x), cfg.min_p, cfg.max_p)
-        out_ref[i, :] = (jnp.log1p(-p) + x) * m
+        _, ell = edge_terms(x, cfg)         # single source of the clip math
+        out_ref[i, :] = ell * m
 
 
 def candidate_edge_terms(

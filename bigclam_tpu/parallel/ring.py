@@ -129,8 +129,8 @@ def make_ring_train_step(
             s, d, m = sdm
             fs, fd = F_loc[s], F_rot[d]
             x = lax.psum(jnp.einsum("ek,ek->e", fs, fd), K_AXIS)
-            p, ell = edge_terms(x, cfg)
-            coeff = m / (1.0 - p)
+            omp, ell = edge_terms(x, cfg)
+            coeff = m / omp
             return (
                 nbr_llh + jax.ops.segment_sum(
                     (ell * m).astype(adt), s, num_segments=n_loc,
@@ -220,7 +220,14 @@ def make_ring_train_step(
     # edge arrays as jit ARGUMENTS (multi-controller: no closing over
     # non-addressable-device arrays; see parallel/sharded.py)
     jitted = jax.jit(step)
-    return lambda state: jitted(state, edges.src, edges.dst, edges.mask)
+
+    def step_fn(state):
+        return jitted(state, edges.src, edges.dst, edges.mask)
+
+    # AOT handles for scripts/ring_memory.py's compiler memory analysis
+    step_fn.jitted = jitted
+    step_fn.jit_args = (edges.src, edges.dst, edges.mask)
+    return step_fn
 
 
 def make_ring_csr_train_step(
@@ -540,10 +547,19 @@ def make_ring_csr_train_step(
     # tile arrays as jit ARGUMENTS (multi-controller: no closing over
     # non-addressable-device arrays; see parallel/sharded.py)
     jitted = jax.jit(step)
-    return lambda state: jitted(
-        state, tiles["src_local"], tiles["dst_local"], tiles["mask"],
+
+    def step_fn(state):
+        return jitted(
+            state, tiles["src_local"], tiles["dst_local"], tiles["mask"],
+            tiles["block_id"],
+        )
+
+    step_fn.jitted = jitted
+    step_fn.jit_args = (
+        tiles["src_local"], tiles["dst_local"], tiles["mask"],
         tiles["block_id"],
     )
+    return step_fn
 
 
 class RingBigClamModel(ShardedBigClamModel):
@@ -553,7 +569,21 @@ class RingBigClamModel(ShardedBigClamModel):
     With the blocked-CSR kernels engaged (auto on TPU) each ring phase runs
     the MXU kernels over its (shard, phase) tile bucket; with the K axis
     also sharded (tp > 1) each phase uses the TP kernel split (partial dots
-    + psum over "k"). The XLA chunk-scan schedule remains the fallback."""
+    + psum over "k"). The XLA chunk-scan schedule remains the fallback.
+
+    EDGE-ORDER SENSITIVITY (measured, RINGMEM_r05.json): the per-(shard,
+    phase) edge buckets are padded to the LARGEST bucket so phases can run
+    under one compiled scan. On a graph whose node ids are locality-
+    ordered (contiguous communities, BFS/DFS orderings), ~every edge is
+    shard-local, the diagonal bucket holds ~all of the shard's edges, and
+    the padded sweep does up to dp x the real edge work — the entire
+    "7.8x ring slowdown" in WEAKSCALING_r04 (15.7x padded slots at dp=8).
+    With edges spread uniformly over shard pairs the buckets balance and
+    the ring steps at PARITY with the all-gather schedule while holding
+    peak per-device F memory at O(2 * N/dp * K_loc) vs O(N * K_loc)
+    (all-gather peak grows ~one per-shard F per added shard; compiler-
+    verified). For locality-ordered inputs, shuffle/relabel node ids (or
+    use balance=True, which relabels) before the ring schedule."""
 
     @property
     def engaged_path(self) -> str:

@@ -354,10 +354,19 @@ def make_sharded_csr_train_step(
     # devices is an error (caught by tests/test_multihost.py's true
     # two-process test)
     jitted = jax.jit(step)
-    return lambda state: jitted(
-        state, tiles["src_local"], tiles["dst"], tiles["mask"],
-        tiles["block_id"],
+
+    def step_fn(state):
+        return jitted(
+            state, tiles["src_local"], tiles["dst"], tiles["mask"],
+            tiles["block_id"],
+        )
+
+    # AOT handles for scripts/ring_memory.py's compiler memory analysis
+    step_fn.jitted = jitted
+    step_fn.jit_args = (
+        tiles["src_local"], tiles["dst"], tiles["mask"], tiles["block_id"],
     )
+    return step_fn
 
 
 def make_sharded_train_step(
@@ -389,8 +398,8 @@ def make_sharded_train_step(
             s, d, m = sdm
             fs, fd = F_loc[s], F_full[d]
             x = lax.psum(jnp.einsum("ek,ek->e", fs, fd), K_AXIS)
-            p, ell = edge_terms(x, cfg)
-            coeff = m / (1.0 - p)
+            omp, ell = edge_terms(x, cfg)
+            coeff = m / omp
             nbr_llh = nbr_llh + jax.ops.segment_sum(
                 (ell * m).astype(adt), s, num_segments=n_loc,
                 indices_are_sorted=True,
@@ -468,7 +477,13 @@ def make_sharded_train_step(
     # edge arrays as jit ARGUMENTS (multi-controller: no closing over
     # non-addressable-device arrays; see make_sharded_csr_train_step)
     jitted = jax.jit(step)
-    return lambda state: jitted(state, edges.src, edges.dst, edges.mask)
+
+    def step_fn(state):
+        return jitted(state, edges.src, edges.dst, edges.mask)
+
+    step_fn.jitted = jitted
+    step_fn.jit_args = (edges.src, edges.dst, edges.mask)
+    return step_fn
 
 
 class ShardedBigClamModel:

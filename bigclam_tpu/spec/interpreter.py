@@ -46,6 +46,15 @@ def _edge_terms(F_src_rows, F_dst_rows, cfg: BigClamConfig):
     """Per-directed-edge dot, clipped prob, and LLH term log(1-p) + x."""
     x = np.einsum("ek,ek->e", F_src_rows, F_dst_rows)
     p = np.clip(np.exp(-x), cfg.min_p, cfg.max_p)
+    # DELIBERATE form divergence from the implementation: the spec keeps
+    # the reference's own f64 subtraction 1 - clip(exp(-x)) (the Scala
+    # code's arithmetic), while every production path computes the
+    # survival directly as clip(-expm1(-x), ...) (ops.objective.edge_terms)
+    # for f32 stability under the quality-mode MAX_P_ relaxation. In f64
+    # at parity clips the two agree to ~1e-15 relative (the trajectory
+    # equality tests pin this); in the RELAXED regime (max_p -> 1-1e-15)
+    # the spec's subtraction collapses first — the spec is the REFERENCE
+    # oracle, not an oracle for the relaxed extension.
     return x, p, np.log(1.0 - p) + x
 
 

@@ -1,0 +1,131 @@
+"""com-Orkut-class end-to-end ladder on ONE chip (VERDICT r4 item 5).
+
+SEEDING_r04.json proved the host seeding pass at 100M directed edges; this
+script proves the FULL pipeline at that scale on hardware: synthetic graph
+build -> ingest/symmetrize/CSR -> conductance seeding -> F init ->
+K-blocked CSR fit iterations on the accelerator -> device-side extraction.
+Every stage is timed; one JSON line is the artifact.
+
+    python scripts/e2e_ladder.py [n] [m_edges_millions] [k] [iters] [out.json]
+
+Defaults: N=3,000,000 nodes, 50M undirected edges (~100M directed after
+symmetrize+dedup), K=256, 5 timed optimizer iterations.
+
+Sizing: the train step holds three (N_pad, K_pad) f32 arrays at peak
+(F, grad, F_new) -> 3M x 256 x 4B x 3 ~ 9.2 GB, plus ~1 GB CSR edge
+arrays: fits a 16 GB v5e with headroom. K-blocking is forced via
+cfg.csr_k_block=128 so the csr_grouped_kb kernel path (the pod-scale
+large-K path, BASELINE configs 3-5) is what runs on hardware — on a TPU
+backend a silent fallback FAILS the run rather than polluting the artifact.
+
+Scale anchor: BASELINE config 4 (com-Orkut N=3.07M, E=117M); the
+reference's own proof-of-scale was its 36-core HDFS cluster run
+(/root/reference/codes/bigclam4-7.scala:14,45).
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def main() -> int:
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 3_000_000
+    m_m = float(sys.argv[2]) if len(sys.argv) > 2 else 50.0
+    k = int(sys.argv[3]) if len(sys.argv) > 3 else 256
+    iters = int(sys.argv[4]) if len(sys.argv) > 4 else 5
+    out_path = sys.argv[5] if len(sys.argv) > 5 else None
+
+    import jax
+
+    if os.environ.get("E2E_CPU"):
+        # smoke-test hook: the outer env pins JAX_PLATFORMS to the real
+        # TPU and the axon plugin hooks get_backend, so an env override is
+        # too late — jax.config before backend init is what works
+        jax.config.update("jax_platforms", "cpu")
+
+    from bigclam_tpu.config import BigClamConfig
+    from bigclam_tpu.models import BigClamModel
+    from bigclam_tpu.ops import extraction, seeding
+    from scripts.seeding_bench import build_synthetic
+
+    on_tpu = jax.default_backend() == "tpu"
+    sec = {}
+    rng = np.random.default_rng(0)
+
+    t0 = time.time()
+    g = build_synthetic(n, int(m_m * 1e6), rng)
+    sec["graph_build"] = round(time.time() - t0, 1)
+    e = g.num_directed_edges
+
+    cfg = BigClamConfig(num_communities=k, csr_k_block=128)
+
+    t0 = time.time()
+    seeds = seeding.conductance_seeds(g, cfg)
+    sec["seeding"] = round(time.time() - t0, 1)
+
+    t0 = time.time()
+    F0 = seeding.init_F(g, seeds, cfg, np.random.default_rng(1)).astype(
+        np.float32
+    )
+    sec["init_F"] = round(time.time() - t0, 1)
+
+    t0 = time.time()
+    model = BigClamModel(g, cfg)
+    state = model.init_state(F0)
+    del F0
+    state = model._step(state)          # compile + first step
+    jax.block_until_ready(state.F)
+    sec["compile_first_step"] = round(time.time() - t0, 1)
+    if on_tpu and model.engaged_path != "csr_grouped_kb":
+        raise RuntimeError(
+            f"K-blocked path did not engage on TPU: {model.engaged_path} "
+            f"({model.path_reason})"
+        )
+    llh0 = float(state.llh)
+
+    t0 = time.time()
+    for _ in range(iters):
+        state = model._step(state)
+    jax.block_until_ready(state.F)
+    dt = time.time() - t0
+    sec["fit_iters"] = round(dt, 1)
+    eps = e * iters / dt
+
+    t0 = time.time()
+    comms = extraction.extract_communities_device(
+        state.F, g, num_communities=k
+    )
+    sec["extraction"] = round(time.time() - t0, 1)
+
+    rec = {
+        "bench": "e2e-ladder",
+        "config": f"synthetic N={n} 2E={e} K={k} iters={iters}",
+        "backend": jax.default_backend(),
+        "path": model.engaged_path,
+        "seconds": sec,
+        "total_seconds": round(sum(sec.values()), 1),
+        "fit_edges_per_sec": round(eps, 1),
+        "llh_first": llh0,
+        "llh_last": float(state.llh),
+        "llh_monotone": float(state.llh) >= llh0,
+        "num_communities_extracted": len(comms),
+        "pass": bool(
+            (not on_tpu or model.engaged_path == "csr_grouped_kb")
+            and float(state.llh) >= llh0
+        ),
+    }
+    line = json.dumps(rec)
+    print(line)
+    if out_path:
+        with open(out_path, "w") as f:
+            f.write(line + "\n")
+    return 0 if rec["pass"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,112 @@
+"""External anchor for the p_in=0.3 midscale identifiability claim
+(VERDICT r4 item 4).
+
+QUALITY_MIDSCALE_r04.json records F1 0.761 at planted N=12K K=500
+p_in=0.3 (24-node blocks) and the builder adjudicated it as an AGM
+identifiability threshold (p_in=0.5 recovers 1.0). This script grounds
+that claim the way the K=300 probe grounded the quality mechanisms
+(models/quality.py round-4 diagnosis): initialize AT the planted optimum
+and run the FAITHFUL fit.
+
+  * planted-init lands at F1 ~ 1.0 with LLH above the quality run's
+    -> the planted structure IS a stable, better optimum: the quality
+       mechanisms have a real midscale gap (threshold claim refuted);
+  * planted-init degrades toward F1 ~ 0.76 and/or its converged LLH is
+    not above the quality run's
+    -> the data itself does not prefer the planted structure at this
+       p_in: identifiability threshold confirmed.
+
+    python scripts/planted_anchor.py [n] [k] [p_in] [out.json]
+
+Defaults match QUALITY_MIDSCALE_r04: N=12000, K=500, p_in=0.3 (same
+sampler seed 7 -> same graph).
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+QUALITY_MIDSCALE_LLH = -173787.828125   # QUALITY_MIDSCALE_r04.json
+QUALITY_MIDSCALE_F1 = 0.761
+
+
+def main() -> int:
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 12000
+    k = int(sys.argv[2]) if len(sys.argv) > 2 else 500
+    p_in = float(sys.argv[3]) if len(sys.argv) > 3 else 0.3
+    out_path = sys.argv[4] if len(sys.argv) > 4 else None
+
+    import jax
+
+    if os.environ.get("E2E_CPU"):
+        jax.config.update("jax_platforms", "cpu")
+
+    from bigclam_tpu.config import BigClamConfig
+    from bigclam_tpu.evaluation import avg_f1
+    from bigclam_tpu.models import BigClamModel
+    from bigclam_tpu.models.agm import sample_planted_graph
+    from bigclam_tpu.ops import extraction
+    from bigclam_tpu.spec import interpreter as spec
+
+    rng = np.random.default_rng(7)       # quality_gate.py's sampler seed
+    g, truth = sample_planted_graph(n, k, p_in=p_in, rng=rng)
+    cfg = BigClamConfig(num_communities=k)       # faithful parity semantics
+
+    # planted F: one shared community per within-block pair ->
+    # P(edge) = 1 - exp(-s^2) = p_in at s = sqrt(-log(1-p_in))
+    s = float(np.sqrt(-np.log1p(-p_in)))
+    F_planted = np.zeros((g.num_nodes, k), np.float64)
+    for c, members in enumerate(truth):
+        F_planted[members, c] = s
+
+    model = BigClamModel(g, cfg)
+    llh_at_planted = float(
+        spec.loglikelihood(F_planted, F_planted.sum(0), g, cfg)
+    )
+
+    t0 = time.time()
+    res = model.fit(F_planted)
+    dt = time.time() - t0
+
+    delta = extraction.delta_threshold(g.num_nodes, g.num_edges)
+    comms = extraction.extract_communities(res.F, g, delta)
+    f1 = avg_f1([set(c) for c in comms.values()], [set(t) for t in truth])
+
+    stayed = f1 >= 0.95
+    rec = {
+        "gate": "planted-init anchor (midscale identifiability)",
+        "config": f"planted AGM N={n} K={k} p_in={p_in} "
+                  f"2E={g.num_directed_edges}",
+        "backend": jax.default_backend(),
+        "planted_strength": s,
+        "llh_at_planted_init": llh_at_planted,
+        "llh_after_faithful_fit": float(res.llh),
+        "f1_after_faithful_fit": float(f1),
+        "num_iters": res.num_iters,
+        "seconds": round(dt, 1),
+        "quality_run_llh": QUALITY_MIDSCALE_LLH,
+        "quality_run_f1": QUALITY_MIDSCALE_F1,
+        "planted_beats_quality_llh": float(res.llh) > QUALITY_MIDSCALE_LLH,
+        # verdict semantics, not pass/fail: which story does the data tell?
+        "verdict": (
+            "mechanism-gap: planted F is a stable fixed point well above "
+            "the quality run's plateau"
+            if stayed and float(res.llh) > QUALITY_MIDSCALE_LLH
+            else "threshold-confirmed: data does not prefer planted structure"
+        ),
+    }
+    line = json.dumps(rec)
+    print(line)
+    if out_path:
+        with open(out_path, "w") as f:
+            f.write(line + "\n")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -34,6 +34,11 @@ def main() -> int:
 
     import jax
 
+    if os.environ.get("E2E_CPU"):
+        # CPU stand-in hook (tunnel-down runs): jax.config before backend
+        # init is the mechanism that works under the axon plugin
+        jax.config.update("jax_platforms", "cpu")
+
     from bigclam_tpu.config import BigClamConfig
     from bigclam_tpu.evaluation import avg_f1
     from bigclam_tpu.models import BigClamModel

@@ -1,0 +1,150 @@
+"""MAX_P_ relaxation past the old f32 amp ceiling: the N=5M gate
+(VERDICT r4 item 3).
+
+The quality-mode relaxation needs amp = 16N/avg_deg; at N=5M, avg_deg~4
+that is 2e7 — beyond the 1e6 ceiling the old `1 - clip(exp(-x))` f32 form
+imposed (exp(-x) rounds to 1.0 below x = 2^-24). ops.objective.edge_terms
+now forms 1-p as -expm1(-x) (full f32 relative precision at any
+amplification), so the auto rule relaxes all the way. This gate PROVES the
+regime is functional at the actual scale: same graph, same kicked init,
+a few optimizer steps under (a) the old ceiling amp=1e6 and (b) the auto
+relaxation amp=2e7, measuring
+
+  * movement of noise-level entries on low-degree nodes (deg <= avg):
+    under (a) these are provably frozen (deg * amp < N -> the neighbor
+    term cannot beat -sumF), under (b) they move;
+  * the accepted-step histogram (TrainState.accept_hist): (b) must accept
+    real candidate steps, not the 1e-15 tail.
+
+    python scripts/relax_floor_gate.py [n] [m_edges_millions] [k] [out.json]
+
+Defaults: N=5,000,000, 10M undirected edges, K=16, 3 iterations/config.
+Runs on any backend (CPU: ~minutes at f32).
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def main() -> int:
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 5_000_000
+    m_m = float(sys.argv[2]) if len(sys.argv) > 2 else 10.0
+    k = int(sys.argv[3]) if len(sys.argv) > 3 else 16
+    out_path = sys.argv[4] if len(sys.argv) > 4 else None
+
+    import jax
+
+    if os.environ.get("E2E_CPU"):
+        jax.config.update("jax_platforms", "cpu")
+
+    from bigclam_tpu.config import BigClamConfig
+    from bigclam_tpu.models import BigClamModel
+    from bigclam_tpu.models.quality import _relax_params, auto_quality_max_p
+    from bigclam_tpu.ops import seeding
+    from scripts.seeding_bench import build_synthetic
+
+    rng = np.random.default_rng(11)
+    t0 = time.time()
+    g = build_synthetic(n, int(m_m * 1e6), rng)
+    avg_deg = g.num_directed_edges / n
+    amp_needed = 16.0 * n / avg_deg
+    t_build = time.time() - t0
+
+    deg = np.diff(g.indptr)
+    base = BigClamConfig(num_communities=k, quality_mode=True, max_iters=3)
+    seeds = seeding.conductance_seeds(g, base)
+    F0 = seeding.init_F(g, seeds, base, np.random.default_rng(1)).astype(
+        np.float32
+    )
+    model0 = BigClamModel(g, base)
+    _, eps = _relax_params(model0, n)
+    kick = np.random.default_rng([11, 0x5EED]).uniform(
+        0.0, eps, size=F0.shape
+    ).astype(np.float32)
+    F_kicked = np.clip(F0 + kick, base.min_f, base.max_f)
+    # the measured population: entries that are NOISE-level after the kick
+    # (no seeded mass) on LOW-degree nodes — the provably-frozen set under
+    # the old ceiling (deg * 1e6 < N <=> deg < 5 here)
+    low_deg = deg <= max(int(avg_deg), 1)
+    noise_mask = (F0 <= 0.0) & low_deg[:, None]
+    del F0, kick
+
+    def run(tag: str, max_p_q: float):
+        cfg = base.replace(max_p=max_p_q)
+        model = BigClamModel(g, cfg)
+        hists = []
+
+        def cb(it, llh, extras=None):
+            if extras and extras.get("accept_hist") is not None:
+                hists.append(extras["accept_hist"])
+
+        t0 = time.time()
+        res = model.fit(F_kicked, callback=cb)
+        dt = time.time() - t0
+        dF = np.abs(
+            np.asarray(res.F[:n], np.float64) - F_kicked.astype(np.float64)
+        )
+        moved = dF[noise_mask]
+        return {
+            "max_p": max_p_q,
+            "amp": 1.0 / (1.0 - max_p_q),
+            "llh": float(res.llh),
+            "iters": res.num_iters,
+            "seconds": round(dt, 1),
+            "noise_move_max": float(moved.max()),
+            "noise_move_mean": float(moved.mean()),
+            "frac_noise_moved": float((moved > eps).mean()),
+            "accept_hist": hists[-1] if hists else None,
+        }
+
+    old_ceiling = 1.0 - 1e-6           # what the pre-round-5 clamp allowed
+    auto = auto_quality_max_p(n, avg_deg, floor=base.max_p)
+    frozen = run("old_ceiling", old_ceiling)
+    relaxed = run("auto_relaxed", auto)
+
+    ratio = relaxed["noise_move_max"] / max(frozen["noise_move_max"], 1e-300)
+    # pass = the relaxation does on this graph what the mechanism claims:
+    # a noise-level entry can GROW to macroscopic membership under the
+    # relaxed clip (>= 1000x the kick scale) where the old ceiling holds
+    # max growth orders of magnitude lower (>= 100x contrast), and the
+    # extra freedom is LLH-productive. Breadth (frac moved) is NOT the
+    # claim — in 3 iterations most noise entries of a structureless
+    # uniform graph have no gradient signal to ride; what matters is that
+    # the clip no longer freezes the ones that do. (The frozen run's own
+    # nonzero movement is the 16x headroom in the auto rule: at
+    # avg_deg=4, deg*1e6 sits within a constant of N=5M.)
+    passed = bool(
+        ratio >= 100.0
+        and relaxed["noise_move_max"] >= 1000.0 * eps
+        and relaxed["llh"] > frozen["llh"]
+    )
+    rec = {
+        "bench": "relax-floor-gate",
+        "config": f"synthetic N={n} 2E={g.num_directed_edges} K={k} "
+                  f"avg_deg={avg_deg:.2f}",
+        "backend": jax.default_backend(),
+        "amp_needed": amp_needed,
+        "kick_eps": eps,
+        "graph_build_seconds": round(t_build, 1),
+        "frozen": frozen,
+        "relaxed": relaxed,
+        "move_ratio_relaxed_over_frozen": ratio,
+        "relaxed_llh_above_frozen": bool(relaxed["llh"] > frozen["llh"]),
+        "pass": passed,
+    }
+    line = json.dumps(rec)
+    print(line)
+    if out_path:
+        with open(out_path, "w") as f:
+            f.write(line + "\n")
+    return 0 if rec["pass"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

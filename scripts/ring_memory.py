@@ -1,0 +1,256 @@
+"""Ring vs all-gather PEAK-MEMORY measurement + overhead decomposition
+(VERDICT r4 item 2).
+
+The ring schedule exists for its memory profile: peak per-device HBM
+O(2 * N/dp * K_loc) (resident + rotating shard) vs the all-gather
+schedule's O(N * K_loc) (every device materializes full F each step).
+WEAKSCALING_r04 showed the ring LOSING 7.8x on step time at dp=8 on the
+CPU fake without measuring the memory it buys. This script produces both
+halves of the story:
+
+1. PEAK MEMORY, from the compiler: XLA's buffer assignment
+   (compiled.memory_analysis(), temp+argument bytes, PER-DEVICE) for one
+   optimizer step of each schedule, dp = 1/2/4/8 at fixed per-shard size
+   — the same buffer assignment XLA performs for TPU HBM; static and
+   deterministic. Per-device peak = schedule-dependent F buffers
+   (all-gather O(N*K_loc) vs ring O(2 * N/dp * K_loc)) + schedule-
+   INDEPENDENT workspace W (live (edge_chunk, K) gather buffers from the
+   scan body + candidate accumulators). The config pins W small
+   (edge_chunk=1024, K=64, per_shard=65536) so the F term is visible at
+   dp <= 8; the headline is the SLOPE: all-gather peak must grow ~
+   linearly in dp (one per-shard-F per added shard) while ring peak
+   stays flat. The measured slope/intercept then project the advantage
+   at the BASELINE config-5 design point (dp=64). Both schedules also
+   carry ~3 schedule-independent F-sized working copies (grad, F_new,
+   candidate accumulators), so the asymptotic advantage is ~ dp/5.
+   Compile-only: no step execution. Done-bar: ring flat (dp8 <= 1.5x
+   dp2... dp1 has no rotation buffer), all-gather slope within 2x of
+   per-shard-F theory, dp8 measured ratio >= 1.4, projected dp64 >= 6.
+
+2. THE 7.8x RESOLVED (bucket_balance_dp8 + tiny-step sections): the
+   weak-scaling graphs have CONTIGUOUS planted blocks, so ~every edge is
+   shard-local; the ring's per-(shard, phase) edge buckets pad to the
+   max bucket (the diagonal), and the step sweeps ~dp x the real edge
+   volume — measured 4.66M padded slots vs 297K real at dp=8, 15.7x.
+   On uniformly-spread edges the buckets balance and the ring times at
+   PARITY with all-gather (measured 0.99x). The tiny-step probe shows
+   per-phase fixed dispatch is negligible (<1% of the gap). Mitigation
+   for locality-ordered real graphs: shuffle/relabel node ids (or
+   balance=True) before the ring schedule — see parallel/ring.py.
+
+    python scripts/ring_memory.py [out.json]
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+MEM_PER_SHARD, MEM_K, MEM_CHUNK = 65536, 64, 1024
+TIME_PER_SHARD, TIME_K = 2048, 8
+
+
+def _mem_stats(model, state):
+    """Compiler memory analysis for one step (whole-program bytes)."""
+    fn = model._step
+    jitted = getattr(fn, "jitted", None)
+    if jitted is None:
+        return None
+    lowered = jitted.lower(state, *fn.jit_args)
+    ma = lowered.compile().memory_analysis()
+    if ma is None:
+        return None
+    return {
+        "argument_bytes": int(ma.argument_size_in_bytes),
+        "temp_bytes": int(ma.temp_size_in_bytes),
+        "output_bytes": int(ma.output_size_in_bytes),
+        "peak_bytes": int(ma.argument_size_in_bytes + ma.temp_size_in_bytes),
+    }
+
+
+def _time_step(model, state, steps):
+    import jax
+
+    state = model._step(state)              # compile + warm
+    jax.block_until_ready(state.F)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        state = model._step(state)
+    jax.block_until_ready(state.F)
+    return (time.perf_counter() - t0) / steps
+
+
+def _build(cls, cfg, per_shard, dp, k, mesh, seed, uniform=False):
+    from bigclam_tpu.models.agm import sample_planted_graph
+
+    n = per_shard * dp
+    if uniform:
+        # UNIFORM edge endpoints for the memory section: a planted graph
+        # with contiguous blocks makes every edge shard-local, and the
+        # ring's per-(shard, phase) edge buckets pad to the max bucket —
+        # a dp-fold argument blowup that swamps the F story (real runs
+        # hit this too: relabel/shuffle node ids for ring schedules on
+        # locality-ordered graphs; see parallel/ring.py).
+        from bigclam_tpu.graph.ingest import graph_from_edges
+
+        rng = np.random.default_rng(seed)
+        m = 9 * n           # avg UNDIRECTED degree ~ 17 like the planted cfg
+        e = rng.integers(0, n, size=(m, 2), dtype=np.int64)
+        g = graph_from_edges(e[e[:, 0] != e[:, 1]], num_nodes=n)
+    else:
+        g, _ = sample_planted_graph(
+            n, max(n // 256, 2), p_in=0.15, rng=np.random.default_rng(seed)
+        )
+    F0 = np.random.default_rng(0).uniform(0.1, 1.0, size=(n, k))
+    model = cls(g, cfg, mesh)
+    return model, model.init_state(F0)
+
+
+def run(out_path=None) -> dict:
+    import jax
+
+    try:
+        jax.config.update("jax_platforms", "cpu")
+        jax.config.update("jax_num_cpu_devices", 8)
+    except RuntimeError:
+        pass
+    if len(jax.devices()) < 8:
+        raise RuntimeError("need 8 CPU devices (run before other jax use)")
+
+    from bigclam_tpu.config import BigClamConfig
+    from bigclam_tpu.parallel import (
+        RingBigClamModel,
+        ShardedBigClamModel,
+        make_mesh,
+    )
+
+    pairs = (("allgather", ShardedBigClamModel), ("ring", RingBigClamModel))
+
+    # --- 1. compile-only memory analysis, F-dominated sizing ---
+    mem_cfg = BigClamConfig(num_communities=MEM_K, use_pallas=False,
+                            use_pallas_csr=False, edge_chunk=MEM_CHUNK)
+    mem = {}
+    for dp in (1, 2, 4, 8):
+        mesh = make_mesh((dp, 1), jax.devices()[:dp])
+        row = {}
+        for name, cls in pairs:
+            model, state = _build(cls, mem_cfg, MEM_PER_SHARD, dp, MEM_K,
+                                  mesh, seed=dp, uniform=True)
+            row[name] = _mem_stats(model, state)
+        f_shard = MEM_PER_SHARD * MEM_K * 4
+        row["f_bytes_theory"] = {
+            "full_F": f_shard * dp, "per_shard_F": f_shard,
+        }
+        mem[dp] = row
+
+    # --- 3. the 7.8x resolution: balanced-bucket timing at dp=8 ---
+    # WEAKSCALING_r04's planted graphs have CONTIGUOUS blocks -> ~every
+    # edge is shard-local -> the ring's per-(shard, phase) buckets pad to
+    # the diagonal bucket and the step sweeps ~dp x the real edge volume.
+    # On uniformly-spread edges the buckets balance and the ring runs at
+    # parity. Both cases recorded, with the padded edge-slot counts that
+    # prove the mechanism.
+    def _padded_slots(model):
+        fn = model._step
+        return (int(np.prod(fn.jit_args[0].shape))
+                if hasattr(fn, "jit_args") else -1)
+
+    t_cfg0 = BigClamConfig(num_communities=TIME_K, use_pallas=False,
+                           use_pallas_csr=False)
+    mesh8 = make_mesh((8, 1), jax.devices()[:8])
+    buckets = {}
+    for label, uni in (("planted_shard_local", False), ("uniform", True)):
+        row = {}
+        for name, cls in pairs:
+            model, state = _build(cls, t_cfg0, TIME_PER_SHARD, 8, TIME_K,
+                                  mesh8, seed=8, uniform=uni)
+            row[name] = {
+                "sec_per_step": round(_time_step(model, state, 5), 4),
+                "padded_edge_slots": _padded_slots(model),
+            }
+        row["ring_over_allgather"] = round(
+            row["ring"]["sec_per_step"] / row["allgather"]["sec_per_step"], 2
+        )
+        buckets[label] = row
+
+    # --- 2. step-time + tiny-step overhead decomposition (r04 config) ---
+    t_cfg = BigClamConfig(num_communities=TIME_K, use_pallas=False,
+                          use_pallas_csr=False)
+    step_time, tiny_time = {}, {}
+    for dp in (1, 2, 4, 8):
+        mesh = make_mesh((dp, 1), jax.devices()[:dp])
+        rt, rtiny = {}, {}
+        for name, cls in pairs:
+            model, state = _build(cls, t_cfg, TIME_PER_SHARD, dp, TIME_K,
+                                  mesh, seed=dp)
+            rt[name] = round(_time_step(model, state, 5), 4)
+            tmodel, tstate = _build(cls, t_cfg, 64, dp, TIME_K, mesh,
+                                    seed=99)
+            rtiny[name] = round(_time_step(tmodel, tstate, 10), 4)
+        step_time[dp] = rt
+        tiny_time[dp] = rtiny
+
+    f_shard = MEM_PER_SHARD * MEM_K * 4
+    ag = {dp: mem[dp]["allgather"]["peak_bytes"] for dp in (1, 2, 4, 8)}
+    rg = {dp: mem[dp]["ring"]["peak_bytes"] for dp in (1, 2, 4, 8)}
+    slope_ag = (ag[8] - ag[1]) / 7.0       # bytes added per extra shard
+    ring_flat = rg[8] <= 1.5 * rg[2]    # dp1 has no rotation buffer
+    ratio8 = ag[8] / max(rg[8], 1)
+    # linear projection to the BASELINE config-5 design point: all-gather
+    # adds one per-shard F per shard, ring stays at its dp=8 level
+    proj64 = (ag[1] + slope_ag * 63) / max(rg[8], 1)
+    t8, tiny8 = step_time[8], tiny_time[8]
+    gap = t8["ring"] - t8["allgather"]
+    fixed_gap = tiny8["ring"] - tiny8["allgather"]
+    rec = {
+        "bench": "ring-memory+overhead",
+        "mem_config": f"per_shard={MEM_PER_SHARD} K={MEM_K} f32 "
+                      f"edge_chunk={MEM_CHUNK}",
+        "time_config": f"per_shard={TIME_PER_SHARD} K={TIME_K}",
+        "mem": mem,
+        "bucket_balance_dp8": buckets,
+        "step_time": step_time,
+        "tiny_step_time": tiny_time,
+        "per_shard_F_bytes": f_shard,
+        "allgather_slope_bytes_per_shard": int(slope_ag),
+        "allgather_slope_over_theory": round(slope_ag / f_shard, 2),
+        "ring_dp8_over_dp2": round(rg[8] / max(rg[2], 1), 2),
+        "peak_mem_ratio_dp8": round(ratio8, 2),
+        "projected_ratio_dp64": round(proj64, 1),
+        "dp8_gap_sec": round(gap, 4),
+        "dp8_fixed_cost_gap_sec": round(fixed_gap, 4),
+        "dp8_gap_fixed_share": round(fixed_gap / gap, 3) if gap > 0 else None,
+        # the claim, as the compiler verifies it: all-gather's peak gains
+        # ~one per-shard F per added shard (slope ~ theory); ring's stays
+        # flat. The RATIO at any dp is dragged by schedule-independent
+        # buffers both carry (grad, F_new, candidate accumulators ~ 3
+        # F-copies + edge workspace), so the asymptotic advantage is
+        # ~ dp/5, not dp/2 — measured components projected to dp=64
+        # (BASELINE config-5 class) must clear 6x for the ring to be
+        # worth its schedule.
+        "pass": bool(
+            ring_flat
+            and 0.5 * f_shard <= slope_ag <= 2.0 * f_shard
+            and ratio8 >= 1.4
+            and proj64 >= 6.0
+            # the 7.8x is bucket padding, not schedule cost: balanced
+            # buckets must put the ring within 1.5x of all-gather
+            and buckets["uniform"]["ring_over_allgather"] <= 1.5
+        ),
+    }
+    line = json.dumps(rec)
+    print(line)
+    if out_path:
+        with open(out_path, "w") as f:
+            f.write(line + "\n")
+    return rec
+
+
+if __name__ == "__main__":
+    out_path = sys.argv[1] if len(sys.argv) > 1 else None
+    rec = run(out_path)
+    sys.exit(0 if rec["pass"] else 1)

@@ -122,3 +122,24 @@ def test_fit_convergence_state_matches_spec(toy_graphs):
     assert res.num_iters == st.num_iters
     np.testing.assert_allclose(res.F, st.F, rtol=1e-10)
     assert np.isclose(res.llh, st.llh, rtol=1e-12)
+
+
+def test_edge_terms_stable_below_f32_floor():
+    """The -expm1 form of 1-p keeps full f32 RELATIVE precision for tiny
+    edge dots — the regime where the naive 1 - exp(-x) collapses to 0 and
+    froze the quality-mode MAX_P_ relaxation at amp 1e6 (VERDICT r4 item
+    3; models/quality.py relaxation notes)."""
+    import jax.numpy as jnp
+
+    cfg = BigClamConfig(num_communities=4, max_p=1.0 - 1e-12)
+    for x in (1e-10, 1e-8, 1e-5):
+        omp, ell = obj_ops.edge_terms(jnp.float32(x), cfg)
+        # naive f32: 1 - clip(exp(-x)) == 0 for x < 2^-24 — unusable
+        np.testing.assert_allclose(float(omp), x, rtol=1e-5)
+        np.testing.assert_allclose(float(ell), np.log(x) + x, rtol=1e-5)
+    # the clip floor still binds: amp is capped at 1/(1-max_p)
+    omp_clip, _ = obj_ops.edge_terms(jnp.float32(1e-14), cfg)
+    np.testing.assert_allclose(float(omp_clip), 1e-12, rtol=1e-4)  # f32 repr
+    # f64 path agrees with the spec's subtraction form at moderate x
+    omp64, _ = obj_ops.edge_terms(jnp.float64(0.3), CFG)
+    np.testing.assert_allclose(float(omp64), 1.0 - np.exp(-0.3), rtol=1e-14)

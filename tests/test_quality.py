@@ -340,7 +340,11 @@ def test_quality_device_recovers_planted(planted):
     f1 = _score(qres.fit.F, g, truth)
     assert f1 >= 0.8, f1
     kept = np.maximum.accumulate(qres.cycles_llh)
-    assert qres.fit.llh == pytest.approx(kept[-1])
+    # round 5: the discrete stage (repair/atomize) also runs on the device
+    # path, so the final LLH may exceed the best CYCLE's (never fall below)
+    assert qres.fit.llh >= kept[-1] - abs(kept[-1]) * 1e-6
+    if qres.num_repairs == 0:
+        assert qres.fit.llh == pytest.approx(kept[-1])
 
 
 def test_quality_device_sharded_padding_inert(planted):
@@ -443,3 +447,66 @@ def test_repair_communities_fixes_constructed_defects():
     F_rep2, nrep2 = repair_communities(F_pad, g, delta, k)
     assert nrep2 == 1
     assert np.all(F_rep2[:, k:] == 0.0)
+
+
+def test_atomize_reassign_retiles_shifted_partition():
+    """atomize_reassign on a hand-built SHIFTED partition (each column =
+    one block + half the next — the midscale plateau's defect class,
+    PARITY.md): shattering to graph components and re-seeding must
+    produce one column per planted block, at the block's AGM-consistent
+    strength."""
+    from bigclam_tpu.models.quality import atomize_reassign
+    from bigclam_tpu.ops.extraction import delta_threshold
+
+    g, truth = sample_planted_graph(
+        240, 10, p_in=0.8, rng=np.random.default_rng(5)
+    )
+    k = 10
+    F = np.zeros((g.num_nodes, k))
+    for c in range(k):                     # shifted: block c + half of c+1
+        nxt = truth[(c + 1) % k]
+        F[truth[c], c] = 1.0
+        F[nxt[: len(nxt) // 2], c] = 1.0
+    delta = delta_threshold(g.num_nodes, g.num_edges)
+    F_at, n_atoms = atomize_reassign(F, g, delta, k)
+    assert n_atoms == k
+    mask = F_at >= delta
+    # every planted block ends up whole in exactly one column
+    for blk in truth:
+        cols = {int(c) for u in blk for c in np.flatnonzero(mask[u])}
+        assert len(cols) == 1, cols
+    # per-atom strength tracks the MEASURED block density: the sampler
+    # dedups uniform pairs, so nominal p_in=0.8 lands at d ~ 1-e^-0.8
+    # ~ 0.55 and s = sqrt(-log(1-d)) ~ 0.87 — the adaptation must follow
+    # the data, not the nominal parameter
+    vals = F_at[F_at > 0]
+    assert 0.7 <= vals.min() and vals.max() <= 1.1, (vals.min(), vals.max())
+    # padding columns beyond k_active stay zero
+    F_pad = np.zeros((g.num_nodes, k + 4))
+    F_pad[:, :k] = F
+    F_at2, n2 = atomize_reassign(F_pad, g, delta, k)
+    assert n2 == k
+    assert np.all(F_at2[:, k:] == 0.0)
+
+
+def test_quality_reassign_llh_gated(planted):
+    """The discrete stage with atomize enabled can only improve the kept
+    LLH over the same schedule without it (every move is refit + gated),
+    and the improvement path stays deterministic."""
+    from bigclam_tpu.models.quality import fit_quality
+
+    g, truth = planted
+    k = len(truth)
+    base = dict(num_communities=k, quality_mode=True, restart_cycles=2,
+                use_pallas=False, use_pallas_csr=False)
+    m_off = BigClamModel(g, BigClamConfig(**base, quality_reassign=False))
+    m_on = BigClamModel(g, BigClamConfig(**base))
+    F0 = np.zeros((g.num_nodes, k))
+    r_off = fit_quality(m_off, F0)
+    r_on = fit_quality(m_on, F0)
+    # each run's discrete stage may only improve ITS OWN annealed best
+    # (cross-schedule ordering is not guaranteed: an accepted atomize
+    # changes what the same round's merge/split sees)
+    for r in (r_off, r_on):
+        best_cycle = max(r.cycles_llh)
+        assert r.fit.llh >= best_cycle - abs(best_cycle) * 1e-6
