@@ -76,6 +76,14 @@ def _add_common(p: argparse.ArgumentParser) -> None:
         "--platform", default=None, choices=["cpu", "tpu"],
         help="force a JAX platform (the env may pin one; this overrides it)",
     )
+    p.add_argument(
+        "--seed-backend", default="auto",
+        choices=["auto", "numpy", "dense", "sampled", "sampled_device"],
+        help="conductance scorer backend (ops.seeding.conductance): "
+             "sampled_device runs the degree-capped estimator on the "
+             "accelerator — the C5 path past the 16,384-node dense bound "
+             "(validated at N=1M, DEVSEED_r05.json)",
+    )
 
 
 def _build(args, k: int):
@@ -154,7 +162,9 @@ def _init_F(g, cfg, args):
     from bigclam_tpu.ops import seeding
 
     if args.init == "conductance":
-        seeds = seeding.conductance_seeds(g, cfg)
+        seeds = seeding.conductance_seeds(
+            g, cfg, backend=getattr(args, "seed_backend", "auto")
+        )
         return seeding.init_F(g, seeds, cfg)
     rng = np.random.default_rng(cfg.seed)
     return rng.integers(

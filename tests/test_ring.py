@@ -225,3 +225,36 @@ def test_ring_fit_converges(toy_graphs):
     res_1 = BigClamModel(g, cfg).fit(F0)
     assert res_r.num_iters == res_1.num_iters
     np.testing.assert_allclose(res_r.F, res_1.F, rtol=1e-10)
+
+
+def test_ring_bucket_imbalance_warns_and_balance_fixes(toy_graphs):
+    """Contiguous planted blocks make ~every edge shard-local; the ring's
+    per-(shard, phase) buckets pad to the diagonal and the build must say
+    so (measured dp x padded work, RINGMEM_r05.json). balance=True
+    interleaves nodes across shards and must silence the warning."""
+    import warnings
+
+    import jax
+
+    from bigclam_tpu.models.agm import sample_planted_graph
+    from bigclam_tpu.parallel import RingBigClamModel, make_mesh
+
+    g, _ = sample_planted_graph(
+        1024, 16, p_in=0.5, rng=np.random.default_rng(2)
+    )
+    cfg = BigClamConfig(
+        num_communities=4, use_pallas=False, use_pallas_csr=False
+    )
+    mesh = make_mesh((4, 1), jax.devices()[:4])
+    with warnings.catch_warnings(record=True) as rec:
+        warnings.simplefilter("always")
+        RingBigClamModel(g, cfg, mesh)
+    assert any("imbalanced" in str(w.message) for w in rec), [
+        str(w.message) for w in rec
+    ]
+    with warnings.catch_warnings(record=True) as rec:
+        warnings.simplefilter("always")
+        RingBigClamModel(g, cfg, mesh, balance=True)
+    assert not any("imbalanced" in str(w.message) for w in rec), [
+        str(w.message) for w in rec
+    ]
