@@ -91,6 +91,7 @@ def main() -> int:
         "llh_quality": qres.fit.llh,
         "quality_cycles": qres.num_cycles,
         "quality_total_iters": qres.total_iters,
+        "discrete_moves_accepted": qres.num_repairs,
         "seconds": {
             "seeding": round(t_seed, 1),
             "faithful": round(t_faithful, 1),

@@ -143,3 +143,28 @@ def test_edge_terms_stable_below_f32_floor():
     # f64 path agrees with the spec's subtraction form at moderate x
     omp64, _ = obj_ops.edge_terms(jnp.float64(0.3), CFG)
     np.testing.assert_allclose(float(omp64), 1.0 - np.exp(-0.3), rtol=1e-14)
+
+
+def test_fit_permutation_invariance(toy_graphs):
+    """SURVEY §4.5 property: relabeling node ids permutes the fit result
+    and leaves the LLH trajectory unchanged (float64; summation order
+    differs across labelings, so exact-math equality holds to ~1e-9)."""
+    g = toy_graphs["two_cliques"]
+    n = g.num_nodes
+    cfg = BigClamConfig(num_communities=4, dtype="float64", max_iters=3,
+                        conv_tol=0.0)
+    perm = np.random.default_rng(3).permutation(n)
+    gp = g.permute(perm)
+    F0 = _rand_F(5, n, 4)
+    F0p = np.empty_like(F0)
+    F0p[perm] = F0
+
+    m = BigClamModel(g, cfg)
+    mp = BigClamModel(gp, cfg)
+    r = m.fit(F0)
+    rp = mp.fit(F0p)
+    np.testing.assert_allclose(rp.llh, r.llh, rtol=1e-9)
+    np.testing.assert_allclose(
+        rp.llh_history, r.llh_history, rtol=1e-9
+    )
+    np.testing.assert_allclose(rp.F[perm], r.F, rtol=1e-8, atol=1e-10)
