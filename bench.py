@@ -25,6 +25,14 @@ round-2/3 scoreboards by 11%).
 
 On a TPU backend the CSR kernels MUST engage for the headline configs — a
 silent XLA fallback fails the run rather than polluting the scoreboard.
+
+A hung/crashed accelerator init (BENCH_r05: the axon relay) re-execs the
+benchmark on the CPU platform with a reduced config set; the record is
+tagged "backend": "cpu-fallback" so the scoreboard can tell a degraded
+measurement from a healthy one. With >= 2 devices a ring-schedule config
+additionally reports edges/sec/chip under the overlapped vs the serialized
+rotation schedule plus the comm-hidden fraction
+(utils.profiling.overlap_report).
 """
 
 import json
@@ -40,12 +48,19 @@ LARGE_N, LARGE_K, LARGE_P_IN = 300_000, 1000, 0.1
 # refused by fit_tile_shape (~2500 at the default tile shape) and the
 # csr_grouped_kb path must engage
 XLK_N, XLK_K, XLK_P_IN = 60_000, 3000, 0.5
+# ring overlap config: per-chip shard size / K for the overlapped-vs-serial
+# rotation timing (scaled to the device count at runtime)
+RING_PER_SHARD, RING_K, RING_STEPS = 2048, 8, 5
 WINDOWS = 5
 ITERS_PER_WINDOW = 10
 WARMUP_ITERS = 3
 LARGE_WINDOWS = 3
 LARGE_ITERS_PER_WINDOW = 3
 BASELINE_ITERS = 3
+
+# set on the re-exec'd process when the accelerator backend init hung or
+# crashed and the benchmark restarted itself on the CPU platform
+FALLBACK_ENV = "BIGCLAM_BENCH_CPU_FALLBACK"
 
 _T0 = time.perf_counter()
 
@@ -80,11 +95,21 @@ def time_windows(model, F0, windows, iters_per_window, warmup=WARMUP_ITERS):
     return med, recs, float(state.llh)
 
 
-def _backend_or_die(timeout_s: float = 180.0) -> str:
+def _backend_or_fallback(timeout_s: float = 180.0) -> str:
     """Initialize the JAX backend with a watchdog: a down accelerator
-    tunnel makes jax.devices() hang FOREVER (observed: the axon relay),
-    which would hang the whole scoreboard run. Emit a diagnostic JSON line
-    and exit instead."""
+    tunnel makes jax.devices() hang FOREVER (observed: the axon relay,
+    BENCH_r05), which would hang the whole scoreboard run.
+
+    On a hang/crash the benchmark now RE-EXECS itself on the CPU platform
+    instead of emitting a zero-value error record: the fallback run is
+    clearly tagged ("backend": "cpu-fallback" in the output record) and
+    runs a reduced config set, so the scoreboard gets a real (if slow)
+    measurement plus the diagnosis rather than a zero. Re-exec, not
+    in-process retry: the hung init thread may hold the backend-init lock
+    forever. The zero-value error record remains only as the last resort
+    when even the CPU re-exec cannot initialize."""
+    import os
+    import sys
     import threading
 
     out = {}
@@ -103,36 +128,52 @@ def _backend_or_die(timeout_s: float = 180.0) -> str:
     t = threading.Thread(target=init, daemon=True)
     t.start()
     t.join(timeout_s)
-    if "backend" not in out:
-        import os
-        import sys
-
-        err = out.get(
-            "crash",
-            f"backend init hung > {timeout_s:.0f}s "
-            "(accelerator tunnel down?)",
-        )
-        if "crash_tb" in out:     # full traceback for the run log
-            print(out["crash_tb"], file=sys.stderr)
+    if "backend" in out:
+        if os.environ.get(FALLBACK_ENV) == "1":
+            return "cpu-fallback"
+        return out["backend"]
+    err = out.get(
+        "crash",
+        f"backend init hung > {timeout_s:.0f}s "
+        "(accelerator tunnel down?)",
+    )
+    if "crash_tb" in out:         # full traceback for the run log
+        print(out["crash_tb"], file=sys.stderr)
+    if os.environ.get(FALLBACK_ENV) != "1":
         print(
-            json.dumps(
-                {
-                    "metric": "edges/sec/chip",
-                    "value": 0,
-                    "unit": "edges/sec/chip",
-                    "vs_baseline": 0,
-                    "error": err,
-                }
-            ),
-            flush=True,       # os._exit skips stdio flush; a piped run
-        )                     # would otherwise lose the diagnostic line
+            f"[bench] {err}; re-execing on JAX_PLATFORMS=cpu",
+            file=sys.stderr,
+        )
         sys.stderr.flush()
-        os._exit(3)
-    return out["backend"]
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        env[FALLBACK_ENV] = "1"
+        # 8 virtual host devices so the ring overlap config still runs
+        env["XLA_FLAGS"] = (
+            env.get("XLA_FLAGS", "")
+            + " --xla_force_host_platform_device_count=8"
+        ).strip()
+        os.execvpe(sys.executable, [sys.executable] + sys.argv, env)
+    print(
+        json.dumps(
+            {
+                "metric": "edges/sec/chip",
+                "value": 0,
+                "unit": "edges/sec/chip",
+                "vs_baseline": 0,
+                "backend": "cpu-fallback",
+                "error": err,
+            }
+        ),
+        flush=True,       # os._exit skips stdio flush; a piped run
+    )                     # would otherwise lose the diagnostic line
+    sys.stderr.flush()
+    os._exit(3)
 
 
 def main() -> None:
-    _backend_or_die()
+    backend = _backend_or_fallback()
+    cpu_fallback = backend == "cpu-fallback"
     import jax
 
     from bigclam_tpu.config import BigClamConfig
@@ -143,6 +184,11 @@ def main() -> None:
 
     on_tpu = jax.default_backend() == "tpu"
     configs = {}
+    # cpu-fallback: a real (if slow) measurement beats a zero record, but
+    # the big synthetic configs would take hours on a host CPU — keep the
+    # headline config with fewer windows and record the rest as skipped
+    windows = 2 if cpu_fallback else WINDOWS
+    xla_windows = 2 if cpu_fallback else 3
 
     # --- Email-Enron K=100 (headline config), CSR vs XLA ---
     g = build_graph(ENRON)
@@ -158,14 +204,14 @@ def main() -> None:
             f"reason: {model.path_reason})"
         )
     enron_eps, enron_windows, llh_last = time_windows(
-        model, F0, WINDOWS, ITERS_PER_WINDOW
+        model, F0, windows, ITERS_PER_WINDOW
     )
     xla_model = BigClamModel(
         g, cfg.replace(use_pallas_csr=False, use_pallas=False),
         k_multiple=128,
     )
     enron_xla_eps, enron_xla_windows, _ = time_windows(
-        xla_model, F0, 3, ITERS_PER_WINDOW
+        xla_model, F0, xla_windows, ITERS_PER_WINDOW
     )
     configs["enron"] = {
         "config": f"Email-Enron N={g.num_nodes} 2E={g.num_directed_edges} "
@@ -178,6 +224,14 @@ def main() -> None:
     }
 
     # --- representative grouped-path scale: AGM N=300K K=1000 ---
+    if cpu_fallback:
+        configs["large"] = {"skipped": "cpu-fallback (reduced run)"}
+        configs["xl_k"] = {"skipped": "cpu-fallback (reduced run)"}
+        _ring_overlap_config(configs, jax, BigClamConfig,
+                             sample_planted_graph)
+        _emit(jax, spec, g, cfg, F0, backend, model, configs,
+              enron_eps, llh_last)
+        return
     gl, _ = sample_planted_graph(
         LARGE_N, LARGE_K, p_in=LARGE_P_IN, rng=np.random.default_rng(1)
     )
@@ -250,6 +304,62 @@ def main() -> None:
     except Exception as e:           # noqa: BLE001 — recorded, not silent
         configs["xl_k"] = {"error": f"{type(e).__name__}: {e}"}
 
+    _ring_overlap_config(configs, jax, BigClamConfig, sample_planted_graph)
+    _emit(jax, spec, g, cfg, F0, backend, model, configs, enron_eps,
+          llh_last)
+
+
+def _ring_overlap_config(configs, jax, BigClamConfig, sample_planted_graph):
+    """Ring schedule, overlapped vs serialized rotations: edges/sec/chip
+    under both schedules + the comm-hidden fraction (the double-buffered
+    ppermute win; utils.profiling.overlap_report is the shared hook).
+    Needs >= 2 devices — the ring is a collective schedule. Contained like
+    xl_k: a failure is recorded in the artifact, not fatal."""
+    ndev = len(jax.devices())
+    if ndev < 2:
+        configs["ring_overlap"] = {"skipped": f"single device (ndev={ndev})"}
+        return
+    try:
+        from bigclam_tpu.parallel import RingBigClamModel, make_mesh
+        from bigclam_tpu.utils.profiling import overlap_report
+
+        dp = min(8, ndev)
+        n = RING_PER_SHARD * dp
+        gr, _ = sample_planted_graph(
+            n, max(n // 256, 2), p_in=0.15, rng=np.random.default_rng(5)
+        )
+        cfg_r = BigClamConfig(num_communities=RING_K)
+        mesh = make_mesh((dp, 1), jax.devices()[:dp])
+        # balance=True: the planted fixture is locality-ordered — the
+        # ring's bucket-padding worst case; relabeled is how a real
+        # deployment runs it (and it mutes the imbalance warning)
+        model_r = RingBigClamModel(gr, cfg_r, mesh, balance=True)
+        Fr = np.random.default_rng(6).uniform(
+            0.1, 1.0, size=(gr.num_nodes, RING_K)
+        )
+        rep = overlap_report(
+            model_r, model_r.init_state(Fr), steps=RING_STEPS, warmup=1
+        )
+        e = gr.num_directed_edges
+        configs["ring_overlap"] = {
+            "config": f"AGM planted N={gr.num_nodes} 2E={e} K={RING_K} "
+                      f"dp={dp} (ring, balanced)",
+            "path": model_r.engaged_path,
+            "eps_per_chip": {
+                k: round(e / v / dp, 1)
+                for k, v in rep["sec_per_step"].items()
+            },
+            "sec_per_step": rep["sec_per_step"],
+            "comm_hidden_fraction": rep["comm_hidden_fraction"],
+        }
+    except Exception as e:           # noqa: BLE001 — recorded, not silent
+        configs["ring_overlap"] = {"error": f"{type(e).__name__}: {e}"}
+
+
+def _emit(jax, spec, g, cfg, F0, backend, model, configs, enron_eps,
+          llh_last) -> None:
+    """Oracle baseline + the one-line JSON record (shared by the normal and
+    the cpu-fallback run)."""
     # --- oracle baseline: exact-semantics iterations on host CPU ---
     base_times = []
     for _ in range(BASELINE_ITERS):
@@ -268,6 +378,7 @@ def main() -> None:
                 "unit": "edges/sec/chip",
                 "vs_baseline": round(enron_eps / base_eps, 2),
                 "path": model.engaged_path,
+                "backend": backend,
                 "config": configs["enron"]["config"],
                 "configs": configs,
                 "baseline_spec_eps": round(base_eps, 1),

@@ -82,7 +82,8 @@ def _add_common(p: argparse.ArgumentParser) -> None:
         help="conductance scorer backend (ops.seeding.conductance): "
              "sampled_device runs the degree-capped estimator on the "
              "accelerator — the C5 path past the 16,384-node dense bound "
-             "(validated at N=1M, DEVSEED_r05.json)",
+             "(scripts/device_seeding_bench.py measures the backends on "
+             "your hardware)",
     )
 
 

@@ -170,6 +170,30 @@ class BigClamConfig:
     seed: int = 0                       # PRNG seed for Bernoulli(0.5) F-row padding
 
     # --- execution shape ---
+    ring_overlap: bool = True           # double-buffered ring rotations
+                                        # (parallel.ring.rotate_scan): the
+                                        # ppermute moving phase r+1's F shard
+                                        # is issued CONCURRENTLY with phase
+                                        # r's edge sweep, so the inter-chip
+                                        # hop hides behind compute whenever
+                                        # the sweep outlasts the shard
+                                        # transfer. False = strictly
+                                        # serialized sweep -> hop schedule
+                                        # (an optimization_barrier pins the
+                                        # order) — the A/B fallback for
+                                        # hosts/interconnects where the
+                                        # in-flight buffer's extra HBM or
+                                        # the async collective hurts
+    donate_state: bool = True           # fit loops donate the dropped
+                                        # previous TrainState's buffers back
+                                        # to the next step (ping-pong
+                                        # scratch, models.bigclam
+                                        # .run_fit_loop): XLA reuses the old
+                                        # F storage for the new F instead of
+                                        # holding both plus the in-flight
+                                        # copy. Host-only flag — the
+                                        # donating entry is compiled lazily
+                                        # and only when used
     edge_chunk: int = 1 << 20           # directed edges per on-device chunk,
                                         # further capped by gather bytes (see
                                         # models.bigclam.edge_chunk_bound).

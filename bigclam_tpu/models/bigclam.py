@@ -54,7 +54,7 @@ def csr_want_reason(cfg: BigClamConfig) -> tuple[bool, str]:
 # around every annealing schedule — without the cache that is two fresh
 # compiles per fit_quality call, per K in a sweep).
 _HOST_ONLY_FIELDS = dict(
-    conv_tol=0.0, max_iters=0,
+    conv_tol=0.0, max_iters=0, donate_state=False,
     min_com=1, max_com=1, div_com=1, ksweep_tol=0.0,
     seed=0, seed_include_self=True, isolated_phi_sentinel=0.0,
     seeding_degree_cap=None, seed_exclusion=None,
@@ -69,6 +69,68 @@ def step_cfg_key(cfg: BigClamConfig) -> BigClamConfig:
     """Step-baked identity of a config (hashable — the frozen dataclass):
     two configs with equal keys compile byte-identical train steps."""
     return cfg.replace(**_HOST_ONLY_FIELDS)
+
+
+def attach_donating(step_fn, step, fixed_args=()):
+    """Attach `step_fn.donating(scratch, state)`: the same step compiled
+    with a DONATED ping-pong scratch state prepended.
+
+    `scratch` must be a shape/dtype/sharding twin of `state` (in practice:
+    a previous TrainState the caller guarantees dead). Its buffers are
+    donated to XLA and reused for the outputs — the new F lands in the old
+    F's storage instead of a fresh allocation, so a step holds ONE live F
+    copy plus the output instead of two plus the output. The scratch is
+    data-dead (never read; keep_unused=True keeps it in the signature so
+    the aliasing survives jit's unused-argument pruning), and the caller
+    must not touch it afterwards: on backends that honor donation its
+    buffers are DELETED.
+
+    run_fit_loop drives this entry (cfg.donate_state) with the state it
+    dropped one iteration ago — the ping-pong that keeps the convergence
+    protocol's "return the PREVIOUS state" semantics exact (the current
+    input is never donated). `fixed_args` ride along un-donated (edge/tile
+    device arrays, matching step_fn.jit_args).
+
+    Compiled lazily on first use: callers that never donate (bench loops,
+    parity tests stepping two models in lockstep) pay nothing.
+    """
+
+    def _donating_step(scratch, state, *a):
+        del scratch                     # storage-only: aliased to outputs
+        return step(state, *a)
+
+    jitted_d = jax.jit(
+        _donating_step, donate_argnums=(0,), keep_unused=True
+    )
+
+    def donating(scratch, state):
+        return jitted_d(scratch, state, *fixed_args)
+
+    step_fn.donating = donating
+    step_fn.jitted_donating = jitted_d
+    return step_fn
+
+
+def finalize_step(step):
+    """jit `step` and wrap it in a plain closure carrying the AOT handle
+    (`.jitted`) and the donating entry (attach_donating) — jit's compiled
+    callable cannot hold attributes itself."""
+    jitted = jax.jit(step)
+
+    def step_fn(state):
+        return jitted(state)
+
+    step_fn.jitted = jitted
+    step_fn.jit_args = ()
+    return attach_donating(step_fn, step)
+
+
+def donation_scratch(state):
+    """A donate-able twin of `state`: same shapes/dtypes/shardings, values
+    irrelevant (jnp.copy is elementwise identity, so sharding propagation
+    preserves the layout on every backend). Used by run_fit_loop for the
+    first calls of a fit, before a dropped previous state exists."""
+    return jax.tree.map(jnp.copy, state)
 
 
 def log_engaged_path(model_name: str, path: str, reason: str = "") -> None:
@@ -234,6 +296,18 @@ def run_fit_loop(
     (final_state, final_llh, num_iters, llh_history) and never fetches F
     to the host — the trainers' fit_state and the device-resident quality
     annealing (models.quality.fit_quality_device) build on this.
+
+    BUFFER DONATION (cfg.donate_state, default on): when step_fn exposes a
+    `donating(scratch, state)` entry (attach_donating), the loop feeds each
+    step the TrainState it dropped one iteration ago as a donated scratch,
+    so XLA writes the new F into the old F's storage — ping-pong buffers
+    instead of a fresh F-sized allocation per step. The CURRENT input is
+    never donated (the convergence protocol returns it as the final
+    state), and a caller-provided initial state is never donated either
+    (the caller may still hold it); the first calls donate a freshly
+    allocated twin until a loop-owned state is available to recycle.
+    Trajectories are bit-identical to the non-donated path — donation
+    moves storage, not math (pinned by tests/test_donation.py).
     """
     import inspect
 
@@ -258,11 +332,19 @@ def run_fit_loop(
                 cb_arity = 3
         except (TypeError, ValueError):
             cb_arity = 2
-    prev_state = state
-    hist: list[float] = list(initial_hist)
+    donating = getattr(step_fn, "donating", None)
+    donate = bool(getattr(cfg, "donate_state", False)) and donating is not None
+    scratch = None      # dead previous state whose buffers the next donating
+    hist: list[float] = list(initial_hist)  # call recycles
     remaining = max(cfg.max_iters - int(state.it), 0)
-    for _ in range(remaining + 1):
-        new_state = step_fn(state)
+    for i in range(remaining + 1):
+        if donate:
+            dead, scratch = scratch, None
+            if dead is None:
+                dead = donation_scratch(state)
+            new_state = donating(dead, state)
+        else:
+            new_state = step_fn(state)
         llh_t = float(new_state.llh)           # LLH of state.F
         if callback is not None:
             if cb_arity >= 3:
@@ -280,7 +362,15 @@ def run_fit_loop(
             hist.append(llh_t)
             break
         hist.append(llh_t)
-        prev_state = state
+        if i == remaining:
+            # hit max_iters without converging; `state` is the last state
+            # whose LLH was actually evaluated (hist[-1])
+            final, final_llh, iters = state, llh_t, int(state.it)
+            break
+        if i > 0:
+            # loop-produced and dropped below -> next call's donation;
+            # i == 0 is the caller's initial state (may still be held)
+            scratch = state
         state = new_state
         if (
             checkpoints is not None
@@ -299,10 +389,6 @@ def run_fit_loop(
                     arrays,
                     meta={"llh_history": hist, **(ckpt_meta or {})},
                 )
-    else:
-        # hit max_iters without converging; prev_state is the last state
-        # whose LLH was actually evaluated (hist[-1])
-        final, final_llh, iters = prev_state, hist[-1], int(prev_state.it)
     if extract_F is None:
         # state-resident mode (fit_state / device annealing): hand back the
         # converged TrainState with NO host F fetch — the only scalars
@@ -446,7 +532,7 @@ def make_train_step(
             )
 
         if kblocked:
-            return jax.jit(csr_step_kblocked), "csr_grouped_kb"
+            return finalize_step(csr_step_kblocked), "csr_grouped_kb"
 
         def csr_step(state: TrainState) -> TrainState:
             F, sumF = state.F, state.sumF
@@ -473,7 +559,7 @@ def make_train_step(
                 accept_hist=hist,
             )
 
-        return jax.jit(csr_step), ("csr_grouped" if grouped else "csr")
+        return finalize_step(csr_step), ("csr_grouped" if grouped else "csr")
 
     cand_impl, cand_path = pick_candidates_impl(
         edges, k_pad or cfg.num_communities, cfg
@@ -492,7 +578,7 @@ def make_train_step(
             accept_hist=hist,
         )
 
-    return jax.jit(step), cand_path
+    return finalize_step(step), cand_path
 
 
 class BigClamModel:

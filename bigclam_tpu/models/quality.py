@@ -549,6 +549,14 @@ def _repair_stage(
                 and int(meta.get("kick_cols", -1)) == kc
                 and meta.get("reassign") == bool(cfg.quality_reassign)
                 and meta.get("seed") == cfg.seed
+                # polish kick scale (derived from cfg.init_noise) and the
+                # component floor both change the repair schedule: a
+                # checkpoint written under different values (or predating
+                # the stamp — .get() misses) must be discarded, or resume
+                # silently replays a different kick schedule than the
+                # uninterrupted run (ADVICE round-5)
+                and meta.get("eps") == eps
+                and int(meta.get("min_comp", -1)) == min_comp
             ):
                 F_r = np.asarray(arrays["F"])
                 best = FitResult(
@@ -589,6 +597,8 @@ def _repair_stage(
                     "kick_cols": kc,
                     "reassign": bool(cfg.quality_reassign),
                     "seed": cfg.seed,
+                    "eps": float(eps),
+                    "min_comp": int(min_comp),
                     "fit_num_iters": int(best.num_iters),
                     "accepted_repairs": accepted_repairs,
                     "extra_iters": extra_iters,
@@ -699,10 +709,21 @@ def fit_quality(
             # written under a different effective max_p carries best_llh /
             # cycles_llh on a systematically different scale, silently
             # skewing acceptance and patience on resume. A meta WITHOUT
-            # the stamp predates the MAX_P_ relaxation (its LLHs are
-            # parity-clip) — only compatible when no relaxation applies.
-            ck_max_p = meta.get("quality_max_p", cfg.max_p)
-            if ck_max_p != max_p_q:
+            # the stamp predates the stamp itself — the clip it actually
+            # ran under is unrecorded, so refuse whenever this run would
+            # relax (don't claim a max_p the checkpoint never wrote).
+            ck_max_p = meta.get("quality_max_p")
+            if ck_max_p is None:
+                if max_p_q != cfg.max_p:
+                    raise ValueError(
+                        "quality checkpoint predates the quality_max_p "
+                        "stamp (the clip bound its LLHs were computed "
+                        f"under is unrecorded), but this run relaxes "
+                        f"MAX_P_ to {max_p_q} — cannot verify the LLH "
+                        f"scales match; restart without the stale "
+                        f"checkpoint (dir: {checkpoints.directory})"
+                    )
+            elif ck_max_p != max_p_q:
                 raise ValueError(
                     f"quality checkpoint incompatible: written under "
                     f"max_p={ck_max_p}, this run relaxes to {max_p_q} — "

@@ -4,13 +4,20 @@ instead of all-gathering F.
 The C21 "ring-attention analog" (SURVEY.md §2/§5): at pod scale the
 all-gather schedule of parallel/sharded.py materializes a full (N_pad, K_loc)
 copy of F per device — impossible for com-Friendster-class graphs
-(N=65M x K=25K). Here each device only ever holds TWO (N_pad/dp, K_loc)
-shards: its own F_loc and a rotating buffer F_rot that `lax.ppermute`s
-around the "nodes" ring, one hop per phase, exactly like ring attention
-rotates KV blocks. Edges are bucketed by destination shard at ingest; in
+(N=65M x K=25K). Here each device only ever holds a handful of
+(N_pad/dp, K_loc) shards: its own F_loc and a rotating buffer F_rot that
+`lax.ppermute`s around the "nodes" ring, one hop per phase, exactly like
+ring attention rotates KV blocks (the default double-buffered schedule
+adds one more in-flight shard buffer; cfg.ring_overlap=False drops back
+to exactly two). Edges are bucketed by destination shard at ingest; in
 phase r device i processes the bucket whose destinations live in shard
 (i + r) % dp, accumulating neighbor LLH/gradient contributions, then passes
-F_rot to its ring predecessor. Communication totals match the all-gather
+F_rot to its ring predecessor. Every rotation goes through the shared
+`rotate_scan` primitive, which by default DOUBLE-BUFFERS the rotation: the
+ppermute carrying phase r+1's shard is issued concurrently with phase r's
+sweep, so the inter-chip hop hides behind compute (cfg.ring_overlap=False
+forces the strictly serialized sweep->hop schedule; identical numerics
+either way). Communication totals match the all-gather
 (every shard visits every device) but peak HBM drops from O(N*K_loc) to
 O(2 * N/dp * K_loc); the gradient pass and the 16-candidate Armijo pass each
 take one full rotation (the candidate pass re-rotates because it needs the
@@ -35,7 +42,12 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from bigclam_tpu.config import BigClamConfig
 from bigclam_tpu.graph.csr import Graph
-from bigclam_tpu.models.bigclam import TrainState, _round_up, edge_chunk_bound
+from bigclam_tpu.models.bigclam import (
+    TrainState,
+    _round_up,
+    attach_donating,
+    edge_chunk_bound,
+)
 from bigclam_tpu.ops.objective import EdgeChunks, edge_terms
 from bigclam_tpu.parallel.mesh import K_AXIS, NODES_AXIS
 from bigclam_tpu.parallel.multihost import put_sharded
@@ -66,6 +78,55 @@ def _warn_bucket_imbalance(g: Graph, dp: int, max_count: int) -> None:
             "before the ring schedule.",
             stacklevel=3,
         )
+
+
+def rotate_scan(F0, acc0, xs, sweep, perm, overlap: bool):
+    """The shared rotation primitive: scan the ring phases, sweeping each
+    phase's edge bucket against the resident rotating shard and moving the
+    shard one hop per phase.
+
+    `sweep(acc, x, F_rot) -> acc` consumes one phase's bucket slice `x`
+    (any pytree sliced along the leading phase axis of `xs`) against the
+    resident rotating shard. Every rotation site in this module goes
+    through here, so the communication schedule is decided in exactly one
+    place.
+
+    overlap=True (the default, cfg.ring_overlap): DOUBLE-BUFFERED. The
+    ppermute carrying phase r+1's shard is issued before phase r's sweep
+    and has no data dependence on it — two (N/dp, K_loc) buffers are live
+    (the one being read by the sweep, the one in flight) and the async
+    collective-permute proceeds concurrently with the sweep, hiding the
+    inter-chip hop whenever the sweep outlasts the shard transfer
+    (the rotate-and-reduce overlap of Sparse Allreduce, arXiv:1312.3020).
+
+    overlap=False: the FORCED-serial schedule — an optimization_barrier
+    makes the hop wait for the sweep, so every hop is dead time on the
+    compute timeline by construction. Note this is stricter than the
+    pre-primitive code (which had the same hop/sweep dataflow but left the
+    ordering to the scheduler): the A/B against it measures the hop time
+    that overlapping CAN hide — an upper bound on the win over a build
+    whose scheduler already overlapped some of it. Kept for that
+    measurement (utils.profiling.overlap_report), for the parity suite,
+    and as the fallback that guarantees only two live shard buffers. Both
+    schedules compute bit-identical results (the barrier moves no math).
+
+    Returns (F_back, acc): the shard after the full rotation (== F0 — every
+    shard visits every device exactly once) and the final accumulator.
+    """
+
+    def phase(carry, x):
+        F_rot, acc = carry
+        if overlap:
+            F_next = lax.ppermute(F_rot, NODES_AXIS, perm)
+            acc = sweep(acc, x, F_rot)
+        else:
+            acc = sweep(acc, x, F_rot)
+            F_rot, acc = lax.optimization_barrier((F_rot, acc))
+            F_next = lax.ppermute(F_rot, NODES_AXIS, perm)
+        return (F_next, acc), None
+
+    (F_back, acc), _ = lax.scan(phase, (F0, acc0), xs)
+    return F_back, acc
 
 
 def ring_shard_edges(
@@ -164,19 +225,17 @@ def make_ring_train_step(
                 ),
             )
 
-        def grad_phase(carry, sdm_ph):
-            (F_rot, acc) = carry
+        def grad_sweep(acc, sdm_ph, F_rot):
             s_ph, d_ph, m_ph = sdm_ph
-            acc = sweep_chunks(grad_chunk, acc, s_ph, d_ph, m_ph, F_rot)
-            F_rot = lax.ppermute(F_rot, NODES_AXIS, perm)
-            return (F_rot, acc), None
+            return sweep_chunks(grad_chunk, acc, s_ph, d_ph, m_ph, F_rot)
 
         init_acc = (
             _mark_varying(jnp.zeros(n_loc, adt), (NODES_AXIS,)),
             _mark_varying(jnp.zeros_like(F_loc), (NODES_AXIS, K_AXIS)),
         )
-        (F_back, (nbr_llh, nbr_grad)), _ = lax.scan(
-            grad_phase, (F_loc, init_acc), (src, dst, mask)
+        F_back, (nbr_llh, nbr_grad) = rotate_scan(
+            F_loc, init_acc, (src, dst, mask), grad_sweep, perm,
+            cfg.ring_overlap,
         )
         grad = nbr_grad - sumF[None, :] + F_loc
         node_llh = nbr_llh + (
@@ -200,18 +259,16 @@ def make_ring_train_step(
 
             return cand + lax.map(one_eta, etas)
 
-        def cand_phase(carry, sdm_ph):
-            (F_rot, cand) = carry
+        def cand_sweep(cand, sdm_ph, F_rot):
             s_ph, d_ph, m_ph = sdm_ph
-            cand = sweep_chunks(cand_chunk, cand, s_ph, d_ph, m_ph, F_rot)
-            F_rot = lax.ppermute(F_rot, NODES_AXIS, perm)
-            return (F_rot, cand), None
+            return sweep_chunks(cand_chunk, cand, s_ph, d_ph, m_ph, F_rot)
 
         init_cand = _mark_varying(
             jnp.zeros((len(cfg.step_candidates), n_loc), adt), (NODES_AXIS,)
         )
-        (_, cand_nbr), _ = lax.scan(
-            cand_phase, (F_back, init_cand), (src, dst, mask)
+        _, cand_nbr = rotate_scan(
+            F_back, init_cand, (src, dst, mask), cand_sweep, perm,
+            cfg.ring_overlap,
         )
 
         # --- Armijo acceptance + Jacobi update (shared helper) ---
@@ -249,7 +306,7 @@ def make_ring_train_step(
     # AOT handles for scripts/ring_memory.py's compiler memory analysis
     step_fn.jitted = jitted
     step_fn.jit_args = (edges.src, edges.dst, edges.mask)
-    return step_fn
+    return attach_donating(step_fn, step, fixed_args=step_fn.jit_args)
 
 
 def make_ring_csr_train_step(
@@ -323,8 +380,8 @@ def make_ring_csr_train_step(
             return jnp.take(cols, d, axis=0)             # (nt, T, kc)
 
         # --- rotation 1: K-block dots -> psum -> per-K-block consume ---
-        def grad_phase(carry, xs):
-            F_rot, gn_acc, ln_acc = carry
+        def grad_sweep(acc, xs, F_rot):
+            gn_acc, ln_acc = acc
             td, d = td_of(xs)
 
             def dots_kb(x_acc, kb):
@@ -348,20 +405,19 @@ def make_ring_csr_train_step(
 
             _, (gns, lns) = lax.scan(consume_kb, None, jnp.arange(n_kb))
             gn = gns.transpose(1, 0, 2).reshape(n_loc, k_loc)
-            F_rot = lax.ppermute(F_rot, NODES_AXIS, perm)
             # ln depends only on the (already global) x — identical across
             # K blocks
-            return (F_rot, gn_acc + gn, ln_acc + lns[0]), None
+            return gn_acc + gn, ln_acc + lns[0]
 
         init = (
-            F_loc,
             _mark_varying(
                 jnp.zeros((n_loc, k_loc), F_loc.dtype), (NODES_AXIS, K_AXIS)
             ),
             _mark_varying(jnp.zeros(n_loc, F_loc.dtype), (NODES_AXIS,)),
         )
-        (F_back, gn, ln), _ = lax.scan(
-            grad_phase, init, (srcl, dstl, mask, bid)
+        F_back, (gn, ln) = rotate_scan(
+            F_loc, init, (srcl, dstl, mask, bid), grad_sweep, perm,
+            cfg.ring_overlap,
         )
         grad = gn - sumF[None, :] + F_loc
         node_llh = ln.astype(adt) + (
@@ -370,8 +426,7 @@ def make_ring_csr_train_step(
         llh_cur = lax.psum(node_llh.sum(), NODES_AXIS)
 
         # --- rotation 2: candidate K-block dots -> psum -> consume ---
-        def cand_phase(carry, xs):
-            F_rot, cn_acc = carry
+        def cand_sweep(cn_acc, xs, F_rot):
             td, d = td_of(xs)
 
             def cdots_kb(xc_acc, kb):
@@ -389,16 +444,15 @@ def make_ring_csr_train_step(
             )
             xc = lax.psum(xc_loc, K_AXIS)
             cb = cand_nbr_from_x_csr(xc, td, cfg, interpret=interp)
-            F_rot = lax.ppermute(F_rot, NODES_AXIS, perm)
-            return (F_rot, cn_acc + cb), None
+            return cn_acc + cb
 
-        initc = (
-            F_back,
-            _mark_varying(
-                jnp.zeros((num_s, n_loc), F_loc.dtype), (NODES_AXIS,)
-            ),
+        initc = _mark_varying(
+            jnp.zeros((num_s, n_loc), F_loc.dtype), (NODES_AXIS,)
         )
-        (_, cb), _ = lax.scan(cand_phase, initc, (srcl, dstl, mask, bid))
+        _, cb = rotate_scan(
+            F_back, initc, (srcl, dstl, mask, bid), cand_sweep, perm,
+            cfg.ring_overlap,
+        )
         F_new, sum_loc, hist = armijo_tail_select_sharded(
             F_loc, grad, node_llh, cb.astype(adt), sumF, cfg, with_stats=True
         )
@@ -421,26 +475,25 @@ def make_ring_csr_train_step(
             return td, d
 
         # --- rotation 1: partial dots -> psum over "k" -> grad consume ---
-        def grad_phase(carry, xs):
-            F_rot, gn_acc, ln_acc = carry
+        def grad_sweep(acc, xs, F_rot):
+            gn_acc, ln_acc = acc
             td, d = td_of(xs)
             fd = jnp.take(F_rot, d, axis=0)      # K_loc columns of F_rot
             x = lax.psum(
                 edge_dots_csr(F_loc, td, fd, interpret=interp), K_AXIS
             )
             gn, ln = grad_nbr_from_x_csr(x, td, fd, cfg, interpret=interp)
-            F_rot = lax.ppermute(F_rot, NODES_AXIS, perm)
-            return (F_rot, gn_acc + gn, ln_acc + ln), None
+            return gn_acc + gn, ln_acc + ln
 
         init = (
-            F_loc,
             _mark_varying(
                 jnp.zeros((n_loc, k_loc), F_loc.dtype), (NODES_AXIS, K_AXIS)
             ),
             _mark_varying(jnp.zeros(n_loc, F_loc.dtype), (NODES_AXIS,)),
         )
-        (F_back, gn, ln), _ = lax.scan(
-            grad_phase, init, (srcl, dstl, mask, bid)
+        F_back, (gn, ln) = rotate_scan(
+            F_loc, init, (srcl, dstl, mask, bid), grad_sweep, perm,
+            cfg.ring_overlap,
         )
         grad = gn - sumF[None, :] + F_loc
         node_llh = ln.astype(adt) + (
@@ -449,8 +502,7 @@ def make_ring_csr_train_step(
         llh_cur = lax.psum(node_llh.sum(), NODES_AXIS)
 
         # --- rotation 2: candidate partial dots -> psum -> consume ---
-        def cand_phase(carry, xs):
-            F_rot, cn_acc = carry
+        def cand_sweep(cn_acc, xs, F_rot):
             td, d = td_of(xs)
             fd = jnp.take(F_rot, d, axis=0)
             xc = lax.psum(
@@ -458,16 +510,15 @@ def make_ring_csr_train_step(
                 K_AXIS,
             )
             cb = cand_nbr_from_x_csr(xc, td, cfg, interpret=interp)
-            F_rot = lax.ppermute(F_rot, NODES_AXIS, perm)
-            return (F_rot, cn_acc + cb), None
+            return cn_acc + cb
 
-        initc = (
-            F_back,
-            _mark_varying(
-                jnp.zeros((num_s, n_loc), F_loc.dtype), (NODES_AXIS,)
-            ),
+        initc = _mark_varying(
+            jnp.zeros((num_s, n_loc), F_loc.dtype), (NODES_AXIS,)
         )
-        (_, cb), _ = lax.scan(cand_phase, initc, (srcl, dstl, mask, bid))
+        _, cb = rotate_scan(
+            F_back, initc, (srcl, dstl, mask, bid), cand_sweep, perm,
+            cfg.ring_overlap,
+        )
         F_new, sum_loc, hist = armijo_tail_select_sharded(
             F_loc, grad, node_llh, cb.astype(adt), sumF, cfg, with_stats=True
         )
@@ -490,16 +541,14 @@ def make_ring_csr_train_step(
             return td, d
 
         # --- rotation 1: per-phase grad/LLH kernels, block accumulators ---
-        def grad_phase(carry, xs):
-            F_rot, gn_acc, ln_acc = carry
+        def grad_sweep(acc, xs, F_rot):
+            gn_acc, ln_acc = acc
             td, d = td_of(xs)
             fd = jnp.take(F_rot, d, axis=0)      # local rows of F_rot
             gn, ln = _grad_blocks(F_loc, td, cfg, fd, interp)
-            F_rot = lax.ppermute(F_rot, NODES_AXIS, perm)
-            return (F_rot, gn_acc + gn, ln_acc + ln), None
+            return gn_acc + gn, ln_acc + ln
 
         init = (
-            F_loc,
             _mark_varying(
                 jnp.zeros((n_blocks, block_b, k), F_loc.dtype),
                 (NODES_AXIS,),
@@ -509,8 +558,9 @@ def make_ring_csr_train_step(
                 (NODES_AXIS,),
             ),
         )
-        (F_back, gn, ln), _ = lax.scan(
-            grad_phase, init, (srcl, dstl, mask, bid)
+        F_back, (gn, ln) = rotate_scan(
+            F_loc, init, (srcl, dstl, mask, bid), grad_sweep, perm,
+            cfg.ring_overlap,
         )
         grad = gn.reshape(n_loc, k) - sumF[None, :] + F_loc
         node_llh = ln.reshape(n_loc).astype(adt) + (
@@ -519,24 +569,23 @@ def make_ring_csr_train_step(
         llh_cur = lax.psum(node_llh.sum(), NODES_AXIS)
 
         # --- rotation 2: per-phase candidate kernels (neighbor terms) ---
-        def cand_phase(carry, xs):
-            F_rot, cn_acc = carry
+        def cand_sweep(cn_acc, xs, F_rot):
             td, d = td_of(xs)
             fd = jnp.take(F_rot, d, axis=0)
             cb = _cand_blocks(
                 F_loc, grad, sumF, td, cfg, fd, interp, with_tails=False
             )
-            F_rot = lax.ppermute(F_rot, NODES_AXIS, perm)
-            return (F_rot, cn_acc + cb), None
+            return cn_acc + cb
 
-        initc = (
-            F_back,                              # full rotation restored F
-            _mark_varying(
-                jnp.zeros((n_blocks, num_s, block_b), F_loc.dtype),
-                (NODES_AXIS,),
-            ),
+        initc = _mark_varying(
+            jnp.zeros((n_blocks, num_s, block_b), F_loc.dtype),
+            (NODES_AXIS,),
         )
-        (_, cb), _ = lax.scan(cand_phase, initc, (srcl, dstl, mask, bid))
+        # F_back: the full rotation restored F
+        _, cb = rotate_scan(
+            F_back, initc, (srcl, dstl, mask, bid), cand_sweep, perm,
+            cfg.ring_overlap,
+        )
         cand_nbr = cb.transpose(1, 0, 2).reshape(num_s, n_loc).astype(adt)
         F_new, sum_loc, hist = armijo_tail_select_sharded(
             F_loc, grad, node_llh, cand_nbr, sumF, cfg, with_stats=True
@@ -581,7 +630,7 @@ def make_ring_csr_train_step(
         tiles["src_local"], tiles["dst_local"], tiles["mask"],
         tiles["block_id"],
     )
-    return step_fn
+    return attach_donating(step_fn, step, fixed_args=step_fn.jit_args)
 
 
 class RingBigClamModel(ShardedBigClamModel):

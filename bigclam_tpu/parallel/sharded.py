@@ -40,6 +40,7 @@ from bigclam_tpu.models.bigclam import (
     TrainState,
     _lcm,
     _round_up,
+    attach_donating,
     edge_chunk_bound,
     restore_checkpoint,
     run_fit_loop,
@@ -366,7 +367,7 @@ def make_sharded_csr_train_step(
     step_fn.jit_args = (
         tiles["src_local"], tiles["dst"], tiles["mask"], tiles["block_id"],
     )
-    return step_fn
+    return attach_donating(step_fn, step, fixed_args=step_fn.jit_args)
 
 
 def make_sharded_train_step(
@@ -483,7 +484,7 @@ def make_sharded_train_step(
 
     step_fn.jitted = jitted
     step_fn.jit_args = (edges.src, edges.dst, edges.mask)
-    return step_fn
+    return attach_donating(step_fn, step, fixed_args=step_fn.jit_args)
 
 
 class ShardedBigClamModel:

@@ -1,5 +1,7 @@
 """Profiler hooks: jax.profiler trace scopes (SURVEY.md §5 — the reference
-had none; `println` was its only instrumentation)."""
+had none; `println` was its only instrumentation), plus the step-time /
+comm-hidden-fraction hooks consumed by bench.py and
+scripts/weak_scaling.py."""
 
 from __future__ import annotations
 
@@ -27,3 +29,71 @@ def annotate(name: str) -> Iterator[None]:
 
     with jax.profiler.TraceAnnotation(name):
         yield
+
+
+def step_time(step_fn, state, steps: int = 5, warmup: int = 1) -> float:
+    """Wall-clock seconds per compiled training step.
+
+    Runs `warmup` un-timed steps (compilation + steady state), then times
+    `steps` chained steps and blocks on the final F. The state threads
+    through, so the measurement covers the real dependency chain — exactly
+    what the fit loop pays per iteration."""
+    import time
+
+    import jax
+
+    for _ in range(max(warmup, 0)):
+        state = step_fn(state)
+    jax.block_until_ready(state.F)
+    t0 = time.perf_counter()
+    for _ in range(max(steps, 1)):
+        state = step_fn(state)
+    jax.block_until_ready(state.F)
+    return (time.perf_counter() - t0) / max(steps, 1)
+
+
+def comm_hidden_fraction(overlap_s: float, serial_s: float) -> float:
+    """Fraction of the FORCED-serial step time the overlapped schedule
+    eliminated: (serial - overlap) / serial, clamped at 0. The single
+    definition shared by overlap_report and scripts/weak_scaling.py.
+    The serial baseline pins sweep->hop ordering with a barrier, so this
+    is the hop time overlapping CAN hide — an upper bound on the win over
+    a scheduler that already overlapped some of it."""
+    if serial_s <= 0:
+        return 0.0
+    return round(max(1.0 - overlap_s / serial_s, 0.0), 4)
+
+
+def overlap_report(model, state, steps: int = 5, warmup: int = 1) -> dict:
+    """Time a ring trainer's step under BOTH rotation schedules and report
+    the communication-hiding win (the hook ISSUE 1 instruments; consumed by
+    bench.py's ring config and scripts/weak_scaling.py).
+
+    Rebuilds the model's step with cfg.ring_overlap toggled (steps are
+    cached by step_cfg_key, so each schedule compiles once) and restores
+    the original cfg/step afterwards. comm_hidden_fraction is the fraction
+    of the SERIAL step time the double-buffered schedule eliminated,
+    (serial - overlap) / serial, clamped at 0 — on hardware it approaches
+    the rotations' hop time share when the edge sweep outlasts the shard
+    transfer; on the shared-core CPU fake it is noise around 0 (there is no
+    async interconnect to hide) and only the plumbing is exercised.
+
+    Returns {"sec_per_step": {"overlap": s, "serial": s},
+             "comm_hidden_fraction": f}.
+    """
+    cfg0 = model.cfg
+    times = {}
+    try:
+        for name, flag in (("overlap", True), ("serial", False)):
+            model.cfg = cfg0.replace(ring_overlap=flag)
+            model.rebuild_step()
+            times[name] = step_time(model._step, state, steps, warmup)
+    finally:
+        model.cfg = cfg0
+        model.rebuild_step()
+    return {
+        "sec_per_step": {k: round(v, 6) for k, v in times.items()},
+        "comm_hidden_fraction": comm_hidden_fraction(
+            times["overlap"], times["serial"]
+        ),
+    }

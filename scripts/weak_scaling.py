@@ -16,7 +16,6 @@ as a step-time ratio jump between rounds.
 import json
 import os
 import sys
-import time
 import warnings
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
@@ -42,6 +41,7 @@ def run(per_shard: int = 2048, steps: int = 5, out_path=None) -> dict:
         ShardedBigClamModel,
         make_mesh,
     )
+    from bigclam_tpu.utils.profiling import comm_hidden_fraction, step_time
 
     k = 8
     cfg = BigClamConfig(num_communities=k, use_pallas=False,
@@ -55,14 +55,21 @@ def run(per_shard: int = 2048, steps: int = 5, out_path=None) -> dict:
         F0 = np.random.default_rng(0).uniform(0.1, 1.0, size=(n, k))
         mesh = make_mesh((dp, 1), jax.devices()[:dp])
         row = {"n": n, "directed_edges": g.num_directed_edges}
-        for name, cls, bal in (
-            ("allgather", ShardedBigClamModel, False),
-            ("ring", RingBigClamModel, False),
+        for name, cls, bal, cfg_m in (
+            ("allgather", ShardedBigClamModel, False, cfg),
+            ("ring", RingBigClamModel, False, cfg),
+            # the overlap-OFF twin of the ring column: strictly serialized
+            # sweep->hop rotations (cfg.ring_overlap=False). On real chips
+            # ring / ring_serial is the communication-hiding win of the
+            # double-buffered schedule; on the CPU fake the pair only
+            # guards the plumbing (both columns should track each other).
+            ("ring_serial", RingBigClamModel, False,
+             cfg.replace(ring_overlap=False)),
             # the planted fixtures have CONTIGUOUS blocks — the ring's
             # bucket-padding worst case (RINGMEM_r05.json: dp x padded
             # work). The balanced column is the ring as a real deployment
             # would run it on locality-ordered ids (relabeled).
-            ("ring_balanced", RingBigClamModel, True),
+            ("ring_balanced", RingBigClamModel, True, cfg),
         ):
             with warnings.catch_warnings():
                 # mute ONLY the known bucket-imbalance warning: the
@@ -72,17 +79,18 @@ def run(per_shard: int = 2048, steps: int = 5, out_path=None) -> dict:
                 warnings.filterwarnings(
                     "ignore", message=".*ring phase buckets are imbalanced.*"
                 )
-                model = cls(g, cfg, mesh, balance=bal)
-            state = model.init_state(F0)
-            state = model._step(state)         # compile
-            jax.block_until_ready(state.F)
-            t0 = time.perf_counter()
-            for _ in range(steps):
-                state = model._step(state)
-            jax.block_until_ready(state.F)
-            row[name] = round((time.perf_counter() - t0) / steps, 4)
+                model = cls(g, cfg_m, mesh, balance=bal)
+            # shared timing protocol (bench.py's overlap_report uses the
+            # same helper, so the columns stay comparable)
+            row[name] = round(
+                step_time(model._step, model.init_state(F0), steps=steps),
+                4,
+            )
+        row["comm_hidden_fraction"] = comm_hidden_fraction(
+            row["ring"], row["ring_serial"]
+        )
         results[str(dp)] = row                 # str keys: match the JSON
-    cols = ("allgather", "ring", "ring_balanced")
+    cols = ("allgather", "ring", "ring_serial", "ring_balanced")
     base = {s: results["1"][s] for s in cols}
     rec = {
         "bench": "weak-scaling-cpu-fake",

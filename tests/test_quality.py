@@ -567,3 +567,17 @@ def test_repair_stage_checkpoint_resume_and_invalidation(planted, tmp_path):
     calls.clear()
     _repair_stage(model, bumped, k, eps, None, checkpoints=cm)
     assert len(calls) > 0          # stale stamp discarded, stage re-ran
+
+    # a DIFFERENT polish kick scale (an init_noise change reaching
+    # _relax_params) also invalidates: the kick schedule differs, so the
+    # stale checkpoint must not be resumed (ADVICE round-5)
+    _repair_stage(model, base, k, eps, None, checkpoints=cm)
+    calls.clear()
+    _repair_stage(model, base, k, eps * 2, None, checkpoints=cm)
+    assert len(calls) > 0          # eps stamp mismatch -> stage re-ran
+
+    # and a DIFFERENT component floor likewise
+    _repair_stage(model, base, k, eps, None, checkpoints=cm)
+    calls.clear()
+    _repair_stage(model, base, k, eps, None, checkpoints=cm, min_comp=7)
+    assert len(calls) > 0          # min_comp stamp mismatch -> re-ran

@@ -51,6 +51,57 @@ def test_ring_matches_single_chip(agm_graph, mesh_shape):
     np.testing.assert_allclose(llhs, ref_llh, rtol=1e-11)
 
 
+@pytest.mark.parametrize("mesh_shape", [(2, 1), (4, 1), (8, 1), (4, 2)])
+def test_ring_overlap_matches_serial(agm_graph, mesh_shape):
+    """The double-buffered (overlapped) rotation schedule — the default —
+    must produce the IDENTICAL float64 LLH trajectory and final F as the
+    serialized schedule on the planted fixture: rotate_scan moves the hop
+    off the compute timeline, never the math."""
+    import jax
+
+    g = agm_graph
+    rng = np.random.default_rng(0)
+    F0 = rng.uniform(0.1, 1.0, size=(g.num_nodes, 4))
+    mesh = make_mesh(mesh_shape, jax.devices()[: mesh_shape[0] * mesh_shape[1]])
+    assert CFG.ring_overlap          # overlapped is the default schedule
+    m_ov = RingBigClamModel(g, CFG, mesh)
+    m_se = RingBigClamModel(g, CFG.replace(ring_overlap=False), mesh)
+    s_ov, s_se = m_ov.init_state(F0), m_se.init_state(F0)
+    llh_ov, llh_se = [], []
+    for _ in range(4):
+        s_ov, s_se = m_ov._step(s_ov), m_se._step(s_se)
+        llh_ov.append(float(s_ov.llh))
+        llh_se.append(float(s_se.llh))
+    assert llh_ov == llh_se, f"mesh {mesh_shape}"
+    np.testing.assert_array_equal(
+        np.asarray(s_ov.F), np.asarray(s_se.F),
+        err_msg=f"mesh {mesh_shape}",
+    )
+
+
+def test_ring_overlap_permutation_invariance(agm_graph):
+    """The permutation-invariance property (SURVEY §4.5) holds under the
+    overlapped schedule: relabeling node ids permutes the fit result and
+    leaves the LLH trajectory unchanged (float64; summation order differs
+    across labelings, so exact-math equality holds to ~1e-9)."""
+    import jax
+
+    g = agm_graph
+    n = g.num_nodes
+    perm = np.random.default_rng(3).permutation(n)
+    gp = g.permute(perm)
+    rng = np.random.default_rng(5)
+    F0 = rng.uniform(0.1, 1.0, size=(n, 4))
+    F0p = np.empty_like(F0)
+    F0p[perm] = F0
+    mesh = make_mesh((4, 1), jax.devices()[:4])
+    r = RingBigClamModel(g, CFG, mesh).fit(F0)
+    rp = RingBigClamModel(gp, CFG, mesh).fit(F0p)
+    np.testing.assert_allclose(rp.llh, r.llh, rtol=1e-9)
+    np.testing.assert_allclose(rp.llh_history, r.llh_history, rtol=1e-9)
+    np.testing.assert_allclose(rp.F[perm], r.F, rtol=1e-8, atol=1e-10)
+
+
 def test_ring_bucket_partition(agm_graph):
     """Every directed edge lands in exactly one (src-shard, phase) bucket
     with correctly rebased local indices."""
@@ -159,6 +210,39 @@ class TestRingCSR:
             rtol=3e-5, atol=3e-5,
         )
         np.testing.assert_allclose(float(s_r.llh), float(s_x.llh), rtol=1e-5)
+
+    @pytest.mark.parametrize(
+        "mesh_shape,kb", [((4, 1), 0), ((2, 2), 0), ((2, 2), 3)]
+    )
+    def test_ring_csr_overlap_matches_serial(self, mesh_shape, kb):
+        """Overlap parity on the kernel-path rotation sites (interpret
+        mode): csr_ring, the TP split, and the K-blocked phases must all
+        compute identical results under both rotation schedules."""
+        import jax
+
+        dp, tp = mesh_shape
+        g = _random_graph(0)
+        k = 12 if kb else 6
+        base = BigClamConfig(
+            num_communities=k, edge_chunk=64, use_pallas_csr=True,
+            pallas_interpret=True, csr_block_b=8, csr_tile_t=8,
+            csr_k_block=kb,
+        )
+        mesh = make_mesh(mesh_shape, jax.devices()[: dp * tp])
+        m_ov = RingBigClamModel(g, base, mesh)
+        m_se = RingBigClamModel(
+            g, base.replace(ring_overlap=False), mesh
+        )
+        assert m_ov.engaged_path == ("csr_ring_kb" if kb else "csr_ring")
+        rng = np.random.default_rng(1)
+        F0 = rng.uniform(0.0, 1.0, size=(g.num_nodes, k))
+        s_o, s_s = m_ov.init_state(F0), m_se.init_state(F0)
+        for _ in range(3):
+            s_o, s_s = m_ov._step(s_o), m_se._step(s_s)
+        assert float(s_o.llh) == float(s_s.llh)
+        np.testing.assert_array_equal(
+            np.asarray(s_o.F), np.asarray(s_s.F)
+        )
 
     def test_ring_tile_bucket_partition(self):
         """Every directed edge lands in exactly one (shard, phase) tile
