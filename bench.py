@@ -62,6 +62,81 @@ BASELINE_ITERS = 3
 # crashed and the benchmark restarted itself on the CPU platform
 FALLBACK_ENV = "BIGCLAM_BENCH_CPU_FALLBACK"
 
+# --- roofline / MFU accounting (VERDICT r5 Next #5) -----------------------
+# edges/sec/chip is a RELATIVE number until it has a denominator: the
+# fields below state how far each config sits from the chip's own limits,
+# so "is it actually fast" is answerable from the artifact alone. The
+# sweeps are gather-bound SpMM-shaped work — the expected roofline position
+# is a high HBM fraction with ~1% MFU, and a LOW hbm_frac is the smell
+# (it means gather/scatter latency, not bandwidth, is the wall).
+SWEEPS_PER_ITER = 17          # 1 fused grad/LLH + 16 Armijo candidates
+
+# device_kind substring -> (HBM GB/s, bf16 MXU peak TFLOP/s). Published
+# per-chip numbers; MFU is quoted against the bf16 MXU peak (the kernels
+# run f32 HIGHEST-precision matmuls, so the quoted MFU understates the
+# f32-relative utilization by ~2x — stated here once rather than baked
+# into the numbers).
+DEVICE_PEAKS = {
+    "v5 lite": (819.0, 197.0),       # v5e / v5litepod
+    "v5p": (2765.0, 459.0),
+    "v4": (1228.0, 275.0),
+    "v3": (900.0, 123.0),
+    "v2": (700.0, 45.0),
+}
+
+
+def roofline_model(k: int) -> dict:
+    """Analytic per-directed-edge cost model of one optimizer iteration.
+
+    bytes: every sweep streams BOTH endpoint F rows per edge visit
+    (2*K*4 f32 — the ~800 B/edge-visit at K=100 from the round-5
+    adjudication); the grad sweep additionally scatters one (K,) row of
+    contributions (1 of the 17 sweeps). flops: the K-length dot (2K) per
+    visit, plus the candidate row construction clip(F + eta*grad) (2K) on
+    the 16 candidate sweeps. Index/mask traffic (~12 B/edge) is noise
+    next to the rows and is left out of the model deliberately.
+    """
+    bytes_iter = SWEEPS_PER_ITER * (2 * k * 4) + k * 4
+    flops_iter = SWEEPS_PER_ITER * (2 * k) + 16 * (2 * k)
+    return {
+        "bytes_per_edge_iter": bytes_iter,
+        "flops_per_edge_iter": flops_iter,
+        "sweeps_per_iter": SWEEPS_PER_ITER,
+    }
+
+
+def device_peaks(device_kind: str):
+    """(hbm_gbs, bf16_tflops) for a device kind, or (None, None) when the
+    chip is not in the table (CPU fallback, future TPUs)."""
+    kind = (device_kind or "").lower()
+    for sub, peaks in DEVICE_PEAKS.items():
+        if sub in kind:
+            return peaks
+    return None, None
+
+
+def roofline_position(eps: float, k: int, device_kind: str) -> dict:
+    """The artifact's roofline record for one config: the cost model, the
+    achieved HBM-bandwidth fraction (`hbm_frac`) and MXU utilization
+    (`mfu`), or None fractions off the peaks table."""
+    model = roofline_model(k)
+    hbm_gbs, tflops = device_peaks(device_kind)
+    achieved_gbs = eps * model["bytes_per_edge_iter"] / 1e9
+    achieved_tflops = eps * model["flops_per_edge_iter"] / 1e12
+    return {
+        **model,
+        "achieved_hbm_gbs": round(achieved_gbs, 1),
+        "achieved_tflops": round(achieved_tflops, 4),
+        "peak_hbm_gbs": hbm_gbs,
+        "peak_bf16_tflops": tflops,
+        "hbm_frac": (
+            round(achieved_gbs / hbm_gbs, 4) if hbm_gbs else None
+        ),
+        "mfu": (
+            round(achieved_tflops / tflops, 6) if tflops else None
+        ),
+    }
+
 _T0 = time.perf_counter()
 
 
@@ -213,6 +288,7 @@ def main() -> None:
     enron_xla_eps, enron_xla_windows, _ = time_windows(
         xla_model, F0, xla_windows, ITERS_PER_WINDOW
     )
+    kind = jax.devices()[0].device_kind
     configs["enron"] = {
         "config": f"Email-Enron N={g.num_nodes} 2E={g.num_directed_edges} "
                   f"K={K_ENRON}",
@@ -221,6 +297,7 @@ def main() -> None:
         "xla": {"eps": enron_xla_eps, "path": xla_model.engaged_path,
                 "windows": enron_xla_windows},
         "csr_over_xla": round(enron_eps / enron_xla_eps, 2),
+        "roofline": roofline_position(enron_eps, K_ENRON, kind),
     }
 
     # --- representative grouped-path scale: AGM N=300K K=1000 ---
@@ -263,6 +340,7 @@ def main() -> None:
         "xla": {"eps": large_xla_eps, "path": xla_l.engaged_path,
                 "windows": large_xla_windows},
         "csr_over_xla": round(large_eps / large_xla_eps, 2),
+        "roofline": roofline_position(large_eps, LARGE_K, kind),
     }
 
     # --- K-blocked regime: AGM N=60K K=3000 (csr_grouped_kb vs XLA) ---
@@ -300,6 +378,7 @@ def main() -> None:
             "xla": {"eps": xlk_xla_eps, "path": xla_k.engaged_path,
                     "windows": xlk_xla_windows},
             "csr_over_xla": round(xlk_eps / xlk_xla_eps, 2),
+            "roofline": roofline_position(xlk_eps, XLK_K, kind),
         }
     except Exception as e:           # noqa: BLE001 — recorded, not silent
         configs["xl_k"] = {"error": f"{type(e).__name__}: {e}"}
@@ -341,16 +420,21 @@ def _ring_overlap_config(configs, jax, BigClamConfig, sample_planted_graph):
             model_r, model_r.init_state(Fr), steps=RING_STEPS, warmup=1
         )
         e = gr.num_directed_edges
+        eps_chip = {
+            k: round(e / v / dp, 1)
+            for k, v in rep["sec_per_step"].items()
+        }
         configs["ring_overlap"] = {
             "config": f"AGM planted N={gr.num_nodes} 2E={e} K={RING_K} "
                       f"dp={dp} (ring, balanced)",
             "path": model_r.engaged_path,
-            "eps_per_chip": {
-                k: round(e / v / dp, 1)
-                for k, v in rep["sec_per_step"].items()
-            },
+            "eps_per_chip": eps_chip,
             "sec_per_step": rep["sec_per_step"],
             "comm_hidden_fraction": rep["comm_hidden_fraction"],
+            "roofline": roofline_position(
+                eps_chip["overlap"], RING_K,
+                jax.devices()[0].device_kind,
+            ),
         }
     except Exception as e:           # noqa: BLE001 — recorded, not silent
         configs["ring_overlap"] = {"error": f"{type(e).__name__}: {e}"}
@@ -381,6 +465,11 @@ def _emit(jax, spec, g, cfg, F0, backend, model, configs, enron_eps,
                 "backend": backend,
                 "config": configs["enron"]["config"],
                 "configs": configs,
+                # headline roofline position (VERDICT r5 Next #5): the
+                # denominator for edges/sec/chip — fraction of this
+                # chip's HBM bandwidth and MXU peak the headline config
+                # achieves under the stated bytes/flops-per-edge model
+                "roofline": configs["enron"].get("roofline"),
                 "baseline_spec_eps": round(base_eps, 1),
                 "baseline_iters_sec": [round(t, 3) for t in base_times],
                 "iters_per_window": ITERS_PER_WINDOW,

@@ -231,14 +231,13 @@ def cmd_fit(args) -> int:
             if cfg.quality_mode and getattr(args, "device_annealing", False):
                 from bigclam_tpu.models.quality import fit_quality_device
 
-                if ckpt is not None:
-                    print(
-                        "warning: --device-annealing ignores "
-                        "--checkpoint-dir (a checkpoint is a host fetch; "
-                        "use the host loop where checkpointing matters)",
-                        file=sys.stderr,
-                    )
-                qres = fit_quality_device(model, F0, callback=cb)
+                # --checkpoint-dir wires REPAIR-ROUND checkpointing on
+                # this path (round 6): a crash mid-repair resumes from
+                # the last completed round. Cycle-granularity saves stay
+                # a host-loop feature (a full-F fetch per cycle).
+                qres = fit_quality_device(
+                    model, F0, callback=cb, checkpoints=ckpt
+                )
                 res = qres.fit
             elif cfg.quality_mode:
                 from bigclam_tpu.models.quality import fit_quality
@@ -417,8 +416,10 @@ def main(argv=None) -> int:
 
         jax.config.update("jax_platforms", args.platform)
         if args.platform == "cpu" and getattr(args, "mesh", None):
+            from bigclam_tpu.utils.dist import request_cpu_devices
+
             dp, tp = (int(x) for x in args.mesh.split(","))
-            jax.config.update("jax_num_cpu_devices", dp * tp)
+            request_cpu_devices(dp * tp)
     if getattr(args, "dtype", None) == "float64":
         import jax
 

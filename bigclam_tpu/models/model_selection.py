@@ -143,12 +143,12 @@ def sweep_k(
         else:
             ckpt_k = None
             ckpt_dir = None
-            if (
-                state_dir is not None
-                and cfg.checkpoint_every > 0
-                # the device-annealing path is checkpoint-free by design —
-                # don't create a k_<K> dir that nothing will ever write
-                and not (cfg.quality_mode and device_annealing)
+            if state_dir is not None and (
+                cfg.checkpoint_every > 0
+                # the device-annealing path checkpoints at REPAIR-ROUND
+                # granularity (round 6) regardless of checkpoint_every
+                # (which governs within-fit cadence only)
+                or (cfg.quality_mode and device_annealing)
             ):
                 from bigclam_tpu.utils.checkpoint import CheckpointManager
 
@@ -161,13 +161,14 @@ def sweep_k(
             F0[:, :k] = F0k                         # columns >= k stay zero
             if cfg.quality_mode and device_annealing:
                 # per-K device-resident annealing: one upload per K (the
-                # seeded F0 is host-built), no per-cycle round trips; the
-                # within-K checkpointing of the host path does not apply
-                # (fit_quality_device is checkpoint-free by design)
+                # seeded F0 is host-built), no per-cycle round trips.
+                # Round 6: the k_<K> dir carries REPAIR-ROUND checkpoints
+                # (fit_quality_device wires the discrete stage through
+                # <dir>/repair); within-cycle saves remain host-path-only
                 from bigclam_tpu.models.quality import fit_quality_device
 
                 qres = fit_quality_device(
-                    model, F0, kick_cols=k, key_salt=k
+                    model, F0, kick_cols=k, key_salt=k, checkpoints=ckpt_k
                 )
                 res = qres.fit
             elif cfg.quality_mode:

@@ -84,6 +84,20 @@ and keeps on LLH gain (measured: -173.8K -> -156.26K in 2 accepted
 rounds at N=12K K=500 p_in=0.3). Runs inside the discrete stage
 (_repair_stage) interleaved with merge/split, every round LLH-gated.
 
+Round-6 addition — device residency for the discrete stage: the six
+mechanisms above made quality mode the dominant cost at midscale (644.7s
+vs 17.7s faithful at N=12K K=500, QUALITY_MIDSCALE_r05.json) because
+atomize/repair ran as per-column host scipy component scans and the
+device path re-uploaded F for every discrete refit. The component scans
+now dispatch to a batched on-device label-propagation primitive
+(ops.components — one jitted pass over all thresholded columns with
+membership/density stats fused in; the scipy path stays the oracle and
+small-N fallback), and fit_quality_device keeps F resident through the
+whole atomize->polish->repair cycle (_repair_stage_device: scatter-edit
+repairs, state-resident refits, at most one F download per repair round,
+repair-round checkpointing). Per-stage wall-clock + transfer counts ride
+QualityResult.stages (utils.profiling.StageProfile).
+
 Works with every trainer (single-chip / all-gather sharded / ring). The
 required trainer surface is `.cfg`, `.g`, `.fit(F0, callback=)`, and
 `.rebuild_step()` (invoked whenever the max_p relaxation engages — the
@@ -259,12 +273,101 @@ def _internal_density(members: np.ndarray, indptr, indices) -> float:
     return cnt / (m.size * (m.size - 1))
 
 
+def _column_atoms_host(
+    mask: np.ndarray, indptr, indices, min_comp: int
+) -> List[Tuple[np.ndarray, Optional[float]]]:
+    """Per-column atoms via the host scipy oracle (_graph_components) —
+    the small-problem path and the parity reference for the device
+    backend. Density is deferred (None): computed later for KEPT atoms
+    only (one bounded _internal_density gather each)."""
+    atoms: List[Tuple[np.ndarray, Optional[float]]] = []
+    for c in range(mask.shape[1]):
+        mem = np.flatnonzero(mask[:, c])
+        if mem.size < min_comp:
+            continue
+        for comp in _graph_components(mem, indptr, indices):
+            if len(comp) >= min_comp:
+                atoms.append((np.sort(np.asarray(comp, np.int64)), None))
+    return atoms
+
+
+def _column_atoms_device(
+    member_cols, g, min_comp: int, edges_dev=None
+) -> List[Tuple[np.ndarray, Optional[float]]]:
+    """Per-column atoms via the batched device label-propagation pass
+    (ops.components): ONE jitted sweep covers every thresholded column,
+    with component sizes and internal edge counts fused into it, so atom
+    densities come from device reductions instead of host edge scans.
+    `member_cols` is (C, N) bool — host OR device-resident (the device
+    quality path passes the thresholded F slice without downloading F;
+    only int32 label/stat arrays cross the host boundary)."""
+    from bigclam_tpu.ops.components import (
+        column_component_stats,
+        components_from_labels,
+        device_edges,
+    )
+
+    n = g.num_nodes
+    if edges_dev is None:
+        edges_dev = device_edges(g)
+    labels, _sizes, counts = column_component_stats(
+        member_cols, edges_dev[0], edges_dev[1], n
+    )
+    atoms: List[Tuple[np.ndarray, Optional[float]]] = []
+    for c in range(labels.shape[0]):
+        for comp in components_from_labels(labels[c], n, min_size=min_comp):
+            s = comp.size
+            # root label == min member id == comp[0] (components_from_labels
+            # returns sorted members), so the fused stats index directly
+            cnt = int(counts[c][comp[0]])
+            d = cnt / (s * (s - 1)) if s > 1 else 0.0
+            atoms.append((comp.astype(np.int64), d))
+    return atoms
+
+
+def _plan_atoms(
+    atoms: List[Tuple[np.ndarray, Optional[float]]], n: int, ka: int
+) -> List[Tuple[np.ndarray, Optional[float]]]:
+    """Greedy largest-first dedupe + column assignment, shared by both
+    component backends. Size ties break on min member id — a DETERMINISTIC
+    order independent of the backend's collection order (host: scipy label
+    order per column; device: root id per column), so the kept-atom set is
+    identical across backends (pinned by test_components.py)."""
+    atoms.sort(key=lambda a: (-len(a[0]), int(a[0][0])))
+    kept: List[Tuple[np.ndarray, Optional[float]]] = []
+    owner = np.full(n, -1, np.int64)
+    for at, d in atoms:
+        if len(kept) >= ka:
+            break
+        owners = owner[at]
+        hit = owners[owners >= 0]
+        if hit.size:
+            _, counts = np.unique(hit, return_counts=True)
+            if counts.max() >= 0.5 * at.size:
+                continue          # majority-duplicate of a kept atom
+        unowned = at[owners < 0]
+        owner[unowned] = len(kept)
+        kept.append((at, d))
+    return kept
+
+
+def _atom_strength(at: np.ndarray, d: Optional[float], indptr, indices
+                   ) -> float:
+    """AGM-consistent seed strength s = sqrt(-log(1-d)); the host backend
+    defers density (d=None) to a bounded gather here."""
+    if d is None:
+        d = _internal_density(at, indptr, indices)
+    d = min(max(float(d), 0.05), 0.95)
+    return float(np.sqrt(-np.log1p(-d)))
+
+
 def atomize_reassign(
     F: np.ndarray,
     g,
     delta: float,
     k_active: int,
     min_comp: int = 5,
+    components: str = "auto",
 ) -> Tuple[np.ndarray, int]:
     """Discrete re-tiling move (cfg.quality_reassign): shatter every
     thresholded column into its graph components ("atoms"), dedupe atoms
@@ -285,55 +388,49 @@ def atomize_reassign(
     at sub-identifiability p_in the extracted F1 may move either way
     (documented in PARITY.md) because the band is F1-degenerate.
 
+    `components` picks the per-column connected-components backend
+    (ops.components.components_backend): "host" = the scipy oracle (one
+    induced-subgraph scan per column — the round-5 quality-stage cost),
+    "device" = one batched label-propagation pass over all columns with
+    fused density stats, "auto" = device above the work-size threshold.
+    The two backends produce the same atom PARTITION; kept-atom choice can
+    differ on exact size ties (both orders are valid and LLH-gated).
+
     Returns (reassigned F, number of kept atoms); num_atoms == 0 means
     nothing to do (no thresholded structure).
     """
+    from bigclam_tpu.ops.components import components_backend
+
     F = np.asarray(F, np.float64)
     n = g.num_nodes
     ka = int(k_active)
     mask = F[:n, :ka] >= delta
     indptr, indices = g.indptr, g.indices
-    atoms: List[np.ndarray] = []
-    for c in range(ka):
-        mem = np.flatnonzero(mask[:, c])
-        if mem.size < min_comp:
-            continue
-        for comp in _graph_components(mem, indptr, indices):
-            if len(comp) >= min_comp:
-                atoms.append(np.sort(np.asarray(comp, np.int64)))
+    if components_backend(n, ka, components) == "device":
+        atoms = _column_atoms_device(mask.T, g, min_comp)
+    else:
+        atoms = _column_atoms_host(mask, indptr, indices, min_comp)
     if not atoms:
         return F.copy(), 0
-    atoms.sort(key=len, reverse=True)
-    kept: List[np.ndarray] = []
-    owner = np.full(n, -1, np.int64)
-    for at in atoms:
-        if len(kept) >= ka:
-            break
-        owners = owner[at]
-        hit = owners[owners >= 0]
-        if hit.size:
-            _, counts = np.unique(hit, return_counts=True)
-            if counts.max() >= 0.5 * at.size:
-                continue          # majority-duplicate of a kept atom
-        unowned = at[owners < 0]
-        owner[unowned] = len(kept)
-        kept.append(at)
+    kept = _plan_atoms(atoms, n, ka)
     F_new = np.zeros_like(F)
-    for c, at in enumerate(kept):
-        d = min(max(_internal_density(at, indptr, indices), 0.05), 0.95)
-        F_new[at, c] = float(np.sqrt(-np.log1p(-d)))
+    for c, (at, d) in enumerate(kept):
+        F_new[at, c] = _atom_strength(at, d, indptr, indices)
     return F_new, len(kept)
 
 
-def repair_communities(
+def repair_plan(
     F: np.ndarray,
     g,
     delta: float,
     k_active: int,
     min_comp: int = 5,
     strength: float = 1.0,
-) -> Tuple[np.ndarray, int]:
-    """One merge+split repair pass over the thresholded communities.
+    components: str = "auto",
+    edges_dev=None,
+) -> Tuple[list, int]:
+    """Merge+split repair DETECTION over the thresholded communities —
+    returns the edit list implementing one repair pass without touching F.
 
     Gradient dynamics cannot move a whole column across the graph, so two
     stable defect classes survive annealing (diagnosed on the planted
@@ -347,22 +444,29 @@ def repair_communities(
 
     Detection cost: O(N*K) vectorized mask/top-2 work (the dominant term
     — ~2e9 element ops at com-Amazon N=335K K=5120, seconds of host
-    time) plus O(E) edge counting and a Python BFS over fat columns
-    only. Cross/within column edge counts use each node's top-2
-    above-threshold columns (exact for <= 2 memberships, a subsample for
-    more); nominees are verified with an exact exclusive-to-exclusive
-    density scan.
+    time) plus O(E) edge counting and component scans over fat columns
+    only (batched on the device backend — see `components`, the same
+    backend switch as atomize_reassign). Cross/within column edge counts
+    use each node's top-2 above-threshold columns (exact for <= 2
+    memberships, a subsample for more); nominees are verified with an
+    exact exclusive-to-exclusive density scan.
     Only columns < k_active are touched (the K-sweep's padding columns
-    must stay zero). Returns (repaired F, number of repairs).
+    must stay zero).
+
+    Returns (edits, repairs): edits is an ORDERED list of
+    ("clear", col) and ("set", rows, col, value) steps.
+    repair_communities applies them to a host F; the device repair stage
+    (fit_quality_device) applies them to the RESIDENT F as scatter
+    updates — index vectors cross the host boundary, F does not.
     """
-    F = np.asarray(F, np.float64).copy()
+    F = np.asarray(F, np.float64)
     n = g.num_nodes
     ka = int(k_active)
     Fa = F[:n, :ka]
     mask = Fa >= delta
     sizes = mask.sum(axis=0)
     if not sizes.any():
-        return F, 0
+        return [], 0
     # top-2 above-threshold columns per node
     if ka >= 2:
         top2 = np.argpartition(-Fa, 1, axis=1)[:, :2]
@@ -450,37 +554,110 @@ def repair_communities(
             used.update((a, b))
     if not merges:
         # repairs = min(#merges, #splits): without a freed column the
-        # split BFS below would be a guaranteed host-side no-op
-        return F, 0
-    # split candidates: extra components of fat columns
-    def components(mem):
-        return _graph_components(mem, indptr, indices)
+        # split component scan below would be a guaranteed no-op
+        return [], 0
+    # split candidates: extra components of fat columns. The candidate set
+    # only depends on merge-used columns, so it can be precomputed — which
+    # lets the device backend run ONE batched label-propagation pass over
+    # all fat candidates instead of a host scipy scan per column.
+    from bigclam_tpu.ops.components import components_backend
 
+    cand = [
+        int(c)
+        for c in np.argsort(-sizes)
+        if int(c) not in used and sizes[int(c)] >= 2 * min_comp
+    ]
+    comp_of = None
+    if cand and components_backend(n, len(cand), components) == "device":
+        from bigclam_tpu.ops.components import (
+            column_component_stats,
+            components_from_labels,
+            device_edges,
+        )
+
+        if edges_dev is None:      # round-looping callers pass their cache
+            edges_dev = device_edges(g)
+        member = np.zeros((len(cand), n), bool)
+        for i, c in enumerate(cand):
+            member[i, members[c]] = True
+        labels, _, _ = column_component_stats(member, *edges_dev, n)
+        comp_of = {
+            c: components_from_labels(labels[i], n, min_size=min_comp)
+            for i, c in enumerate(cand)
+        }
     splits = []
-    for c in np.argsort(-sizes):
-        c = int(c)
-        if c in used or sizes[c] < 2 * min_comp:
-            continue
-        comps = [cc for cc in components(members[c]) if len(cc) >= min_comp]
+    for c in cand:
+        comps = (
+            list(comp_of[c])
+            if comp_of is not None
+            else [
+                np.asarray(cc, np.int64)
+                for cc in _graph_components(members[c], indptr, indices)
+                if len(cc) >= min_comp
+            ]
+        )
         if len(comps) <= 1:
             continue
-        comps.sort(key=len, reverse=True)
+        # min-id tiebreak: backend-independent primary-component choice
+        # (component member arrays are ascending on both backends)
+        comps.sort(key=lambda cc: (-len(cc), int(cc[0])))
         for comp in comps[1:]:
-            splits.append((c, comp))
-        used.add(c)
+            splits.append((c, np.asarray(comp, np.int64)))
+    edits: list = []
     repairs = 0
     freed = []
     for a, b in merges:
         if repairs >= len(splits):
             break
-        F[list(msets[b] - msets[a]), a] = strength
-        F[:n, b] = 0.0
+        gained = np.fromiter(
+            sorted(msets[b] - msets[a]), np.int64,
+            count=len(msets[b] - msets[a]),
+        )
+        edits.append(("set", gained, int(a), float(strength)))
+        edits.append(("clear", int(b)))
         freed.append(b)
         repairs += 1
     for (c, comp), v in zip(splits, freed):
-        F[comp, v] = strength
-        F[comp, c] = 0.0
-    return F, repairs
+        edits.append(("set", comp, int(v), float(strength)))
+        edits.append(("set", comp, int(c), 0.0))
+    return edits, repairs
+
+
+def apply_repair_edits(F: np.ndarray, edits: list, num_nodes: int
+                       ) -> np.ndarray:
+    """Apply a repair_plan edit list to a host F in place (rows beyond
+    num_nodes — padding — are never named by edits)."""
+    for e in edits:
+        if e[0] == "clear":
+            F[:num_nodes, e[1]] = 0.0
+        else:
+            _, rows, col, val = e
+            F[rows, col] = val
+    return F
+
+
+def repair_communities(
+    F: np.ndarray,
+    g,
+    delta: float,
+    k_active: int,
+    min_comp: int = 5,
+    strength: float = 1.0,
+    components: str = "auto",
+    edges_dev=None,
+) -> Tuple[np.ndarray, int]:
+    """One merge+split repair pass over the thresholded communities:
+    repair_plan detection + host application of the edit list. Returns
+    (repaired F, number of repairs); see repair_plan for the move's
+    rationale and cost model."""
+    F = np.asarray(F, np.float64).copy()
+    edits, repairs = repair_plan(
+        F, g, delta, k_active, min_comp=min_comp, strength=strength,
+        components=components, edges_dev=edges_dev,
+    )
+    if not repairs:
+        return F, 0
+    return apply_repair_edits(F, edits, g.num_nodes), repairs
 
 
 @dataclasses.dataclass(frozen=True)
@@ -491,6 +668,51 @@ class QualityResult:
     total_iters: int
     num_repairs: int = 0      # accepted merge+split repair rounds (the
     # repair stage can push fit.llh ABOVE max(cycles_llh))
+    stages: Optional[dict] = None   # per-stage wall-clock + transfer
+    # counters (utils.profiling.StageProfile.report()); populated by the
+    # device schedule and by callers that pass a profile to fit_quality
+
+
+def _repair_stamp(
+    cfg, anneal_llh: float, kc: int, eps: float, min_comp: int, rng: str
+) -> dict:
+    """The invalidation stamp a repair checkpoint must match to resume
+    (see _repair_stage). `rng` names the kick-stream family — "host"
+    (NumPy streams) vs "device" (threefry folds): the two stages draw
+    different polish kicks, so their checkpoints must never cross-resume."""
+    return {
+        "anneal_llh": float(anneal_llh),
+        "kick_cols": int(kc),
+        "reassign": bool(cfg.quality_reassign),
+        "seed": cfg.seed,
+        "eps": float(eps),
+        "min_comp": int(min_comp),
+        "rng": rng,
+    }
+
+
+def _repair_ckpt_open(checkpoints, stamp: dict):
+    """(manager under <dir>/repair, restored (rr_done, arrays, meta) or
+    None). A checkpoint whose meta mismatches ANY stamp key — including
+    one written before a stamp key existed (.get() misses) — is stale:
+    deleted, and a fresh manager is returned. The anneal_llh stamp is the
+    resume-extension rule: a restart with more restart_cycles changes the
+    post-annealing best, so the stale repair work is discarded and repair
+    restarts from the NEW annealed state, exactly as an uninterrupted run
+    would (ADVICE round-5 for the eps/min_comp keys)."""
+    from bigclam_tpu.utils.checkpoint import CheckpointManager
+
+    rep_ckpt = CheckpointManager(
+        os.path.join(checkpoints.directory, "repair")
+    )
+    restored = rep_ckpt.restore()
+    if restored is None:
+        return rep_ckpt, None
+    meta = restored[2]
+    if all(meta.get(k) == v for k, v in stamp.items()):
+        return rep_ckpt, restored
+    shutil.rmtree(rep_ckpt.directory, ignore_errors=True)
+    return CheckpointManager(rep_ckpt.directory), None
 
 
 def _repair_stage(
@@ -535,50 +757,30 @@ def _repair_stage(
     anneal_llh = float(best.llh)       # the post-annealing stamp
     start_round = 0
     rep_ckpt = None
+    stamp: dict = {}
     if checkpoints is not None:
-        from bigclam_tpu.utils.checkpoint import CheckpointManager
-
-        rep_ckpt = CheckpointManager(
-            os.path.join(checkpoints.directory, "repair")
-        )
-        restored = rep_ckpt.restore()
+        # the stamp (incl. eps/min_comp — a checkpoint written under a
+        # different polish kick scale or component floor replays a
+        # different schedule on resume, ADVICE round-5) gates the restore
+        stamp = _repair_stamp(cfg, anneal_llh, kc, eps, min_comp, "host")
+        rep_ckpt, restored = _repair_ckpt_open(checkpoints, stamp)
         if restored is not None:
             rr_done, arrays, meta = restored
-            if (
-                meta.get("anneal_llh") == anneal_llh
-                and int(meta.get("kick_cols", -1)) == kc
-                and meta.get("reassign") == bool(cfg.quality_reassign)
-                and meta.get("seed") == cfg.seed
-                # polish kick scale (derived from cfg.init_noise) and the
-                # component floor both change the repair schedule: a
-                # checkpoint written under different values (or predating
-                # the stamp — .get() misses) must be discarded, or resume
-                # silently replays a different kick schedule than the
-                # uninterrupted run (ADVICE round-5)
-                and meta.get("eps") == eps
-                and int(meta.get("min_comp", -1)) == min_comp
-            ):
-                F_r = np.asarray(arrays["F"])
-                best = FitResult(
-                    F=F_r,
-                    sumF=F_r.sum(axis=0),
-                    llh=float(meta["best_llh"]),
-                    num_iters=int(meta.get("fit_num_iters", best.num_iters)),
-                    llh_history=tuple(
-                        np.asarray(arrays.get("llh_history", ())).tolist()
-                    ),
-                )
-                accepted_repairs = int(meta.get("accepted_repairs", 0))
-                extra_iters = int(meta.get("extra_iters", 0))
-                start_round = rr_done + 1
-                if meta.get("done"):
-                    return best, accepted_repairs, extra_iters
-            else:
-                # stale: written against a different annealing outcome
-                shutil.rmtree(rep_ckpt.directory, ignore_errors=True)
-                rep_ckpt = CheckpointManager(
-                    os.path.join(checkpoints.directory, "repair")
-                )
+            F_r = np.asarray(arrays["F"])
+            best = FitResult(
+                F=F_r,
+                sumF=F_r.sum(axis=0),
+                llh=float(meta["best_llh"]),
+                num_iters=int(meta.get("fit_num_iters", best.num_iters)),
+                llh_history=tuple(
+                    np.asarray(arrays.get("llh_history", ())).tolist()
+                ),
+            )
+            accepted_repairs = int(meta.get("accepted_repairs", 0))
+            extra_iters = int(meta.get("extra_iters", 0))
+            start_round = rr_done + 1
+            if meta.get("done"):
+                return best, accepted_repairs, extra_iters
 
     g_orig = getattr(model, "g_original", model.g)
     delta = delta_threshold(g_orig.num_nodes, g_orig.num_edges)
@@ -592,13 +794,8 @@ def _repair_stage(
                     "llh_history": np.asarray(best.llh_history, np.float64),
                 },
                 meta={
+                    **stamp,
                     "best_llh": float(best.llh),
-                    "anneal_llh": anneal_llh,
-                    "kick_cols": kc,
-                    "reassign": bool(cfg.quality_reassign),
-                    "seed": cfg.seed,
-                    "eps": float(eps),
-                    "min_comp": int(min_comp),
                     "fit_num_iters": int(best.num_iters),
                     "accepted_repairs": accepted_repairs,
                     "extra_iters": extra_iters,
@@ -655,6 +852,7 @@ def fit_quality(
     callback: Optional[Callable[[int, float], None]] = None,
     checkpoints=None,
     kick_cols: Optional[int] = None,
+    profile=None,
 ) -> QualityResult:
     """Train with the quality-mode schedule (see module docstring).
 
@@ -676,7 +874,17 @@ def fit_quality(
     columns). The K-sweep passes the active K here — its F buffer is sized
     to the grid max with columns >= K masked to zero, and an unrestricted
     kick would lift those padding columns off their inert zeros.
+
+    `profile` (utils.profiling.StageProfile, created when omitted)
+    accumulates anneal/repair wall-clock; the report lands in
+    QualityResult.stages so artifacts can attribute the quality stage's
+    cost (the device loop records finer stages plus transfer counts).
     """
+    import time
+
+    from bigclam_tpu.utils.profiling import StageProfile
+
+    profile = profile if profile is not None else StageProfile()
     cfg = model.cfg
     n, k = F0.shape
     kc = k if kick_cols is None else int(kick_cols)
@@ -758,6 +966,7 @@ def fit_quality(
         if max_p_q != cfg.max_p:
             model.rebuild_step()
             rebuilt = True
+        t_anneal = time.perf_counter()
         for cycle in range(start_cycle, max_cycles):
             if gainless >= cfg.restart_patience:
                 break          # a restored run that already tripped
@@ -816,6 +1025,7 @@ def fit_quality(
                         shutil.rmtree(cyc_dir, ignore_errors=True)
             if gainless >= cfg.restart_patience:
                 break
+        profile.add_seconds("anneal", time.perf_counter() - t_anneal)
         # --- discrete repair stage (cfg.quality_repair; _repair_stage):
         # runs after the cycle loop, checkpointed under <dir>/repair/ with
         # the post-annealing best LLH as its invalidation stamp — a
@@ -825,10 +1035,12 @@ def fit_quality(
         # Repairs use the ORIGINAL-id graph: FitResult.F is in original
         # ids even when a balanced sharded trainer relabeled rows.
         if cfg.quality_repair and best is not None:
+            t_rep = time.perf_counter()
             best, accepted_repairs, rep_iters = _repair_stage(
                 model, best, kc, eps, callback, checkpoints=checkpoints
             )
             total_iters += rep_iters
+            profile.add_seconds("repair", time.perf_counter() - t_rep)
     finally:
         model.cfg = cfg_saved
         if rebuilt:
@@ -839,6 +1051,273 @@ def fit_quality(
         num_cycles=len(cycles_llh),
         total_iters=total_iters,
         num_repairs=accepted_repairs,
+        stages=profile.report(),
+    )
+
+
+def _repair_stage_device(
+    model,
+    best_state,
+    best_llh: float,
+    best_iters: int,
+    best_hist: tuple,
+    kc: int,
+    eps: float,
+    callback,
+    kick_fn,
+    base_key,
+    profile,
+    checkpoints=None,
+    min_comp: int = 5,
+):
+    """DEVICE-RESIDENT discrete stage: the _repair_stage twin that keeps F
+    on the chips (fit_quality_device's residency protocol; DESIGN.md
+    "Device-resident quality pipeline").
+
+    Differences from the host stage, by design:
+
+    * components + membership/density stats for atomize and the
+      fat-column splits come from the batched device label-propagation
+      pass (ops.components) — int32 label/stat arrays cross the host
+      boundary; F itself does not.
+    * move order is merge/split -> atomize within a round (the host stage
+      runs atomize first): merge/split detection is a host pass over
+      thresholded F VALUES (top-2 columns, exclusive densities), so it
+      needs the round's one F fetch — running it first lets that fetch
+      double as the previous round's checkpoint payload, holding the
+      stage to AT MOST ONE full-F device->host download per repair round
+      (the transfer contract pinned by tests/test_components.py).
+      Atomize needs only the thresholded MASK, which stays on device.
+    * repairs reach the resident F as scatter edits (repair_plan's edit
+      list / the atomize plan's (rows, cols, vals) arrays — index vectors
+      ~K times smaller than F), and every refit (the atomize refit and
+      the 6 polish fits) runs state-resident through model.fit_state,
+      reusing the donated TrainState ping-pong of run_fit_loop. Zero F
+      uploads per refit.
+    * polish kicks draw from the device threefry stream (folded per
+      (round, polish)) — deterministic for a fixed seed/mesh, but a
+      different schedule than the host stage's NumPy streams; repair
+      checkpoints therefore carry rng="device" and never cross-resume
+      with host-stage checkpoints (shared _repair_stamp).
+
+    Round checkpoints are DEFERRED one fetch: round rr's state is saved
+    by round rr+1's fetch (the identical F — nothing moves between
+    rounds), and the last round's by the caller's final result fetch via
+    the returned `finalize(F_host)` closure. Returns (best_state,
+    best_llh, best_iters, best_hist, accepted_repairs, extra_iters,
+    finalize).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from bigclam_tpu.ops.components import device_edges
+    from bigclam_tpu.ops.extraction import delta_threshold
+
+    cfg = model.cfg
+    g = model.g
+    g_orig = getattr(model, "g_original", g)
+    n = g.num_nodes
+    delta = delta_threshold(g_orig.num_nodes, g_orig.num_edges)
+    accepted_repairs = 0
+    extra_iters = 0
+    anneal_llh = float(best_llh)
+    start_round = 0
+    rep_ckpt = None
+    stamp: dict = {}
+    if checkpoints is not None:
+        stamp = _repair_stamp(cfg, anneal_llh, kc, eps, min_comp, "device")
+        rep_ckpt, restored = _repair_ckpt_open(checkpoints, stamp)
+        if restored is not None:
+            rr_done, arrays, meta = restored
+            best_state = model.init_state(np.asarray(arrays["F"]))
+            profile.count("f_host_uploads")
+            best_llh = float(meta["best_llh"])
+            best_iters = int(meta.get("fit_num_iters", best_iters))
+            best_hist = tuple(
+                np.asarray(arrays.get("llh_history", ())).tolist()
+            )
+            accepted_repairs = int(meta.get("accepted_repairs", 0))
+            extra_iters = int(meta.get("extra_iters", 0))
+            start_round = rr_done + 1
+            if meta.get("done"):
+                return (
+                    best_state, best_llh, best_iters, best_hist,
+                    accepted_repairs, extra_iters, lambda F_host: None,
+                )
+
+    perm = getattr(model, "_perm", None)   # edits arrive in ORIGINAL ids
+    n_pad = int(best_state.F.shape[0])
+    edges_dev = device_edges(g)            # one upload for every round
+    # merge/split detection runs in ORIGINAL ids (on the fetched F); a
+    # balanced trainer's g is relabeled, so its edge cache cannot be
+    # shared with repair_plan there
+    edges_dev_orig = (
+        edges_dev if g_orig is g else device_edges(g_orig)
+    )
+
+    scatter_set = jax.jit(
+        lambda F, rows, cols, vals: F.at[rows, cols].set(vals, mode="drop")
+    )
+    clear_col = jax.jit(
+        lambda F, col: jnp.where(
+            jnp.arange(F.shape[1], dtype=jnp.int32)[None, :] == col,
+            jnp.zeros((), F.dtype),
+            F,
+        )
+    )
+
+    def apply_sets(F, rows, cols, vals):
+        # pow-2 padding (pad rows land at n_pad, out of bounds -> dropped
+        # by mode="drop"), so at most log2 scatter shapes ever compile
+        r = np.asarray(rows, np.int32)
+        size = 1 << max(int(r.size - 1).bit_length(), 0)
+        pad = size - r.size
+        r = np.pad(r, (0, pad), constant_values=n_pad)
+        c = np.pad(np.asarray(cols, np.int32), (0, pad))
+        v = np.pad(np.asarray(vals, np.float64), (0, pad))
+        return scatter_set(
+            F, jnp.asarray(r), jnp.asarray(c), jnp.asarray(v, F.dtype)
+        )
+
+    pending: list = [None]     # (round, done) awaiting an F fetch
+
+    def _save(rr: int, done: bool, F_host: np.ndarray) -> None:
+        if rep_ckpt is not None and is_primary():
+            rep_ckpt.save(
+                rr,
+                {
+                    "F": np.asarray(F_host),
+                    "llh_history": np.asarray(best_hist, np.float64),
+                },
+                meta={
+                    **stamp,
+                    "best_llh": float(best_llh),
+                    "fit_num_iters": int(best_iters),
+                    "accepted_repairs": accepted_repairs,
+                    "extra_iters": extra_iters,
+                    "done": done,
+                },
+            )
+
+    def finalize(F_host) -> None:
+        if pending[0] is not None:
+            _save(pending[0][0], pending[0][1], F_host)
+            pending[0] = None
+
+    for rr in range(start_round, max(cfg.repair_rounds, 0)):
+        changed = False
+        # --- the round's ONE F fetch: the previous round's deferred
+        # checkpoint payload + the merge/split detection input ---
+        with profile.stage("repair_fetch"):
+            F_host = model.extract_F(best_state)
+        profile.count("f_device_fetches")
+        finalize(F_host)
+        # --- (a) merge/split repair; polish refits state-resident ---
+        with profile.stage("repair_detect"):
+            edits, nrep = repair_plan(
+                F_host, g_orig, delta, kc, min_comp=min_comp,
+                edges_dev=edges_dev_orig,
+            )
+        del F_host
+        if nrep:
+            F_rep = best_state.F
+            for e in edits:
+                if e[0] == "clear":
+                    F_rep = clear_col(F_rep, jnp.int32(e[1]))
+                else:
+                    _, rows, col, val = e
+                    rows = rows if perm is None else perm[rows]
+                    F_rep = apply_sets(
+                        F_rep, rows,
+                        np.full(rows.size, col, np.int32),
+                        np.full(rows.size, val),
+                    )
+            cand_state = None
+            cand_llh = None
+            cand_iters, cand_hist = 0, ()
+            F_c = F_rep
+            with profile.stage("repair_polish"):
+                for pc in range(6):    # polish: short re-annealing
+                    key = jax.random.fold_in(
+                        base_key, 0x0F17_0000 + rr * 64 + pc
+                    )
+                    final, llh, iters, hist = model.fit_state(
+                        model.reset_state(kick_fn(F_c, key)),
+                        callback=callback,
+                    )
+                    extra_iters += iters
+                    if cand_llh is None or llh > cand_llh:
+                        cand_state, cand_llh = final, llh
+                        cand_iters, cand_hist = iters, hist
+                        F_c = final.F
+                    del final          # rejected polish buffers die now
+            del F_rep, F_c
+            if cand_llh is not None and cand_llh > best_llh:
+                best_state, best_llh = cand_state, cand_llh
+                best_iters, best_hist = cand_iters, cand_hist
+                accepted_repairs += 1
+                changed = True
+                profile.count("repair_accepted")
+            del cand_state
+        # --- (b) atomize re-tiling from the DEVICE mask (no F fetch) ---
+        if cfg.quality_reassign:
+            with profile.stage("atomize_components"):
+                mask_cols = (best_state.F[:n, :kc] >= delta).T
+                # backend dispatch (ops.components.components_backend): on
+                # an accelerator the batched device pass is the only
+                # option that keeps F resident; on a CPU backend "device"
+                # memory IS host memory, so the scipy oracle runs on the
+                # same bool mask for a fraction of the wall-clock (the
+                # mask is kc*n bools — not F)
+                from bigclam_tpu.ops.components import components_backend
+
+                if components_backend(n, kc) == "device":
+                    atoms = _column_atoms_device(
+                        mask_cols, g, min_comp, edges_dev
+                    )
+                else:
+                    atoms = _column_atoms_host(
+                        np.asarray(mask_cols).T, g.indptr, g.indices,
+                        min_comp,
+                    )
+                del mask_cols
+            if atoms:
+                kept = _plan_atoms(atoms, n, kc)
+                rows = np.concatenate([at for at, _ in kept])
+                cols = np.concatenate([
+                    np.full(at.size, c, np.int32)
+                    for c, (at, _) in enumerate(kept)
+                ])
+                vals = np.concatenate([
+                    np.full(
+                        at.size,
+                        _atom_strength(at, d, g.indptr, g.indices),
+                    )
+                    for at, d in kept
+                ])
+                F_at = apply_sets(
+                    jnp.zeros_like(best_state.F), rows, cols, vals
+                )
+                with profile.stage("atomize_refit"):
+                    final, llh, iters, hist = model.fit_state(
+                        model.reset_state(F_at), callback=callback
+                    )
+                del F_at
+                extra_iters += iters
+                if llh > best_llh:
+                    best_state, best_llh = final, llh
+                    best_iters, best_hist = iters, hist
+                    accepted_repairs += 1
+                    changed = True
+                    profile.count("atomize_accepted")
+                del final
+        profile.count("repair_rounds")
+        pending[0] = (rr, not changed)
+        if not changed:
+            break
+    return (
+        best_state, best_llh, best_iters, best_hist, accepted_repairs,
+        extra_iters, finalize,
     )
 
 
@@ -848,33 +1327,52 @@ def fit_quality_device(
     callback: Optional[Callable[[int, float], None]] = None,
     kick_cols: Optional[int] = None,
     key_salt: int = 0,
+    checkpoints=None,
+    profile=None,
 ) -> QualityResult:
-    """DEVICE-RESIDENT annealing: the pod-scale variant of fit_quality.
+    """DEVICE-RESIDENT annealing + discrete stage: the pod-scale variant
+    of fit_quality.
 
     The host loop round-trips the full (N, K) F to the host every cycle
     (res.F out, kicked F_try back in) — at com-Orkut scale (N=3.07M,
     K=15000, 184 GB global F) that F does not even fit one host. Here the
-    state stays sharded on the devices for the WHOLE schedule: one
-    init_state upload, then per cycle a jitted on-device kick (uniform
-    noise masked to the live (num_nodes, kick_cols) region — padding rows
-    and columns stay on their inert zeros) and the trainers' state-resident
-    loop (fit_state); only per-iteration LLH scalars cross the host
-    boundary. The final best F is fetched once at the end.
+    state stays sharded on the devices for the WHOLE schedule — cycles AND
+    the discrete repair stage: one init_state upload, then per cycle a
+    jitted on-device kick (uniform noise masked to the live
+    (num_nodes, kick_cols) region — padding rows and columns stay on their
+    inert zeros) and the trainers' state-resident loop (fit_state); only
+    per-iteration LLH scalars cross the host boundary. The discrete stage
+    (_repair_stage_device) computes atomize components + density stats
+    from the device mask via batched label propagation (ops.components),
+    applies repairs as scatter edits to the resident F, runs every refit
+    through fit_state with the donated TrainState ping-pong, and performs
+    at most ONE full-F download per repair round (serving merge/split
+    detection and repair-round checkpointing together). The final best F
+    is fetched once at the end.
 
-    Differences from fit_quality, by design: the kick noise comes from
-    jax.random (threefry, folded per cycle) instead of the host NumPy
-    streams — deterministic for a fixed seed/mesh but NOT bit-identical to
-    the host schedule; checkpointing is not wired (a host-F pass — use
-    the host loop where it matters more than transfer cost). The
-    cfg.quality_repair merge+split stage DOES run (host-side, on the
-    final fetched F — the one fetch proves F fits the host; each polish
-    fit re-uploads F on sharded trainers). Stop rule, patience, MAX_P_
-    relaxation, and the kept-LLH semantics are identical (shared
-    _relax_params).
+    `checkpoints` (utils.checkpoint.CheckpointManager) wires REPAIR-ROUND
+    granularity checkpointing: a crash mid-repair at pod scale resumes
+    from the last completed round instead of redoing hours of polish fits.
+    Cycle-granularity checkpointing stays a host-loop feature (it is a
+    full-F host pass by definition); device-stage checkpoints are stamped
+    rng="device" and never cross-resume with host-stage ones.
+
+    Differences from fit_quality, by design: kick noise comes from
+    jax.random (threefry, folded per cycle / per (round, polish)) instead
+    of the host NumPy streams — deterministic for a fixed seed/mesh but
+    NOT bit-identical to the host schedule — and the discrete stage runs
+    merge/split before atomize within a round (see _repair_stage_device).
+    Stop rule, patience, MAX_P_ relaxation, and the kept-LLH semantics
+    are identical (shared _relax_params). Per-stage wall-clock and
+    transfer counts land in QualityResult.stages
+    (utils.profiling.StageProfile).
     """
     import jax
     import jax.numpy as jnp
 
+    from bigclam_tpu.utils.profiling import StageProfile
+
+    profile = profile if profile is not None else StageProfile()
     cfg = model.cfg
     n, k = F0.shape
     kc = k if kick_cols is None else int(kick_cols)
@@ -884,6 +1382,7 @@ def fit_quality_device(
     max_p_q, eps = _relax_params(model, n)
 
     state0 = model.init_state(F0)          # the ONE host->device upload
+    profile.count("f_host_uploads")
     n_pad, k_pad = state0.F.shape
 
     @jax.jit
@@ -925,51 +1424,59 @@ def fit_quality_device(
             model.rebuild_step()
             rebuilt = True
         best_iters, best_hist = 0, ()
-        for cycle in range(max_cycles):
-            F_try = kick_fn(F_cur, jax.random.fold_in(base_key, cycle))
-            final, llh, iters, hist = model.fit_state(
-                model.reset_state(F_try), callback=callback
+        with profile.stage("anneal"):
+            for cycle in range(max_cycles):
+                F_try = kick_fn(F_cur, jax.random.fold_in(base_key, cycle))
+                final, llh, iters, hist = model.fit_state(
+                    model.reset_state(F_try), callback=callback
+                )
+                del F_try                  # free the kicked input buffer
+                total_iters += iters
+                profile.count("anneal_cycles")
+                cycles_llh.append(llh)
+                prev_best = best_llh
+                if best_llh is None or llh > best_llh:
+                    best_state, best_llh = final, llh
+                    best_iters, best_hist = iters, hist
+                    F_cur = final.F        # kick accepted: anneal from here
+                # a rejected cycle's converged state must not stay live
+                # through the next cycle — at pod scale that extra F-sized
+                # buffer is the difference between fitting and OOM
+                del final
+                if prev_best is not None and prev_best != 0.0:
+                    gain = (best_llh - prev_best) / abs(prev_best)
+                    gainless = gainless + 1 if gain < cfg.restart_tol else 0
+                if gainless >= cfg.restart_patience:
+                    break
+        # still under the RELAXED cfg: the discrete stage's refits must
+        # anneal under the same clip the cycles did — one swap/rebuild
+        # round-trip for the whole schedule. F STAYS DEVICE-RESIDENT
+        # through the stage (the round-5 device path fetched F here and
+        # ran the host stage, paying one F round trip per refit — the
+        # exact transfer this path exists to avoid).
+        finalize = None
+        accepted_repairs = 0
+        if cfg.quality_repair:
+            (
+                best_state, best_llh, best_iters, best_hist,
+                accepted_repairs, rep_iters, finalize,
+            ) = _repair_stage_device(
+                model, best_state, best_llh, best_iters, best_hist, kc,
+                eps, callback, kick_fn, base_key, profile,
+                checkpoints=checkpoints,
             )
-            del F_try                      # free the kicked input buffer
-            total_iters += iters
-            cycles_llh.append(llh)
-            prev_best = best_llh
-            if best_llh is None or llh > best_llh:
-                best_state, best_llh = final, llh
-                best_iters, best_hist = iters, hist
-                F_cur = final.F            # kick accepted: anneal from here
-            # a rejected cycle's converged state must not stay live through
-            # the next cycle — at pod scale that extra F-sized buffer is
-            # the difference between fitting and OOM
-            del final
-            if prev_best is not None and prev_best != 0.0:
-                gain = (best_llh - prev_best) / abs(prev_best)
-                gainless = gainless + 1 if gain < cfg.restart_tol else 0
-            if gainless >= cfg.restart_patience:
-                break
-        # still under the RELAXED cfg: the fetch does not depend on it, and
-        # the discrete stage's refits must anneal under the same clip the
-        # cycles did — one swap/rebuild round-trip for the whole schedule
-        F_best = model.extract_F(best_state)   # the ONE device->host fetch
-        # same FitResult contract as the host loop: the BEST cycle's
+            total_iters += rep_iters
+        with profile.stage("final_fetch"):
+            F_best = model.extract_F(best_state)   # ONE device->host fetch
+        profile.count("f_device_fetches")
+        if finalize is not None:
+            finalize(F_best)   # deferred last-round repair checkpoint
+        # same FitResult contract as the host loop: the BEST fit's
         # iteration count and LLH trace (total_iters on the QualityResult)
         fit = FitResult(
             F=F_best, sumF=F_best.sum(axis=0), llh=best_llh,
             num_iters=best_iters, llh_history=best_hist,
         )
-        accepted_repairs = 0
-        if cfg.quality_repair:
-            # the discrete stage is a host-F pass; the fetch above just
-            # proved F fits the host, so run it here instead of silently
-            # dropping a default-on stage (the device path then matches
-            # the host loop's quality). Each refit re-uploads F (sharded
-            # trainers) — transfer cost traded for schedule parity.
-            # Un-checkpointed on this path (checkpointing is not wired
-            # here at all).
-            fit, accepted_repairs, rep_iters = _repair_stage(
-                model, fit, kc, eps, callback
-            )
-            total_iters += rep_iters
     finally:
         model.cfg = cfg_saved
         if rebuilt:
@@ -980,4 +1487,5 @@ def fit_quality_device(
         num_cycles=len(cycles_llh),
         total_iters=total_iters,
         num_repairs=accepted_repairs,
+        stages=profile.report(),
     )

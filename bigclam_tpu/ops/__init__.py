@@ -1,4 +1,17 @@
 from bigclam_tpu.ops.objective import grad_llh, loglikelihood
 from bigclam_tpu.ops.linesearch import candidates_pass, armijo_update
+from bigclam_tpu.ops.components import (
+    column_component_stats,
+    components_backend,
+    graph_components_device,
+)
 
-__all__ = ["grad_llh", "loglikelihood", "candidates_pass", "armijo_update"]
+__all__ = [
+    "grad_llh",
+    "loglikelihood",
+    "candidates_pass",
+    "armijo_update",
+    "column_component_stats",
+    "components_backend",
+    "graph_components_device",
+]

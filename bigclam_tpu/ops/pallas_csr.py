@@ -145,10 +145,11 @@ def csr_tiles_supported(
 
 def _out_struct(shape, dtype, *operands) -> jax.ShapeDtypeStruct:
     """Output spec carrying the union of the operands' varying-mesh-axes
-    (vma) types — required when the kernels run inside jax.shard_map."""
-    vma = frozenset().union(
-        *(getattr(jax.typeof(x), "vma", frozenset()) for x in operands)
-    )
+    (vma) types — required when the kernels run inside jax.shard_map.
+    (Empty on jax 0.4.x, where the VMA type system does not exist.)"""
+    from bigclam_tpu.utils.compat import vma_of
+
+    vma = frozenset().union(*(vma_of(x) for x in operands))
     if vma:
         return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
     return jax.ShapeDtypeStruct(shape, dtype)

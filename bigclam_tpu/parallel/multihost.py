@@ -46,7 +46,9 @@ def initialize_distributed(
     the CPU test fake) never try to open a coordination channel. Idempotent:
     re-initialization is detected and skipped.
     """
-    if jax.distributed.is_initialized():
+    from bigclam_tpu.utils.compat import distributed_is_initialized
+
+    if distributed_is_initialized():
         return True
     if coordinator_address is None:
         for k in _COORD_ENVS:

@@ -48,6 +48,7 @@ from bigclam_tpu.models.bigclam import (
 from bigclam_tpu.ops.objective import EdgeChunks, edge_terms
 from bigclam_tpu.parallel.mesh import K_AXIS, NODES_AXIS
 from bigclam_tpu.parallel.multihost import fetch_global, put_sharded
+from bigclam_tpu.utils.compat import shard_map
 
 
 def shard_edges(
@@ -96,10 +97,13 @@ def _rowdot(a: jax.Array, b: jax.Array) -> jax.Array:
 
 def _mark_varying(x: jax.Array, axes: tuple) -> jax.Array:
     """Mark x as varying over the given mesh axes for the VMA type system
-    (idempotent: axes already varying are left alone)."""
-    vma = getattr(jax.typeof(x), "vma", frozenset())
+    (idempotent: axes already varying are left alone; no-op on jax 0.4.x,
+    where the type system — and the need for the annotation — is absent)."""
+    from bigclam_tpu.utils.compat import pcast_varying, vma_of
+
+    vma = vma_of(x)
     missing = tuple(a for a in axes if a not in vma)
-    return lax.pcast(x, missing, to="varying") if missing else x
+    return pcast_varying(x, missing) if missing else x
 
 
 def armijo_tail_select_sharded(
@@ -332,7 +336,7 @@ def make_sharded_csr_train_step(
         # dynamic_slice, which the VMA type check cannot express yet; the
         # XLA sharded step keeps the checked path and the equivalence tests
         # (tests/test_pallas_csr.py::TestShardedCSR) pin the semantics
-        F_new, sumF, llh, it, hist = jax.shard_map(
+        F_new, sumF, llh, it, hist = shard_map(
             step_shard,
             mesh=mesh,
             in_specs=(
@@ -459,7 +463,7 @@ def make_sharded_train_step(
         return F_new, sumF_new, llh_cur.astype(F_loc.dtype), it + 1, hist
 
     def step(state: TrainState, src, dst, mask) -> TrainState:
-        F_new, sumF, llh, it, hist = jax.shard_map(
+        F_new, sumF, llh, it, hist = shard_map(
             step_shard,
             mesh=mesh,
             in_specs=(

@@ -1,0 +1,64 @@
+"""Version-portable shims for the handful of jax APIs that moved between
+the 0.4.x and 0.5+ lines.
+
+The trainers target current jax (jax.shard_map, the varying-mesh-axes type
+system, jax.distributed.is_initialized); CI containers and some driver
+hosts still carry 0.4.x, where the same capabilities live under
+jax.experimental.shard_map / check_rep and the VMA types do not exist at
+all. Everything here resolves AT CALL TIME (no import-order sensitivity)
+and degrades to exact equivalents: check_vma maps onto check_rep, and
+varying-axis marking is a no-op where the type system is absent (it was
+only ever a static annotation — no math moves).
+"""
+
+from __future__ import annotations
+
+import jax
+
+
+def shard_map(f, mesh, in_specs, out_specs, check_vma: bool = True):
+    """jax.shard_map on 0.5+; jax.experimental.shard_map.shard_map on
+    0.4.x. The older check_rep inference is strictly weaker than the VMA
+    type system the trainer bodies are annotated for (it cannot see
+    through the psum-completed accumulators the steps return), so the
+    0.4.x path always disables it — the check is a static type audit, not
+    a numeric transform, and the 0.5+ path keeps it fully on."""
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_vma=check_vma,
+        )
+    from jax.experimental.shard_map import shard_map as _sm
+
+    return _sm(
+        f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+        check_rep=False,
+    )
+
+
+def vma_of(x) -> frozenset:
+    """The varying-mesh-axes set of x's type; empty where the VMA type
+    system does not exist (jax 0.4.x)."""
+    if not hasattr(jax, "typeof"):
+        return frozenset()
+    return getattr(jax.typeof(x), "vma", frozenset())
+
+
+def pcast_varying(x, axes: tuple):
+    """lax.pcast(x, axes, to="varying") on jax 0.5+; identity on 0.4.x
+    (no VMA types to satisfy — the cast never moved data)."""
+    from jax import lax
+
+    if not hasattr(lax, "pcast"):
+        return x
+    return lax.pcast(x, axes, to="varying")
+
+
+def distributed_is_initialized() -> bool:
+    """jax.distributed.is_initialized, with the 0.4.x fallback of probing
+    the global state object the accessor reads."""
+    dist = jax.distributed
+    if hasattr(dist, "is_initialized"):
+        return dist.is_initialized()
+    state = getattr(dist, "global_state", None)
+    return bool(state is not None and state.client is not None)

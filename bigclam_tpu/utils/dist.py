@@ -12,6 +12,38 @@ for the GCS/NFS setups multi-host TPU jobs run on).
 from __future__ import annotations
 
 
+def request_cpu_devices(n: int) -> None:
+    """Provision `n` virtual CPU devices, portably across jax generations.
+
+    jax >= 0.5 exposes the `jax_num_cpu_devices` config option; 0.4.x only
+    honors `XLA_FLAGS=--xla_force_host_platform_device_count`, which the
+    backend reads at init — so either way this must run before the first
+    backend use (jax.devices() etc.). Callers that need a hard guarantee
+    should check len(jax.devices()) afterwards; once a backend is up,
+    neither mechanism can resize it.
+    """
+    import os
+
+    import jax
+
+    try:
+        jax.config.update("jax_num_cpu_devices", n)
+    except AttributeError:           # jax < 0.5: env-flag fallback
+        import re
+
+        flags = os.environ.get("XLA_FLAGS", "")
+        flag = f"--xla_force_host_platform_device_count={n}"
+        if "xla_force_host_platform_device_count" in flags:
+            # REPLACE an inherited count (a pytest parent exports 8; a
+            # spawned two-process worker must drop to its own 2)
+            flags = re.sub(
+                r"--xla_force_host_platform_device_count=\d+", flag, flags
+            )
+        else:
+            flags = (flags + " " + flag).strip()
+        os.environ["XLA_FLAGS"] = flags
+
+
 def is_primary() -> bool:
     """True on the process that owns shared-filesystem writes (process 0).
 

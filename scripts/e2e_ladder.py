@@ -89,10 +89,17 @@ def main() -> int:
     llh0 = float(state.llh)
 
     t0 = time.time()
+    llh_traj = []
     for _ in range(iters):
         state = model._step(state)
+        # state.llh is the LLH of the step's INPUT F. Append the UNFORCED
+        # device scalar — a float() here would sync every iteration and
+        # distort the timed loop; the conversion happens after the single
+        # block_until_ready the measurement already pays
+        llh_traj.append(state.llh)
     jax.block_until_ready(state.F)
     dt = time.time() - t0
+    llh_traj = [float(v) for v in llh_traj]
     sec["fit_iters"] = round(dt, 1)
     eps = e * iters / dt
 
@@ -102,6 +109,18 @@ def main() -> int:
     )
     sec["extraction"] = round(time.time() - t0, 1)
 
+    # health criterion: the simultaneous Jacobi update (reference
+    # semantics) carries NO per-iteration global-LLH guarantee — each
+    # node's Armijo acceptance is against the OTHERS' old rows, and the
+    # combined move can overshoot for an iteration before recovering
+    # (observed at N=200K: a one-iteration dip at iter 3, then recovery
+    # well above the start — the r06 CPU smoke caught the old strict
+    # last>=first gate sampling exactly that dip). The gate therefore
+    # asks what the optimizer does guarantee on a healthy pipeline: the
+    # best LLH seen over the run improves on the initial one, and every
+    # value is finite.
+    llh_best = max(llh_traj) if llh_traj else llh0
+    finite = all(np.isfinite(v) for v in llh_traj + [llh0])
     rec = {
         "bench": "e2e-ladder",
         "config": f"synthetic N={n} 2E={e} K={k} iters={iters}",
@@ -112,11 +131,16 @@ def main() -> int:
         "fit_edges_per_sec": round(eps, 1),
         "llh_first": llh0,
         "llh_last": float(state.llh),
-        "llh_monotone": float(state.llh) >= llh0,
+        "llh_trajectory": llh_traj,
+        "llh_best": llh_best,
+        "llh_monotone": bool(
+            all(b >= a for a, b in zip([llh0] + llh_traj, llh_traj))
+        ),
         "num_communities_extracted": len(comms),
         "pass": bool(
             (not on_tpu or model.engaged_path == "csr_grouped_kb")
-            and float(state.llh) >= llh0
+            and finite
+            and llh_best > llh0
         ),
     }
     line = json.dumps(rec)

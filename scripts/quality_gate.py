@@ -1,14 +1,29 @@
-"""Quality-mode recovery gate (VERDICT round-3 item 1).
+"""Quality-mode recovery gate (VERDICT round-3 item 1; criterion
+re-grounded round 6 per VERDICT r5 Next #4).
 
-Plants an equal-block AGM at the requested scale (default N=60000, K=300 —
-the PARITY.md regime where faithful semantics land at F1 ~ 0.1), runs the
-faithful fit AND the quality-mode schedule from the same conductance-seeded
-init on the default backend (TPU when available; blocked-CSR kernels
-engage), and prints one JSON line with both F1 scores.
+Plants an equal-block AGM at the requested scale, runs the faithful fit
+AND the quality-mode schedule from the same conductance-seeded init on the
+default backend (TPU when available; blocked-CSR kernels engage), and
+prints one JSON line with both scores plus the quality stage's PER-STAGE
+wall-clock and transfer counts (QualityResult.stages — the round-6
+device-resident pipeline's instrumentation).
 
     python scripts/quality_gate.py [N] [K] [out.json] [p_in]
 
-Gate: quality F1 >= 0.8 (exit 1 otherwise).
+The quality schedule runs DEVICE-RESIDENT (fit_quality_device: on-device
+kicks, batched label-propagation components, scatter-edit repairs, <= 1 F
+download per repair round); set QUALITY_GATE_HOST=1 for the host loop.
+
+Gate criterion (round 6 — gate and adjudication must agree in the
+artifact, VERDICT r5 weak #2):
+
+  * p_in >= 0.5 (identifiable regime): quality F1 >= 0.8 — unchanged.
+  * p_in < 0.5 (sub-identifiability): final quality LLH within 2% of the
+    PLANTED-ANCHOR LLH — the planted F refit under faithful semantics
+    (MIDSCALE_ANCHOR_r05.json proved the optimum band is F1-degenerate
+    there: the anchor refits to itself at -156.59K while distinct
+    re-tilings of the same band score F1 anywhere from 0.74 to 1.0, so
+    LLH is what the optimizer can be held to). F1 is still reported.
 
 Note on single-chip sizing: the train step holds three (N_pad, K_pad) f32
 arrays at peak (F, grad, F_new), so N*K is bounded by ~HBM/12B on one
@@ -24,6 +39,8 @@ import time
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import numpy as np
+
+LLH_BAND_TOL = 0.02     # quality LLH may sit this far below the anchor
 
 
 def main() -> int:
@@ -43,9 +60,14 @@ def main() -> int:
     from bigclam_tpu.evaluation import avg_f1
     from bigclam_tpu.models import BigClamModel
     from bigclam_tpu.models.agm import sample_planted_graph
-    from bigclam_tpu.models.quality import auto_quality_max_p, fit_quality
+    from bigclam_tpu.models.quality import (
+        auto_quality_max_p,
+        fit_quality,
+        fit_quality_device,
+    )
     from bigclam_tpu.ops import extraction, seeding
 
+    host_loop = os.environ.get("QUALITY_GATE_HOST") == "1"
     rng = np.random.default_rng(7)
     g, truth = sample_planted_graph(n, k, p_in=p_in, rng=rng)
     cfg = BigClamConfig(num_communities=k, quality_mode=True)
@@ -73,22 +95,55 @@ def main() -> int:
     res_f = model.fit(F0, callback=cb)
     t_faithful = time.time() - t0
     f1_f = score(res_f.F)
-    progress(f"faithful done in {t_faithful:.0f}s; quality annealing")
+    progress(f"faithful done in {t_faithful:.0f}s; quality annealing "
+             f"({'host' if host_loop else 'device'} loop)")
 
     t0 = time.time()
-    qres = fit_quality(model, F0, callback=cb)
+    if host_loop:
+        qres = fit_quality(model, F0, callback=cb)
+    else:
+        qres = fit_quality_device(model, F0, callback=cb)
     t_quality = time.time() - t0
     f1_q = score(qres.fit.F)
+
+    # planted anchor (sub-identifiability criterion): the planted F refit
+    # under FAITHFUL semantics — the LLH band the optimizer is held to
+    llh_anchor = None
+    llh_band = None
+    if p_in < 0.5:
+        progress("quality done; fitting planted anchor")
+        s = float(np.sqrt(-np.log1p(-p_in)))
+        F_planted = np.zeros((g.num_nodes, k), np.float64)
+        for c, members in enumerate(truth):
+            F_planted[members, c] = s
+        res_anchor = model.fit(F_planted)
+        llh_anchor = float(res_anchor.llh)
+        llh_band = (qres.fit.llh - llh_anchor) / abs(llh_anchor)
+        passed = bool(llh_band >= -LLH_BAND_TOL)
+        criterion = (
+            f"llh within {LLH_BAND_TOL:.0%} of planted anchor "
+            "(sub-identifiability regime: the optimum band is "
+            "F1-degenerate — MIDSCALE_ANCHOR_r05)"
+        )
+    else:
+        passed = bool(f1_q >= 0.8)
+        criterion = "quality F1 >= 0.8 (identifiable regime)"
 
     avg_deg = g.num_directed_edges / max(n, 1)
     rec = {
         "gate": "planted-recovery",
         "config": f"planted AGM N={n} K={k} p_in={p_in} "
                   f"2E={g.num_directed_edges}",
+        "criterion": criterion,
         "f1_faithful": round(f1_f, 4),
         "llh_faithful": res_f.llh,
         "f1_quality": round(f1_q, 4),
         "llh_quality": qres.fit.llh,
+        "llh_planted_anchor": llh_anchor,
+        "llh_band_vs_anchor": (
+            round(llh_band, 5) if llh_band is not None else None
+        ),
+        "quality_loop": "host" if host_loop else "device",
         "quality_cycles": qres.num_cycles,
         "quality_total_iters": qres.total_iters,
         "discrete_moves_accepted": qres.num_repairs,
@@ -97,16 +152,20 @@ def main() -> int:
             "faithful": round(t_faithful, 1),
             "quality": round(t_quality, 1),
         },
+        # round-6 instrumentation: per-stage wall-clock + transfer counts
+        # (anneal / repair_detect / repair_polish / atomize_components /
+        # atomize_refit / fetches — utils.profiling.StageProfile)
+        "quality_stages": qres.stages,
         "engaged_path": model.engaged_path,
         "path_reason": model.path_reason,
         "num_seeds": int(len(seeds)),
-        # the relaxed clip fit_quality ran with (shared rule — see
+        # the relaxed clip the quality run used (shared rule — see
         # models.quality.auto_quality_max_p)
         "quality_max_p_auto": auto_quality_max_p(
             n, avg_deg, floor=cfg.max_p
         ),
         "device": str(jax.devices()[0]),
-        "pass": bool(f1_q >= 0.8),
+        "pass": passed,
     }
     line = json.dumps(rec)
     print(line)

@@ -113,9 +113,11 @@ def _build(cls, cfg, per_shard, dp, k, mesh, seed, uniform=False):
 def run(out_path=None) -> dict:
     import jax
 
+    from bigclam_tpu.utils.dist import request_cpu_devices
+
     try:
         jax.config.update("jax_platforms", "cpu")
-        jax.config.update("jax_num_cpu_devices", 8)
+        request_cpu_devices(8)
     except RuntimeError:
         pass
     if len(jax.devices()) < 8:

@@ -64,7 +64,9 @@ def main() -> None:
     # backend use are the reliable override (see tests/conftest.py)
     jax.config.update("jax_platforms", "cpu")
     jax.config.update("jax_enable_x64", True)
-    jax.config.update("jax_num_cpu_devices", 2)
+    from bigclam_tpu.utils.dist import request_cpu_devices
+
+    request_cpu_devices(2)
     os.environ["JAX_COORDINATOR_ADDRESS"] = f"127.0.0.1:{port}"
     os.environ["JAX_NUM_PROCESSES"] = "2"
     os.environ["JAX_PROCESS_ID"] = pid

@@ -5,7 +5,6 @@ import numpy as np
 import pytest
 
 from bigclam_tpu.graph.ingest import build_graph, graph_from_edges, load_edge_list
-from tests.conftest import REFERENCE_DATA
 
 
 def test_triangle_csr(toy_graphs):
@@ -54,7 +53,9 @@ def test_facebook_golden(facebook_graph):
 
 @pytest.mark.slow
 def test_enron_golden():
-    g = build_graph(f"{REFERENCE_DATA}/Email-Enron.txt")
+    from tests.conftest import require_reference_data
+
+    g = build_graph(require_reference_data("Email-Enron.txt"))
     # header: Nodes: 36692 Edges: 367662 (file lists both directions;
     # dedup halves it to 183,831 undirected edges)
     assert g.num_nodes == 36692

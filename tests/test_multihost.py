@@ -150,6 +150,16 @@ def _worker_module():
     return mod
 
 
+# the true two-process tests need cross-process CPU collectives, which
+# jaxlib grew after the 0.4 line ("Multiprocess computations aren't
+# implemented on the CPU backend" there) — skip, don't fail, on old jax
+_needs_multiproc_cpu = pytest.mark.skipif(
+    jax.__version_info__ < (0, 5, 0),
+    reason="jaxlib 0.4.x CPU backend lacks multiprocess computations",
+)
+
+
+@_needs_multiproc_cpu
 def test_true_two_process_fit(tmp_path):
     """Spawn TWO real processes (coordinator on 127.0.0.1) running the same
     sharded fit over a 4-device mesh (2 CPU devices per process): exercises
@@ -171,6 +181,7 @@ def test_true_two_process_fit(tmp_path):
     )
 
 
+@_needs_multiproc_cpu
 def test_true_two_process_checkpoint_single_writer_resume(tmp_path):
     """Kill-and-resume THROUGH a checkpoint with process_count() == 2 and
     exactly one writer (VERDICT round-3 item 3): round 1 writes checkpoints
@@ -225,6 +236,7 @@ def test_sharded_trainer_still_exact_after_put_sharded(toy_graphs):
     assert np.isclose(res_s.llh, res_1.llh, rtol=1e-12)
 
 
+@_needs_multiproc_cpu
 def test_true_two_process_quality_device(tmp_path):
     """Device-resident quality annealing across TWO real processes: the
     jitted kick + state-resident loop + single final fetch_global must

@@ -71,7 +71,9 @@ def test_enron_known_triangle_count():
     sum_u tri(u) counts each triangle three times."""
     from bigclam_tpu.graph.ingest import build_graph
 
-    g = build_graph("/root/reference/data/Email-Enron.txt")
+    from tests.conftest import require_reference_data
+
+    g = build_graph(require_reference_data("Email-Enron.txt"))
     assert int(native.triangle_counts(g).sum()) == 3 * 727044
 
 

@@ -321,13 +321,13 @@ def test_export_gexf(tmp_path, toy_graphs):
 
 
 def test_cli_csr_and_cap_flags(tmp_path):
-    from conftest import REFERENCE_DATA
+    from conftest import require_reference_data
 
     out = tmp_path / "c.txt"
     gexf = tmp_path / "g.gexf"
     r = _run_cli(
         "fit",
-        "--graph", f"{REFERENCE_DATA}/facebook_combined.txt",
+        "--graph", require_reference_data("facebook_combined.txt"),
         "--k", "8", "--max-iters", "3", "--platform", "cpu",
         "--csr-kernels", "off", "--seeding-degree-cap", "32",
         "--out", str(out), "--export-gexf", str(gexf), "--quiet",
