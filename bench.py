@@ -36,12 +36,18 @@ rotation schedule plus the comm-hidden fraction
 """
 
 import json
+import os
 import statistics
 import time
 
 import numpy as np
 
-ENRON = "/root/reference/data/Email-Enron.txt"
+# headline graph: Email-Enron text by default; point BIGCLAM_BENCH_GRAPH at
+# a graph-cache dir (cli ingest) to time the cached-reload data path — the
+# record tags which one fed the run ("graph_source": "text" | "cache")
+ENRON = os.environ.get(
+    "BIGCLAM_BENCH_GRAPH", "/root/reference/data/Email-Enron.txt"
+)
 K_ENRON = 100
 LARGE_N, LARGE_K, LARGE_P_IN = 300_000, 1000, 0.1
 # K-blocked single-chip regime: K large enough that whole-K rows are
@@ -266,7 +272,12 @@ def main() -> None:
     xla_windows = 2 if cpu_fallback else 3
 
     # --- Email-Enron K=100 (headline config), CSR vs XLA ---
+    from bigclam_tpu.graph.store import is_cache_dir
+
+    graph_source = "cache" if is_cache_dir(ENRON) else "text"
+    t_load0 = time.perf_counter()
     g = build_graph(ENRON)
+    graph_load_s = round(time.perf_counter() - t_load0, 3)
     cfg = BigClamConfig(num_communities=K_ENRON)
     rng = np.random.default_rng(0)
     F0 = rng.integers(0, 2, size=(g.num_nodes, K_ENRON)).astype(np.float64)
@@ -292,6 +303,8 @@ def main() -> None:
     configs["enron"] = {
         "config": f"Email-Enron N={g.num_nodes} 2E={g.num_directed_edges} "
                   f"K={K_ENRON}",
+        "graph_source": graph_source,
+        "graph_load_s": graph_load_s,
         "csr": {"eps": enron_eps, "path": model.engaged_path,
                 "windows": enron_windows},
         "xla": {"eps": enron_xla_eps, "path": xla_model.engaged_path,
@@ -464,6 +477,7 @@ def _emit(jax, spec, g, cfg, F0, backend, model, configs, enron_eps,
                 "path": model.engaged_path,
                 "backend": backend,
                 "config": configs["enron"]["config"],
+                "graph_source": configs["enron"].get("graph_source"),
                 "configs": configs,
                 # headline roofline position (VERDICT r5 Next #5): the
                 # denominator for edges/sec/chip — fraction of this

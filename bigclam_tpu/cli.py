@@ -2,9 +2,15 @@
 hard-coded vars in three spark-shell scripts (SURVEY.md §5/C19); here one CLI
 covers fitting, K-sweeps and ground-truth evaluation.
 
+    python -m bigclam_tpu.cli ingest --graph data.txt --cache-dir data.cache
     python -m bigclam_tpu.cli fit   --graph data.txt --k 100 --out cmty.txt
+    python -m bigclam_tpu.cli fit   --graph data.cache --k 100 --out cmty.txt
     python -m bigclam_tpu.cli sweep --graph data.txt --min-com 50 --max-com 200
     python -m bigclam_tpu.cli eval  --pred cmty.txt --truth truth.cmty
+
+`fit`/`sweep` accept either a SNAP text path or a graph-cache directory
+compiled by `ingest` (binary shards, mmap fast reload); passing a text path
+plus --cache-dir compiles the cache on first use and reloads from it after.
 """
 
 from __future__ import annotations
@@ -17,7 +23,15 @@ import numpy as np
 
 
 def _add_common(p: argparse.ArgumentParser) -> None:
-    p.add_argument("--graph", required=True, help="SNAP edge-list path")
+    p.add_argument(
+        "--graph", required=True,
+        help="SNAP edge-list path, or a graph-cache dir from `ingest`",
+    )
+    p.add_argument(
+        "--cache-dir", default=None,
+        help="graph-cache directory: compile the text --graph into it on "
+             "first use (see `ingest`), then reload from the binary shards",
+    )
     p.add_argument("--dtype", default="float32", choices=["float32", "float64"])
     p.add_argument("--max-iters", type=int, default=1000)
     p.add_argument("--conv-tol", type=float, default=1e-4)
@@ -87,9 +101,28 @@ def _add_common(p: argparse.ArgumentParser) -> None:
     )
 
 
+def _load_graph(args):
+    """Graph for fit/sweep: text+--cache-dir compiles once then reloads;
+    everything else (text OR cache dir) goes through build_graph, which
+    dispatches cache directories itself."""
+    from bigclam_tpu.graph import build_graph
+    from bigclam_tpu.graph.store import compile_graph_cache, is_cache_dir
+
+    path = args.graph
+    cache = getattr(args, "cache_dir", None)
+    if cache and not is_cache_dir(path):
+        if not is_cache_dir(cache):
+            print(
+                f"note: compiling graph cache {cache} from {path}",
+                file=sys.stderr,
+            )
+            return compile_graph_cache(path, cache).load_graph()
+        return build_graph(cache)
+    return build_graph(path)
+
+
 def _build(args, k: int):
     from bigclam_tpu.config import BigClamConfig
-    from bigclam_tpu.graph import build_graph
 
     if getattr(args, "quiet", False):
         # one knob: --quiet silences the model-build engagement lines too
@@ -119,7 +152,7 @@ def _build(args, k: int):
         ],
         seeding_degree_cap=args.seeding_degree_cap,
     )
-    g = build_graph(args.graph)
+    g = _load_graph(args)
     return g, cfg
 
 
@@ -324,6 +357,55 @@ def cmd_sweep(args) -> int:
     return 0
 
 
+def cmd_ingest(args) -> int:
+    """Compile a SNAP edge list into a binary shard cache, out of core.
+
+    Deliberately jax-free: ingest runs on data-prep hosts where the only
+    budget that matters is host RAM — the reported peak-RSS delta is the
+    ingest pipeline's own footprint (O(chunk + bucket + N), not O(file))."""
+    from bigclam_tpu.graph.store import compile_graph_cache, is_cache_dir
+    from bigclam_tpu.utils.profiling import IngestProfile
+
+    if is_cache_dir(args.cache_dir) and not args.overwrite:
+        print(
+            f"{args.cache_dir}: already compiled (use --overwrite to "
+            "rebuild)",
+            file=sys.stderr,
+        )
+        return 1
+    if args.balance:
+        # balance pulls in the parallel package (and with it jax); import
+        # it BEFORE the profile's RSS baseline so the reported delta
+        # measures the streaming build, not the jax import
+        import bigclam_tpu.parallel.balance  # noqa: F401
+
+    prof = IngestProfile()
+    store = compile_graph_cache(
+        args.graph,
+        args.cache_dir,
+        num_shards=args.shards,
+        chunk_bytes=args.chunk_bytes,
+        workers=args.workers,
+        balance=args.balance,
+        overwrite=args.overwrite,
+        profile=prof,
+    )
+    print(
+        json.dumps(
+            {
+                "cache_dir": args.cache_dir,
+                "n": store.num_nodes,
+                "edges": store.num_directed_edges // 2,
+                "shards": store.num_shards,
+                "balanced": store.balanced,
+                "chunk_bytes": args.chunk_bytes,
+                **prof.report(),
+            }
+        )
+    )
+    return 0
+
+
 def cmd_eval(args) -> int:
     from bigclam_tpu.evaluation import avg_f1, overlapping_nmi
     from bigclam_tpu.ops.extraction import load_communities
@@ -401,6 +483,34 @@ def main(argv=None) -> int:
              "(fit_quality_device; no per-cycle host F round trips)",
     )
     p_sweep.set_defaults(fn=cmd_sweep)
+
+    p_ing = sub.add_parser(
+        "ingest",
+        help="compile a SNAP edge list into a binary graph-shard cache "
+             "(streaming, memory-bounded; reports edges/sec + peak RSS)",
+    )
+    p_ing.add_argument("--graph", required=True, help="SNAP edge-list path")
+    p_ing.add_argument("--cache-dir", required=True)
+    p_ing.add_argument(
+        "--shards", type=int, default=8,
+        help="node-range shards (match the target mesh's node-shard count "
+             "for per-host loading)",
+    )
+    p_ing.add_argument(
+        "--chunk-bytes", type=int, default=64 << 20,
+        help="streaming parse chunk size — the host-RSS budget knob",
+    )
+    p_ing.add_argument(
+        "--workers", type=int, default=0,
+        help="parallel parse workers (spawn pool; 0 = in-process)",
+    )
+    p_ing.add_argument(
+        "--balance", action="store_true",
+        help="bake the degree-balance permutation (parallel/balance.py) "
+             "into the shards, so multi-host loads are pre-balanced",
+    )
+    p_ing.add_argument("--overwrite", action="store_true")
+    p_ing.set_defaults(fn=cmd_ingest)
 
     p_eval = sub.add_parser("eval", help="score predicted vs ground-truth communities")
     p_eval.add_argument("--pred", required=True)

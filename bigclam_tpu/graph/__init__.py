@@ -1,4 +1,26 @@
 from bigclam_tpu.graph.csr import Graph
-from bigclam_tpu.graph.ingest import load_edge_list, build_graph, graph_from_edges
+from bigclam_tpu.graph.ingest import (
+    build_graph,
+    dedup_directed,
+    graph_from_edges,
+    load_edge_list,
+)
+from bigclam_tpu.graph.store import (
+    GraphStore,
+    compile_graph_cache,
+    is_cache_dir,
+)
+from bigclam_tpu.graph.stream import load_edge_list_streaming, stream_edge_list
 
-__all__ = ["Graph", "load_edge_list", "build_graph", "graph_from_edges"]
+__all__ = [
+    "Graph",
+    "GraphStore",
+    "build_graph",
+    "compile_graph_cache",
+    "dedup_directed",
+    "graph_from_edges",
+    "is_cache_dir",
+    "load_edge_list",
+    "load_edge_list_streaming",
+    "stream_edge_list",
+]

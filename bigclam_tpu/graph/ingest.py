@@ -2,11 +2,18 @@
 
 Replaces the reference's ``GraphLoader.edgeListFile`` + driver-side edge
 collection (C1/C2; Bigclamv2.scala:14-20 — which `collect`ed the whole edge
-list onto the Spark driver, SURVEY.md Q9). Parsing is a vectorized bulk pass
-on host; ``bigclam_tpu.graph.native`` (C++ fast path, used when its shared
-library has been built) takes over when importable; the result is a
-deduplicated symmetric CSR
-ready to be sliced into node-contiguous shards and ``device_put``.
+list onto the Spark driver, SURVEY.md Q9). Parsing streams the file in
+newline-snapped byte-range chunks (graph/stream.py) so transient parse state
+is O(chunk), not O(file); ``bigclam_tpu.graph.native`` (C++ fast path, used
+when its shared library has been built) takes over when importable; the
+result is a deduplicated symmetric CSR ready to be sliced into
+node-contiguous shards and ``device_put``.
+
+``build_graph`` is a thin wrapper over the graph store (graph/store.py): a
+cache directory produced by ``cli ingest`` reloads from binary shards
+(mmap'd, no parse/remap/dedup); a text path takes the in-memory pipeline
+below. Out-of-core builds that never materialize the edge set go through
+``store.compile_graph_cache``.
 
 Format: SNAP edge lists — ``#``-prefixed comment header lines, then one
 whitespace-separated integer pair per line (one edge per line). Self-loops
@@ -19,6 +26,7 @@ from __future__ import annotations
 import numpy as np
 
 from bigclam_tpu.graph.csr import Graph
+from bigclam_tpu.graph.stream import load_edge_list_streaming
 
 
 def load_edge_list(path: str) -> np.ndarray:
@@ -31,21 +39,31 @@ def load_edge_list(path: str) -> np.ndarray:
             return pairs
     except ImportError:
         pass
-    return _numpy_parse(path)
+    return load_edge_list_streaming(path)
 
 
-def _numpy_parse(path: str) -> np.ndarray:
-    with open(path, "rb") as f:
-        data = f.read()
-    # Strip '#' comment lines, then bulk-parse all integers at once.
-    lines = data.split(b"\n")
-    body = b" ".join(ln for ln in lines if ln and not ln.lstrip().startswith(b"#"))
-    flat = np.array(body.split(), dtype=np.int64)
-    if flat.size % 2 != 0:
-        raise ValueError(
-            f"{path}: expected an even number of integers, got {flat.size}"
-        )
-    return flat.reshape(-1, 2)
+def dedup_directed(both: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+    """Sort directed (src, dst) pairs lexicographically and drop duplicate
+    rows; returns (src, dst) int64 in CSR order.
+
+    Replaces the seed's single-int64 packed key (``src * n + dst``), whose
+    comment-only ``n < 2^31`` assumption silently corrupts the dedup past
+    ~2.1B nodes: a row-wise lexsort has no node-count ceiling (the parity
+    test against the packed path lives in tests/test_ingest.py). Shared by
+    the in-memory pipeline below and the store's per-bucket out-of-core
+    dedup (duplicates of an edge always share a src, so bucket-local dedup
+    composes to the global one).
+    """
+    both = np.asarray(both, dtype=np.int64).reshape(-1, 2)
+    if both.shape[0] == 0:
+        return both[:, 0], both[:, 1]
+    order = np.lexsort((both[:, 1], both[:, 0]))
+    both = both[order]
+    keep = np.empty(both.shape[0], dtype=bool)
+    keep[0] = True
+    np.any(both[1:] != both[:-1], axis=1, out=keep[1:])
+    both = both[keep]
+    return both[:, 0].copy(), both[:, 1].copy()
 
 
 def graph_from_edges(pairs: np.ndarray, num_nodes: int | None = None) -> Graph:
@@ -70,21 +88,29 @@ def graph_from_edges(pairs: np.ndarray, num_nodes: int | None = None) -> Graph:
     keep = pairs[:, 0] != pairs[:, 1]
     pairs = pairs[keep]
 
+    if n > np.iinfo(np.int32).max:
+        # the dedup itself has no ceiling now, but Graph stores indices as
+        # int32 — refuse loudly instead of wrapping ids negative
+        raise ValueError(
+            f"num_nodes={n} exceeds the int32 CSR indices bound (2^31-1)"
+        )
+
     # symmetrize: every edge in both directions, then dedup directed pairs
     both = np.concatenate([pairs, pairs[:, ::-1]], axis=0)
-    # dedup via a single int64 key (n < 2^31 assumed for the key packing)
-    key = both[:, 0] * np.int64(n) + both[:, 1]
-    key = np.unique(key)
-    src = (key // n).astype(np.int32)
-    dst = (key % n).astype(np.int32)
+    src, dst = dedup_directed(both)
 
-    # CSR: keys are sorted by (src, dst) already
+    # CSR: dedup_directed returns (src, dst)-sorted pairs
     counts = np.bincount(src, minlength=n)
     indptr = np.zeros(n + 1, dtype=np.int64)
     np.cumsum(counts, out=indptr[1:])
-    return Graph(indptr=indptr, indices=dst, raw_ids=raw_ids)
+    return Graph(indptr=indptr, indices=dst.astype(np.int32), raw_ids=raw_ids)
 
 
 def build_graph(path: str) -> Graph:
-    """Load a SNAP edge-list file into a symmetric CSR Graph."""
+    """Load a graph: a SNAP edge-list file (parse + remap + dedup) or a
+    graph-cache directory compiled by ``cli ingest`` (binary fast reload)."""
+    from bigclam_tpu.graph.store import GraphStore, is_cache_dir
+
+    if is_cache_dir(path):
+        return GraphStore.open(path).load_graph()
     return graph_from_edges(load_edge_list(path))

@@ -1,0 +1,611 @@
+"""Out-of-core sharded graph store: compile once, load per host.
+
+The north-star run (com-Friendster on a v5e-64) cannot afford the seed data
+path — every host parsing ~30 GB of text and materializing the full CSR
+before the first device step. This module is the input-pipeline layer between
+raw SNAP text and the device trainers:
+
+* ``compile_graph_cache`` builds a write-once **binary shard cache** from an
+  edge list without ever holding the edge set in RAM: the streaming scanner
+  (graph/stream.py) spills parsed pairs chunk by chunk, a scatter pass
+  buckets directed edges by owner node range, a per-bucket lexsort dedups
+  (no packed-key node-count ceiling — see graph/ingest.dedup_directed), and
+  the result is written as per-node-range packed CSR shards
+  (``indptr``/``indices`` npy blobs). Peak RSS is O(chunk + bucket + N),
+  never O(E) or O(file). With ``balance=True`` the degree-balance
+  permutation (parallel/balance.py) is baked into the shards at compile
+  time, so a multi-host job loads already-balanced node ranges.
+* a versioned JSON **manifest** records the format version, N/E, the shard
+  table (node ranges + per-shard directed-edge counts) and a crc32 per blob;
+  loads verify the version and checksums, so a stale or corrupted cache is
+  rejected instead of silently mis-training.
+* ``GraphStore.load_shard`` / ``load_shard_range`` give **per-host loading**:
+  a host reads exactly the shard files for the node-contiguous ranges its
+  devices own (wired through parallel/multihost.load_host_shard and the
+  store-backed trainer in parallel/sharded.py) — no host ever assembles the
+  global CSR. ``load_graph`` assembles the full ``Graph`` (bit-identical to
+  ``build_graph`` on the same text for unbalanced caches) for single-host
+  runs and as the mmap-backed fast reload behind ``cli --cache-dir``.
+
+Cache directory layout::
+
+    manifest.json
+    raw_ids.npy                  original node id of each compact id
+    perm.npy                     (balanced caches) old id -> new id
+    shard_00000.indptr.npy       per-shard local CSR row pointers (rebased)
+    shard_00000.indices.npy      per-shard neighbor lists (global int32 ids)
+    ...
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import shutil
+import zlib
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from bigclam_tpu.graph.csr import Graph
+from bigclam_tpu.graph.ingest import dedup_directed
+from bigclam_tpu.graph.stream import DEFAULT_CHUNK_BYTES, stream_edge_list
+
+MANIFEST_VERSION = 1
+MANIFEST_NAME = "manifest.json"
+
+
+def is_cache_dir(path: str) -> bool:
+    """True when `path` is a graph-cache directory (has a manifest)."""
+    return os.path.isdir(path) and os.path.exists(
+        os.path.join(path, MANIFEST_NAME)
+    )
+
+
+def _crc32_file(path: str, bufsize: int = 1 << 22) -> int:
+    crc = 0
+    with open(path, "rb") as f:
+        while True:
+            buf = f.read(bufsize)
+            if not buf:
+                return crc
+            crc = zlib.crc32(buf, crc)
+
+
+def _shard_files(s: int) -> Tuple[str, str]:
+    return f"shard_{s:05d}.indptr.npy", f"shard_{s:05d}.indices.npy"
+
+
+@dataclasses.dataclass(frozen=True)
+class HostShard:
+    """The node-contiguous slice of a cached graph one host loads.
+
+    ``indptr`` is rebased to 0 at ``lo`` (length hi - lo + 1); ``indices``
+    keep GLOBAL destination ids, so device code slices F rows without any
+    further translation. ``shard_edge_counts`` covers ALL shards (from the
+    manifest), letting every host agree on padded edge-block geometry
+    without touching another host's files — ``files_read`` records exactly
+    which blobs were opened, so tests can pin the isolation contract.
+    """
+
+    lo: int
+    hi: int
+    indptr: np.ndarray
+    indices: np.ndarray
+    num_nodes: int
+    num_directed_edges: int
+    rows_per_shard: int
+    shard_ids: Tuple[int, ...]
+    shard_edge_counts: Tuple[int, ...]
+    files_read: Tuple[str, ...]
+
+    @property
+    def num_local_nodes(self) -> int:
+        return self.hi - self.lo
+
+
+class GraphStore:
+    """Handle on a compiled cache directory (validated manifest)."""
+
+    def __init__(self, directory: str, manifest: dict):
+        self.directory = directory
+        self.manifest = manifest
+
+    @classmethod
+    def open(cls, directory: str) -> "GraphStore":
+        mpath = os.path.join(directory, MANIFEST_NAME)
+        try:
+            with open(mpath) as f:
+                manifest = json.load(f)
+        except (OSError, ValueError) as e:
+            raise ValueError(f"{directory}: not a graph cache ({e})") from e
+        version = manifest.get("format_version")
+        if version != MANIFEST_VERSION:
+            raise ValueError(
+                f"{directory}: cache format version {version!r} != "
+                f"{MANIFEST_VERSION} (stale cache; re-run "
+                "`python -m bigclam_tpu.cli ingest`)"
+            )
+        for key in ("num_nodes", "num_directed_edges", "num_shards",
+                    "rows_per_shard", "shards", "files"):
+            if key not in manifest:
+                raise ValueError(f"{directory}: manifest missing {key!r}")
+        if len(manifest["shards"]) != manifest["num_shards"]:
+            raise ValueError(
+                f"{directory}: shard table has {len(manifest['shards'])} "
+                f"entries for num_shards={manifest['num_shards']}"
+            )
+        return cls(directory, manifest)
+
+    # --- manifest accessors ---
+    @property
+    def num_nodes(self) -> int:
+        return int(self.manifest["num_nodes"])
+
+    @property
+    def num_directed_edges(self) -> int:
+        return int(self.manifest["num_directed_edges"])
+
+    @property
+    def num_shards(self) -> int:
+        return int(self.manifest["num_shards"])
+
+    @property
+    def rows_per_shard(self) -> int:
+        return int(self.manifest["rows_per_shard"])
+
+    @property
+    def balanced(self) -> bool:
+        return bool(self.manifest.get("balanced", False))
+
+    def shard_files(self, s: int) -> Tuple[str, str]:
+        """Absolute (indptr, indices) blob paths of shard s."""
+        entry = self.manifest["shards"][s]
+        return (
+            os.path.join(self.directory, entry["indptr"]),
+            os.path.join(self.directory, entry["indices"]),
+        )
+
+    def node_range(self, s: int) -> Tuple[int, int]:
+        entry = self.manifest["shards"][s]
+        return int(entry["lo"]), int(entry["hi"])
+
+    # --- loading ---
+    def _load_blob(
+        self,
+        relname: str,
+        crc: Optional[int],
+        verify: bool,
+        mmap: bool,
+        files_read: List[str],
+    ) -> np.ndarray:
+        path = os.path.join(self.directory, relname)
+        if verify:
+            got = _crc32_file(path)
+            if got != crc:
+                raise ValueError(
+                    f"{path}: checksum mismatch (expected {crc}, got {got}) "
+                    "— cache corrupted; re-run ingest"
+                )
+        files_read.append(relname)
+        return np.load(path, mmap_mode="r" if mmap else None)
+
+    def load_shard_range(
+        self,
+        first_shard: int,
+        last_shard: int,
+        verify: bool = True,
+        mmap: bool = False,
+    ) -> HostShard:
+        """Assemble shards [first_shard, last_shard) into one contiguous
+        HostShard, reading ONLY those shards' blobs."""
+        S = self.num_shards
+        if not (0 <= first_shard < last_shard <= S):
+            raise ValueError(
+                f"shard range [{first_shard}, {last_shard}) outside [0, {S})"
+            )
+        files_read: List[str] = []
+        entries = self.manifest["shards"][first_shard:last_shard]
+        iparts, dparts = [], []
+        for entry in entries:
+            iparts.append(
+                self._load_blob(
+                    entry["indptr"], entry["crc32"]["indptr"], verify,
+                    mmap, files_read,
+                ).astype(np.int64, copy=False)
+            )
+            dparts.append(
+                self._load_blob(
+                    entry["indices"], entry["crc32"]["indices"], verify,
+                    mmap, files_read,
+                )
+            )
+        lo = int(entries[0]["lo"])
+        hi = int(entries[-1]["hi"])
+        indptr = np.zeros(hi - lo + 1, dtype=np.int64)
+        offset = 0
+        row = 0
+        for part in iparts:
+            rows = part.shape[0] - 1
+            indptr[row : row + rows + 1] = part + offset
+            offset = int(indptr[row + rows])
+            row += rows
+        indices = (
+            np.concatenate(dparts)
+            if len(dparts) > 1
+            else np.asarray(dparts[0])
+        ).astype(np.int32, copy=False)
+        if indptr[-1] != indices.shape[0]:
+            raise ValueError(
+                f"{self.directory}: shard range [{first_shard}, "
+                f"{last_shard}) indptr/indices length mismatch "
+                f"({int(indptr[-1])} vs {indices.shape[0]})"
+            )
+        return HostShard(
+            lo=lo,
+            hi=hi,
+            indptr=indptr,
+            indices=indices,
+            num_nodes=self.num_nodes,
+            num_directed_edges=self.num_directed_edges,
+            rows_per_shard=self.rows_per_shard,
+            shard_ids=tuple(range(first_shard, last_shard)),
+            shard_edge_counts=tuple(
+                int(e["edges"]) for e in self.manifest["shards"]
+            ),
+            files_read=tuple(files_read),
+        )
+
+    def load_shard(
+        self, host_id: int, num_hosts: int, verify: bool = True
+    ) -> HostShard:
+        """The node-contiguous shard slice host `host_id` of `num_hosts`
+        owns (requires num_shards % num_hosts == 0 — compile the cache with
+        one shard per node-shard of the target mesh)."""
+        S = self.num_shards
+        if num_hosts <= 0 or S % num_hosts != 0:
+            raise ValueError(
+                f"num_shards={S} not divisible by num_hosts={num_hosts}"
+            )
+        if not (0 <= host_id < num_hosts):
+            raise ValueError(f"host_id={host_id} outside [0, {num_hosts})")
+        per = S // num_hosts
+        return self.load_shard_range(
+            host_id * per, (host_id + 1) * per, verify=verify
+        )
+
+    def load_raw_ids(self, verify: bool = True) -> np.ndarray:
+        entry = self.manifest["files"]["raw_ids"]
+        return np.asarray(
+            self._load_blob(entry["name"], entry["crc32"], verify, False, [])
+        )
+
+    def load_perm(self, verify: bool = True) -> Optional[np.ndarray]:
+        """The baked-in balance permutation (old id -> new id), or None for
+        unbalanced caches."""
+        entry = self.manifest["files"].get("perm")
+        if entry is None:
+            return None
+        return np.asarray(
+            self._load_blob(entry["name"], entry["crc32"], verify, False, [])
+        )
+
+    def load_graph(self, verify: bool = True, mmap: bool = True) -> Graph:
+        """Assemble the full Graph from every shard (the fast single-host
+        reload path: binary blobs, optionally mmap-read — no text parse,
+        no remap, no dedup)."""
+        hs = self.load_shard_range(0, self.num_shards, verify=verify,
+                                   mmap=mmap)
+        return Graph(
+            indptr=hs.indptr,
+            indices=np.ascontiguousarray(hs.indices),
+            raw_ids=self.load_raw_ids(verify=verify),
+        )
+
+
+# --------------------------------------------------------------------------
+# compile: text -> cache, out of core
+# --------------------------------------------------------------------------
+
+
+class _BucketWriter:
+    """Append-only int64 pair spill files, one per node-range bucket."""
+
+    def __init__(self, directory: str, num_buckets: int, tag: str):
+        os.makedirs(directory, exist_ok=True)
+        self.paths = [
+            os.path.join(directory, f"{tag}_{b:05d}.bin")
+            for b in range(num_buckets)
+        ]
+        self._handles = [open(p, "ab") for p in self.paths]
+
+    def append(self, bucket: int, pairs: np.ndarray) -> None:
+        if pairs.size:
+            self._handles[bucket].write(
+                np.ascontiguousarray(pairs, dtype=np.int64).tobytes()
+            )
+
+    def close(self) -> None:
+        for h in self._handles:
+            h.close()
+
+    def read(self, bucket: int) -> np.ndarray:
+        return np.fromfile(self.paths[bucket], dtype=np.int64).reshape(-1, 2)
+
+
+def _scatter_by_bucket(
+    pairs: np.ndarray, rows: int, writer: _BucketWriter
+) -> None:
+    """Append each directed pair to the bucket owning its source node."""
+    if pairs.shape[0] == 0:
+        return
+    bidx = pairs[:, 0] // rows
+    order = np.argsort(bidx, kind="stable")
+    pairs = pairs[order]
+    bidx = bidx[order]
+    bounds = np.flatnonzero(np.diff(bidx)) + 1
+    starts = np.concatenate([[0], bounds])
+    ends = np.concatenate([bounds, [pairs.shape[0]]])
+    for s, e in zip(starts, ends):
+        writer.append(int(bidx[s]), pairs[s:e])
+
+
+def _merge_sorted_unique(table: np.ndarray, chunk: np.ndarray) -> np.ndarray:
+    """Fold a chunk's ids into the sorted unique id table WITHOUT re-sorting
+    the table (np.union1d re-sorts all N ids per chunk — O(chunks * N log N)
+    across a Friendster-scale scan): unique the chunk, drop ids already in
+    the table via searchsorted, merge-insert the rest. O(N + m) per chunk.
+    """
+    ids = np.unique(chunk)
+    if table.size == 0:
+        return ids
+    if ids.size == 0:
+        return table
+    pos = np.searchsorted(table, ids)
+    known = table[np.minimum(pos, table.size - 1)] == ids
+    fresh = ids[~known]
+    if fresh.size == 0:
+        return table
+    return np.insert(table, np.searchsorted(table, fresh), fresh)
+
+
+def _atomic_json(path: str, obj: dict) -> None:
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(obj, f, indent=2, sort_keys=True)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+
+def compile_graph_cache(
+    text_path: str,
+    cache_dir: str,
+    num_shards: int = 8,
+    chunk_bytes: int = DEFAULT_CHUNK_BYTES,
+    workers: int = 0,
+    balance: bool = False,
+    overwrite: bool = False,
+    profile=None,
+) -> GraphStore:
+    """Compile a SNAP edge list into a binary shard cache, out of core.
+
+    Stages (each a `profile` stage when an IngestProfile is passed):
+      scan     stream newline-snapped chunks, spill parsed raw pairs to
+               disk, merge the sorted unique raw-id table (O(chunk + N) RSS)
+      scatter  remap raw ids -> compact [0, N), drop self-loops, symmetrize,
+               bucket directed pairs by owner node range
+      dedup    per-bucket lexsort + duplicate-row drop (duplicates of an
+               edge always land in the same bucket, so local dedup is
+               globally exact); exact deduped degrees fall out here
+      shards   (balance=True: relabel through the balance permutation and
+               re-scatter first) write per-shard packed CSR blobs + the
+               versioned manifest with per-blob crc32s
+
+    Shard s owns node rows [s*rows, (s+1)*rows) with
+    rows = ceil(max(N, num_shards) / num_shards) — exactly the contiguous
+    ranges the sharded trainers slice on a dp=num_shards mesh, so a baked
+    balance permutation (balance_permutation(degrees, num_shards, rows *
+    num_shards)) is the same relabeling ShardedBigClamModel(balance=True)
+    would compute at model build.
+    """
+    if num_shards <= 0:
+        raise ValueError(f"num_shards must be positive, got {num_shards}")
+    manifest_path = os.path.join(cache_dir, MANIFEST_NAME)
+    if os.path.exists(manifest_path):
+        if not overwrite:
+            raise FileExistsError(
+                f"{cache_dir}: cache already compiled (pass overwrite=True "
+                "/ --overwrite to rebuild)"
+            )
+        # drop the OLD manifest (and its blobs) before rebuilding: a crash
+        # mid-rebuild must leave an unrecognizable directory, never an
+        # old manifest validating over mixed old/new shard files
+        os.unlink(manifest_path)
+        for name in os.listdir(cache_dir):
+            if name.endswith(".npy") and (
+                name.startswith("shard_") or name in ("raw_ids.npy",
+                                                      "perm.npy")
+            ):
+                os.unlink(os.path.join(cache_dir, name))
+    os.makedirs(cache_dir, exist_ok=True)
+    spill_dir = os.path.join(cache_dir, "_spill")
+    if os.path.exists(spill_dir):
+        shutil.rmtree(spill_dir)
+    os.makedirs(spill_dir)
+
+    if profile is None:
+        from bigclam_tpu.utils.profiling import IngestProfile
+
+        profile = IngestProfile()
+
+    try:
+        return _compile(
+            text_path, cache_dir, spill_dir, manifest_path, num_shards,
+            chunk_bytes, workers, balance, profile,
+        )
+    finally:
+        shutil.rmtree(spill_dir, ignore_errors=True)
+
+
+def _compile(
+    text_path, cache_dir, spill_dir, manifest_path, num_shards,
+    chunk_bytes, workers, balance, profile,
+) -> GraphStore:
+    # --- scan: parse chunks, spill raw pairs, merge unique raw ids ---
+    chunk_paths: List[str] = []
+    raw_ids = np.empty(0, dtype=np.int64)
+    raw_edges = 0
+    with profile.stage("scan"):
+        for i, pairs in enumerate(
+            stream_edge_list(text_path, chunk_bytes, workers)
+        ):
+            cpath = os.path.join(spill_dir, f"chunk_{i:06d}.bin")
+            pairs.tofile(cpath)
+            chunk_paths.append(cpath)
+            raw_edges += pairs.shape[0]
+            raw_ids = _merge_sorted_unique(raw_ids, pairs)
+            profile.count("chunks")
+            profile.count("raw_edges", pairs.shape[0])
+            profile.sample_rss()
+    n = int(raw_ids.shape[0])
+    if n > np.iinfo(np.int32).max:
+        # dedup/remap are ceiling-free, but shard indices are int32 (the
+        # Graph container's dtype): refuse instead of wrapping negative
+        raise ValueError(
+            f"num_nodes={n} exceeds the int32 CSR indices bound (2^31-1)"
+        )
+    rows = -(-max(n, num_shards) // num_shards)    # == trainers' n_pad // dp
+
+    # --- scatter: remap, drop loops, symmetrize, bucket by src range ---
+    buckets = _BucketWriter(spill_dir, num_shards, "bucket")
+    with profile.stage("scatter"):
+        for cpath in chunk_paths:
+            pairs = np.fromfile(cpath, dtype=np.int64).reshape(-1, 2)
+            os.unlink(cpath)
+            pairs = np.searchsorted(raw_ids, pairs)
+            pairs = pairs[pairs[:, 0] != pairs[:, 1]]
+            both = np.concatenate([pairs, pairs[:, ::-1]], axis=0)
+            _scatter_by_bucket(both, rows, buckets)
+            profile.sample_rss()
+    buckets.close()
+
+    # --- dedup: per-bucket lexsort + unique rows; exact degrees ---
+    degrees = np.zeros(max(n, 1), dtype=np.int64)
+    deduped = _BucketWriter(spill_dir, num_shards, "dedup")
+    with profile.stage("dedup"):
+        for b in range(num_shards):
+            both = buckets.read(b)
+            os.unlink(buckets.paths[b])
+            src, dst = dedup_directed(both)
+            lo, hi = min(b * rows, n), min((b + 1) * rows, n)
+            if src.size:
+                degrees[lo:hi] += np.bincount(src - lo, minlength=hi - lo)
+            deduped.append(b, np.stack([src, dst], axis=1))
+            profile.sample_rss()
+    deduped.close()
+
+    # --- balance permutation (baked at compile time) ---
+    perm = None
+    if balance:
+        # lazy: parallel/__init__ pulls in jax, which the default ingest
+        # path must not pay for (RSS + import time on data-prep hosts)
+        from bigclam_tpu.parallel.balance import balance_permutation
+
+        perm = balance_permutation(degrees[:n], num_shards, rows * num_shards)
+
+    # --- shards: (relabel + re-scatter when balanced,) write CSR blobs ---
+    final = deduped
+    if perm is not None:
+        final = _BucketWriter(spill_dir, num_shards, "final")
+        with profile.stage("shards"):
+            for b in range(num_shards):
+                arr = deduped.read(b)
+                os.unlink(deduped.paths[b])
+                _scatter_by_bucket(perm[arr], rows, final)
+                profile.sample_rss()
+        final.close()
+
+    shard_table = []
+    total_directed = 0
+    with profile.stage("shards"):
+        for s in range(num_shards):
+            arr = final.read(s)
+            os.unlink(final.paths[s])
+            lo, hi = min(s * rows, n), min((s + 1) * rows, n)
+            if perm is not None and arr.size:
+                # re-scattered buckets are unsorted; dedup already happened
+                order = np.lexsort((arr[:, 1], arr[:, 0]))
+                arr = arr[order]
+            local_indptr = np.zeros(hi - lo + 1, dtype=np.int64)
+            if arr.size:
+                np.cumsum(
+                    np.bincount(arr[:, 0] - lo, minlength=hi - lo),
+                    out=local_indptr[1:],
+                )
+            indices = arr[:, 1].astype(np.int32)
+            iname, dname = _shard_files(s)
+            np.save(os.path.join(cache_dir, iname), local_indptr)
+            np.save(os.path.join(cache_dir, dname), indices)
+            total_directed += int(indices.shape[0])
+            shard_table.append(
+                {
+                    "lo": lo,
+                    "hi": hi,
+                    "edges": int(indices.shape[0]),
+                    "indptr": iname,
+                    "indices": dname,
+                    "crc32": {
+                        "indptr": _crc32_file(
+                            os.path.join(cache_dir, iname)
+                        ),
+                        "indices": _crc32_file(
+                            os.path.join(cache_dir, dname)
+                        ),
+                    },
+                }
+            )
+            profile.count("directed_edges", int(indices.shape[0]))
+            profile.sample_rss()
+
+        # raw_ids in FINAL node order (balanced caches relabel rows)
+        if perm is not None:
+            raw_final = np.empty_like(raw_ids)
+            raw_final[perm] = raw_ids
+        else:
+            raw_final = raw_ids
+        np.save(os.path.join(cache_dir, "raw_ids.npy"), raw_final)
+        files: Dict[str, dict] = {
+            "raw_ids": {
+                "name": "raw_ids.npy",
+                "crc32": _crc32_file(os.path.join(cache_dir, "raw_ids.npy")),
+            }
+        }
+        if perm is not None:
+            np.save(os.path.join(cache_dir, "perm.npy"), perm)
+            files["perm"] = {
+                "name": "perm.npy",
+                "crc32": _crc32_file(os.path.join(cache_dir, "perm.npy")),
+            }
+
+    manifest = {
+        "format_version": MANIFEST_VERSION,
+        "num_nodes": n,
+        "num_directed_edges": total_directed,
+        "num_undirected_edges": total_directed // 2,
+        "num_shards": num_shards,
+        "rows_per_shard": rows,
+        "balanced": perm is not None,
+        "dtypes": {"indptr": "int64", "indices": "int32",
+                   "raw_ids": "int64"},
+        "shards": shard_table,
+        "files": files,
+        "source": {
+            "path": os.path.abspath(text_path),
+            "bytes": os.path.getsize(text_path),
+            "raw_pairs": raw_edges,
+        },
+    }
+    _atomic_json(manifest_path, manifest)
+    return GraphStore(cache_dir, manifest)

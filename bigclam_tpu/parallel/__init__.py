@@ -1,17 +1,23 @@
 from bigclam_tpu.parallel.mesh import make_mesh
 from bigclam_tpu.parallel.multihost import (
     initialize_distributed,
+    load_host_shard,
     make_multihost_mesh,
     put_sharded,
 )
 from bigclam_tpu.parallel.ring import RingBigClamModel
-from bigclam_tpu.parallel.sharded import ShardedBigClamModel
+from bigclam_tpu.parallel.sharded import (
+    ShardedBigClamModel,
+    StoreShardedBigClamModel,
+)
 
 __all__ = [
     "initialize_distributed",
+    "load_host_shard",
     "make_mesh",
     "make_multihost_mesh",
     "put_sharded",
     "RingBigClamModel",
     "ShardedBigClamModel",
+    "StoreShardedBigClamModel",
 ]

@@ -153,6 +153,65 @@ def put_process_local(host_array: np.ndarray, sharding: NamedSharding):
     )
 
 
+def host_shard_ids(
+    num_shards: int,
+    process_index: Optional[int] = None,
+    process_count: Optional[int] = None,
+) -> range:
+    """Store-shard indices this process owns under the slice-major
+    contiguous layout (host h of H owns shards [h*S/H, (h+1)*S/H))."""
+    pid = jax.process_index() if process_index is None else process_index
+    pc = jax.process_count() if process_count is None else process_count
+    if pc <= 0 or num_shards % pc != 0:
+        raise ValueError(
+            f"num_shards={num_shards} not divisible by "
+            f"process_count={pc}; compile the cache with one shard per "
+            "node-shard of the mesh"
+        )
+    per = num_shards // pc
+    return range(pid * per, (pid + 1) * per)
+
+
+def load_host_shard(
+    store,
+    process_index: Optional[int] = None,
+    process_count: Optional[int] = None,
+    verify: bool = True,
+):
+    """This process's node-contiguous slice of a graph cache
+    (graph/store.GraphStore): reads ONLY the shard files for the ranges
+    this host's devices own — the multi-host ingest analog of
+    put_process_local, and the reason no host ever materializes the global
+    CSR on the store-backed path (parallel/sharded.py)."""
+    ids = host_shard_ids(store.num_shards, process_index, process_count)
+    return store.load_shard_range(ids.start, ids.stop, verify=verify)
+
+
+def put_host_local(
+    local_rows: np.ndarray, sharding: NamedSharding, global_shape
+):
+    """Place a dim-0-sharded global array from ONLY this process's rows.
+
+    Unlike put_process_local (which slices a host-global array), the global
+    array never exists anywhere: the caller hands exactly the rows this
+    process's devices own (e.g. edge blocks built from a per-host graph
+    shard) and the result is assembled as a global jax.Array across
+    processes. Raises when the row count disagrees with the sharding's
+    addressable bounds rather than silently mis-placing.
+    """
+    global_shape = tuple(global_shape)
+    lo, hi = addressable_row_bounds(sharding, global_shape)
+    local_rows = np.ascontiguousarray(local_rows)
+    if local_rows.shape != (hi - lo,) + global_shape[1:]:
+        raise ValueError(
+            f"local rows shape {local_rows.shape} != addressable block "
+            f"{(hi - lo,) + global_shape[1:]} of global {global_shape}"
+        )
+    return jax.make_array_from_process_local_data(
+        sharding, local_rows, global_shape
+    )
+
+
 def put_sharded(host_array: np.ndarray, sharding: NamedSharding):
     """device_put that works under multi-controller: single-process runs use
     plain jax.device_put; multi-process runs hand each process only its own

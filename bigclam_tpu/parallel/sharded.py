@@ -47,7 +47,14 @@ from bigclam_tpu.models.bigclam import (
 )
 from bigclam_tpu.ops.objective import EdgeChunks, edge_terms
 from bigclam_tpu.parallel.mesh import K_AXIS, NODES_AXIS
-from bigclam_tpu.parallel.multihost import fetch_global, put_sharded
+from bigclam_tpu.parallel.multihost import (
+    addressable_row_bounds,
+    fetch_global,
+    host_shard_ids,
+    load_host_shard,
+    put_host_local,
+    put_sharded,
+)
 from bigclam_tpu.utils.compat import shard_map
 
 
@@ -87,6 +94,70 @@ def shard_edges(
         src=src.reshape(dp, c, chunk),
         dst=dst.reshape(dp, c, chunk),
         mask=mask.reshape(dp, c, chunk).astype(dtype),
+    )
+
+
+def shard_edges_local(
+    shard,
+    cfg: BigClamConfig,
+    dp: int,
+    n_pad: int,
+    dtype,
+    chunk_bound: int = 0,
+) -> EdgeChunks:
+    """This host's rows of the (dp, C, chunk) edge blocks, built from a
+    per-host graph-store slice (graph/store.HostShard) — the out-of-core
+    twin of shard_edges: no global CSR exists anywhere.
+
+    The chunk geometry (max per-shard count -> chunk -> C) is computed from
+    the manifest's GLOBAL per-shard edge counts, so every host pads
+    identically without seeing another host's edges. Requires the cache to
+    have been compiled with num_shards == dp: the store's node ranges are
+    then exactly the trainer's shard rows (store rows_per_shard ==
+    n_pad // dp), and this host's store shards map 1:1 onto its trainer
+    shards.
+    """
+    shard_rows = n_pad // dp
+    if shard.rows_per_shard != shard_rows:
+        raise ValueError(
+            f"cache rows_per_shard={shard.rows_per_shard} != trainer shard "
+            f"rows {shard_rows} (n_pad={n_pad}, dp={dp}); recompile the "
+            "cache with num_shards == dp"
+        )
+    counts = np.asarray(shard.shard_edge_counts, dtype=np.int64)
+    max_count = int(counts.max()) if counts.size else 1
+    chunk = min(chunk_bound or cfg.edge_chunk, max(max_count, 1))
+    c = max(1, -(-max_count // chunk))
+    padded = c * chunk
+    n_local = len(shard.shard_ids)
+    src = np.full((n_local, padded), shard_rows - 1, dtype=np.int32)
+    dst = np.zeros((n_local, padded), dtype=np.int32)
+    mask = np.zeros((n_local, padded), dtype=np.float32)
+    n = shard.num_nodes
+    deg = np.diff(shard.indptr)
+    for row, s in enumerate(shard.shard_ids):
+        glo = min(s * shard_rows, n)
+        ghi = min((s + 1) * shard_rows, n)
+        e0 = int(shard.indptr[glo - shard.lo])
+        e1 = int(shard.indptr[ghi - shard.lo])
+        m = e1 - e0
+        if m != counts[s]:
+            raise ValueError(
+                f"shard {s}: manifest says {int(counts[s])} directed edges "
+                f"but the loaded indptr holds {m} — cache inconsistent "
+                "(partially rebuilt, or loaded with verify=False?)"
+            )
+        src[row, :m] = (
+            np.repeat(np.arange(glo, ghi, dtype=np.int64),
+                      deg[glo - shard.lo : ghi - shard.lo])
+            - s * shard_rows
+        )
+        dst[row, :m] = shard.indices[e0:e1]
+        mask[row, :m] = 1.0
+    return EdgeChunks(
+        src=src.reshape(n_local, c, chunk),
+        dst=dst.reshape(n_local, c, chunk),
+        mask=mask.reshape(n_local, c, chunk).astype(dtype),
     )
 
 
@@ -993,3 +1064,95 @@ class ShardedBigClamModel:
         return run_fit_loop(
             self._step, state, self.cfg, callback, None
         )
+
+
+class _StoreGraphView:
+    """Graph-shaped scalar metadata for the store-backed trainer: just the
+    sizes the training loop needs. Global CSR arrays deliberately do not
+    exist — touching .src/.dst/.indptr here is the bug this class exists
+    to turn into a loud error."""
+
+    def __init__(self, store):
+        self.num_nodes = store.num_nodes
+        self.num_directed_edges = store.num_directed_edges
+        self.num_edges = store.num_directed_edges // 2
+
+    def __getattr__(self, name):
+        raise AttributeError(
+            f"store-backed trainer has no global CSR (asked for {name!r}); "
+            "load the full graph with GraphStore.load_graph() if you "
+            "really need it on this host"
+        )
+
+
+class StoreShardedBigClamModel(ShardedBigClamModel):
+    """Sharded trainer fed per-host from a compiled graph cache.
+
+    Each process loads ONLY its own shard blobs
+    (multihost.load_host_shard), builds only its rows of the edge blocks
+    (shard_edges_local), and places them with put_host_local — the global
+    CSR is never materialized on any host, which is the whole point of the
+    store at Friendster scale. The math is byte-identical to
+    ShardedBigClamModel on the same graph (same edge blocks, same step).
+
+    Constraints of this path: the XLA edge schedule only (the blocked-CSR
+    tile builders are host-global — ROADMAP open item), and balance is
+    baked at INGEST time (`cli ingest --balance`), not at model build: the
+    cache's node order IS the trainer's row order, so results come back in
+    cache order (map to original ids via the cache's raw_ids).
+    """
+
+    def __init__(self, store, cfg: BigClamConfig, mesh: Mesh, dtype=None,
+                 verify: bool = True):
+        if cfg.use_pallas_csr:
+            raise ValueError(
+                "use_pallas_csr=True is unsupported on the store-backed "
+                "trainer (CSR tile construction needs the global CSR)"
+            )
+        dp = mesh.shape[NODES_AXIS]
+        if store.num_shards != dp:
+            raise ValueError(
+                f"cache has {store.num_shards} shards but the mesh has "
+                f"dp={dp} node shards; recompile with --shards {dp}"
+            )
+        self.store = store
+        self._shard_verify = verify
+        super().__init__(
+            _StoreGraphView(store), cfg.replace(use_pallas_csr=False),
+            mesh, dtype=dtype, balance=False,
+        )
+
+    def _build_edges_and_step(self) -> None:
+        dp = self.mesh.shape[NODES_AXIS]
+        tp = self.mesh.shape[K_AXIS]
+        espec = NamedSharding(self.mesh, P(NODES_AXIS, None, None))
+        # the process-major shard ownership load_host_shard assumes must
+        # agree with where the mesh actually places this process's rows
+        lo_s, hi_s = addressable_row_bounds(espec, (dp, 1, 1))
+        ids = host_shard_ids(dp)
+        if (ids.start, ids.stop) != (lo_s, hi_s):
+            raise ValueError(
+                f"mesh places this process's node shards at [{lo_s}, "
+                f"{hi_s}) but process-major shard ownership is "
+                f"[{ids.start}, {ids.stop}); use a slice-major mesh "
+                "(make_multihost_mesh)"
+            )
+        self.host_shard = load_host_shard(
+            self.store, verify=self._shard_verify
+        )
+        bound = edge_chunk_bound(
+            self.cfg, max(self.k_pad // tp, 1), self.dtype
+        )
+        local = shard_edges_local(
+            self.host_shard, self.cfg, dp, self.n_pad, np.float32,
+            chunk_bound=bound,
+        )
+        gshape = (dp,) + local.src.shape[1:]
+        self.edges = EdgeChunks(
+            src=put_host_local(local.src, espec, gshape),
+            dst=put_host_local(local.dst, espec, gshape),
+            mask=put_host_local(
+                local.mask.astype(self.dtype), espec, gshape
+            ),
+        )
+        self._step = make_sharded_train_step(self.mesh, self.edges, self.cfg)

@@ -16,10 +16,19 @@ from __future__ import annotations
 
 import json
 import os
+import sys
 import tempfile
+import zipfile
+import zlib
 from typing import Any, Dict, Optional, Tuple
 
 import numpy as np
+
+# np.load on a truncated/corrupted .npz surfaces any of these depending on
+# where the truncation landed (zip directory, member header, deflate stream)
+_CORRUPT_ERRORS = (
+    OSError, ValueError, EOFError, KeyError, zipfile.BadZipFile, zlib.error,
+)
 
 
 class CheckpointManager:
@@ -43,6 +52,12 @@ class CheckpointManager:
         try:
             with os.fdopen(fd, "wb") as f:
                 np.savez(f, **{k: np.asarray(v) for k, v in arrays.items()})
+                # fsync BEFORE the rename: os.replace is atomic in the
+                # namespace but not in the page cache — a preemption between
+                # rename and writeback would leave a fully-named, truncated
+                # checkpoint, exactly what restore must never see
+                f.flush()
+                os.fsync(f.fileno())
             os.replace(tmp, path)
         except BaseException:
             if os.path.exists(tmp):
@@ -52,6 +67,8 @@ class CheckpointManager:
             mp = path + ".json"
             with open(mp + ".tmp", "w") as f:
                 json.dump({"step": step, **meta}, f)
+                f.flush()
+                os.fsync(f.fileno())
             os.replace(mp + ".tmp", mp)
         self._rotate()
         return path
@@ -70,11 +87,27 @@ class CheckpointManager:
     def restore(
         self, step: Optional[int] = None
     ) -> Optional[Tuple[int, Dict[str, np.ndarray], Dict[str, Any]]]:
-        """Load (step, arrays, meta); newest checkpoint when step is None."""
-        if step is None:
-            step = self.latest_step()
-            if step is None:
-                return None
+        """Load (step, arrays, meta); newest READABLE checkpoint when step
+        is None — a corrupted/truncated newest file (e.g. the filesystem
+        lost the writeback after a preemption) falls back to the next-older
+        one with a warning instead of crashing the resume. An explicitly
+        requested step propagates its error."""
+        if step is not None:
+            return self._load(step)
+        for s in reversed(self.steps()):
+            try:
+                return self._load(s)
+            except _CORRUPT_ERRORS as e:
+                print(
+                    f"warning: checkpoint step {s} unreadable "
+                    f"({type(e).__name__}: {e}); trying an older one",
+                    file=sys.stderr,
+                )
+        return None
+
+    def _load(
+        self, step: int
+    ) -> Tuple[int, Dict[str, np.ndarray], Dict[str, Any]]:
         path = self._path(step)
         with np.load(path) as z:
             arrays = {k: z[k] for k in z.files}

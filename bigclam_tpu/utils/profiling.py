@@ -82,6 +82,75 @@ class StageProfile:
         }
 
 
+def current_rss_bytes() -> int:
+    """This process's resident set right now (/proc/self/statm; Linux)."""
+    try:
+        import os
+
+        with open("/proc/self/statm") as f:
+            pages = int(f.read().split()[1])
+        return pages * os.sysconf("SC_PAGE_SIZE")
+    except (OSError, ValueError, IndexError):
+        return peak_rss_bytes()        # no /proc: lifetime peak as fallback
+
+
+def peak_rss_bytes() -> int:
+    """Process-lifetime peak RSS (ru_maxrss; KiB on Linux)."""
+    import resource
+    import sys
+
+    scale = 1024 if sys.platform != "darwin" else 1
+    return resource.getrusage(resource.RUSAGE_SELF).ru_maxrss * scale
+
+
+class IngestProfile(StageProfile):
+    """StageProfile + host-RSS tracking for the streaming ingest pipeline
+    (graph/store.compile_graph_cache; surfaced by `cli ingest` and
+    scripts/ingest_bench.py in INGEST_* artifacts).
+
+    The store's bounded-memory contract — peak RSS O(chunk + bucket + N),
+    never O(file) — is only a contract if it's measured: `sample_rss()` is
+    called at chunk/bucket granularity inside the compile stages, so the
+    reported peak is the steady-state footprint of the out-of-core build
+    sampled where the transients actually live. The report records the
+    baseline taken at construction, the sampled peak, their delta (the
+    ingest's own footprint, independent of whatever the host process had
+    already mapped), and the process-lifetime ru_maxrss for cross-checking.
+    `count("raw_edges", m)` at the parse sites feeds the edges/sec figure.
+
+    Scope: THIS process only. With parse workers (spawn pool), the
+    tokenizer transients live in the children and are not counted here —
+    the bounded-RSS gate (scripts/ingest_bench.py) therefore measures
+    workers=0, where the budget model's per-chunk transient is actually
+    resident in the sampled process.
+    """
+
+    def __init__(self) -> None:
+        super().__init__()
+        self.rss_baseline = current_rss_bytes()
+        self.rss_peak = self.rss_baseline
+
+    def sample_rss(self) -> int:
+        rss = current_rss_bytes()
+        if rss > self.rss_peak:
+            self.rss_peak = rss
+        return rss
+
+    def report(self) -> dict:
+        rep = super().report()
+        rep["rss"] = {
+            "baseline_bytes": self.rss_baseline,
+            "peak_sampled_bytes": self.rss_peak,
+            "delta_bytes": self.rss_peak - self.rss_baseline,
+            "process_peak_bytes": peak_rss_bytes(),
+        }
+        total_s = sum(self.seconds.values())
+        edges = self.counts.get("raw_edges", 0)
+        if edges and total_s > 0:
+            rep["edges_per_sec"] = round(edges / total_s, 1)
+        return rep
+
+
 def step_time(step_fn, state, steps: int = 5, warmup: int = 1) -> float:
     """Wall-clock seconds per compiled training step.
 

@@ -16,6 +16,10 @@ Modes:
   ckpt-resume    fit max_iters=8 resuming from the SHARED ckpt_root/p0
                  (all processes read; only process 0 keeps writing);
                  process 0 writes the resumed trajectory to out.npz
+  store          fit through StoreShardedBigClamModel from the graph cache
+                 at ckpt_root (compiled by the parent): asserts this
+                 process loaded ONLY its own shard files and its own node
+                 ranges, then process 0 writes the trajectory
 """
 
 import os
@@ -112,6 +116,38 @@ def main() -> None:
         ckpt = CheckpointManager(shared)
         assert ckpt.latest_step() == 4, ckpt.steps()
         res = model.fit(F0, checkpoints=ckpt)
+        if jax.process_index() == 0:
+            np.savez(
+                out_path, F=res.F, llh_history=np.asarray(res.llh_history)
+            )
+        jax.distributed.shutdown()
+        return
+
+    if mode == "store":
+        from bigclam_tpu.graph.store import GraphStore
+        from bigclam_tpu.parallel.sharded import StoreShardedBigClamModel
+
+        store = GraphStore.open(ckpt_root)
+        model = StoreShardedBigClamModel(
+            store, cfg.replace(use_pallas_csr=False), mesh
+        )
+        hs = model.host_shard
+        # per-host isolation: with 4 shards over 2 processes, this process
+        # owns exactly shards [2*pid, 2*pid+2) and read ONLY their blobs
+        p = jax.process_index()
+        assert hs.shard_ids == (2 * p, 2 * p + 1), hs.shard_ids
+        rows = store.rows_per_shard
+        assert (hs.lo, hs.hi) == (
+            2 * p * rows, min((2 * p + 2) * rows, store.num_nodes)
+        ), (hs.lo, hs.hi)
+        own = {
+            os.path.basename(path)
+            for s in hs.shard_ids
+            for path in store.shard_files(s)
+        }
+        assert set(hs.files_read) == own, (hs.files_read, own)
+
+        res = model.fit(F0)
         if jax.process_index() == 0:
             np.savez(
                 out_path, F=res.F, llh_history=np.asarray(res.llh_history)

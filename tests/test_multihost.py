@@ -237,6 +237,44 @@ def test_sharded_trainer_still_exact_after_put_sharded(toy_graphs):
 
 
 @_needs_multiproc_cpu
+def test_true_two_process_store_shard_loading(tmp_path):
+    """TWO real processes training from a compiled graph cache
+    (StoreShardedBigClamModel): the worker asserts its HostShard covers
+    exactly its own node ranges and that ONLY its own shard files were
+    read (HostShard.files_read), and the per-host-loaded trajectory must
+    equal the single-chip run exactly (float64) — no host ever saw the
+    global CSR."""
+    from bigclam_tpu.graph.store import compile_graph_cache
+
+    g, cfg, F0 = _worker_module().problem()
+    text = tmp_path / "g.txt"
+    text.write_text(
+        "\n".join(
+            f"{u} {v}"
+            for u, v in zip(g.src.tolist(), g.dst.tolist())
+            if u < v
+        )
+    )
+    cache = tmp_path / "cache"
+    compile_graph_cache(
+        str(text), str(cache), num_shards=4, chunk_bytes=256
+    )
+
+    out = tmp_path / "proc0.npz"
+    _run_two_workers(out, mode="store", ckpt_root=cache)
+    assert out.exists()
+
+    from bigclam_tpu.models import BigClamModel
+
+    ref = BigClamModel(g, cfg).fit(F0)
+    got = np.load(out)
+    np.testing.assert_allclose(got["F"], ref.F, rtol=1e-12)
+    np.testing.assert_allclose(
+        got["llh_history"], np.asarray(ref.llh_history), rtol=1e-12
+    )
+
+
+@_needs_multiproc_cpu
 def test_true_two_process_quality_device(tmp_path):
     """Device-resident quality annealing across TWO real processes: the
     jitted kick + state-resident loop + single final fetch_global must

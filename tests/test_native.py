@@ -14,12 +14,12 @@ needs_native = pytest.mark.skipif(native is None, reason="native lib unavailable
 
 @needs_native
 def test_parser_matches_numpy(tmp_path):
-    from bigclam_tpu.graph.ingest import _numpy_parse
+    from bigclam_tpu.graph.stream import load_edge_list_streaming
 
     p = tmp_path / "g.txt"
     p.write_text("# header\n# another\n0 1\n1\t2\n  3   4\n\n5 6\n")
     np.testing.assert_array_equal(
-        native.parse_edge_list(str(p)), _numpy_parse(str(p))
+        native.parse_edge_list(str(p)), load_edge_list_streaming(str(p))
     )
 
 

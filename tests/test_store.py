@@ -1,0 +1,472 @@
+"""Graph store tests: streaming parse, out-of-core compile, manifest
+validation, per-host shard loading, and the store-backed sharded trainer.
+
+The round-trip contract is BIT-identity: text -> cache -> load_graph must
+reproduce build_graph's indptr/indices/raw_ids exactly (the store changes
+where the graph lives, never what it is)."""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from bigclam_tpu.graph.ingest import build_graph, graph_from_edges
+from bigclam_tpu.graph.store import (
+    MANIFEST_NAME,
+    MANIFEST_VERSION,
+    GraphStore,
+    compile_graph_cache,
+    is_cache_dir,
+)
+from bigclam_tpu.graph.stream import (
+    byte_ranges,
+    load_edge_list_streaming,
+    stream_edge_list,
+)
+
+
+def _write_edges(path, pairs, header=True):
+    with open(path, "w") as f:
+        if header:
+            f.write("# synthetic\n# Nodes: ? Edges: ?\n\n")
+        for u, v in np.asarray(pairs).tolist():
+            f.write(f"{u} {v}\n")
+    return str(path)
+
+
+@pytest.fixture()
+def messy_text(tmp_path):
+    """Sparse raw ids, duplicate edges (both directions), self-loops."""
+    rng = np.random.default_rng(7)
+    pairs = rng.integers(0, 300, size=(2000, 2)) * 11 + 5
+    pairs = np.concatenate([pairs, pairs[:50, ::-1], pairs[:20]])
+    loops = np.stack([pairs[:15, 0], pairs[:15, 0]], axis=1)
+    pairs = np.concatenate([pairs, loops])
+    return _write_edges(tmp_path / "g.txt", pairs)
+
+
+# --------------------------------------------------------------------------
+# streaming parse
+# --------------------------------------------------------------------------
+
+
+def test_byte_ranges_partition_and_snap(messy_text):
+    size = os.path.getsize(messy_text)
+    with open(messy_text, "rb") as f:
+        data = f.read()
+    for chunk in (17, 256, 4096, size + 10):
+        spans = byte_ranges(messy_text, chunk)
+        assert spans[0][0] == 0 and spans[-1][1] == size
+        for (_, e1), (s2, _) in zip(spans, spans[1:]):
+            assert e1 == s2                       # exact partition
+            assert data[s2 - 1 : s2] == b"\n"     # snapped to newline
+
+
+def test_stream_parity_with_bulk_parse(messy_text):
+    from bigclam_tpu.graph.ingest import load_edge_list
+
+    ref = load_edge_list(messy_text)
+    for chunk in (64, 1000, 1 << 30):
+        got = load_edge_list_streaming(messy_text, chunk_bytes=chunk)
+        np.testing.assert_array_equal(got, ref)
+
+
+def test_stream_chunks_in_file_order(messy_text):
+    parts = list(stream_edge_list(messy_text, chunk_bytes=256))
+    assert len(parts) > 3
+    np.testing.assert_array_equal(
+        np.concatenate([p for p in parts if p.size]),
+        load_edge_list_streaming(messy_text),
+    )
+
+
+@pytest.mark.slow
+def test_stream_parity_with_workers(messy_text):
+    """Spawn-pool parse matches serial (slow: pool startup dominates)."""
+    ref = load_edge_list_streaming(messy_text, chunk_bytes=512)
+    got = load_edge_list_streaming(messy_text, chunk_bytes=512, workers=2)
+    np.testing.assert_array_equal(got, ref)
+
+
+def test_parse_rejects_odd_tokens(tmp_path):
+    p = tmp_path / "bad.txt"
+    p.write_text("0 1\n2\n")
+    with pytest.raises(ValueError, match="even number"):
+        load_edge_list_streaming(str(p))
+
+
+# --------------------------------------------------------------------------
+# compile -> load round trip
+# --------------------------------------------------------------------------
+
+
+def _assert_graphs_identical(a, b):
+    np.testing.assert_array_equal(a.indptr, b.indptr)
+    np.testing.assert_array_equal(a.indices, b.indices)
+    np.testing.assert_array_equal(a.raw_ids, b.raw_ids)
+
+
+@pytest.mark.parametrize("num_shards,chunk", [(1, 1 << 20), (4, 300), (7, 64)])
+def test_roundtrip_bit_identical(messy_text, tmp_path, num_shards, chunk):
+    ref = build_graph(messy_text)
+    store = compile_graph_cache(
+        messy_text, str(tmp_path / "cache"), num_shards=num_shards,
+        chunk_bytes=chunk,
+    )
+    g = store.load_graph()
+    _assert_graphs_identical(g, ref)
+    g.validate()
+    assert store.num_nodes == ref.num_nodes
+    assert store.num_directed_edges == ref.num_directed_edges
+    # build_graph dispatches the cache dir transparently
+    assert is_cache_dir(store.directory)
+    _assert_graphs_identical(build_graph(store.directory), ref)
+
+
+def test_roundtrip_toy_graphs(toy_graphs, tmp_path):
+    for name, g in toy_graphs.items():
+        pairs = np.stack([g.src, g.dst], axis=1)
+        pairs = pairs[pairs[:, 0] < pairs[:, 1]]        # undirected listing
+        text = _write_edges(tmp_path / f"{name}.txt", pairs, header=False)
+        store = compile_graph_cache(
+            text, str(tmp_path / f"{name}.cache"), num_shards=2,
+            chunk_bytes=16,
+        )
+        _assert_graphs_identical(store.load_graph(), build_graph(text))
+
+
+def test_roundtrip_agm_graph(tmp_path):
+    from bigclam_tpu.models.agm import sample_planted_graph
+
+    g, _ = sample_planted_graph(
+        400, 8, p_in=0.2, rng=np.random.default_rng(3)
+    )
+    pairs = np.stack([g.src, g.dst], axis=1)
+    pairs = pairs[pairs[:, 0] < pairs[:, 1]]
+    text = _write_edges(tmp_path / "agm.txt", pairs, header=False)
+    store = compile_graph_cache(
+        text, str(tmp_path / "agm.cache"), num_shards=8, chunk_bytes=2048,
+    )
+    got = store.load_graph()
+    ref = build_graph(text)
+    _assert_graphs_identical(got, ref)
+    # the AGM fixture's ids are already contiguous, so the cache reproduces
+    # the original graph object too
+    np.testing.assert_array_equal(got.indptr, g.indptr)
+    np.testing.assert_array_equal(got.indices, g.indices)
+
+
+def test_facebook_golden_roundtrip(facebook_graph, tmp_path):
+    from tests.conftest import require_reference_data
+
+    text = require_reference_data("facebook_combined.txt")
+    store = compile_graph_cache(
+        text, str(tmp_path / "fb.cache"), num_shards=8, chunk_bytes=1 << 20,
+    )
+    _assert_graphs_identical(store.load_graph(), facebook_graph)
+    assert store.num_nodes == 4039
+    assert store.num_directed_edges == 2 * 88234
+
+
+def test_compile_refuses_overwrite(messy_text, tmp_path):
+    cache = str(tmp_path / "cache")
+    compile_graph_cache(messy_text, cache, num_shards=4)
+    with pytest.raises(FileExistsError):
+        compile_graph_cache(messy_text, cache, num_shards=4)
+    # overwrite=True rebuilds cleanly, dropping the old manifest and blobs
+    # first (a crash mid-rebuild must never leave the old manifest
+    # validating over mixed files) — shrinking shards strands no strays
+    store = compile_graph_cache(
+        messy_text, cache, num_shards=2, overwrite=True
+    )
+    assert store.num_shards == 2
+    assert not os.path.exists(os.path.join(cache, "shard_00003.indices.npy"))
+    _assert_graphs_identical(store.load_graph(), build_graph(messy_text))
+
+
+def test_balanced_cache_matches_balance_graph(messy_text, tmp_path):
+    """balance=True bakes exactly the permutation the sharded trainers
+    would compute (parallel/balance.py) into the shard layout."""
+    from bigclam_tpu.parallel.balance import balance_permutation
+
+    S = 4
+    ref = build_graph(messy_text)
+    n_pad = -(-max(ref.num_nodes, S) // S) * S
+    perm = balance_permutation(ref.degrees, S, n_pad)
+    expected = ref.permute(perm)
+
+    store = compile_graph_cache(
+        messy_text, str(tmp_path / "bal.cache"), num_shards=S,
+        chunk_bytes=500, balance=True,
+    )
+    assert store.balanced
+    _assert_graphs_identical(store.load_graph(), expected)
+    np.testing.assert_array_equal(store.load_perm(), perm)
+
+
+# --------------------------------------------------------------------------
+# manifest validation
+# --------------------------------------------------------------------------
+
+
+def test_stale_format_version_rejected(messy_text, tmp_path):
+    cache = str(tmp_path / "cache")
+    compile_graph_cache(messy_text, cache, num_shards=2)
+    mpath = os.path.join(cache, MANIFEST_NAME)
+    with open(mpath) as f:
+        manifest = json.load(f)
+    manifest["format_version"] = MANIFEST_VERSION + 1
+    with open(mpath, "w") as f:
+        json.dump(manifest, f)
+    with pytest.raises(ValueError, match="format version"):
+        GraphStore.open(cache)
+
+
+def test_corrupted_checksum_rejected(messy_text, tmp_path):
+    cache = str(tmp_path / "cache")
+    store = compile_graph_cache(messy_text, cache, num_shards=4)
+    _, indices_path = store.shard_files(1)
+    with open(indices_path, "r+b") as f:
+        f.seek(os.path.getsize(indices_path) - 3)
+        b = f.read(1)
+        f.seek(-1, os.SEEK_CUR)
+        f.write(bytes([b[0] ^ 0xFF]))
+    store = GraphStore.open(cache)                 # manifest itself is fine
+    with pytest.raises(ValueError, match="checksum"):
+        store.load_graph()
+    with pytest.raises(ValueError, match="checksum"):
+        store.load_shard(0, 2)                     # shard 1 is host 0's
+    # the corruption is localized: the other host's shards still load
+    hs = store.load_shard(1, 2)
+    assert hs.lo == 2 * store.rows_per_shard
+    # verify=False is the explicit escape hatch
+    store.load_graph(verify=False)
+
+
+def test_missing_manifest_rejected(tmp_path):
+    with pytest.raises(ValueError, match="not a graph cache"):
+        GraphStore.open(str(tmp_path))
+    assert not is_cache_dir(str(tmp_path))
+
+
+# --------------------------------------------------------------------------
+# per-host shard loading
+# --------------------------------------------------------------------------
+
+
+def test_load_shard_two_host_fake(messy_text, tmp_path):
+    """2-host fake: each host gets its contiguous node range, concatenation
+    reassembles the full graph bit-identically, and a host's load touches
+    ONLY its own shard files (proved by deleting the other host's)."""
+    ref = build_graph(messy_text)
+    store = compile_graph_cache(
+        messy_text, str(tmp_path / "cache"), num_shards=4, chunk_bytes=400,
+    )
+    rows = store.rows_per_shard
+    s0 = store.load_shard(0, 2)
+    s1 = store.load_shard(1, 2)
+    assert (s0.lo, s0.hi) == (0, min(2 * rows, ref.num_nodes))
+    assert (s1.lo, s1.hi) == (min(2 * rows, ref.num_nodes), ref.num_nodes)
+    assert s0.shard_ids == (0, 1) and s1.shard_ids == (2, 3)
+
+    # reassembly == build_graph, bit for bit
+    indptr = np.concatenate([s0.indptr, s1.indptr[1:] + s0.indptr[-1]])
+    np.testing.assert_array_equal(indptr, ref.indptr)
+    np.testing.assert_array_equal(
+        np.concatenate([s0.indices, s1.indices]), ref.indices
+    )
+    # local indptr agrees with the global CSR over the host's range
+    np.testing.assert_array_equal(
+        np.diff(s0.indptr), ref.degrees[s0.lo : s0.hi]
+    )
+
+    # files_read is exactly the host's own blobs
+    own0 = {os.path.basename(p) for s in (0, 1) for p in store.shard_files(s)}
+    assert set(s0.files_read) == own0
+
+    # hard isolation: delete host 1's blobs, host 0 still loads
+    for s in (2, 3):
+        for p in store.shard_files(s):
+            os.unlink(p)
+    s0_again = store.load_shard(0, 2)
+    np.testing.assert_array_equal(s0_again.indices, s0.indices)
+    with pytest.raises(FileNotFoundError):
+        store.load_shard(1, 2)
+
+
+def test_load_shard_bad_host_counts(messy_text, tmp_path):
+    store = compile_graph_cache(
+        messy_text, str(tmp_path / "cache"), num_shards=4
+    )
+    with pytest.raises(ValueError, match="divisible"):
+        store.load_shard(0, 3)
+    with pytest.raises(ValueError, match="outside"):
+        store.load_shard(4, 4)
+
+
+def test_host_shard_ids_process_mapping():
+    from bigclam_tpu.parallel.multihost import host_shard_ids
+
+    assert list(host_shard_ids(8, 0, 2)) == [0, 1, 2, 3]
+    assert list(host_shard_ids(8, 1, 2)) == [4, 5, 6, 7]
+    with pytest.raises(ValueError, match="divisible"):
+        host_shard_ids(8, 0, 3)
+
+
+# --------------------------------------------------------------------------
+# store-backed sharded trainer
+# --------------------------------------------------------------------------
+
+
+def _two_clique_problem(tmp_path):
+    edges = []
+    for base in (0, 12):
+        for i in range(12):
+            for j in range(i + 1, 12):
+                edges.append((base + i, base + j))
+    edges.append((11, 12))
+    g = graph_from_edges(edges, num_nodes=24)
+    text = _write_edges(tmp_path / "mh.txt", edges, header=False)
+    return g, text
+
+
+def test_store_sharded_model_matches_sharded(tmp_path):
+    """Single-process equality: the store-backed trainer (per-host shard
+    loading + put_host_local edge placement) reproduces ShardedBigClamModel
+    EXACTLY (float64, atol=0) — the sharding changes where the edges come
+    from, not the math."""
+    import jax
+
+    from bigclam_tpu.config import BigClamConfig
+    from bigclam_tpu.parallel import (
+        ShardedBigClamModel,
+        StoreShardedBigClamModel,
+        make_mesh,
+    )
+
+    g, text = _two_clique_problem(tmp_path)
+    store = compile_graph_cache(
+        text, str(tmp_path / "cache"), num_shards=4, chunk_bytes=64,
+    )
+    cfg = BigClamConfig(
+        num_communities=2, dtype="float64", max_iters=8, conv_tol=0.0,
+        use_pallas_csr=False,
+    )
+    F0 = np.random.default_rng(5).uniform(0.1, 1.0, size=(24, 2))
+    mesh = make_mesh((4, 1), jax.devices()[:4])
+    ref = ShardedBigClamModel(g, cfg, mesh).fit(F0)
+    model = StoreShardedBigClamModel(store, cfg, mesh)
+    assert model.engaged_path == "xla"
+    got = model.fit(F0)
+    np.testing.assert_allclose(got.F, ref.F, rtol=0, atol=0)
+    assert got.llh_history == ref.llh_history
+    # the trainer loaded all 4 shards (single process owns the whole mesh)
+    assert model.host_shard.shard_ids == (0, 1, 2, 3)
+
+
+def test_store_sharded_model_refuses_mismatch(tmp_path):
+    import jax
+
+    from bigclam_tpu.config import BigClamConfig
+    from bigclam_tpu.parallel import StoreShardedBigClamModel, make_mesh
+
+    _, text = _two_clique_problem(tmp_path)
+    store = compile_graph_cache(
+        text, str(tmp_path / "cache"), num_shards=2, chunk_bytes=64,
+    )
+    cfg = BigClamConfig(num_communities=2, dtype="float64", max_iters=2)
+    mesh = make_mesh((4, 1), jax.devices()[:4])
+    with pytest.raises(ValueError, match="--shards 4"):
+        StoreShardedBigClamModel(store, cfg, mesh)
+    with pytest.raises(ValueError, match="unsupported"):
+        StoreShardedBigClamModel(
+            store, cfg.replace(use_pallas_csr=True),
+            make_mesh((2, 1), jax.devices()[:2]),
+        )
+
+
+def test_store_graph_view_refuses_global_csr(tmp_path):
+    """Touching global CSR arrays on the store-backed trainer's graph view
+    is a loud error, not a silent materialization."""
+    import jax
+
+    from bigclam_tpu.config import BigClamConfig
+    from bigclam_tpu.parallel import StoreShardedBigClamModel, make_mesh
+
+    _, text = _two_clique_problem(tmp_path)
+    store = compile_graph_cache(
+        text, str(tmp_path / "cache"), num_shards=4, chunk_bytes=64,
+    )
+    cfg = BigClamConfig(num_communities=2, dtype="float64", max_iters=2)
+    model = StoreShardedBigClamModel(
+        store, cfg, make_mesh((4, 1), jax.devices()[:4])
+    )
+    assert model.g.num_nodes == 24
+    with pytest.raises(AttributeError, match="no global CSR"):
+        model.g.src
+
+
+# --------------------------------------------------------------------------
+# CLI
+# --------------------------------------------------------------------------
+
+
+def _run_cli(*argv):
+    return subprocess.run(
+        [sys.executable, "-m", "bigclam_tpu.cli", *argv],
+        capture_output=True, text=True, timeout=600, cwd="/root/repo",
+    )
+
+
+def test_cli_ingest_then_fit_from_cache(tmp_path):
+    g, text = _two_clique_problem(tmp_path)
+    cache = str(tmp_path / "cache")
+    r = _run_cli(
+        "ingest", "--graph", text, "--cache-dir", cache, "--shards", "2",
+        "--chunk-bytes", "128",
+    )
+    assert r.returncode == 0, r.stderr
+    rec = json.loads(r.stdout.strip().splitlines()[-1])
+    assert rec["n"] == 24 and rec["shards"] == 2
+    assert rec["edges"] == g.num_edges
+    assert "edges_per_sec" in rec
+    assert rec["rss"]["peak_sampled_bytes"] >= rec["rss"]["baseline_bytes"]
+    assert set(rec["seconds"]) >= {"scan", "scatter", "dedup", "shards"}
+
+    # re-ingest without --overwrite refuses
+    r2 = _run_cli("ingest", "--graph", text, "--cache-dir", cache)
+    assert r2.returncode == 1 and "already compiled" in r2.stderr
+
+    # fit straight from the cache dir
+    r3 = _run_cli(
+        "fit", "--graph", cache, "--k", "2", "--dtype", "float64",
+        "--max-iters", "10", "--init", "random", "--quiet",
+        "--platform", "cpu",
+    )
+    assert r3.returncode == 0, r3.stderr
+    rec3 = json.loads(r3.stdout.strip().splitlines()[-1])
+    assert rec3["n"] == 24 and rec3["edges"] == g.num_edges
+
+
+def test_cli_fit_autocompiles_cache_dir(tmp_path):
+    g, text = _two_clique_problem(tmp_path)
+    cache = str(tmp_path / "auto.cache")
+    r = _run_cli(
+        "fit", "--graph", text, "--cache-dir", cache, "--k", "2",
+        "--dtype", "float64", "--max-iters", "5", "--init", "random",
+        "--quiet", "--platform", "cpu",
+    )
+    assert r.returncode == 0, r.stderr
+    assert "compiling graph cache" in r.stderr
+    assert is_cache_dir(cache)
+    # second run reloads from the cache (no compile note)
+    r2 = _run_cli(
+        "fit", "--graph", text, "--cache-dir", cache, "--k", "2",
+        "--dtype", "float64", "--max-iters", "5", "--init", "random",
+        "--quiet", "--platform", "cpu",
+    )
+    assert r2.returncode == 0, r2.stderr
+    assert "compiling graph cache" not in r2.stderr

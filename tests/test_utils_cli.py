@@ -25,6 +25,44 @@ def test_checkpoint_roundtrip(tmp_path):
     np.testing.assert_array_equal(arrays["F"], np.zeros((3, 2)))
 
 
+def test_checkpoint_truncated_restore_falls_back(tmp_path, capsys):
+    """Satellite: a preempted write can never leave restore() crashing on a
+    truncated .npz — saves are fsync'd tmp+rename, and restore falls back
+    past an unreadable newest checkpoint to the next older one."""
+    import os
+
+    import pytest
+
+    cm = CheckpointManager(str(tmp_path), keep=3)
+    cm.save(1, {"F": np.ones((4, 3))}, meta={"llh_history": [-5.0]})
+    cm.save(2, {"F": np.full((4, 3), 2.0)}, meta={"llh_history": [-4.0]})
+    path2 = cm._path(2)
+    size = os.path.getsize(path2)
+    with open(path2, "r+b") as f:        # simulate a lost writeback
+        f.truncate(size // 2)
+
+    step, arrays, meta = cm.restore()
+    assert step == 1
+    np.testing.assert_array_equal(arrays["F"], np.ones((4, 3)))
+    assert meta["llh_history"] == [-5.0]
+    assert "unreadable" in capsys.readouterr().err
+
+    # an explicitly requested corrupt step propagates its error
+    import zipfile
+    import zlib
+
+    with pytest.raises(
+        (OSError, ValueError, EOFError, KeyError, zipfile.BadZipFile,
+         zlib.error)
+    ):
+        cm.restore(2)
+
+    # every checkpoint unreadable -> None (fresh start), not a crash
+    with open(cm._path(1), "r+b") as f:
+        f.truncate(4)
+    assert cm.restore() is None
+
+
 def test_fit_resume_matches_uninterrupted(toy_graphs, tmp_path):
     """Fit with mid-run checkpointing, then resume from the checkpoint: the
     final state must equal an uninterrupted run (SURVEY.md §5)."""
