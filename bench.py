@@ -252,9 +252,37 @@ def _backend_or_fallback(timeout_s: float = 180.0) -> str:
     os._exit(3)
 
 
+def _open_telemetry():
+    """Opt-in run telemetry (bigclam_tpu.obs): point BIGCLAM_TELEMETRY_DIR
+    at a directory and the bench run leaves events.jsonl + run_report.json
+    there — config stage timings, device-memory watermarks after each
+    model build (the roofline's HBM model gets a measured counterpart),
+    compile counts, and a stall heartbeat for hung backends."""
+    tdir = os.environ.get("BIGCLAM_TELEMETRY_DIR")
+    if not tdir:
+        return None
+    from bigclam_tpu.obs import RunTelemetry, install
+
+    return install(
+        RunTelemetry(tdir, entry="bench", heartbeat_s=600.0)
+    )
+
+
 def main() -> None:
     backend = _backend_or_fallback()
     cpu_fallback = backend == "cpu-fallback"
+    tel = _open_telemetry()
+    try:
+        _main(backend, cpu_fallback)
+    finally:
+        if tel is not None:
+            from bigclam_tpu.obs import uninstall
+
+            tel.finalize()
+            uninstall(tel)
+
+
+def _main(backend, cpu_fallback) -> None:
     import jax
 
     from bigclam_tpu.config import BigClamConfig
@@ -263,7 +291,10 @@ def main() -> None:
     from bigclam_tpu.models.agm import sample_planted_graph
     from bigclam_tpu.spec import interpreter as spec
 
-    on_tpu = jax.default_backend() == "tpu"
+    from bigclam_tpu.utils.profiling import StageProfile
+
+    prof = StageProfile()       # forwards stage events + memory watermarks
+    on_tpu = jax.default_backend() == "tpu"   # into tel when installed
     configs = {}
     # cpu-fallback: a real (if slow) measurement beats a zero record, but
     # the big synthetic configs would take hours on a host CPU — keep the
@@ -282,23 +313,25 @@ def main() -> None:
     rng = np.random.default_rng(0)
     F0 = rng.integers(0, 2, size=(g.num_nodes, K_ENRON)).astype(np.float64)
 
-    model = BigClamModel(g, cfg, k_multiple=128)
-    if on_tpu and model.engaged_path not in ("csr", "csr_grouped"):
-        raise RuntimeError(
-            "benchmark invalid: blocked-CSR kernels did not engage on the "
-            f"TPU backend (path={model.engaged_path}, "
-            f"reason: {model.path_reason})"
+    with prof.stage("enron_csr"):
+        model = BigClamModel(g, cfg, k_multiple=128)
+        if on_tpu and model.engaged_path not in ("csr", "csr_grouped"):
+            raise RuntimeError(
+                "benchmark invalid: blocked-CSR kernels did not engage on "
+                f"the TPU backend (path={model.engaged_path}, "
+                f"reason: {model.path_reason})"
+            )
+        enron_eps, enron_windows, llh_last = time_windows(
+            model, F0, windows, ITERS_PER_WINDOW
         )
-    enron_eps, enron_windows, llh_last = time_windows(
-        model, F0, windows, ITERS_PER_WINDOW
-    )
-    xla_model = BigClamModel(
-        g, cfg.replace(use_pallas_csr=False, use_pallas=False),
-        k_multiple=128,
-    )
-    enron_xla_eps, enron_xla_windows, _ = time_windows(
-        xla_model, F0, xla_windows, ITERS_PER_WINDOW
-    )
+    with prof.stage("enron_xla"):
+        xla_model = BigClamModel(
+            g, cfg.replace(use_pallas_csr=False, use_pallas=False),
+            k_multiple=128,
+        )
+        enron_xla_eps, enron_xla_windows, _ = time_windows(
+            xla_model, F0, xla_windows, ITERS_PER_WINDOW
+        )
     kind = jax.devices()[0].device_kind
     configs["enron"] = {
         "config": f"Email-Enron N={g.num_nodes} 2E={g.num_directed_edges} "
@@ -322,29 +355,30 @@ def main() -> None:
         _emit(jax, spec, g, cfg, F0, backend, model, configs,
               enron_eps, llh_last)
         return
-    gl, _ = sample_planted_graph(
-        LARGE_N, LARGE_K, p_in=LARGE_P_IN, rng=np.random.default_rng(1)
-    )
-    cfg_l = BigClamConfig(num_communities=LARGE_K)
-    Fl = np.random.default_rng(2).integers(
-        0, 2, size=(gl.num_nodes, LARGE_K)
-    ).astype(np.float64)
-    model_l = BigClamModel(gl, cfg_l, k_multiple=128)
-    if on_tpu and model_l.engaged_path not in ("csr", "csr_grouped"):
-        raise RuntimeError(
-            "benchmark invalid: large config fell back to "
-            f"{model_l.engaged_path} ({model_l.path_reason})"
+    with prof.stage("large"):
+        gl, _ = sample_planted_graph(
+            LARGE_N, LARGE_K, p_in=LARGE_P_IN, rng=np.random.default_rng(1)
         )
-    large_eps, large_windows, _ = time_windows(
-        model_l, Fl, LARGE_WINDOWS, LARGE_ITERS_PER_WINDOW, warmup=2
-    )
-    xla_l = BigClamModel(
-        gl, cfg_l.replace(use_pallas_csr=False, use_pallas=False),
-        k_multiple=128,
-    )
-    large_xla_eps, large_xla_windows, _ = time_windows(
-        xla_l, Fl, 2, LARGE_ITERS_PER_WINDOW, warmup=1
-    )
+        cfg_l = BigClamConfig(num_communities=LARGE_K)
+        Fl = np.random.default_rng(2).integers(
+            0, 2, size=(gl.num_nodes, LARGE_K)
+        ).astype(np.float64)
+        model_l = BigClamModel(gl, cfg_l, k_multiple=128)
+        if on_tpu and model_l.engaged_path not in ("csr", "csr_grouped"):
+            raise RuntimeError(
+                "benchmark invalid: large config fell back to "
+                f"{model_l.engaged_path} ({model_l.path_reason})"
+            )
+        large_eps, large_windows, _ = time_windows(
+            model_l, Fl, LARGE_WINDOWS, LARGE_ITERS_PER_WINDOW, warmup=2
+        )
+        xla_l = BigClamModel(
+            gl, cfg_l.replace(use_pallas_csr=False, use_pallas=False),
+            k_multiple=128,
+        )
+        large_xla_eps, large_xla_windows, _ = time_windows(
+            xla_l, Fl, 2, LARGE_ITERS_PER_WINDOW, warmup=1
+        )
     configs["large"] = {
         "config": f"AGM planted N={gl.num_nodes} "
                   f"2E={gl.num_directed_edges} K={LARGE_K}",
@@ -467,34 +501,44 @@ def _emit(jax, spec, g, cfg, F0, backend, model, configs, enron_eps,
         base_times.append(time.perf_counter() - t0)
     base_eps = g.num_directed_edges / statistics.median(base_times)
 
-    print(
-        json.dumps(
+    record = {
+        "metric": "edges/sec/chip",
+        "value": enron_eps,
+        "unit": "edges/sec/chip",
+        "vs_baseline": round(enron_eps / base_eps, 2),
+        "path": model.engaged_path,
+        "backend": backend,
+        "config": configs["enron"]["config"],
+        "graph_source": configs["enron"].get("graph_source"),
+        "configs": configs,
+        # headline roofline position (VERDICT r5 Next #5): the
+        # denominator for edges/sec/chip — fraction of this
+        # chip's HBM bandwidth and MXU peak the headline config
+        # achieves under the stated bytes/flops-per-edge model
+        "roofline": configs["enron"].get("roofline"),
+        "baseline_spec_eps": round(base_eps, 1),
+        "baseline_iters_sec": [round(t, 3) for t in base_times],
+        "iters_per_window": ITERS_PER_WINDOW,
+        "sec_per_iter": round(g.num_directed_edges / enron_eps, 4),
+        "device": str(jax.devices()[0]),
+        # TrainState.llh is the LLH of the step's INPUT F, so this
+        # is the last *evaluated* LLH (one update behind state.F)
+        "llh_at_last_eval": llh_last,
+    }
+    from bigclam_tpu.obs import telemetry as _obs
+
+    tel = _obs.current()
+    if tel is not None:
+        tel.set_final(
             {
-                "metric": "edges/sec/chip",
-                "value": enron_eps,
-                "unit": "edges/sec/chip",
-                "vs_baseline": round(enron_eps / base_eps, 2),
-                "path": model.engaged_path,
-                "backend": backend,
-                "config": configs["enron"]["config"],
-                "graph_source": configs["enron"].get("graph_source"),
-                "configs": configs,
-                # headline roofline position (VERDICT r5 Next #5): the
-                # denominator for edges/sec/chip — fraction of this
-                # chip's HBM bandwidth and MXU peak the headline config
-                # achieves under the stated bytes/flops-per-edge model
-                "roofline": configs["enron"].get("roofline"),
-                "baseline_spec_eps": round(base_eps, 1),
-                "baseline_iters_sec": [round(t, 3) for t in base_times],
-                "iters_per_window": ITERS_PER_WINDOW,
-                "sec_per_iter": round(g.num_directed_edges / enron_eps, 4),
-                "device": str(jax.devices()[0]),
-                # TrainState.llh is the LLH of the step's INPUT F, so this
-                # is the last *evaluated* LLH (one update behind state.F)
-                "llh_at_last_eval": llh_last,
+                "metric": record["metric"],
+                "value": record["value"],
+                "vs_baseline": record["vs_baseline"],
+                "path": record["path"],
+                "backend": record["backend"],
             }
         )
-    )
+    print(json.dumps(record))
 
 
 if __name__ == "__main__":

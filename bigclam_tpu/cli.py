@@ -85,7 +85,25 @@ def _add_common(p: argparse.ArgumentParser) -> None:
     p.add_argument("--checkpoint-every", type=int, default=0)
     p.add_argument("--metrics", default=None, help="JSONL metrics path")
     p.add_argument("--profile-dir", default=None, help="jax.profiler trace dir")
-    p.add_argument("--quiet", action="store_true")
+    p.add_argument(
+        "--telemetry-dir", default=None,
+        help="run-telemetry directory (bigclam_tpu.obs): events.jsonl + "
+             "run_report.json — step metrics, stage transitions, device-"
+             "memory watermarks, compile counters, stall heartbeat; render "
+             "with `cli report <dir>`",
+    )
+    p.add_argument(
+        "--heartbeat-s", type=float, default=300.0,
+        help="stall-heartbeat deadline with --telemetry-dir: emit a stall "
+             "event when no step/stage completes within this many seconds "
+             "(0 disables; --quiet silences the stderr echo, never the "
+             "JSONL)",
+    )
+    p.add_argument(
+        "--quiet", action="store_true",
+        help="silence per-step echo, engagement lines, and the heartbeat's "
+             "stderr warnings (telemetry JSONL stays complete)",
+    )
     p.add_argument(
         "--platform", default=None, choices=["cpu", "tpu"],
         help="force a JAX platform (the env may pin one; this overrides it)",
@@ -99,6 +117,37 @@ def _add_common(p: argparse.ArgumentParser) -> None:
              "(scripts/device_seeding_bench.py measures the backends on "
              "your hardware)",
     )
+
+
+def _open_telemetry(args, entry: str):
+    """Create + install the run telemetry when --telemetry-dir was given
+    (None otherwise). device telemetry is off for jax-free entries
+    (ingest); --distributed defers the single-writer gate until the
+    process group is joined (initialize_distributed commits it)."""
+    tdir = getattr(args, "telemetry_dir", None)
+    if not tdir:
+        return None
+    from bigclam_tpu.obs import RunTelemetry, install
+
+    return install(
+        RunTelemetry(
+            tdir,
+            entry=entry,
+            heartbeat_s=getattr(args, "heartbeat_s", 0.0),
+            quiet=getattr(args, "quiet", False),
+            device_memory=entry != "ingest",
+            auto_gate=not getattr(args, "distributed", False),
+        )
+    )
+
+
+def _close_telemetry(tel) -> None:
+    if tel is None:
+        return
+    from bigclam_tpu.obs import uninstall
+
+    tel.finalize()
+    uninstall(tel)
 
 
 def _load_graph(args):
@@ -207,11 +256,23 @@ def _init_F(g, cfg, args):
 
 
 def cmd_fit(args) -> int:
+    tel = _open_telemetry(args, "fit")
+    try:
+        return _cmd_fit(args, tel)
+    finally:
+        _close_telemetry(tel)
+
+
+def _cmd_fit(args, tel=None) -> int:
     from bigclam_tpu.ops import extraction
     from bigclam_tpu.utils import CheckpointManager, MetricsLogger
-    from bigclam_tpu.utils.profiling import trace
+    from bigclam_tpu.utils.profiling import StageProfile, trace
 
-    g, cfg = _build(args, args.k)
+    # stage boundaries forward into the telemetry (event + device-memory
+    # watermark + heartbeat beat) when --telemetry-dir is active
+    prof = StageProfile()
+    with prof.stage("graph_load"):
+        g, cfg = _build(args, args.k)
     if getattr(args, "seed_exclusion", None) is not None:
         # orthogonal to --quality: an explicit True engages the covering
         # walk even for parity fits (the auto rule is on-iff-quality)
@@ -246,8 +307,15 @@ def cmd_fit(args) -> int:
             "defaulting to every 50 iterations",
             file=sys.stderr,
         )
-    model = _make_model(g, cfg, args)
-    F0 = _init_F(g, cfg, args)
+    with prof.stage("model_build"):
+        model = _make_model(g, cfg, args)
+    if tel is not None:
+        # the process group (if any) was joined inside _make_model: the
+        # single-writer gate is decidable now even when
+        # initialize_distributed never ran (single-process fallback)
+        tel.commit_gate()
+    with prof.stage("seeding"):
+        F0 = _init_F(g, cfg, args)
     ckpt = (
         CheckpointManager(args.checkpoint_dir) if args.checkpoint_dir else None
     )
@@ -260,7 +328,7 @@ def cmd_fit(args) -> int:
             path=getattr(model, "engaged_path", ""),
             num_nodes=g.num_nodes,
         )
-        with trace(args.profile_dir):
+        with prof.stage("fit"), trace(args.profile_dir):
             if cfg.quality_mode and getattr(args, "device_annealing", False):
                 from bigclam_tpu.models.quality import fit_quality_device
 
@@ -291,32 +359,45 @@ def cmd_fit(args) -> int:
         out["quality_cycles"] = qres.num_cycles
         out["quality_total_iters"] = qres.total_iters
         out["cycles_llh"] = [round(v, 2) for v in qres.cycles_llh]
-    com = (
-        extraction.extract_communities(res.F, g)
-        if (args.out or args.export_gexf)
-        else None
-    )
-    if args.out:
-        extraction.save_communities(args.out, com)
-        out["communities"] = len(com)
-        out["out"] = args.out
-    if args.save_f:
-        np.save(args.save_f, res.F)
-        out["save_f"] = args.save_f
-    if args.export_gexf:
-        from bigclam_tpu.utils.viz import export_gexf
+    with prof.stage("extract"):
+        com = (
+            extraction.extract_communities(res.F, g)
+            if (args.out or args.export_gexf)
+            else None
+        )
+        if args.out:
+            extraction.save_communities(args.out, com)
+            out["communities"] = len(com)
+            out["out"] = args.out
+        if args.save_f:
+            np.save(args.save_f, res.F)
+            out["save_f"] = args.save_f
+        if args.export_gexf:
+            from bigclam_tpu.utils.viz import export_gexf
 
-        export_gexf(args.export_gexf, g, communities=com, F=res.F)
-        out["export_gexf"] = args.export_gexf
+            export_gexf(args.export_gexf, g, communities=com, F=res.F)
+            out["export_gexf"] = args.export_gexf
+    if tel is not None:
+        tel.set_final(out)
     print(json.dumps(out))
     return 0
 
 
 def cmd_sweep(args) -> int:
-    from bigclam_tpu.models.model_selection import sweep_k
-    from bigclam_tpu.utils.profiling import trace
+    tel = _open_telemetry(args, "sweep")
+    try:
+        return _cmd_sweep(args, tel)
+    finally:
+        _close_telemetry(tel)
 
-    g, cfg = _build(args, getattr(args, "max_com"))
+
+def _cmd_sweep(args, tel=None) -> int:
+    from bigclam_tpu.models.model_selection import sweep_k
+    from bigclam_tpu.utils.profiling import StageProfile, trace
+
+    prof = StageProfile()
+    with prof.stage("graph_load"):
+        g, cfg = _build(args, getattr(args, "max_com"))
     if getattr(args, "quality", False):
         cfg = cfg.replace(quality_mode=True)
     if args.checkpoint_dir:
@@ -336,7 +417,7 @@ def cmd_sweep(args) -> int:
         def cb(k, llh):
             ml.log({"k": k, "llh": llh})
 
-        with trace(args.profile_dir):
+        with prof.stage("sweep"), trace(args.profile_dir):
             res = sweep_k(
                 g,
                 cfg,
@@ -345,24 +426,33 @@ def cmd_sweep(args) -> int:
                 state_dir=args.checkpoint_dir,
                 device_annealing=getattr(args, "device_annealing", False),
             )
-    print(
-        json.dumps(
-            {
-                "chosen_k": res.chosen_k,
-                "kset": res.kset,
-                "llh_by_k": {str(k): v for k, v in res.llh_by_k.items()},
-            }
-        )
-    )
+    out = {
+        "chosen_k": res.chosen_k,
+        "kset": res.kset,
+        "llh_by_k": {str(k): v for k, v in res.llh_by_k.items()},
+    }
+    if tel is not None:
+        tel.set_final(out)
+    print(json.dumps(out))
     return 0
 
 
 def cmd_ingest(args) -> int:
+    tel = _open_telemetry(args, "ingest")
+    try:
+        return _cmd_ingest(args, tel)
+    finally:
+        _close_telemetry(tel)
+
+
+def _cmd_ingest(args, tel=None) -> int:
     """Compile a SNAP edge list into a binary shard cache, out of core.
 
     Deliberately jax-free: ingest runs on data-prep hosts where the only
     budget that matters is host RAM — the reported peak-RSS delta is the
-    ingest pipeline's own footprint (O(chunk + bucket + N), not O(file))."""
+    ingest pipeline's own footprint (O(chunk + bucket + N), not O(file)).
+    Telemetry (when on) follows suit: device-memory sampling is disabled
+    (_open_telemetry), so the stage events/watermarks never import jax."""
     from bigclam_tpu.graph.store import compile_graph_cache, is_cache_dir
     from bigclam_tpu.utils.profiling import IngestProfile
 
@@ -390,20 +480,33 @@ def cmd_ingest(args) -> int:
         overwrite=args.overwrite,
         profile=prof,
     )
-    print(
-        json.dumps(
-            {
-                "cache_dir": args.cache_dir,
-                "n": store.num_nodes,
-                "edges": store.num_directed_edges // 2,
-                "shards": store.num_shards,
-                "balanced": store.balanced,
-                "chunk_bytes": args.chunk_bytes,
-                **prof.report(),
-            }
-        )
-    )
+    out = {
+        "cache_dir": args.cache_dir,
+        "n": store.num_nodes,
+        "edges": store.num_directed_edges // 2,
+        "shards": store.num_shards,
+        "balanced": store.balanced,
+        "chunk_bytes": args.chunk_bytes,
+        **prof.report(),
+    }
+    if tel is not None:
+        tel.set_final(out)
+    print(json.dumps(out))
     return 0
+
+
+def cmd_report(args) -> int:
+    """Render a telemetry directory human-readable (obs.report): merged
+    per-process run reports, stage seconds, device-memory watermarks,
+    compile counts, stalls, and an events.jsonl schema check. Exit 1 when
+    artifacts are missing/invalid, so CI can gate on a telemetry dir."""
+    from bigclam_tpu.obs.report import render
+
+    text, errors = render(args.dir)
+    print(text)
+    if errors:
+        print(f"\n{errors} problem(s) found", file=sys.stderr)
+    return 1 if errors else 0
 
 
 def cmd_eval(args) -> int:
@@ -510,7 +613,26 @@ def main(argv=None) -> int:
              "into the shards, so multi-host loads are pre-balanced",
     )
     p_ing.add_argument("--overwrite", action="store_true")
+    p_ing.add_argument(
+        "--telemetry-dir", default=None,
+        help="run-telemetry directory (events.jsonl + run_report.json; "
+             "jax-free on this entry — no device sampling)",
+    )
+    p_ing.add_argument(
+        "--heartbeat-s", type=float, default=300.0,
+        help="stall-heartbeat deadline with --telemetry-dir (0 disables)",
+    )
+    p_ing.add_argument("--quiet", action="store_true")
     p_ing.set_defaults(fn=cmd_ingest)
+
+    p_rep = sub.add_parser(
+        "report",
+        help="render a --telemetry-dir human-readable (stage seconds, "
+             "memory watermarks, compile counts, stalls; validates the "
+             "event schema)",
+    )
+    p_rep.add_argument("dir", help="telemetry directory of a finished run")
+    p_rep.set_defaults(fn=cmd_report)
 
     p_eval = sub.add_parser("eval", help="score predicted vs ground-truth communities")
     p_eval.add_argument("--pred", required=True)

@@ -295,13 +295,30 @@ class GraphStore:
         """Assemble the full Graph from every shard (the fast single-host
         reload path: binary blobs, optionally mmap-read — no text parse,
         no remap, no dedup)."""
+        import time
+
+        t0 = time.perf_counter()
         hs = self.load_shard_range(0, self.num_shards, verify=verify,
                                    mmap=mmap)
-        return Graph(
+        g = Graph(
             indptr=hs.indptr,
             indices=np.ascontiguousarray(hs.indices),
             raw_ids=self.load_raw_ids(verify=verify),
         )
+        from bigclam_tpu.obs import telemetry as _obs
+
+        tel = _obs.current()
+        if tel is not None:
+            tel.event(
+                "graph_load",
+                source="cache",
+                path=self.directory,
+                nodes=self.num_nodes,
+                directed_edges=self.num_directed_edges,
+                seconds=round(time.perf_counter() - t0, 4),
+                mmap=bool(mmap),
+            )
+        return g
 
 
 # --------------------------------------------------------------------------
@@ -608,4 +625,16 @@ def _compile(
         },
     }
     _atomic_json(manifest_path, manifest)
+    from bigclam_tpu.obs import telemetry as _obs
+
+    tel = _obs.current()
+    if tel is not None:
+        tel.event(
+            "ingest",
+            edges=total_directed // 2,
+            nodes=n,
+            shards=num_shards,
+            balanced=perm is not None,
+            cache_dir=cache_dir,
+        )
     return GraphStore(cache_dir, manifest)

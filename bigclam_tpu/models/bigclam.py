@@ -139,10 +139,19 @@ def log_engaged_path(model_name: str, path: str, reason: str = "") -> None:
     Silent fallbacks hid perf regressions in round-1 production runs (the
     7.66M-vs-27.4M bench capture artifact); every trainer now states which
     edge-sweep implementation it compiled, and why the CSR kernels did not
-    engage when they did not. Set BIGCLAM_QUIET=1 to suppress."""
+    engage when they did not. Set BIGCLAM_QUIET=1 to suppress the stderr
+    line; the telemetry event (and its post-placement device-memory
+    watermark) is emitted regardless — the event log stays complete under
+    --quiet."""
     import os
     import sys
 
+    from bigclam_tpu.obs import telemetry as _obs
+
+    tel = _obs.current()
+    if tel is not None:
+        tel.event("model_build", model=model_name, path=path, reason=reason)
+        tel.watermark(f"model_build:{model_name}")
     if os.environ.get("BIGCLAM_QUIET") == "1":
         return
     why = (
@@ -308,8 +317,22 @@ def run_fit_loop(
     allocated twin until a loop-owned state is available to recycle.
     Trajectories are bit-identical to the non-donated path — donation
     moves storage, not math (pinned by tests/test_donation.py).
+
+    OBSERVABILITY (bigclam_tpu.obs): each iteration beats the stall
+    heartbeat of the installed RunTelemetry (progress = iter + LLH), and a
+    NON-FINITE LLH aborts through _abort_nonfinite — F/accept-hist
+    diagnostics are dumped (to the telemetry dir when one is active)
+    before the FloatingPointError, instead of the loop silently iterating
+    on garbage until max_iters. Telemetry off costs one None check per
+    iteration plus math.isfinite on a host float (pinned < 2% of step time
+    by tests/test_telemetry.py).
     """
     import inspect
+    import math
+
+    from bigclam_tpu.obs import telemetry as _obs
+
+    tel = _obs.current()
 
     cb_arity = 0
     if callback is not None:
@@ -346,6 +369,10 @@ def run_fit_loop(
         else:
             new_state = step_fn(state)
         llh_t = float(new_state.llh)           # LLH of state.F
+        if not math.isfinite(llh_t):
+            _abort_nonfinite(state, new_state, llh_t, hist)
+        if tel is not None:
+            tel.step_beat(int(state.it), llh_t)
         if callback is not None:
             if cb_arity >= 3:
                 ah = getattr(new_state, "accept_hist", None)
@@ -389,6 +416,8 @@ def run_fit_loop(
                     arrays,
                     meta={"llh_history": hist, **(ckpt_meta or {})},
                 )
+            if tel is not None:
+                tel.event("checkpoint", step=int(state.it))
     if extract_F is None:
         # state-resident mode (fit_state / device annealing): hand back the
         # converged TrainState with NO host F fetch — the only scalars
@@ -398,6 +427,64 @@ def run_fit_loop(
     return FitResult(
         F=F, sumF=F.sum(axis=0), llh=final_llh,
         num_iters=iters, llh_history=tuple(hist),
+    )
+
+
+def _abort_nonfinite(state, new_state, llh_t: float, hist) -> None:
+    """Non-finite-LLH sentinel (SURVEY §5 / ISSUE 4): diagnose, dump,
+    abort.
+
+    A NaN/inf LLH means the optimizer state is already poisoned — every
+    further iteration is wasted accelerator time and the convergence test
+    (|1 - new/old|) can never fire on NaN, so the loop would silently burn
+    to max_iters. Diagnostics are computed DEVICE-SIDE (reductions on the
+    possibly-globally-sharded F return replicated scalars, so this works
+    under multi-controller where np.asarray(F) would throw), emitted as a
+    `nonfinite` telemetry event, and dumped to <telemetry>/nonfinite_dump
+    .npz before raising FloatingPointError."""
+    import jax.numpy as jnp
+
+    from bigclam_tpu.obs import telemetry as _obs
+
+    F = state.F
+    diag = {
+        "iter": int(state.it),
+        "llh": llh_t,
+        "f_nonfinite": int(jnp.size(F) - jnp.isfinite(F).sum()),
+        "f_min": float(jnp.min(F)),
+        "f_max": float(jnp.max(F)),
+        "sumF_min": float(jnp.min(state.sumF)),
+        "sumF_max": float(jnp.max(state.sumF)),
+        "llh_tail": [float(v) for v in hist[-5:]],
+    }
+    ah = getattr(new_state, "accept_hist", None)
+    try:
+        diag["accept_hist"] = np.asarray(ah).tolist() if ah is not None else None
+    except Exception:            # not fully addressable on this process
+        diag["accept_hist"] = None
+    tel = _obs.current()
+    dump = ""
+    if tel is not None:
+        tel.event("nonfinite", **diag)
+        if is_primary():
+            import os
+
+            dump = os.path.join(tel.directory, "nonfinite_dump.npz")
+            np.savez(
+                dump,
+                **{
+                    k: np.asarray(v)
+                    for k, v in diag.items()
+                    if v is not None
+                },
+            )
+        tel.finalize()           # the report must exist even on abort
+    raise FloatingPointError(
+        f"non-finite LLH {llh_t} at iteration {diag['iter']}: "
+        f"{diag['f_nonfinite']} non-finite F entries, "
+        f"F range [{diag['f_min']:.3g}, {diag['f_max']:.3g}], "
+        f"accept_hist={diag['accept_hist']}"
+        + (f"; diagnostics dumped to {dump}" if dump else "")
     )
 
 
@@ -419,7 +506,12 @@ def restore_checkpoint(checkpoints, expected_meta: dict, state_from_arrays):
     restored = checkpoints.restore()
     if restored is None:
         return None, ()
-    _, arrays, meta = restored
+    ckpt_step, arrays, meta = restored
+    from bigclam_tpu.obs import telemetry as _obs
+
+    tel = _obs.current()
+    if tel is not None:
+        tel.event("restore", step=int(ckpt_step))
     soft = {"n_pad", "k_pad"}
     for key, val in expected_meta.items():
         if key in soft:
@@ -635,6 +727,9 @@ class BigClamModel:
         )
         self._step_cache = {step_cfg_key(cfg): (self._step, self.engaged_path)}
         self.path_reason = getattr(self, "_csr_reason", "")
+        from bigclam_tpu.obs import note_step_build
+
+        note_step_build(cfg, "BigClamModel")
         log_engaged_path("BigClamModel", self.engaged_path, self.path_reason)
 
     def rebuild_step(self) -> None:
@@ -651,6 +746,9 @@ class BigClamModel:
             self._step_cache[key] = make_train_step(
                 self._edges, self.cfg, tiles=self._tiles, k_pad=self.k_pad
             )
+            from bigclam_tpu.obs import note_step_build
+
+            note_step_build(self.cfg, "BigClamModel")
         self._step, self.engaged_path = self._step_cache[key]
 
     @property

@@ -123,6 +123,24 @@ from bigclam_tpu.models.bigclam import FitResult
 from bigclam_tpu.utils.dist import is_primary
 
 
+def _cycle_event(cycle: int, llh: float, kept: bool, iters: int) -> None:
+    """Telemetry for one annealing cycle (host and device schedules share
+    this): `cycle` events make the restart dynamics — which kicks were
+    kept, how long each cycle annealed — readable from events.jsonl, and
+    each completed cycle beats the stall heartbeat."""
+    from bigclam_tpu.obs import telemetry as _obs
+
+    tel = _obs.current()
+    if tel is None:
+        return
+    tel.event(
+        "cycle", cycle=int(cycle), llh=float(llh), kept=bool(kept),
+        iters=int(iters),
+    )
+    if tel.heartbeat is not None:
+        tel.heartbeat.beat(cycle=int(cycle), llh=float(llh))
+
+
 def auto_quality_max_p(
     num_nodes: int, avg_deg: float, floor: float = 0.0
 ) -> float:
@@ -997,7 +1015,9 @@ def fit_quality(
             total_iters += res.num_iters
             cycles_llh.append(res.llh)
             prev_best = best.llh if best is not None else None
-            if best is None or res.llh > best.llh:
+            kept = best is None or res.llh > best.llh
+            _cycle_event(cycle, res.llh, kept, res.num_iters)
+            if kept:
                 best = res
                 F_cur = res.F              # kick accepted: anneal from here
             # else: converged worse than the kept state — revert the kick
@@ -1434,6 +1454,9 @@ def fit_quality_device(
                 total_iters += iters
                 profile.count("anneal_cycles")
                 cycles_llh.append(llh)
+                _cycle_event(
+                    cycle, llh, best_llh is None or llh > best_llh, iters
+                )
                 prev_best = best_llh
                 if best_llh is None or llh > best_llh:
                     best_state, best_llh = final, llh

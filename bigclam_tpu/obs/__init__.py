@@ -1,0 +1,47 @@
+"""Run-scoped telemetry (ISSUE 4): one structured event log + device-memory
+watermarks + compile counters + stall heartbeat + final run report, shared
+by every entry point (cli fit/sweep/ingest, bench.py, the gate scripts, the
+multihost workers).
+
+The reference's only instrumentation was `println` of iteration and LLH
+(SURVEY.md §5). The pre-existing slices — MetricsLogger JSONL, StageProfile
+/ IngestProfile, overlap_report — are SINKS of this layer now: they keep
+their local contracts (per-step JSONL, per-stage seconds in artifacts) and
+additionally forward into the active RunTelemetry, so one events.jsonl
+carries steps, stage transitions, checkpoint saves, compiles, memory
+watermarks and stalls under a single schema (obs.schema).
+
+Activation is a process-global current-telemetry slot (install/current):
+entry points create and install a RunTelemetry; library code asks
+`current()` and does nothing when telemetry is off — the off path costs one
+None check, which is what keeps the fit loop's overhead pinned under 2%
+(tests/test_telemetry.py).
+"""
+
+from bigclam_tpu.obs.heartbeat import Heartbeat
+from bigclam_tpu.obs.schema import (
+    EVENT_KINDS,
+    SCHEMA_VERSION,
+    validate_event,
+    validate_events_file,
+)
+from bigclam_tpu.obs.telemetry import (
+    RunTelemetry,
+    current,
+    install,
+    note_step_build,
+    uninstall,
+)
+
+__all__ = [
+    "EVENT_KINDS",
+    "Heartbeat",
+    "RunTelemetry",
+    "SCHEMA_VERSION",
+    "current",
+    "install",
+    "note_step_build",
+    "uninstall",
+    "validate_event",
+    "validate_events_file",
+]

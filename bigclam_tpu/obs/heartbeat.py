@@ -1,0 +1,110 @@
+"""Stall heartbeat: a daemon thread that notices when NOTHING completes.
+
+Multihost collectives hang with zero output (a wedged DCN hop blocks every
+process inside the same jitted step), and a host-side stage that silently
+spins looks identical to progress from the outside. The heartbeat inverts
+the burden: fit iterations and stage completions call `beat()`, and when no
+beat lands within `deadline_s` the thread emits a `stall` event — last
+known progress, how long the run has been silent, host RSS, and a device
+memory snapshot — to the telemetry event log (always) and to stderr
+(unless the run is quiet; --quiet silences the echo, never the JSONL).
+
+The thread samples, it never interrupts: a stalled collective cannot be
+cancelled from Python anyway, so the job is to make the hang *visible* and
+attributable (which phase, which process, what memory state) rather than
+to kill it. Repeated stalls re-emit once per deadline, so a 30-minute hang
+produces a timeline, not one line.
+"""
+
+from __future__ import annotations
+
+import sys
+import threading
+import time
+from typing import Optional
+
+
+class Heartbeat:
+    """Daemon watchdog bound to a RunTelemetry (`telemetry.event` is the
+    sink; it is thread-safe). Deterministically testable: `poll_s` pins the
+    check cadence and `stop()` joins the thread."""
+
+    def __init__(
+        self,
+        telemetry,
+        deadline_s: float,
+        echo: bool = True,
+        poll_s: Optional[float] = None,
+    ):
+        self.telemetry = telemetry
+        self.deadline_s = float(deadline_s)
+        self.echo = echo
+        self.poll_s = poll_s if poll_s is not None else max(
+            self.deadline_s / 4.0, 0.01
+        )
+        self.stalls = 0
+        self._last_beat = time.monotonic()
+        self._last_emit = self._last_beat
+        self._progress: dict = {}
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> "Heartbeat":
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._run, name="bigclam-heartbeat", daemon=True
+            )
+            self._thread.start()
+        return self
+
+    def beat(self, **progress) -> None:
+        """Record forward progress (called from the fit loop / stage sink;
+        must stay cheap — two attribute writes under a lock)."""
+        with self._lock:
+            self._last_beat = time.monotonic()
+            self._last_emit = self._last_beat
+            if progress:
+                self._progress = progress
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=max(self.poll_s * 4, 1.0))
+            self._thread = None
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.poll_s):
+            now = time.monotonic()
+            with self._lock:
+                silent = now - self._last_beat
+                since_emit = now - self._last_emit
+                progress = dict(self._progress)
+            if silent < self.deadline_s or since_emit < self.deadline_s:
+                continue
+            with self._lock:
+                self._last_emit = now
+            self._emit(silent, progress)
+
+    def _emit(self, silent_s: float, progress: dict) -> None:
+        from bigclam_tpu.utils.profiling import current_rss_bytes
+
+        self.stalls += 1
+        rss = current_rss_bytes()
+        devices = self.telemetry.device_memory_snapshot()
+        self.telemetry.event(
+            "stall",
+            silent_s=round(silent_s, 3),
+            rss_bytes=rss,
+            progress=progress,
+            devices=devices,
+        )
+        if self.echo:
+            print(
+                f"[bigclam] STALL: no step/stage completed for "
+                f"{silent_s:.0f}s (deadline {self.deadline_s:g}s); "
+                f"last progress: {progress or 'none'}; "
+                f"rss {rss >> 20} MiB",
+                file=sys.stderr,
+                flush=True,
+            )

@@ -1,0 +1,230 @@
+"""Render a telemetry directory human-readable (`cli report`) and merge
+per-process run reports.
+
+A multi-controller run leaves `run_report.json` (process 0) plus
+`run_report.p<i>.json` siblings — each written independently at finalize,
+with no cross-process synchronization. Merging happens HERE, at read time:
+stage seconds are reported per process (wall-clock buckets across
+processes do not add — every process spans the same wall time), device
+peaks union (each process only sees its own addressable devices), and
+compile counts sum (each process compiles its own executables).
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+from typing import Dict, List, Optional, Tuple
+
+from bigclam_tpu.obs.schema import summarize_kinds, validate_events_file
+from bigclam_tpu.obs.telemetry import EVENTS_NAME, REPORT_NAME
+
+
+def load_reports(directory: str) -> List[dict]:
+    """Every run_report*.json in the dir, primary first then by pid."""
+    paths = sorted(
+        glob.glob(os.path.join(directory, "run_report*.json")),
+        key=lambda p: (os.path.basename(p) != REPORT_NAME, p),
+    )
+    out = []
+    for p in paths:
+        with open(p) as f:
+            out.append(json.load(f))
+    return out
+
+
+def load_events(directory: str) -> Optional[List[dict]]:
+    path = os.path.join(directory, EVENTS_NAME)
+    if not os.path.exists(path):
+        return None
+    events = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if line:
+                try:
+                    events.append(json.loads(line))
+                except ValueError:
+                    events.append({"kind": "?", "unparsed": line[:80]})
+    return events
+
+
+def merge_reports(reports: List[dict]) -> dict:
+    """One cross-process view of a run (see module docstring for the
+    per-field merge rules)."""
+    if not reports:
+        return {}
+    merged = {
+        "run": reports[0].get("run"),
+        "entry": reports[0].get("entry"),
+        "processes_reported": len(reports),
+        "processes_expected": max(
+            int(r.get("processes", 1) or 1) for r in reports
+        ),
+        "wall_s": max(float(r.get("wall_s", 0.0)) for r in reports),
+        "stages_by_pid": {
+            str(r.get("pid", "?")): r.get("stages", {}).get("seconds", {})
+            for r in reports
+        },
+        "stalls": sum(
+            int(r.get("heartbeat", {}).get("stalls", 0)) for r in reports
+        ),
+        "final": reports[0].get("final", {}),
+    }
+    device_peak: Dict[str, dict] = {}
+    compiles = {"count": 0, "backend_compiles": 0, "step_builds": 0,
+                "backend_compile_s": 0.0, "by_key": {}}
+    events: Dict[str, int] = {}
+    for r in reports:
+        for dev, stats in r.get("memory", {}).get("device_peak", {}).items():
+            seen = device_peak.setdefault(dev, dict(stats))
+            for key in ("bytes_in_use", "peak_bytes_in_use"):
+                v = stats.get(key)
+                if v is not None and (
+                    seen.get(key) is None or v > seen[key]
+                ):
+                    seen[key] = v
+        comp = r.get("compiles", {})
+        for key in ("count", "backend_compiles", "step_builds"):
+            compiles[key] += int(comp.get(key, 0))
+        compiles["backend_compile_s"] = round(
+            compiles["backend_compile_s"]
+            + float(comp.get("backend_compile_s", 0.0)),
+            4,
+        )
+        for key, stats in comp.get("by_key", {}).items():
+            agg = compiles["by_key"].setdefault(
+                key, {"builds": 0, "compiles": 0}
+            )
+            agg["builds"] += int(stats.get("builds", 0))
+            agg["compiles"] += int(stats.get("compiles", 0))
+        for kind, n in r.get("events", {}).items():
+            events[kind] = events.get(kind, 0) + int(n)
+    merged["device_peak"] = device_peak
+    merged["compiles"] = compiles
+    merged["events"] = events
+    return merged
+
+
+def _fmt_bytes(v: Optional[int]) -> str:
+    if v is None:
+        return "-"
+    if v >= 1 << 30:
+        return f"{v / (1 << 30):.2f} GiB"
+    if v >= 1 << 20:
+        return f"{v / (1 << 20):.1f} MiB"
+    return f"{v} B"
+
+
+def render(directory: str) -> Tuple[str, int]:
+    """(human-readable report text, error count). Errors are schema
+    violations in events.jsonl plus a missing-artifact note; the CLI maps
+    error count > 0 to a nonzero exit so CI can gate on a telemetry dir."""
+    lines: List[str] = []
+    errors = 0
+    reports = load_reports(directory)
+    events = load_events(directory)
+    if not reports and events is None:
+        return f"{directory}: no telemetry artifacts found", 1
+
+    merged = merge_reports(reports)
+    if merged:
+        lines.append(
+            f"run {merged['run']}  entry={merged['entry']}  "
+            f"wall {merged['wall_s']:.1f}s  "
+            f"processes {merged['processes_reported']}"
+            f"/{merged['processes_expected']}"
+        )
+        if merged["processes_reported"] < merged["processes_expected"]:
+            errors += 1
+            lines.append(
+                "  WARNING: fewer per-process reports than processes — "
+                "a process died before finalize"
+            )
+        lines.append("")
+        lines.append("stage seconds (per process):")
+        for pid, stages in sorted(merged["stages_by_pid"].items()):
+            if not stages:
+                lines.append(f"  p{pid}: (none)")
+                continue
+            total = sum(stages.values())
+            lines.append(f"  p{pid}: total {total:.1f}s")
+            for name, secs in sorted(
+                stages.items(), key=lambda kv: -kv[1]
+            ):
+                pct = 100.0 * secs / total if total else 0.0
+                lines.append(f"    {name:<20} {secs:>9.2f}s  {pct:5.1f}%")
+        lines.append("")
+        lines.append("device memory watermarks (max over samples):")
+        if merged["device_peak"]:
+            for dev, stats in sorted(merged["device_peak"].items()):
+                lines.append(
+                    f"  {dev:<24} in_use {_fmt_bytes(stats.get('bytes_in_use')):>10}  "
+                    f"peak {_fmt_bytes(stats.get('peak_bytes_in_use')):>10}  "
+                    f"limit {_fmt_bytes(stats.get('bytes_limit')):>10}"
+                )
+        else:
+            lines.append(
+                "  (none sampled — CPU backend or device telemetry off)"
+            )
+        comp = merged["compiles"]
+        lines.append("")
+        lines.append(
+            f"compiles: {comp['count']} "
+            f"(backend {comp['backend_compiles']}, "
+            f"{comp['backend_compile_s']:.1f}s; "
+            f"step builds {comp['step_builds']})"
+        )
+        for key, stats in sorted(comp["by_key"].items()):
+            lines.append(
+                f"  {key:<40} builds {stats['builds']}  "
+                f"compiles {stats['compiles']}"
+            )
+        if merged["stalls"]:
+            # stalls are a finding, not a schema error — reported, not
+            # counted into the exit code
+            lines.append("")
+            lines.append(f"STALLS: {merged['stalls']} heartbeat deadline(s) hit")
+        if merged["final"]:
+            lines.append("")
+            lines.append("final: " + json.dumps(merged["final"]))
+
+    if events is not None:
+        n, schema_errors = validate_events_file(
+            os.path.join(directory, EVENTS_NAME)
+        )
+        errors += len(schema_errors)
+        lines.append("")
+        lines.append(
+            f"events.jsonl: {n} events "
+            + json.dumps(summarize_kinds(events))
+        )
+        if schema_errors:
+            lines.append(f"  SCHEMA ERRORS ({len(schema_errors)}):")
+            lines.extend(f"    {e}" for e in schema_errors[:20])
+        steps = [
+            e for e in events
+            if e.get("kind") == "step"
+            and isinstance(e.get("llh"), (int, float))
+        ]
+        if steps:
+            first, last = steps[0], steps[-1]
+            lines.append(
+                f"  steps: {len(steps)}  iter {first.get('iter')}→"
+                f"{last.get('iter')}  llh {first.get('llh'):.6g}→"
+                f"{last.get('llh'):.6g}"
+            )
+        stalls = [e for e in events if e.get("kind") == "stall"]
+        for s in stalls[:5]:
+            lines.append(
+                f"  stall at t={s.get('t')}s: silent {s.get('silent_s')}s, "
+                f"last progress {s.get('progress')}"
+            )
+    elif merged and merged["events"].get("start"):
+        lines.append("")
+        lines.append(
+            "events.jsonl: absent (non-primary dir? events are written by "
+            "process 0 only)"
+        )
+    return "\n".join(lines), errors

@@ -1,0 +1,118 @@
+"""The events.jsonl schema: one flat JSON object per line, validated by a
+dependency-free checker (no jsonschema in the container; the rules below ARE
+the schema, shared by scripts/telemetry_smoke.py, `cli report`, and the
+tier-1 test).
+
+Base fields on EVERY event:
+
+    v     int     schema version (SCHEMA_VERSION)
+    run   str     run id — one RunTelemetry instance = one run
+    pid   int     jax process index (0 before/without jax.distributed)
+    t     float   seconds since the RunTelemetry was created
+    kind  str     one of EVENT_KINDS
+
+Kind-specific REQUIRED fields are listed in EVENT_KINDS; extra fields are
+always allowed (events stay extensible without a schema bump — consumers
+must ignore unknown keys). Unknown kinds are invalid: the smoke gate exists
+to catch a producer drifting from this file.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, List, Tuple
+
+SCHEMA_VERSION = 1
+
+_NUM = (int, float)
+
+# kind -> {required_field: allowed types}
+EVENT_KINDS = {
+    "start": {"entry": (str,)},            # run began (entry = fit/sweep/...)
+    "end": {"wall_s": _NUM},               # run finalized
+    "step": {"iter": (int,), "llh": _NUM},  # one optimizer iteration
+    "metric": {},                          # non-step MetricsLogger record
+    "stage": {"name": (str,), "seconds": _NUM},   # stage completed
+    "memory": {"tag": (str,), "devices": (list,)},  # device-mem watermark
+    "checkpoint": {"step": (int,)},        # checkpoint saved
+    "restore": {"step": (int,)},           # checkpoint restored
+    "compile": {"name": (str,), "seconds": _NUM},  # backend compile observed
+    "model_build": {"model": (str,), "path": (str,)},  # trainer compiled
+    "distributed_init": {"processes": (int,)},
+    "cycle": {"cycle": (int,), "llh": _NUM},   # quality annealing cycle
+    "stall": {"silent_s": _NUM, "rss_bytes": (int,)},  # heartbeat deadline hit
+    "nonfinite": {"iter": (int,)},         # non-finite LLH sentinel fired
+    "ingest": {"edges": (int,)},           # graph cache compiled
+    "graph_load": {"source": (str,)},      # graph materialized on host
+    "note": {},                            # freeform annotation
+}
+
+_BASE = {"v": (int,), "run": (str,), "pid": (int,), "t": _NUM, "kind": (str,)}
+
+
+def validate_event(event) -> List[str]:
+    """Schema errors for one decoded event dict; [] when valid."""
+    errors: List[str] = []
+    if not isinstance(event, dict):
+        return [f"event is {type(event).__name__}, not an object"]
+    for field, types in _BASE.items():
+        if field not in event:
+            errors.append(f"missing base field {field!r}")
+        elif not isinstance(event[field], types) or isinstance(
+            event[field], bool
+        ):
+            errors.append(
+                f"{field!r} is {type(event[field]).__name__}, "
+                f"want {'/'.join(t.__name__ for t in types)}"
+            )
+    if errors:
+        return errors
+    if event["v"] != SCHEMA_VERSION:
+        errors.append(f"schema version {event['v']} != {SCHEMA_VERSION}")
+    kind = event["kind"]
+    required = EVENT_KINDS.get(kind)
+    if required is None:
+        return errors + [f"unknown kind {kind!r}"]
+    for field, types in required.items():
+        if field not in event:
+            errors.append(f"kind {kind!r} missing field {field!r}")
+        elif not isinstance(event[field], types) or isinstance(
+            event[field], bool
+        ):
+            errors.append(
+                f"{kind}.{field} is {type(event[field]).__name__}, "
+                f"want {'/'.join(t.__name__ for t in types)}"
+            )
+    return errors
+
+
+def validate_events_file(path: str) -> Tuple[int, List[str]]:
+    """(number of events, errors) for a whole events.jsonl; every line must
+    parse as JSON and validate. Error strings carry 1-based line numbers."""
+    import json
+
+    n = 0
+    errors: List[str] = []
+    with open(path) as f:
+        for lineno, line in enumerate(f, 1):
+            line = line.strip()
+            if not line:
+                continue
+            n += 1
+            try:
+                event = json.loads(line)
+            except ValueError as e:
+                errors.append(f"line {lineno}: not JSON ({e})")
+                continue
+            errors.extend(
+                f"line {lineno}: {msg}" for msg in validate_event(event)
+            )
+    return n, errors
+
+
+def summarize_kinds(events: Iterable[dict]) -> dict:
+    """{kind: count} over decoded events (report + rendering helper)."""
+    out: dict = {}
+    for e in events:
+        k = e.get("kind", "?")
+        out[k] = out.get(k, 0) + 1
+    return out

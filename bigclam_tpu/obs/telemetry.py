@@ -1,0 +1,575 @@
+"""RunTelemetry: the run-scoped telemetry object every entry point threads
+through (see obs.__init__ for the architecture).
+
+Design constraints that shaped this file:
+
+* **jax-free at import.** `cli ingest` runs on data-prep hosts and must not
+  pay the jax import (RSS + time); this module only touches jax when the
+  entry point opted into device telemetry (`device_memory=True`) or jax is
+  already loaded (`sys.modules` probe — never triggers an import).
+
+* **Single-writer event log.** Under multi-controller jax, N processes
+  appending one events.jsonl would interleave. Like MetricsLogger, the
+  primary gate is decided lazily — but telemetry starts BEFORE
+  jax.distributed.initialize (the CLI creates it before the model factory
+  joins the process group), when every process reads index 0. Events are
+  therefore buffered in memory until `commit_gate()` (auto on first event
+  by default; entry points that will join a process group construct with
+  `auto_gate=False` and commit after the join), and only the primary opens
+  the file. Every process still counts events locally for its own report.
+
+* **Compile visibility.** jax.monitoring duration listeners fire
+  `/jax/core/compile/backend_compile_duration` per real XLA compile (and
+  jaxpr_trace per retrace) on both the 0.4 and 0.5 lines — a module-level
+  listener dispatches to the installed telemetry. Where the listener API
+  is absent, `note_step_build` (called at every trainer step-cache miss,
+  keyed by models.bigclam.step_cfg_key) still counts step builds — the
+  fallback signal, and on both paths the per-key attribution that makes a
+  sweep silently recompiling per-K visible.
+
+* **Thread safety.** The heartbeat emits from its own thread; event writes
+  and counter updates take one lock.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import sys
+import threading
+import time
+from typing import Any, Dict, List, Optional, TextIO
+
+from bigclam_tpu.obs.schema import SCHEMA_VERSION
+from bigclam_tpu.utils.profiling import current_rss_bytes, peak_rss_bytes
+
+EVENTS_NAME = "events.jsonl"
+REPORT_NAME = "run_report.json"
+
+_CURRENT: Optional["RunTelemetry"] = None
+# jax.monitoring listener registration is irreversible on the public API
+# (there is clear_event_listeners but no targeted unregister on 0.4.x), so
+# ONE module-level listener is registered on first need and dispatches to
+# whatever telemetry is currently installed.
+_MONITOR_STATE = {"registered": False, "available": None}
+
+
+def current() -> Optional["RunTelemetry"]:
+    """The installed telemetry, or None when observability is off — the
+    whole off-path cost at instrumentation sites is this None check."""
+    return _CURRENT
+
+
+def install(tel: "RunTelemetry") -> "RunTelemetry":
+    global _CURRENT
+    _CURRENT = tel
+    return tel
+
+
+def uninstall(tel: Optional["RunTelemetry"] = None) -> None:
+    """Clear the slot (only if `tel` still owns it, when given)."""
+    global _CURRENT
+    if tel is None or _CURRENT is tel:
+        _CURRENT = None
+
+
+def note_step_build(cfg, model: str = "") -> None:
+    """Record a trainer step build keyed by step_cfg_key — called at every
+    step-cache MISS (model __init__ / rebuild_step), so per-cfg-key build
+    counts exist even where jax.monitoring listeners do not. No-op with
+    telemetry off."""
+    tel = _CURRENT
+    if tel is None:
+        return
+    from bigclam_tpu.models.bigclam import step_cfg_key
+
+    key = repr(step_cfg_key(cfg))
+    # deterministic short digest (repr of the frozen dataclass is stable;
+    # hash() is not across processes), so per-process reports merge
+    digest = hashlib.sha1(key.encode()).hexdigest()[:10]
+    label = f"{model}:{digest}" if model else digest
+    tel.record_step_build(label)
+
+
+def _on_monitoring_duration(name: str, secs: float, **kw) -> None:
+    tel = _CURRENT
+    if tel is not None and "/compile/" in name:
+        tel._compile_observed(name, secs)
+
+
+def _ensure_monitor() -> bool:
+    """Register the jax.monitoring duration listener once; False when the
+    API is unavailable (note_step_build counts remain the compile signal)."""
+    if _MONITOR_STATE["registered"]:
+        return True
+    if _MONITOR_STATE["available"] is False:
+        return False
+    try:
+        from jax import monitoring
+
+        monitoring.register_event_duration_secs_listener(
+            _on_monitoring_duration
+        )
+    except Exception:
+        _MONITOR_STATE["available"] = False
+        return False
+    _MONITOR_STATE["registered"] = True
+    _MONITOR_STATE["available"] = True
+    return True
+
+
+def _json_default(obj):
+    """numpy scalars/arrays slip into event fields from callers (an int
+    from a manifest, an accept histogram) — serialize them as their
+    Python values instead of crashing the event log mid-run."""
+    if hasattr(obj, "item") and getattr(obj, "ndim", None) == 0:
+        return obj.item()
+    if hasattr(obj, "tolist"):
+        return obj.tolist()
+    return str(obj)
+
+
+def _finite_safe(obj):
+    """Replace non-finite floats with their repr strings ("nan", "inf",
+    "-inf") recursively. json.dumps would otherwise write literal NaN —
+    not JSON — and the one event that carries a NaN by design is the
+    nonfinite sentinel's, exactly the line strict consumers (jq, log
+    pipelines) must be able to parse."""
+    import math as _math
+
+    if isinstance(obj, float):
+        return obj if _math.isfinite(obj) else repr(obj)
+    if isinstance(obj, dict):
+        return {k: _finite_safe(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [_finite_safe(v) for v in obj]
+    if hasattr(obj, "item") or hasattr(obj, "tolist"):
+        return _finite_safe(_json_default(obj))
+    return obj
+
+
+def _resolve_run_id(directory: str) -> str:
+    """One run id per telemetry DIRECTORY, shared across the processes of
+    a multi-controller run with no coordinator: the first process to
+    os.link its candidate onto `run_id` wins (atomic on POSIX), everyone
+    else reads the winner. A dir reused across runs keeps its id — one
+    telemetry dir = one run is the contract (events append; resume after
+    a crash correlates under the same id)."""
+    path = os.path.join(directory, "run_id")
+    rid = f"{int(time.time()):x}-{os.urandom(3).hex()}"
+    tmp = f"{path}.{os.getpid()}.tmp"
+    try:
+        with open(tmp, "w") as f:
+            f.write(rid)
+        os.link(tmp, path)
+        return rid
+    except OSError:
+        pass                    # somebody else claimed it (or no link())
+    finally:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+    for _ in range(100):        # winner may still be mid-write
+        try:
+            with open(path) as f:
+                got = f.read().strip()
+            if got:
+                return got
+        except OSError:
+            pass
+        time.sleep(0.01)
+    return rid
+
+
+def _jax_loaded() -> bool:
+    return "jax" in sys.modules
+
+
+def _jax_ready() -> bool:
+    """True when asking jax for process/device state cannot change the
+    world: the backend is already up, or the process group is joined.
+
+    Telemetry runs BEFORE jax.distributed.initialize (the CLI constructs
+    it first), and jax.process_index()/local_devices() on a cold jax
+    INITIALIZE the backend — after which distributed.initialize raises
+    ("must be called before any JAX computations"). Every telemetry read
+    of jax state therefore goes through this guard; pre-init the answers
+    are the definitional defaults (index 0, no devices) anyway."""
+    if not _jax_loaded():
+        return False
+    try:
+        from bigclam_tpu.utils.compat import distributed_is_initialized
+
+        if distributed_is_initialized():
+            return True
+        from jax._src import xla_bridge
+
+        if hasattr(xla_bridge, "backends_are_initialized"):
+            return bool(xla_bridge.backends_are_initialized())
+        return bool(getattr(xla_bridge, "_backends", None))
+    except Exception:
+        return False
+
+
+def _process_index() -> int:
+    """jax.process_index when jax is UP (see _jax_ready); 0 on jax-free
+    entries (ingest) and before any backend/process-group exists."""
+    if not _jax_ready():
+        return 0
+    try:
+        import jax
+
+        return jax.process_index()
+    except Exception:
+        return 0
+
+
+def _process_count() -> int:
+    if not _jax_ready():
+        return 1
+    try:
+        import jax
+
+        return jax.process_count()
+    except Exception:
+        return 1
+
+
+class RunTelemetry:
+    """One run = one instance = one telemetry directory.
+
+    Usage (the CLI pattern)::
+
+        tel = RunTelemetry(dir, entry="fit", heartbeat_s=args.heartbeat_s,
+                           quiet=args.quiet)
+        with tel:                       # install() + finalize() on exit
+            ... run ...
+            tel.set_final({"llh": ...})
+
+    Artifacts: `events.jsonl` (primary process only) and `run_report.json`
+    (primary) / `run_report.p<i>.json` (others — merged by obs.report at
+    render time, no cross-process synchronization needed).
+    """
+
+    def __init__(
+        self,
+        directory: str,
+        entry: str = "",
+        run_id: Optional[str] = None,
+        heartbeat_s: float = 0.0,
+        quiet: bool = False,
+        device_memory: bool = True,
+        auto_gate: bool = True,
+    ):
+        os.makedirs(directory, exist_ok=True)
+        self.directory = directory
+        self.entry = entry
+        self.run_id = run_id or _resolve_run_id(directory)
+        self.quiet = quiet
+        self.device_memory = device_memory
+        self.auto_gate = auto_gate
+        self.started_unix = time.time()
+        self._t0 = time.perf_counter()
+        self._lock = threading.RLock()
+        self._fh: Optional[TextIO] = None
+        self._gated = False
+        self._pending: List[str] = []
+        self._finalized = False
+        self.event_counts: Dict[str, int] = {}
+        self.stage_seconds: Dict[str, float] = {}
+        self.stage_counts: Dict[str, int] = {}
+        # tag -> number of watermark samples; dev -> running max stats
+        self.watermark_tags: Dict[str, int] = {}
+        self.device_peak: Dict[str, Dict[str, Optional[int]]] = {}
+        self.compiles = {
+            "backend_compiles": 0,
+            "backend_compile_s": 0.0,
+            "retraces": 0,
+            "by_key": {},
+            "step_builds": 0,
+            "monitor": False,
+        }
+        self._compile_key = ""
+        self.final: Dict[str, Any] = {}
+        self.heartbeat = None
+        if heartbeat_s and heartbeat_s > 0:
+            from bigclam_tpu.obs.heartbeat import Heartbeat
+
+            self.heartbeat = Heartbeat(
+                self, heartbeat_s, echo=not quiet
+            ).start()
+        if device_memory or _jax_loaded():
+            self.compiles["monitor"] = _ensure_monitor()
+        self.event("start", entry=entry)
+
+    # ------------------------------------------------------------- events
+    def event(self, kind: str, **fields) -> None:
+        """Append one schema event (obs.schema). Thread-safe; buffered
+        until the primary gate is committed (see class docstring)."""
+        rec = {
+            "v": SCHEMA_VERSION,
+            "run": self.run_id,
+            "pid": _process_index(),
+            "t": round(time.perf_counter() - self._t0, 4),
+            "kind": kind,
+            **fields,
+        }
+        try:
+            line = json.dumps(rec, default=_json_default, allow_nan=False)
+        except ValueError:       # a non-finite float somewhere in fields
+            line = json.dumps(
+                _finite_safe(rec), default=_json_default, allow_nan=False
+            )
+        with self._lock:
+            self.event_counts[kind] = self.event_counts.get(kind, 0) + 1
+            if not self._gated:
+                if self.auto_gate:
+                    self._commit_gate_locked()
+                else:
+                    self._pending.append(line)
+                    return
+            if self._fh is not None:
+                self._fh.write(line + "\n")
+                self._fh.flush()
+
+    def commit_gate(self) -> None:
+        """Decide the single-writer gate NOW (call once jax.distributed
+        membership is known); flushes buffered events. Idempotent."""
+        with self._lock:
+            self._commit_gate_locked()
+
+    def _commit_gate_locked(self) -> None:
+        if self._gated:
+            return
+        self._gated = True
+        if _process_index() == 0:
+            self._fh = open(os.path.join(self.directory, EVENTS_NAME), "a")
+            for line in self._pending:
+                self._fh.write(line + "\n")
+            self._fh.flush()
+        self._pending = []
+
+    # -------------------------------------------------------------- sinks
+    def stage_complete(self, name: str, seconds: float) -> None:
+        """StageProfile sink: stage wall-clock + a memory watermark at the
+        stage boundary + a heartbeat beat."""
+        with self._lock:
+            self.stage_seconds[name] = (
+                self.stage_seconds.get(name, 0.0) + seconds
+            )
+            self.stage_counts[name] = self.stage_counts.get(name, 0) + 1
+        self.event("stage", name=name, seconds=round(seconds, 4))
+        self.watermark(f"stage:{name}")
+        if self.heartbeat is not None:
+            self.heartbeat.beat(stage=name)
+
+    def metric_record(self, record: Dict[str, Any]) -> None:
+        """MetricsLogger sink: per-step records land as `step` events,
+        other records (sweep per-K lines) as `metric`. The logger's own
+        relative "t" is dropped — telemetry stamps run-relative time."""
+        fields = {k: v for k, v in record.items() if k != "t"}
+        kind = "step" if "iter" in fields else "metric"
+        self.event(kind, **fields)
+
+    def step_beat(self, it: int, llh: float) -> None:
+        """Fit-loop heartbeat hook (run_fit_loop): progress only, no event
+        — step events arrive via the MetricsLogger sink when one is wired."""
+        if self.heartbeat is not None:
+            self.heartbeat.beat(iter=int(it), llh=float(llh))
+
+    # ------------------------------------------------------------- memory
+    def device_memory_snapshot(self) -> List[dict]:
+        """Per-device memory_stats right now; [] when device telemetry is
+        off or no jax backend is up yet (_jax_ready — sampling must never
+        INITIALIZE a backend: a pre-distributed-init sample would poison
+        jax.distributed.initialize, and there is nothing on any device to
+        measure before the backend exists anyway). CPU backends report
+        null stats (their allocator does not track — the shape of the
+        record survives so TPU runs and tests share one schema)."""
+        if not (self.device_memory and _jax_ready()):
+            return []
+        try:
+            import jax
+
+            devices = jax.local_devices()
+        except Exception:
+            return []
+        out = []
+        for d in devices:
+            try:
+                stats = d.memory_stats()
+            except Exception:
+                stats = None
+            stats = stats or {}
+            out.append(
+                {
+                    "device": str(d),
+                    "bytes_in_use": stats.get("bytes_in_use"),
+                    "peak_bytes_in_use": stats.get("peak_bytes_in_use"),
+                    "bytes_limit": stats.get("bytes_limit"),
+                }
+            )
+        return out
+
+    def watermark(self, tag: str) -> List[dict]:
+        """Sample device memory, fold into the per-device running peaks,
+        and emit a `memory` event. Called at stage boundaries (the sink)
+        and explicitly after big placements (model build, edge upload)."""
+        devices = self.device_memory_snapshot()
+        if not devices:
+            return []
+        with self._lock:
+            self.watermark_tags[tag] = self.watermark_tags.get(tag, 0) + 1
+            for d in devices:
+                peak = self.device_peak.setdefault(
+                    d["device"],
+                    {"bytes_in_use": None, "peak_bytes_in_use": None,
+                     "bytes_limit": d["bytes_limit"]},
+                )
+                for key in ("bytes_in_use", "peak_bytes_in_use"):
+                    v = d[key]
+                    if v is not None and (
+                        peak[key] is None or v > peak[key]
+                    ):
+                        peak[key] = v
+        self.event("memory", tag=tag, devices=devices)
+        return devices
+
+    # ------------------------------------------------------------ compile
+    def record_step_build(self, key: str) -> None:
+        with self._lock:
+            self.compiles["step_builds"] += 1
+            by = self.compiles["by_key"]
+            entry = by.setdefault(key, {"builds": 0, "compiles": 0})
+            entry["builds"] += 1
+            self._compile_key = key
+
+    def _compile_observed(self, name: str, secs: float) -> None:
+        with self._lock:
+            if name.endswith("backend_compile_duration"):
+                self.compiles["backend_compiles"] += 1
+                self.compiles["backend_compile_s"] = round(
+                    self.compiles["backend_compile_s"] + secs, 4
+                )
+                key = self._compile_key
+                if key:
+                    self.compiles["by_key"].setdefault(
+                        key, {"builds": 0, "compiles": 0}
+                    )["compiles"] += 1
+            elif name.endswith("jaxpr_trace_duration"):
+                self.compiles["retraces"] += 1
+                return          # traces are counted, not event-logged
+            else:
+                return          # lowering etc. ride the backend count
+        self.event(
+            "compile",
+            name=name.rsplit("/", 1)[-1],
+            seconds=round(secs, 4),
+            key=self._compile_key,
+        )
+
+    def compile_count(self) -> int:
+        """The headline compile counter: real XLA backend compiles when the
+        monitoring listener is live, step builds otherwise."""
+        if self.compiles["monitor"]:
+            return self.compiles["backend_compiles"]
+        return self.compiles["step_builds"]
+
+    # ------------------------------------------------------------- report
+    def set_final(self, outcome: Dict[str, Any]) -> None:
+        """Entry-point outcome embedded in the run report (fit LLH, sweep
+        chosen K, ingest stats, ...)."""
+        self.final.update(outcome)
+
+    def report(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "v": SCHEMA_VERSION,
+                "run": self.run_id,
+                "pid": _process_index(),
+                "processes": _process_count(),
+                "entry": self.entry,
+                "started_unix": round(self.started_unix, 3),
+                "wall_s": round(time.perf_counter() - self._t0, 3),
+                "stages": {
+                    "seconds": {
+                        k: round(v, 3)
+                        for k, v in self.stage_seconds.items()
+                    },
+                    "counts": dict(self.stage_counts),
+                },
+                "memory": {
+                    "host_rss_bytes": current_rss_bytes(),
+                    "host_rss_peak_bytes": peak_rss_bytes(),
+                    "device_peak": {
+                        k: dict(v) for k, v in self.device_peak.items()
+                    },
+                    "watermark_tags": dict(self.watermark_tags),
+                },
+                "compiles": {
+                    **{k: v for k, v in self.compiles.items()},
+                    "by_key": {
+                        k: dict(v)
+                        for k, v in self.compiles["by_key"].items()
+                    },
+                    "count": self.compile_count(),
+                },
+                "heartbeat": {
+                    "deadline_s": (
+                        self.heartbeat.deadline_s
+                        if self.heartbeat is not None
+                        else None
+                    ),
+                    "stalls": (
+                        self.heartbeat.stalls
+                        if self.heartbeat is not None
+                        else 0
+                    ),
+                },
+                "events": dict(self.event_counts),
+                "final": dict(self.final),
+            }
+
+    def report_path(self) -> str:
+        pid = _process_index()
+        name = REPORT_NAME if pid == 0 else f"run_report.p{pid}.json"
+        return os.path.join(self.directory, name)
+
+    def finalize(self) -> Dict[str, Any]:
+        """Stop the heartbeat, take a last watermark, emit `end`, write
+        this process's run report, close the log. Idempotent."""
+        with self._lock:
+            if self._finalized:
+                return self.report()
+            self._finalized = True
+        if self.heartbeat is not None:
+            self.heartbeat.stop()
+        self.watermark("final")
+        self.event(
+            "end", wall_s=round(time.perf_counter() - self._t0, 3)
+        )
+        self.commit_gate()        # a run with zero primary events still
+        rep = self.report()       # gets its report written
+        tmp = self.report_path() + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(_finite_safe(rep), f, indent=1, sort_keys=True,
+                      default=_json_default, allow_nan=False)
+        os.replace(tmp, self.report_path())
+        with self._lock:
+            if self._fh is not None:
+                self._fh.close()
+                self._fh = None
+        return rep
+
+    # ------------------------------------------------------- context mgmt
+    def __enter__(self) -> "RunTelemetry":
+        install(self)
+        return self
+
+    def __exit__(self, *exc) -> None:
+        try:
+            self.finalize()
+        finally:
+            uninstall(self)

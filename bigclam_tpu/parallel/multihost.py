@@ -48,7 +48,21 @@ def initialize_distributed(
     """
     from bigclam_tpu.utils.compat import distributed_is_initialized
 
+    def _commit_telemetry_gate():
+        # the single-writer event-log gate was deferred until membership is
+        # known (obs.RunTelemetry auto_gate=False); it is decidable on
+        # EVERY exit of this function — including the no-coordinator
+        # fallback (single process), where leaving it deferred would
+        # buffer the whole run's events (stall heartbeats included) in
+        # memory until finalize
+        from bigclam_tpu.obs import telemetry as _obs
+
+        t = _obs.current()
+        if t is not None:
+            t.commit_gate()
+
     if distributed_is_initialized():
+        _commit_telemetry_gate()
         return True
     if coordinator_address is None:
         for k in _COORD_ENVS:
@@ -56,6 +70,7 @@ def initialize_distributed(
                 coordinator_address = os.environ[k]
                 break
     if coordinator_address is None:
+        _commit_telemetry_gate()
         return False
     if num_processes is None and os.environ.get("JAX_NUM_PROCESSES"):
         num_processes = int(os.environ["JAX_NUM_PROCESSES"])
@@ -67,6 +82,19 @@ def initialize_distributed(
         process_id=process_id,
         local_device_ids=local_device_ids,
     )
+    from bigclam_tpu.obs import telemetry as _obs
+
+    tel = _obs.current()
+    if tel is not None:
+        # membership is now known: commit the single-writer event-log gate
+        # (events buffered since RunTelemetry construction flush here) and
+        # record the join
+        tel.commit_gate()
+        tel.event(
+            "distributed_init",
+            processes=jax.process_count(),
+            coordinator=coordinator_address,
+        )
     return True
 
 

@@ -836,6 +836,9 @@ class RingBigClamModel(ShardedBigClamModel):
                 self._step_cache[key] = make_ring_train_step(
                     self.mesh, self.edges, self.cfg
                 )
+            from bigclam_tpu.obs import note_step_build
+
+            note_step_build(self.cfg, type(self).__name__)
         self._step = self._step_cache[key]
 
     def _build_edges_and_step(self) -> None:

@@ -617,7 +617,9 @@ class ShardedBigClamModel:
         self._step_cache = {step_cfg_key(self.cfg): self._step}
         self.path_reason = getattr(self, "_csr_reason", "")
         from bigclam_tpu.models.bigclam import log_engaged_path
+        from bigclam_tpu.obs import note_step_build
 
+        note_step_build(self.cfg, type(self).__name__)
         log_engaged_path(
             type(self).__name__, self.engaged_path, self.path_reason
         )
@@ -948,6 +950,9 @@ class ShardedBigClamModel:
                 cache[key] = make_sharded_train_step(
                     self.mesh, self.edges, self.cfg
                 )
+            from bigclam_tpu.obs import note_step_build
+
+            note_step_build(self.cfg, type(self).__name__)
         self._step = cache[key]
 
     def init_state(self, F0: np.ndarray) -> TrainState:

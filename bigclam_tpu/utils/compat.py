@@ -56,9 +56,19 @@ def pcast_varying(x, axes: tuple):
 
 def distributed_is_initialized() -> bool:
     """jax.distributed.is_initialized, with the 0.4.x fallback of probing
-    the global state object the accessor reads."""
+    the global state object the accessor reads. On 0.4.37 the public
+    jax.distributed module exposes NEITHER (no is_initialized, no
+    global_state re-export) — the state object lives only at
+    jax._src.distributed.global_state, so the probe goes there last."""
     dist = jax.distributed
     if hasattr(dist, "is_initialized"):
         return dist.is_initialized()
     state = getattr(dist, "global_state", None)
+    if state is None:
+        try:
+            from jax._src import distributed as _src_dist
+
+            state = getattr(_src_dist, "global_state", None)
+        except Exception:
+            state = None
     return bool(state is not None and state.client is not None)

@@ -32,13 +32,21 @@ class MetricsLogger:
         routinely constructed before jax.distributed.initialize (e.g. the
         CLI builds the logger before the model factory joins the process
         group) — checking at construction would both crash the later init
-        and read index 0 on every process."""
+        and read index 0 on every process.
+
+        "t" is seconds since the FIRST log, not since construction: the
+        CLI builds the logger before loading the graph, so a
+        construction-stamped t0 silently folded graph-load + model-build
+        time into the first step's "t". That setup time is now reported
+        once as "load_s" on the first record instead."""
         self.path = path
         self.echo = echo
         self.primary_only = primary_only
         self._fh: Optional[TextIO] = None
         self._gated = False
-        self._t0 = time.perf_counter()
+        self._created = time.perf_counter()
+        self._t0: Optional[float] = None      # stamped lazily in _gate()
+        self.load_s: Optional[float] = None
         self._last_t: Optional[float] = None
         self._last_llh: Optional[float] = None
 
@@ -46,6 +54,8 @@ class MetricsLogger:
         if self._gated:
             return
         self._gated = True
+        self._t0 = time.perf_counter()
+        self.load_s = round(self._t0 - self._created, 4)
         if self.primary_only:
             from bigclam_tpu.utils.dist import is_primary
 
@@ -55,14 +65,26 @@ class MetricsLogger:
             self._fh = open(self.path, "a")
 
     def log(self, record: Dict[str, Any]) -> None:
+        first = not self._gated
         self._gate()
         record = {"t": round(time.perf_counter() - self._t0, 4), **record}
+        if first:
+            record["load_s"] = self.load_s
         line = json.dumps(record)
         if self._fh:
             self._fh.write(line + "\n")
             self._fh.flush()
         if self.echo:
             print(line, file=sys.stderr)
+        # sink of the run-telemetry layer (bigclam_tpu.obs): records land
+        # in events.jsonl too (as `step`/`metric` events) when telemetry is
+        # installed — EVERY process forwards; the telemetry's own
+        # single-writer gate decides who writes
+        from bigclam_tpu.obs import telemetry as _obs
+
+        tel = _obs.current()
+        if tel is not None:
+            tel.metric_record(record)
 
     def step_callback(
         self,

@@ -49,6 +49,12 @@ class StageProfile:
     Re-entering a stage accumulates (stages are wall-clock buckets, not a
     call tree); `count` is a plain event counter. `report()` returns the
     JSON-ready {"seconds": {...}, "counts": {...}} dict artifacts embed.
+
+    SINK of the run telemetry layer (bigclam_tpu.obs): every completed
+    stage additionally forwards (name, seconds) to the installed
+    RunTelemetry — which logs a `stage` event, samples a device-memory
+    watermark at the stage boundary, and beats the stall heartbeat. With
+    telemetry off the forward is one None check.
     """
 
     def __init__(self) -> None:
@@ -63,14 +69,15 @@ class StageProfile:
         try:
             yield
         finally:
-            self.seconds[name] = self.seconds.get(name, 0.0) + (
-                time.perf_counter() - t0
-            )
+            dt = time.perf_counter() - t0
+            self.seconds[name] = self.seconds.get(name, 0.0) + dt
+            _telemetry_stage(name, dt)
 
     def add_seconds(self, name: str, s: float) -> None:
         """Accumulate into a stage bucket without the context manager
         (for loops whose body already lives inside another `with`)."""
         self.seconds[name] = self.seconds.get(name, 0.0) + s
+        _telemetry_stage(name, s)
 
     def count(self, name: str, inc: int = 1) -> None:
         self.counts[name] = self.counts.get(name, 0) + inc
@@ -80,6 +87,16 @@ class StageProfile:
             "seconds": {k: round(v, 3) for k, v in self.seconds.items()},
             "counts": dict(self.counts),
         }
+
+
+def _telemetry_stage(name: str, seconds: float) -> None:
+    """Forward a completed stage to the installed RunTelemetry (lazy import:
+    profiling is loaded by jax-free paths and must stay dependency-light)."""
+    from bigclam_tpu.obs import telemetry
+
+    tel = telemetry.current()
+    if tel is not None:
+        tel.stage_complete(name, seconds)
 
 
 def current_rss_bytes() -> int:
@@ -144,10 +161,19 @@ class IngestProfile(StageProfile):
             "delta_bytes": self.rss_peak - self.rss_baseline,
             "process_peak_bytes": peak_rss_bytes(),
         }
+        # two rates, explicitly (the old single figure divided raw_edges by
+        # the sum of ALL stage buckets — scatter/dedup/shard-write included
+        # — understating parse throughput): "scan" is the parse stage, the
+        # all-stage sum is the end-to-end pipeline rate. edges_per_sec stays
+        # as the end-to-end alias existing artifact consumers read.
         total_s = sum(self.seconds.values())
+        parse_s = self.seconds.get("scan", 0.0)
         edges = self.counts.get("raw_edges", 0)
         if edges and total_s > 0:
             rep["edges_per_sec"] = round(edges / total_s, 1)
+            rep["edges_per_sec_end_to_end"] = rep["edges_per_sec"]
+        if edges and parse_s > 0:
+            rep["edges_per_sec_parse"] = round(edges / parse_s, 1)
         return rep
 
 

@@ -78,6 +78,7 @@ def timed_compile(text, cache_dir, num_shards, chunk_bytes, workers):
         "chunk_bytes": chunk_bytes,
         "seconds": round(seconds, 2),
         "edges_per_sec": rep.get("edges_per_sec"),
+        "edges_per_sec_parse": rep.get("edges_per_sec_parse"),
         "stage_seconds": rep["seconds"],
         "rss": rep["rss"],
     }

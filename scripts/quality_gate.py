@@ -49,6 +49,30 @@ def main() -> int:
     out_path = sys.argv[3] if len(sys.argv) > 3 else None
     p_in = float(sys.argv[4]) if len(sys.argv) > 4 else 0.15
 
+    # opt-in run telemetry (bigclam_tpu.obs): BIGCLAM_TELEMETRY_DIR=<dir>
+    # leaves events.jsonl + run_report.json next to the gate artifact —
+    # cycle events, stage seconds (the quality StageProfile forwards), HBM
+    # watermarks, and a stall heartbeat for the long anneal/repair fits
+    tel = None
+    tdir = os.environ.get("BIGCLAM_TELEMETRY_DIR")
+    if tdir:
+        from bigclam_tpu.obs import RunTelemetry, install
+
+        tel = install(
+            RunTelemetry(tdir, entry="quality_gate", heartbeat_s=600.0)
+        )
+    try:
+        return _main(n, k, out_path, p_in, tel)
+    finally:
+        if tel is not None:
+            from bigclam_tpu.obs import uninstall
+
+            tel.finalize()
+            uninstall(tel)
+
+
+def _main(n, k, out_path, p_in, tel=None) -> int:
+
     import jax
 
     if os.environ.get("E2E_CPU"):
@@ -167,6 +191,15 @@ def main() -> int:
         "device": str(jax.devices()[0]),
         "pass": passed,
     }
+    if tel is not None:
+        tel.set_final(
+            {
+                "gate": rec["gate"],
+                "pass": rec["pass"],
+                "f1_quality": rec["f1_quality"],
+                "llh_quality": rec["llh_quality"],
+            }
+        )
     line = json.dumps(rec)
     print(line)
     if out_path:

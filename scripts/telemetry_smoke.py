@@ -1,0 +1,111 @@
+"""Telemetry smoke gate (ISSUE 4 satellite): run a tiny CPU fit with the
+full telemetry stack on — event log, watermarks, compile counters, a
+sub-second stall heartbeat, metrics sink — validate EVERY event line
+against the schema (bigclam_tpu.obs.schema), check the run report's
+structure, and emit one JSON artifact line.
+
+    python scripts/telemetry_smoke.py [out.json]
+
+Exit 0 iff every check passes. The committed artifact (TELEM_SMOKE_r08.json)
+is the proof the producer and the schema agree at the commit that shipped
+them; the same validation runs in tier-1 (tests/test_telemetry.py), so
+drift between them fails CI, not a Friendster run.
+"""
+
+import json
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def main() -> int:
+    out_path = sys.argv[1] if len(sys.argv) > 1 else None
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_enable_x64", True)
+
+    from bigclam_tpu.config import BigClamConfig
+    from bigclam_tpu.models import BigClamModel
+    from bigclam_tpu.models.agm import sample_planted_graph
+    from bigclam_tpu.obs import (
+        RunTelemetry,
+        install,
+        uninstall,
+        validate_events_file,
+    )
+    from bigclam_tpu.obs.report import render
+    from bigclam_tpu.obs.telemetry import EVENTS_NAME
+    from bigclam_tpu.utils.metrics import MetricsLogger
+
+    g, _ = sample_planted_graph(240, 4, p_in=0.3, rng=np.random.default_rng(0))
+    cfg = BigClamConfig(
+        num_communities=4, dtype="float64", max_iters=8, conv_tol=0.0
+    )
+    F0 = np.random.default_rng(1).uniform(0.1, 1.0, size=(g.num_nodes, 4))
+
+    tdir = tempfile.mkdtemp(prefix="telem_smoke_")
+    checks = {}
+    tel = install(
+        RunTelemetry(tdir, entry="smoke", heartbeat_s=60.0, quiet=True)
+    )
+    try:
+        from bigclam_tpu.utils.profiling import StageProfile
+
+        prof = StageProfile()     # stage-boundary events + watermarks,
+        with prof.stage("model_build"):    # the entry-point pattern
+            model = BigClamModel(g, cfg)
+        with prof.stage("fit"), MetricsLogger(None, echo=False) as ml:
+            res = model.fit(
+                F0,
+                callback=ml.step_callback(
+                    g.num_directed_edges, num_nodes=g.num_nodes
+                ),
+            )
+        tel.set_final({"llh": res.llh, "iters": res.num_iters})
+        refit_base = tel.compile_count()
+        model.fit(F0)                    # re-fit: count must stay flat
+        checks["compile_count_flat_on_refit"] = (
+            tel.compile_count() == refit_base
+        )
+        rep = tel.finalize()
+    finally:
+        uninstall(tel)
+
+    n_events, errors = validate_events_file(os.path.join(tdir, EVENTS_NAME))
+    checks["all_events_schema_valid"] = not errors
+    checks["has_step_events"] = rep["events"].get("step", 0) >= cfg.max_iters
+    checks["has_stage_seconds"] = bool(rep["stages"]["seconds"])
+    checks["has_compile_count"] = rep["compiles"]["count"] > 0
+    checks["has_device_watermarks"] = bool(rep["memory"]["device_peak"])
+    checks["report_renders"] = render(tdir)[1] == 0
+
+    record = {
+        "gate": "telemetry-smoke",
+        "config": f"planted AGM N={g.num_nodes} K=4 "
+                  f"2E={g.num_directed_edges}, max_iters={cfg.max_iters}",
+        "n_events": n_events,
+        "event_kinds": rep["events"],
+        "compiles": rep["compiles"]["count"],
+        "schema_errors": errors[:10],
+        "checks": checks,
+        "device": str(jax.devices()[0]),
+        "jax": jax.__version__,
+        "pass": all(checks.values()),
+    }
+    line = json.dumps(record)
+    print(line)
+    if out_path:
+        with open(out_path, "w") as f:
+            f.write(line + "\n")
+    return 0 if record["pass"] else 1
+
+
+if __name__ == "__main__":
+    main_rc = main()
+    sys.exit(main_rc)

@@ -20,6 +20,11 @@ Modes:
                  at ckpt_root (compiled by the parent): asserts this
                  process loaded ONLY its own shard files and its own node
                  ranges, then process 0 writes the trajectory
+  telemetry      fit with RunTelemetry pointed at the SHARED dir ckpt_root:
+                 asserts the single-writer event-log gate (only process 0
+                 may hold the events.jsonl handle) while every process
+                 writes its own run_report(.p<i>).json for the parent to
+                 merge
 """
 
 import os
@@ -148,6 +153,43 @@ def main() -> None:
         assert set(hs.files_read) == own, (hs.files_read, own)
 
         res = model.fit(F0)
+        if jax.process_index() == 0:
+            np.savez(
+                out_path, F=res.F, llh_history=np.asarray(res.llh_history)
+            )
+        jax.distributed.shutdown()
+        return
+
+    if mode == "telemetry":
+        from bigclam_tpu.obs import RunTelemetry, install, uninstall
+        from bigclam_tpu.utils.metrics import MetricsLogger
+
+        # constructed BEFORE the gate decision would be safe (the process
+        # group is already up here, but auto_gate=False + commit_gate is
+        # the production CLI sequence — exercise it)
+        tel = install(
+            RunTelemetry(
+                ckpt_root, entry="worker-fit", heartbeat_s=60.0,
+                auto_gate=False,
+            )
+        )
+        tel.commit_gate()
+        model = ShardedBigClamModel(g, cfg, mesh)
+        with MetricsLogger(None, echo=False) as ml:
+            res = model.fit(
+                F0,
+                callback=ml.step_callback(
+                    g.num_directed_edges, num_nodes=g.num_nodes
+                ),
+            )
+        tel.set_final({"llh": res.llh, "iters": res.num_iters})
+        # the single-writer gate: only process 0 holds the events handle
+        if jax.process_index() == 0:
+            assert tel._fh is not None
+        else:
+            assert tel._fh is None
+        tel.finalize()
+        uninstall(tel)
         if jax.process_index() == 0:
             np.savez(
                 out_path, F=res.F, llh_history=np.asarray(res.llh_history)

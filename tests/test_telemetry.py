@@ -1,0 +1,505 @@
+"""Run-telemetry subsystem tests (ISSUE 4, bigclam_tpu.obs): event-log
+schema, compile-counter flatness on re-fit, heartbeat stall trigger,
+non-finite LLH sentinel, MetricsLogger/IngestProfile satellite fixes, the
+<2% telemetry-off overhead pin, and the true two-process single-writer /
+report-merge contract."""
+
+import json
+import math
+import os
+import time
+
+import numpy as np
+import pytest
+
+import jax
+
+from bigclam_tpu.config import BigClamConfig
+from bigclam_tpu.models import BigClamModel
+from bigclam_tpu.obs import (
+    RunTelemetry,
+    current,
+    install,
+    uninstall,
+    validate_event,
+    validate_events_file,
+)
+from bigclam_tpu.obs.report import load_reports, merge_reports, render
+from bigclam_tpu.obs.telemetry import EVENTS_NAME
+from bigclam_tpu.utils import MetricsLogger
+
+
+def _problem(toy_graphs, k=2, max_iters=5):
+    g = toy_graphs["two_cliques"]
+    cfg = BigClamConfig(
+        num_communities=k, dtype="float64", max_iters=max_iters,
+        conv_tol=0.0,
+    )
+    F0 = np.random.default_rng(5).uniform(0.1, 1.0, size=(g.num_nodes, k))
+    return g, cfg, F0
+
+
+def _events(directory):
+    with open(os.path.join(directory, EVENTS_NAME)) as f:
+        return [json.loads(line) for line in f if line.strip()]
+
+
+@pytest.fixture
+def telem(tmp_path):
+    tel = install(RunTelemetry(str(tmp_path / "telem"), entry="test"))
+    try:
+        yield tel
+    finally:
+        tel.finalize()
+        uninstall(tel)
+
+
+def test_fit_event_log_validates_and_report_written(toy_graphs, telem):
+    """A fit with telemetry installed leaves a schema-valid events.jsonl
+    (start / stage / step / model_build / memory / compile / end) and a
+    run report carrying stage seconds, watermark structure, and a compile
+    count — the acceptance-criterion artifact, in-process."""
+    g, cfg, F0 = _problem(toy_graphs)
+    model = BigClamModel(g, cfg)
+    with MetricsLogger(None, echo=False) as ml:
+        res = model.fit(
+            F0,
+            callback=ml.step_callback(
+                g.num_directed_edges, num_nodes=g.num_nodes
+            ),
+        )
+    telem.set_final({"llh": res.llh})
+    rep = telem.finalize()
+
+    n, errors = validate_events_file(
+        os.path.join(telem.directory, EVENTS_NAME)
+    )
+    assert errors == [], errors
+    kinds = {e["kind"] for e in _events(telem.directory)}
+    assert {"start", "step", "model_build", "memory", "end"} <= kinds
+    steps = [e for e in _events(telem.directory) if e["kind"] == "step"]
+    assert len(steps) == cfg.max_iters + 1
+    assert all(e["pid"] == 0 for e in _events(telem.directory))
+    # accept histogram rides the step events into the unified log
+    assert "accept_hist" in steps[1]
+
+    assert rep["final"]["llh"] == res.llh
+    assert rep["compiles"]["count"] > 0
+    assert rep["events"]["step"] == len(steps)
+    # device watermarks: structure always present; values are null on the
+    # CPU backend (its allocator doesn't track) but the devices were seen
+    assert rep["memory"]["watermark_tags"]
+    assert rep["memory"]["device_peak"]
+
+    text, render_errors = render(telem.directory)
+    assert render_errors == 0
+    assert telem.run_id in text and "stage seconds" in text
+
+
+def test_compile_count_flat_across_refit(toy_graphs, tmp_path):
+    """Acceptance: the compile count must stay FLAT across a 3-step re-fit
+    with an unchanged cfg (warm jit caches — no silent retrace storm), and
+    must visibly GROW when a sweep-style cfg change compiles a new step."""
+    g, cfg, F0 = _problem(toy_graphs, max_iters=3)
+    with RunTelemetry(str(tmp_path / "t"), entry="test") as tel:
+        model = BigClamModel(g, cfg)
+        model.fit(F0)
+        c1 = tel.compile_count()
+        builds1 = tel.compiles["step_builds"]
+        assert c1 > 0 and builds1 == 1
+        model.fit(F0)              # 3-step re-fit, unchanged cfg
+        assert tel.compile_count() == c1
+        assert tel.compiles["step_builds"] == builds1
+        # a per-K recompile (new model at a different K) is visible
+        cfg3 = cfg.replace(num_communities=3)
+        F3 = np.random.default_rng(6).uniform(
+            0.1, 1.0, size=(g.num_nodes, 3)
+        )
+        BigClamModel(g, cfg3).fit(F3)
+        assert tel.compile_count() > c1
+        assert tel.compiles["step_builds"] == builds1 + 1
+        assert len(tel.compiles["by_key"]) == 2
+
+
+def test_heartbeat_stall_fires_deterministically(tmp_path, capsys):
+    """No beat within the deadline -> a `stall` event with silence
+    duration, RSS, and last progress; repeated silence re-emits."""
+    tel = RunTelemetry(
+        str(tmp_path / "t"), entry="test", heartbeat_s=0.08
+    )
+    tel.heartbeat.beat(iter=7)
+    time.sleep(0.5)
+    tel.finalize()
+    stalls = [e for e in _events(tel.directory) if e["kind"] == "stall"]
+    assert stalls, "heartbeat never fired"
+    assert stalls[0]["silent_s"] >= 0.08
+    assert stalls[0]["rss_bytes"] > 0
+    assert stalls[0]["progress"] == {"iter": 7}
+    assert "STALL" in capsys.readouterr().err
+    n, errors = validate_events_file(
+        os.path.join(tel.directory, EVENTS_NAME)
+    )
+    assert errors == [], errors
+
+
+def test_heartbeat_beats_suppress_stall(tmp_path):
+    tel = RunTelemetry(
+        str(tmp_path / "t"), entry="test", heartbeat_s=0.15
+    )
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < 0.5:
+        tel.heartbeat.beat(iter=1)
+        time.sleep(0.01)
+    tel.finalize()
+    assert not [e for e in _events(tel.directory) if e["kind"] == "stall"]
+
+
+def test_quiet_suppresses_heartbeat_stderr_not_jsonl(tmp_path, capsys):
+    """Satellite: --quiet silences the heartbeat's stderr echo while the
+    JSONL stays complete."""
+    tel = RunTelemetry(
+        str(tmp_path / "t"), entry="test", heartbeat_s=0.08, quiet=True
+    )
+    time.sleep(0.4)
+    tel.finalize()
+    assert [e for e in _events(tel.directory) if e["kind"] == "stall"]
+    assert "STALL" not in capsys.readouterr().err
+
+
+def test_nonfinite_llh_sentinel(toy_graphs, tmp_path):
+    """A poisoned F aborts the fit loop with diagnostics instead of
+    silently iterating on NaN to max_iters (the convergence test can never
+    fire on NaN). With telemetry: a `nonfinite` event + dump file."""
+    g, cfg, F0 = _problem(toy_graphs, max_iters=50)
+    bad = F0.copy()
+    bad[3, 1] = np.nan
+    # without telemetry: still aborts (the sentinel is a safety feature,
+    # not an observability feature)
+    with pytest.raises(FloatingPointError, match="non-finite LLH"):
+        BigClamModel(g, cfg).fit(bad)
+
+    tel = install(RunTelemetry(str(tmp_path / "t"), entry="test"))
+    try:
+        with pytest.raises(FloatingPointError, match="non-finite LLH"):
+            BigClamModel(g, cfg).fit(bad)
+    finally:
+        uninstall(tel)
+    events = [
+        e for e in _events(tel.directory) if e["kind"] == "nonfinite"
+    ]
+    assert len(events) == 1
+    assert events[0]["iter"] == 0
+    assert events[0]["f_nonfinite"] >= 1
+    assert "accept_hist" in events[0]
+    assert os.path.exists(
+        os.path.join(tel.directory, "nonfinite_dump.npz")
+    )
+    # the abort path finalized the report too
+    assert load_reports(tel.directory)
+    n, errors = validate_events_file(
+        os.path.join(tel.directory, EVENTS_NAME)
+    )
+    assert errors == [], errors
+
+
+def test_metrics_logger_t0_lazy_and_load_s(tmp_path):
+    """Satellite: "t" counts from the FIRST log, with construction->first-
+    log time (graph load etc.) reported once as load_s."""
+    p = tmp_path / "m.jsonl"
+    ml = MetricsLogger(str(p), echo=False)
+    time.sleep(0.08)
+    ml.log({"iter": 0, "llh": -1.0})
+    ml.log({"iter": 1, "llh": -0.5})
+    ml.close()
+    recs = [json.loads(x) for x in p.read_text().splitlines()]
+    assert recs[0]["t"] < 0.05, "t still includes pre-first-log time"
+    assert recs[0]["load_s"] >= 0.08
+    assert "load_s" not in recs[1]
+
+
+def test_ingest_profile_reports_parse_and_end_to_end_rates():
+    """Satellite: the old single edges/sec divided raw_edges by ALL stage
+    buckets; now both the parse-stage and end-to-end rates are explicit."""
+    from bigclam_tpu.utils.profiling import IngestProfile
+
+    prof = IngestProfile()
+    prof.seconds = {"scan": 2.0, "scatter": 1.0, "dedup": 0.5,
+                    "shards": 0.5}
+    prof.counts = {"raw_edges": 1000}
+    rep = prof.report()
+    assert rep["edges_per_sec_parse"] == 500.0
+    assert rep["edges_per_sec_end_to_end"] == 250.0
+    assert rep["edges_per_sec"] == 250.0       # back-compat alias
+
+
+def test_stage_profile_forwards_to_telemetry(telem):
+    from bigclam_tpu.utils.profiling import StageProfile
+
+    prof = StageProfile()
+    with prof.stage("quality_stage"):
+        time.sleep(0.01)
+    prof.add_seconds("anneal", 1.5)
+    assert "quality_stage" in telem.stage_seconds
+    assert telem.stage_seconds["anneal"] == 1.5
+    stage_events = [
+        e for e in telem.report()["events"].items() if e[0] == "stage"
+    ]
+    assert stage_events and stage_events[0][1] == 2
+
+
+def test_telemetry_off_overhead_under_2pct(toy_graphs):
+    """Acceptance pin: with telemetry OFF the fit loop's added work is one
+    current()-is-None check + math.isfinite per iteration — measured here
+    against the real compiled step time of a tiny model (the worst case:
+    bigger models make the overhead fraction smaller)."""
+    from bigclam_tpu.obs import telemetry as obs_telemetry
+    from bigclam_tpu.utils.profiling import step_time
+
+    assert current() is None
+    g, cfg, F0 = _problem(toy_graphs)
+    model = BigClamModel(g, cfg)
+    sec_per_step = step_time(
+        model._step, model.init_state(F0), steps=20, warmup=2
+    )
+
+    iters = 20000
+    llh = -123.456
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        tel = obs_telemetry.current()
+        if tel is not None:
+            tel.step_beat(0, llh)
+        math.isfinite(llh)
+    overhead_per_iter = (time.perf_counter() - t0) / iters
+    assert overhead_per_iter < 0.02 * sec_per_step, (
+        f"telemetry-off overhead {overhead_per_iter:.3e}s/iter vs "
+        f"step {sec_per_step:.3e}s"
+    )
+
+
+def test_schema_validator_catches_bad_events(tmp_path):
+    good = {"v": 1, "run": "r", "pid": 0, "t": 0.1, "kind": "step",
+            "iter": 3, "llh": -1.0}
+    assert validate_event(good) == []
+    assert validate_event({**good, "v": 99})        # wrong version
+    assert validate_event({**good, "kind": "nope"})  # unknown kind
+    missing = dict(good)
+    del missing["llh"]
+    assert validate_event(missing)                  # kind field missing
+    assert validate_event({**good, "iter": "3"})    # wrong type
+    assert validate_event([1, 2])                   # not an object
+
+    p = tmp_path / "e.jsonl"
+    p.write_text(json.dumps(good) + "\nnot json\n")
+    n, errors = validate_events_file(str(p))
+    assert n == 2 and len(errors) == 1 and "line 2" in errors[0]
+
+
+def test_quality_device_cycle_events(toy_graphs, telem):
+    """The quality annealing schedules emit one `cycle` event per restart
+    cycle (device loop exercised; the host loop shares _cycle_event)."""
+    from bigclam_tpu.models.quality import fit_quality_device
+
+    g, cfg, F0 = _problem(toy_graphs, max_iters=6)
+    qcfg = cfg.replace(
+        quality_mode=True, restart_cycles=3, restart_tol=0.0,
+        quality_repair=False,
+    )
+    model = BigClamModel(g, qcfg)
+    qres = fit_quality_device(model, F0)
+    cycles = [
+        e for e in _events(telem.directory) if e["kind"] == "cycle"
+    ]
+    assert len(cycles) == qres.num_cycles
+    assert [c["cycle"] for c in cycles] == list(range(len(cycles)))
+    assert all("kept" in c for c in cycles)
+    # the quality StageProfile stages forwarded too
+    assert "anneal" in telem.stage_seconds
+
+
+def test_merge_reports_cross_process_rules():
+    r0 = {
+        "run": "r", "pid": 0, "processes": 2, "entry": "fit",
+        "wall_s": 4.0,
+        "stages": {"seconds": {"fit": 3.0}},
+        "memory": {"device_peak": {"d0": {"bytes_in_use": 10,
+                                          "peak_bytes_in_use": 20}}},
+        "compiles": {"count": 3, "backend_compiles": 3, "step_builds": 1,
+                     "backend_compile_s": 1.0,
+                     "by_key": {"a": {"builds": 1, "compiles": 3}}},
+        "heartbeat": {"stalls": 1},
+        "events": {"step": 5},
+        "final": {"llh": -1.0},
+    }
+    r1 = {
+        **r0, "pid": 1, "wall_s": 5.0,
+        "memory": {"device_peak": {"d0": {"bytes_in_use": 30,
+                                          "peak_bytes_in_use": 15},
+                                   "d1": {"bytes_in_use": 7,
+                                          "peak_bytes_in_use": 7}}},
+        "heartbeat": {"stalls": 0},
+    }
+    m = merge_reports([r0, r1])
+    assert m["processes_reported"] == 2 and m["processes_expected"] == 2
+    assert m["wall_s"] == 5.0
+    assert m["stages_by_pid"] == {"0": {"fit": 3.0}, "1": {"fit": 3.0}}
+    assert m["device_peak"]["d0"]["bytes_in_use"] == 30
+    assert m["device_peak"]["d0"]["peak_bytes_in_use"] == 20
+    assert "d1" in m["device_peak"]
+    assert m["compiles"]["count"] == 6
+    assert m["compiles"]["by_key"]["a"] == {"builds": 2, "compiles": 6}
+    assert m["stalls"] == 1 and m["events"]["step"] == 10
+
+
+def test_cli_fit_telemetry_and_report(tmp_path):
+    """End-to-end acceptance: `cli fit --telemetry-dir` leaves events.jsonl
+    + run_report.json with per-stage seconds, watermark structure, and a
+    compile count; `cli report <dir>` renders it and exits 0."""
+    import subprocess
+    import sys
+
+    graph = tmp_path / "g.txt"
+    edges = []
+    for base in (0, 8):
+        for i in range(8):
+            for j in range(i + 1, 8):
+                edges.append((base + i, base + j))
+    edges.append((7, 8))
+    graph.write_text("\n".join(f"{u} {v}" for u, v in edges))
+    tdir = tmp_path / "telem"
+    r = subprocess.run(
+        [sys.executable, "-m", "bigclam_tpu.cli", "fit",
+         "--graph", str(graph), "--k", "2", "--dtype", "float64",
+         "--max-iters", "5", "--init", "random", "--quiet",
+         "--platform", "cpu", "--telemetry-dir", str(tdir)],
+        capture_output=True, text=True, timeout=600, cwd="/root/repo",
+    )
+    assert r.returncode == 0, r.stderr
+
+    n, errors = validate_events_file(str(tdir / EVENTS_NAME))
+    assert errors == [] and n > 0, errors
+    rep = json.load(open(tdir / "run_report.json"))
+    for stage in ("graph_load", "model_build", "seeding", "fit"):
+        assert stage in rep["stages"]["seconds"], rep["stages"]
+    assert rep["compiles"]["count"] > 0
+    assert rep["memory"]["device_peak"]       # watermarks sampled
+    assert rep["final"]["k"] == 2
+    assert rep["heartbeat"]["deadline_s"] == 300.0
+
+    r2 = subprocess.run(
+        [sys.executable, "-m", "bigclam_tpu.cli", "report", str(tdir)],
+        capture_output=True, text=True, timeout=120, cwd="/root/repo",
+    )
+    assert r2.returncode == 0, r2.stdout + r2.stderr
+    assert "stage seconds" in r2.stdout and "compiles:" in r2.stdout
+
+
+def test_telemetry_does_not_initialize_jax_backend(tmp_path):
+    """Regression: constructing RunTelemetry and emitting events must NOT
+    initialize the jax backend — jax.distributed.initialize afterwards
+    would raise ('must be called before any JAX computations'). Run in a
+    fresh process (conftest already initialized this one's backend); the
+    deferred gate then commits through initialize_distributed's
+    already-initialized path and flushes the buffered events."""
+    import subprocess
+    import sys
+
+    tdir = str(tmp_path / "t")
+    code = f"""
+import socket, sys
+sys.path.insert(0, "/root/repo")
+from bigclam_tpu.obs import RunTelemetry, install
+tel = install(RunTelemetry({tdir!r}, entry="fit", heartbeat_s=0,
+                           auto_gate=False))
+tel.event("note", msg="buffered pre-init")
+import jax
+from jax._src import xla_bridge
+inited = (xla_bridge.backends_are_initialized()
+          if hasattr(xla_bridge, "backends_are_initialized")
+          else bool(xla_bridge._backends))
+assert not inited, "telemetry initialized the backend"
+jax.config.update("jax_platforms", "cpu")
+with socket.socket() as s:
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+jax.distributed.initialize(f"127.0.0.1:{{port}}", num_processes=1,
+                           process_id=0)
+from bigclam_tpu.parallel.multihost import initialize_distributed
+assert initialize_distributed() is True
+tel.finalize()
+"""
+    env = {
+        k: v for k, v in os.environ.items()
+        if k not in ("JAX_COORDINATOR_ADDRESS", "COORDINATOR_ADDRESS",
+                     "JAX_NUM_PROCESSES", "JAX_PROCESS_ID")
+    }
+    r = subprocess.run(
+        [sys.executable, "-c", code], capture_output=True, text=True,
+        timeout=300, env=env,
+    )
+    assert r.returncode == 0, r.stderr
+    events = _events(tdir)
+    assert [e["kind"] for e in events if e["kind"] == "note"] == ["note"]
+    n, errors = validate_events_file(os.path.join(tdir, EVENTS_NAME))
+    assert errors == [], errors
+
+
+def test_nonfinite_event_line_is_strict_json(toy_graphs, tmp_path):
+    """The nonfinite sentinel's own event carries the NaN LLH — that line
+    must still be STRICT JSON (no literal NaN; jq-parseable)."""
+    g, cfg, F0 = _problem(toy_graphs)
+    bad = F0.copy()
+    bad[0, 0] = np.inf
+    tel = install(RunTelemetry(str(tmp_path / "t"), entry="test"))
+    try:
+        with pytest.raises(FloatingPointError):
+            BigClamModel(g, cfg).fit(bad)
+    finally:
+        uninstall(tel)
+    raw = open(os.path.join(tel.directory, EVENTS_NAME)).read()
+    assert "NaN" not in raw and "Infinity" not in raw
+    nf = [e for e in _events(tel.directory) if e["kind"] == "nonfinite"]
+    assert nf and isinstance(nf[0]["llh"], str)   # "nan"/"-inf" repr
+
+
+# --- true two-process contract (pattern of tests/test_multihost.py) ------
+
+_needs_multiproc_cpu = pytest.mark.skipif(
+    jax.__version_info__ < (0, 5, 0),
+    reason="jaxlib 0.4.x CPU backend lacks multiprocess computations",
+)
+
+
+@_needs_multiproc_cpu
+def test_true_two_process_single_writer_and_report_merge(tmp_path):
+    """TWO real processes sharing one telemetry dir: only process 0 writes
+    events.jsonl (the worker asserts the file handle gate in-process), and
+    each process leaves its own run report — merged at read time."""
+    from test_multihost import _run_two_workers
+
+    tdir = tmp_path / "telem"
+    tdir.mkdir()
+    out = tmp_path / "proc0.npz"
+    _run_two_workers(out, mode="telemetry", ckpt_root=tdir)
+    assert out.exists()
+
+    n, errors = validate_events_file(str(tdir / EVENTS_NAME))
+    assert errors == [], errors
+    events = _events(str(tdir))
+    assert events and all(e["pid"] == 0 for e in events)
+    assert {"start", "step", "model_build", "end"} <= {
+        e["kind"] for e in events
+    }
+
+    assert (tdir / "run_report.json").exists()
+    assert (tdir / "run_report.p1.json").exists()
+    reports = load_reports(str(tdir))
+    assert [r["pid"] for r in reports] == [0, 1]
+    assert all(r["processes"] == 2 for r in reports)
+    # both processes resolved ONE run id through the dir claim file
+    assert len({r["run"] for r in reports}) == 1
+    merged = merge_reports(reports)
+    assert merged["processes_reported"] == 2
+    assert merged["final"] == reports[0]["final"]
+    text, render_errors = render(str(tdir))
+    assert render_errors == 0 and "processes 2/2" in text
