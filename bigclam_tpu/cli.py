@@ -105,6 +105,33 @@ def _add_common(p: argparse.ArgumentParser) -> None:
              "stderr warnings (telemetry JSONL stays complete)",
     )
     p.add_argument(
+        "--resume", default="auto", choices=["auto", "never"],
+        help="auto (default): restore the newest VALID checkpoint from "
+             "--checkpoint-dir (falling back past truncated/corrupt newer "
+             "ones), record the attempt in the telemetry resume lineage, "
+             "and continue the bit-identical trajectory; never: cold-start "
+             "(existing checkpoints are kept but ignored; new ones still "
+             "save)",
+    )
+    p.add_argument(
+        "--retry-budget", type=int, default=2,
+        help="transient-failure retries for the whole fit attempt "
+             "(resilience supervisor: each retry RESUMES from the newest "
+             "checkpoint; fatal errors never retry; 0 disables)",
+    )
+    p.add_argument(
+        "--no-self-heal", action="store_true",
+        help="disable shard quarantine + re-ingest: a crc-failed cache "
+             "shard rejects the run (default: quarantine the blob, rebuild "
+             "it from the source edge list, continue)",
+    )
+    p.add_argument(
+        "--heartbeat-escalate", type=int, default=3,
+        help="consecutive stall-heartbeat deadlines before a "
+             "stall_escalated event fires (0 disables escalation; the "
+             "watchdog then only keeps emitting stall events)",
+    )
+    p.add_argument(
         "--platform", default=None, choices=["cpu", "tpu"],
         help="force a JAX platform (the env may pin one; this overrides it)",
     )
@@ -117,6 +144,24 @@ def _add_common(p: argparse.ArgumentParser) -> None:
              "(scripts/device_seeding_bench.py measures the backends on "
              "your hardware)",
     )
+
+
+def _make_supervisor(args, cfg, tel):
+    """The entry-point retry supervisor: --retry-budget extra attempts for
+    transient-classified failures (each re-entering the fit WITH its
+    checkpoints, so retry = resume), stall-escalation hook attached to the
+    telemetry heartbeat when one is running."""
+    from bigclam_tpu.resilience import RetryPolicy, Supervisor
+
+    sup = Supervisor(
+        RetryPolicy(
+            transient_attempts=max(getattr(args, "retry_budget", 2), 0) + 1,
+            seed=cfg.seed,
+        )
+    )
+    if tel is not None:
+        sup.attach(tel)
+    return sup
 
 
 def _open_telemetry(args, entry: str):
@@ -137,6 +182,7 @@ def _open_telemetry(args, entry: str):
             quiet=getattr(args, "quiet", False),
             device_memory=entry != "ingest",
             auto_gate=not getattr(args, "distributed", False),
+            heartbeat_escalate=getattr(args, "heartbeat_escalate", 0),
         )
     )
 
@@ -153,10 +199,12 @@ def _close_telemetry(tel) -> None:
 def _load_graph(args):
     """Graph for fit/sweep: text+--cache-dir compiles once then reloads;
     everything else (text OR cache dir) goes through build_graph, which
-    dispatches cache directories itself."""
+    dispatches cache directories itself. Cache loads self-heal crc-failed
+    shards (quarantine + re-ingest) unless --no-self-heal."""
     from bigclam_tpu.graph import build_graph
     from bigclam_tpu.graph.store import compile_graph_cache, is_cache_dir
 
+    heal = not getattr(args, "no_self_heal", False)
     path = args.graph
     cache = getattr(args, "cache_dir", None)
     if cache and not is_cache_dir(path):
@@ -166,8 +214,8 @@ def _load_graph(args):
                 file=sys.stderr,
             )
             return compile_graph_cache(path, cache).load_graph()
-        return build_graph(cache)
-    return build_graph(path)
+        return build_graph(cache, self_heal=heal)
+    return build_graph(path, self_heal=heal)
 
 
 def _build(args, k: int):
@@ -319,6 +367,19 @@ def _cmd_fit(args, tel=None) -> int:
     ckpt = (
         CheckpointManager(args.checkpoint_dir) if args.checkpoint_dir else None
     )
+    resume = getattr(args, "resume", "auto") != "never"
+    if ckpt is not None and resume:
+        # --resume auto is actually resuming: record the attempt in the
+        # telemetry resume lineage (resume event + resume_lineage.json)
+        # BEFORE the fit, so even a re-crashed attempt leaves its trace.
+        # The step recorded is the newest VALID one — what restore() will
+        # use — not the newest filename (which may be corrupt).
+        valid_step = ckpt.latest_valid_step()
+        if valid_step is not None:
+            from bigclam_tpu.resilience import record_resume
+
+            record_resume(getattr(args, "telemetry_dir", None), valid_step)
+    sup = _make_supervisor(args, cfg, tel)
     mesh = getattr(model, "mesh", None)
     n_chips = mesh.size if mesh is not None else 1
     with MetricsLogger(args.metrics, echo=not args.quiet) as ml:
@@ -328,7 +389,8 @@ def _cmd_fit(args, tel=None) -> int:
             path=getattr(model, "engaged_path", ""),
             num_nodes=g.num_nodes,
         )
-        with prof.stage("fit"), trace(args.profile_dir):
+
+        def _run_fit():
             if cfg.quality_mode and getattr(args, "device_annealing", False):
                 from bigclam_tpu.models.quality import fit_quality_device
 
@@ -337,17 +399,26 @@ def _cmd_fit(args, tel=None) -> int:
                 # the last completed round. Cycle-granularity saves stay
                 # a host-loop feature (a full-F fetch per cycle).
                 qres = fit_quality_device(
-                    model, F0, callback=cb, checkpoints=ckpt
+                    model, F0, callback=cb, checkpoints=ckpt, resume=resume
                 )
-                res = qres.fit
-            elif cfg.quality_mode:
+                return qres, qres.fit
+            if cfg.quality_mode:
                 from bigclam_tpu.models.quality import fit_quality
 
-                qres = fit_quality(model, F0, callback=cb, checkpoints=ckpt)
-                res = qres.fit
-            else:
-                qres = None
-                res = model.fit(F0, callback=cb, checkpoints=ckpt)
+                qres = fit_quality(
+                    model, F0, callback=cb, checkpoints=ckpt, resume=resume
+                )
+                return qres, qres.fit
+            return None, model.fit(
+                F0, callback=cb, checkpoints=ckpt, resume=resume
+            )
+
+        with prof.stage("fit"), trace(args.profile_dir):
+            # the supervisor retries transient-classified failures (and
+            # stall escalations, when wired to abort): each retried
+            # attempt re-enters the fit WITH the CheckpointManager, so a
+            # retry resumes instead of restarting
+            qres, res = sup.run_fit(_run_fit)
     out = {
         "llh": res.llh,
         "iters": res.num_iters,
@@ -418,14 +489,32 @@ def _cmd_sweep(args, tel=None) -> int:
             ml.log({"k": k, "llh": llh})
 
         with prof.stage("sweep"), trace(args.profile_dir):
-            res = sweep_k(
-                g,
-                cfg,
-                model_factory=factory,
-                callback=cb,
-                state_dir=args.checkpoint_dir,
-                device_annealing=getattr(args, "device_annealing", False),
-            )
+            # retried sweep attempts resume from sweep_state.json (per-K
+            # journal) + the within-K checkpoints — the K-sweep-position
+            # half of preemption-safe auto-resume. --resume never ignores
+            # the journal (cold sweep); RETRIES within this run still
+            # resume from what the run itself journaled.
+            sup = _make_supervisor(args, cfg, tel)
+            first_attempt = [True]
+
+            def _run_sweep():
+                first, first_attempt[0] = first_attempt[0], False
+                return sweep_k(
+                    g,
+                    cfg,
+                    model_factory=factory,
+                    callback=cb,
+                    state_dir=args.checkpoint_dir,
+                    device_annealing=getattr(
+                        args, "device_annealing", False
+                    ),
+                    resume=(
+                        getattr(args, "resume", "auto") != "never"
+                        or not first
+                    ),
+                )
+
+            res = sup.run_fit(_run_sweep, site="sweep")
     out = {
         "chosen_k": res.chosen_k,
         "kset": res.kset,

@@ -164,6 +164,29 @@ class BigClamConfig:
                                         # degenerate and may move either
                                         # way (PARITY.md)
 
+    # --- resilience (bigclam_tpu/resilience; DESIGN.md "Failure model &
+    # recovery") ---
+    rollback_budget: int = 3            # non-finite-LLH rollbacks allowed per
+                                        # fit before escalating to the abort/
+                                        # diagnostic path (_abort_nonfinite).
+                                        # 0 = abort-only (pre-round-9
+                                        # behavior, and no snapshot copies)
+    rollback_shrink: float = 0.1        # step_scale multiplier applied at
+                                        # each rollback: the Armijo candidate
+                                        # ladder is cut so the replay takes
+                                        # smaller steps past the blow-up
+    rollback_snapshot_every: int = 8    # iterations between in-HBM snapshots
+                                        # of the last VERIFIED-finite state
+                                        # (ping-pong copy; one extra F-sized
+                                        # buffer resident, one device copy
+                                        # per interval). A rollback replays
+                                        # at most this many iterations
+    step_scale: float = 1.0             # global scale on the Armijo candidate
+                                        # ladder (step_candidates). Baked
+                                        # into the compiled step; the
+                                        # rollback path drives it via
+                                        # rebuild_step — not a user knob
+
     # --- numerics ---
     dtype: str = "float32"              # F / gradient dtype on device
     accum_dtype: Optional[str] = None   # LLH accumulation dtype; None = dtype
@@ -244,6 +267,10 @@ class BigClamConfig:
         for _ in range(self.max_backtracks):
             s *= self.beta
             steps.append(s)
+        if self.step_scale != 1.0:
+            # non-finite rollback's step cut (resilience): the whole ladder
+            # shrinks, the candidate COUNT (and accept_hist shape) does not
+            steps = [self.step_scale * v for v in steps]
         return tuple(steps)
 
     def replace(self, **kw) -> "BigClamConfig":

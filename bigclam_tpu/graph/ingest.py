@@ -106,11 +106,14 @@ def graph_from_edges(pairs: np.ndarray, num_nodes: int | None = None) -> Graph:
     return Graph(indptr=indptr, indices=dst.astype(np.int32), raw_ids=raw_ids)
 
 
-def build_graph(path: str) -> Graph:
+def build_graph(path: str, self_heal: bool = False) -> Graph:
     """Load a graph: a SNAP edge-list file (parse + remap + dedup) or a
-    graph-cache directory compiled by ``cli ingest`` (binary fast reload)."""
+    graph-cache directory compiled by ``cli ingest`` (binary fast reload).
+    `self_heal` lets a cache dir quarantine + rebuild a crc-failed shard
+    from its source edge list (graph.store.GraphStore) instead of
+    rejecting the whole cache — the CLI's default."""
     from bigclam_tpu.graph.store import GraphStore, is_cache_dir
 
     if is_cache_dir(path):
-        return GraphStore.open(path).load_graph()
+        return GraphStore.open(path, self_heal=self_heal).load_graph()
     return graph_from_edges(load_edge_list(path))

@@ -43,6 +43,7 @@ import dataclasses
 import json
 import os
 import shutil
+import sys
 import zlib
 from typing import Dict, List, Optional, Tuple
 
@@ -54,6 +55,17 @@ from bigclam_tpu.graph.stream import DEFAULT_CHUNK_BYTES, stream_edge_list
 
 MANIFEST_VERSION = 1
 MANIFEST_NAME = "manifest.json"
+QUARANTINE_DIR = "quarantine"
+
+
+class ShardCorruption(ValueError):
+    """A cache blob failed its manifest crc32 (or rebuild could not
+    reproduce it). Carries the shard index when the blob belongs to one,
+    so the self-heal path knows what to quarantine."""
+
+    def __init__(self, msg: str, shard: Optional[int] = None):
+        super().__init__(msg)
+        self.shard = shard
 
 
 def is_cache_dir(path: str) -> bool:
@@ -106,14 +118,24 @@ class HostShard:
 
 
 class GraphStore:
-    """Handle on a compiled cache directory (validated manifest)."""
+    """Handle on a compiled cache directory (validated manifest).
 
-    def __init__(self, directory: str, manifest: dict):
+    With ``self_heal=True`` (ISSUE 5: shard quarantine + re-ingest) a
+    crc32-failed SHARD blob is moved to ``quarantine/``, rebuilt from the
+    source edge list for just its node range (``rebuild_shard``), the
+    manifest re-stamped, and the load retried — a pod run degrades and
+    heals instead of dying. Default False: library opens keep the strict
+    reject-on-mismatch contract; the CLI turns healing on.
+    """
+
+    def __init__(self, directory: str, manifest: dict,
+                 self_heal: bool = False):
         self.directory = directory
         self.manifest = manifest
+        self.self_heal = self_heal
 
     @classmethod
-    def open(cls, directory: str) -> "GraphStore":
+    def open(cls, directory: str, self_heal: bool = False) -> "GraphStore":
         mpath = os.path.join(directory, MANIFEST_NAME)
         try:
             with open(mpath) as f:
@@ -136,7 +158,7 @@ class GraphStore:
                 f"{directory}: shard table has {len(manifest['shards'])} "
                 f"entries for num_shards={manifest['num_shards']}"
             )
-        return cls(directory, manifest)
+        return cls(directory, manifest, self_heal=self_heal)
 
     # --- manifest accessors ---
     @property
@@ -179,17 +201,55 @@ class GraphStore:
         verify: bool,
         mmap: bool,
         files_read: List[str],
+        shard: Optional[int] = None,
     ) -> np.ndarray:
         path = os.path.join(self.directory, relname)
         if verify:
             got = _crc32_file(path)
             if got != crc:
-                raise ValueError(
+                raise ShardCorruption(
                     f"{path}: checksum mismatch (expected {crc}, got {got}) "
-                    "— cache corrupted; re-run ingest"
+                    "— cache corrupted; re-run ingest",
+                    shard=shard,
                 )
         files_read.append(relname)
         return np.load(path, mmap_mode="r" if mmap else None)
+
+    def _load_shard_blobs(
+        self, s: int, verify: bool, mmap: bool, files_read: List[str]
+    ) -> Tuple[np.ndarray, np.ndarray]:
+        """One shard's (indptr, indices), crc-checked; the self-heal path
+        quarantines+rebuilds on a checksum failure and retries ONCE (a
+        rebuild that still mismatches propagates — the source is bad)."""
+        entry = self.manifest["shards"][s]
+        # fault-injection site (resilience.faults): corrupt this shard's
+        # indices blob just before the crc check
+        from bigclam_tpu.resilience import faults as _faults
+
+        spec = _faults.maybe_fire("store.load_shard", shard=s)
+        if spec is not None and spec["kind"] == "corrupt_shard":
+            _faults.apply_file_fault(
+                spec, os.path.join(self.directory, entry["indices"])
+            )
+        try:
+            return self._read_shard_blobs(s, entry, verify, mmap, files_read)
+        except ShardCorruption as e:
+            if not self.self_heal:
+                raise
+            self.quarantine_and_rebuild(s, reason=str(e))
+            entry = self.manifest["shards"][s]    # crc may be re-stamped
+            return self._read_shard_blobs(s, entry, verify, mmap, files_read)
+
+    def _read_shard_blobs(self, s, entry, verify, mmap, files_read):
+        ip = self._load_blob(
+            entry["indptr"], entry["crc32"]["indptr"], verify, mmap,
+            files_read, shard=s,
+        ).astype(np.int64, copy=False)
+        dp = self._load_blob(
+            entry["indices"], entry["crc32"]["indices"], verify, mmap,
+            files_read, shard=s,
+        )
+        return ip, dp
 
     def load_shard_range(
         self,
@@ -208,19 +268,10 @@ class GraphStore:
         files_read: List[str] = []
         entries = self.manifest["shards"][first_shard:last_shard]
         iparts, dparts = [], []
-        for entry in entries:
-            iparts.append(
-                self._load_blob(
-                    entry["indptr"], entry["crc32"]["indptr"], verify,
-                    mmap, files_read,
-                ).astype(np.int64, copy=False)
-            )
-            dparts.append(
-                self._load_blob(
-                    entry["indices"], entry["crc32"]["indices"], verify,
-                    mmap, files_read,
-                )
-            )
+        for off in range(first_shard, last_shard):
+            ip, dp = self._load_shard_blobs(off, verify, mmap, files_read)
+            iparts.append(ip)
+            dparts.append(dp)
         lo = int(entries[0]["lo"])
         hi = int(entries[-1]["hi"])
         indptr = np.zeros(hi - lo + 1, dtype=np.int64)
@@ -319,6 +370,152 @@ class GraphStore:
                 mmap=bool(mmap),
             )
         return g
+
+    # --- quarantine + re-ingest (ISSUE 5) ---
+    def quarantine_and_rebuild(self, s: int, reason: str = "") -> None:
+        """Rebuild shard `s` from the source edge list for just its node
+        range, move the corrupt blobs to quarantine/, and re-stamp the
+        manifest. The rebuild runs FIRST (_rebuild_shard_arrays): when it
+        is impossible (source missing / changed / raw-id table corrupt)
+        the ShardCorruption propagates with the cache left exactly as
+        found — still diagnosable by its checksum error, never stripped
+        of files the manifest references. Emits one `quarantine`
+        telemetry event on success."""
+        entry = self.manifest["shards"][s]
+        local_indptr, indices = self._rebuild_shard_arrays(s)
+        qdir = os.path.join(self.directory, QUARANTINE_DIR)
+        os.makedirs(qdir, exist_ok=True)
+        moved = []
+        for rel in (entry["indptr"], entry["indices"]):
+            src = os.path.join(self.directory, rel)
+            if os.path.exists(src):
+                dst = os.path.join(qdir, rel)
+                n = 0
+                while os.path.exists(dst):      # keep every incident
+                    n += 1
+                    dst = os.path.join(qdir, f"{rel}.{n}")
+                os.replace(src, dst)
+                moved.append(os.path.basename(dst))
+        restamped = self._write_shard_blobs(s, local_indptr, indices)
+        print(
+            f"warning: shard {s} of {self.directory} quarantined and "
+            f"rebuilt from source ({reason or 'checksum mismatch'})",
+            file=sys.stderr,
+        )
+        from bigclam_tpu.obs import telemetry as _obs
+
+        tel = _obs.current()
+        if tel is not None:
+            tel.event(
+                "quarantine",
+                shard=s,
+                reason=reason[:200],
+                quarantined=moved,
+                crc_restamped=restamped,
+                cache_dir=self.directory,
+            )
+
+    def rebuild_shard(self, s: int) -> bool:
+        """Re-ingest shard `s` alone and write fresh blobs in place.
+        Returns True when the manifest crc had to be re-stamped (a
+        byte-identical rebuild leaves it untouched)."""
+        local_indptr, indices = self._rebuild_shard_arrays(s)
+        return self._write_shard_blobs(s, local_indptr, indices)
+
+    def _rebuild_shard_arrays(self, s: int):
+        """Re-ingest shard `s` IN MEMORY: stream the source edge list,
+        remap raw ids through the cache's raw-id table (covers balanced
+        caches — raw_ids.npy is stored in final node order), keep
+        directed edges whose source row falls in this shard's node range,
+        dedup, and validate against the manifest's edge count. Touches no
+        cache files, so callers can sequence it before any destructive
+        step."""
+        entry = self.manifest["shards"][s]
+        source = self.manifest.get("source", {}).get("path")
+        if not source or not os.path.exists(source):
+            raise ShardCorruption(
+                f"{self.directory}: shard {s} corrupt and the source edge "
+                f"list is unavailable ({source!r}) — cannot rebuild; "
+                "re-run ingest",
+                shard=s,
+            )
+        raw_final = self.load_raw_ids(verify=True)   # corrupt table: raise
+        order = np.argsort(raw_final, kind="stable")
+        raw_sorted = raw_final[order]
+        n = self.num_nodes
+        lo, hi = int(entry["lo"]), int(entry["hi"])
+        parts: List[np.ndarray] = []
+        for pairs in stream_edge_list(source, DEFAULT_CHUNK_BYTES):
+            if pairs.size == 0:
+                continue
+            pos = np.searchsorted(raw_sorted, pairs)
+            known = raw_sorted[np.minimum(pos, n - 1)] == pairs
+            if not known.all():
+                raise ShardCorruption(
+                    f"{source}: contains node ids absent from the cache's "
+                    "raw-id table — source changed since ingest; re-run "
+                    "ingest",
+                    shard=s,
+                )
+            mapped = order[pos]
+            mapped = mapped[mapped[:, 0] != mapped[:, 1]]
+            both = np.concatenate([mapped, mapped[:, ::-1]], axis=0)
+            keep = both[(both[:, 0] >= lo) & (both[:, 0] < hi)]
+            if keep.size:
+                parts.append(keep)
+        both = (
+            np.concatenate(parts, axis=0)
+            if parts
+            else np.empty((0, 2), dtype=np.int64)
+        )
+        src, dst = dedup_directed(both)
+        local_indptr = np.zeros(hi - lo + 1, dtype=np.int64)
+        if src.size:
+            np.cumsum(
+                np.bincount(src - lo, minlength=hi - lo),
+                out=local_indptr[1:],
+            )
+        indices = dst.astype(np.int32)
+        if int(indices.shape[0]) != int(entry["edges"]):
+            raise ShardCorruption(
+                f"{self.directory}: shard {s} rebuild produced "
+                f"{indices.shape[0]} directed edges, manifest says "
+                f"{entry['edges']} — source changed since ingest; re-run "
+                "ingest",
+                shard=s,
+            )
+        return local_indptr, indices
+
+    def _write_shard_blobs(
+        self, s: int, local_indptr: np.ndarray, indices: np.ndarray
+    ) -> bool:
+        """Atomically install rebuilt blobs for shard `s` and re-stamp
+        the manifest crc when the bytes differ; True iff re-stamped."""
+        entry = self.manifest["shards"][s]
+        for rel, arr in ((entry["indptr"], local_indptr),
+                         (entry["indices"], indices)):
+            path = os.path.join(self.directory, rel)
+            tmp = f"{path}.{os.getpid()}.tmp"
+            with open(tmp, "wb") as f:
+                np.save(f, arr)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, path)
+        new_crc = {
+            "indptr": _crc32_file(
+                os.path.join(self.directory, entry["indptr"])
+            ),
+            "indices": _crc32_file(
+                os.path.join(self.directory, entry["indices"])
+            ),
+        }
+        restamped = new_crc != entry["crc32"]
+        if restamped:
+            entry["crc32"] = new_crc
+            _atomic_json(
+                os.path.join(self.directory, MANIFEST_NAME), self.manifest
+            )
+        return restamped
 
 
 # --------------------------------------------------------------------------

@@ -62,6 +62,10 @@ _HOST_ONLY_FIELDS = dict(
     restart_cycles=0, restart_tol=0.0, restart_patience=0,
     quality_conv_tol=0.0, quality_max_p=None,
     checkpoint_dir=None, checkpoint_every=0, metrics_path=None,
+    # resilience: rollback policy is host-loop-only; step_scale is NOT here
+    # (it rescales the baked Armijo ladder — a rollback's step cut compiles
+    # a new step, cached by this key)
+    rollback_budget=0, rollback_shrink=0.0, rollback_snapshot_every=0,
 )
 
 
@@ -131,6 +135,51 @@ def donation_scratch(state):
     preserves the layout on every backend). Used by run_fit_loop for the
     first calls of a fit, before a dropped previous state exists."""
     return jax.tree.map(jnp.copy, state)
+
+
+def _snapshot_ping_copy(dead, state):
+    """Device-side copy of `state` written into the DONATED buffers of the
+    previous snapshot (`dead`) — the rollback snapshot's in-HBM ping-pong:
+    one extra state-sized buffer stays resident, refreshed with a pure
+    device copy, never a host round trip. Module-level jit so repeated
+    fits at the same shapes hit the cache (the compile-flatness pin in
+    tests/test_telemetry.py counts every backend compile)."""
+    del dead                        # storage-only: aliased to the outputs
+    return jax.tree.map(jnp.copy, state)
+
+
+_SNAPSHOT_PING = jax.jit(
+    _snapshot_ping_copy, donate_argnums=(0,), keep_unused=True
+)
+
+
+class _ScaleRebuilder:
+    """run_fit_loop's step-cut hook (non-finite rollback): rebuilds the
+    model's train step with the Armijo ladder scaled by cfg.step_scale.
+    Works for every trainer exposing .cfg / .rebuild_step() / ._step
+    (BigClamModel, the sharded/ring trainers — the same surface quality
+    mode's max_p relaxation drives). `restore()` puts the model back on
+    its original config after the fit, so a shrunken ladder never leaks
+    into the caller's next fit; compiled steps stay cached either way."""
+
+    def __init__(self, model):
+        self.model = model
+        self.orig_cfg = model.cfg
+        self.engaged = False
+
+    def __call__(self, scale: float):
+        self.engaged = True
+        m = self.model
+        m.cfg = m.cfg.replace(step_scale=scale)
+        m.rebuild_step()
+        return m._step
+
+    def restore(self) -> None:
+        if not self.engaged:
+            return
+        m = self.model
+        m.cfg = self.orig_cfg
+        m.rebuild_step()
 
 
 def log_engaged_path(model_name: str, path: str, reason: str = "") -> None:
@@ -282,6 +331,7 @@ def run_fit_loop(
     state_to_arrays: Optional[Callable[[TrainState], dict]] = None,
     initial_hist: tuple = (),
     ckpt_meta: Optional[dict] = None,
+    rebuild_step: Optional[Callable[[float], Callable]] = None,
 ):
     """Shared convergence loop (MBSGD semantics, Bigclamv2.scala:203-219),
     used by both the single-chip and the sharded trainer.
@@ -326,6 +376,19 @@ def run_fit_loop(
     on garbage until max_iters. Telemetry off costs one None check per
     iteration plus math.isfinite on a host float (pinned < 2% of step time
     by tests/test_telemetry.py).
+
+    NON-FINITE ROLLBACK (cfg.rollback_budget > 0, resilience/ISSUE 5):
+    instead of abort-only, the loop keeps an in-HBM snapshot of the last
+    VERIFIED-finite state (refreshed every cfg.rollback_snapshot_every
+    iterations by a ping-pong device copy — no host round trip on the
+    happy path). On a non-finite LLH it emits a `rollback` event, restores
+    the snapshot (truncating the LLH history to the snapshot point so the
+    convergence test replays rather than spuriously firing), cuts the
+    Armijo ladder by cfg.rollback_shrink via `rebuild_step(scale)` (when
+    the caller provides the hook — _ScaleRebuilder), and continues. After
+    cfg.rollback_budget rollbacks the existing abort/diagnostic path
+    fires. The fault-injection harness (resilience.faults) is consulted
+    once per iteration at site "fit.step" (kill / delay / nan_inject).
     """
     import inspect
     import math
@@ -355,12 +418,29 @@ def run_fit_loop(
                 cb_arity = 3
         except (TypeError, ValueError):
             cb_arity = 2
+    from bigclam_tpu.resilience import faults as _faults
+
     donating = getattr(step_fn, "donating", None)
     donate = bool(getattr(cfg, "donate_state", False)) and donating is not None
     scratch = None      # dead previous state whose buffers the next donating
     hist: list[float] = list(initial_hist)  # call recycles
-    remaining = max(cfg.max_iters - int(state.it), 0)
-    for i in range(remaining + 1):
+    # --- rollback state (see docstring) ---
+    budget = max(int(getattr(cfg, "rollback_budget", 0)), 0)
+    snap_every = max(int(getattr(cfg, "rollback_snapshot_every", 1)), 1)
+    snapshot = None          # last verified-finite state (device copy)
+    snap_hist_len = len(hist)
+    fallback = state if budget else None    # pre-first-snapshot target
+    rollbacks = 0
+    since_snap = 0
+    scale = float(getattr(cfg, "step_scale", 1.0))
+    owned = False       # state is loop-produced (donatable when dropped);
+    while True:         # the caller's initial state never is
+        fault = _faults.maybe_fire("fit.step", it=int(state.it))
+        if fault is not None and fault.get("kind") == "nan_inject":
+            i0, j0 = fault.get("index", (0, 0))
+            state = state._replace(
+                F=state.F.at[int(i0), int(j0)].set(float("nan"))
+            )
         if donate:
             dead, scratch = scratch, None
             if dead is None:
@@ -370,7 +450,54 @@ def run_fit_loop(
             new_state = step_fn(state)
         llh_t = float(new_state.llh)           # LLH of state.F
         if not math.isfinite(llh_t):
-            _abort_nonfinite(state, new_state, llh_t, hist)
+            target = snapshot if snapshot is not None else fallback
+            if rollbacks >= budget or target is None:
+                _abort_nonfinite(state, new_state, llh_t, hist, rollbacks)
+            rollbacks += 1
+            shrink = float(getattr(cfg, "rollback_shrink", 1.0) or 1.0)
+            scale *= shrink
+            if tel is not None:
+                tel.event(
+                    "rollback",
+                    iter=int(state.it),
+                    llh=llh_t,
+                    rollbacks=rollbacks,
+                    resume_iter=int(target.it),
+                    step_scale=scale,
+                )
+            # restore by COPY: the target must stay alive for further
+            # rollbacks while the restored state re-enters the donation
+            # ping-pong as a loop-owned buffer
+            state = jax.tree.map(jnp.copy, target)
+            owned = True
+            scratch = None
+            # truncate the history to the restore point: the replayed
+            # iterations re-evaluate their LLHs, and the convergence test
+            # must compare them against the SAME predecessors as the
+            # original pass (not against themselves)
+            del hist[(snap_hist_len if snapshot is not None
+                      else len(initial_hist)):]
+            since_snap = 0
+            if rebuild_step is not None and scale != 1.0:
+                step_fn = rebuild_step(scale)
+                donating = getattr(step_fn, "donating", None)
+                donate = (
+                    bool(getattr(cfg, "donate_state", False))
+                    and donating is not None
+                )
+            continue
+        if budget and (snapshot is None or since_snap >= snap_every):
+            # state.F is VERIFIED finite (llh_t is its LLH): refresh the
+            # rollback snapshot on the ping-pong cadence
+            snapshot = (
+                _SNAPSHOT_PING(snapshot, state)
+                if snapshot is not None
+                else jax.tree.map(jnp.copy, state)
+            )
+            snap_hist_len = len(hist)
+            since_snap = 0
+            fallback = None      # the snapshot supersedes the initial state
+        since_snap += 1
         if tel is not None:
             tel.step_beat(int(state.it), llh_t)
         if callback is not None:
@@ -389,16 +516,17 @@ def run_fit_loop(
             hist.append(llh_t)
             break
         hist.append(llh_t)
-        if i == remaining:
+        if int(state.it) >= cfg.max_iters:
             # hit max_iters without converging; `state` is the last state
             # whose LLH was actually evaluated (hist[-1])
             final, final_llh, iters = state, llh_t, int(state.it)
             break
-        if i > 0:
-            # loop-produced and dropped below -> next call's donation;
-            # i == 0 is the caller's initial state (may still be held)
+        if owned:
+            # loop-produced and dropped below -> next call's donation; the
+            # caller's initial state (owned=False) may still be held
             scratch = state
         state = new_state
+        owned = True
         if (
             checkpoints is not None
             and cfg.checkpoint_every > 0
@@ -430,7 +558,9 @@ def run_fit_loop(
     )
 
 
-def _abort_nonfinite(state, new_state, llh_t: float, hist) -> None:
+def _abort_nonfinite(
+    state, new_state, llh_t: float, hist, rollbacks: int = 0
+) -> None:
     """Non-finite-LLH sentinel (SURVEY §5 / ISSUE 4): diagnose, dump,
     abort.
 
@@ -441,7 +571,9 @@ def _abort_nonfinite(state, new_state, llh_t: float, hist) -> None:
     possibly-globally-sharded F return replicated scalars, so this works
     under multi-controller where np.asarray(F) would throw), emitted as a
     `nonfinite` telemetry event, and dumped to <telemetry>/nonfinite_dump
-    .npz before raising FloatingPointError."""
+    .npz before raising FloatingPointError. With rollback enabled
+    (cfg.rollback_budget) this is the ESCALATION path — `rollbacks` says
+    how many recovery attempts were already burned."""
     import jax.numpy as jnp
 
     from bigclam_tpu.obs import telemetry as _obs
@@ -450,6 +582,7 @@ def _abort_nonfinite(state, new_state, llh_t: float, hist) -> None:
     diag = {
         "iter": int(state.it),
         "llh": llh_t,
+        "rollbacks": rollbacks,
         "f_nonfinite": int(jnp.size(F) - jnp.isfinite(F).sum()),
         "f_min": float(jnp.min(F)),
         "f_max": float(jnp.max(F)),
@@ -484,6 +617,11 @@ def _abort_nonfinite(state, new_state, llh_t: float, hist) -> None:
         f"{diag['f_nonfinite']} non-finite F entries, "
         f"F range [{diag['f_min']:.3g}, {diag['f_max']:.3g}], "
         f"accept_hist={diag['accept_hist']}"
+        + (
+            f"; rollback budget exhausted after {rollbacks} rollback(s)"
+            if rollbacks
+            else ""
+        )
         + (f"; diagnostics dumped to {dump}" if dump else "")
     )
 
@@ -980,6 +1118,11 @@ class BigClamModel:
             "k": self.cfg.num_communities,
             "n_pad": self.n_pad,
             "k_pad": self.k_pad,
+            # --resume auto reconstructs the rng lineage from here: a
+            # checkpoint written under a different seed must refuse, not
+            # silently splice two trajectories (restore_checkpoint's
+            # falsy-default rule keeps old seedless checkpoints loadable)
+            "seed": self.cfg.seed,
         }
 
     def _state_to_arrays(self, state: TrainState) -> dict:
@@ -1006,28 +1149,36 @@ class BigClamModel:
         F0: np.ndarray,
         callback: Optional[Callable[[int, float], None]] = None,
         checkpoints=None,
+        resume: bool = True,
     ) -> FitResult:
         """Train to convergence (see run_fit_loop). If `checkpoints` (a
         utils.checkpoint.CheckpointManager) holds a saved state, training
-        resumes from it; F0 is only the cold-start init."""
+        resumes from it (resume=False forces a cold start while still
+        SAVING new checkpoints — `cli fit --resume never`); F0 is only the
+        cold-start init."""
         state, hist = self.init_state(F0), ()
-        if checkpoints is not None:
+        if checkpoints is not None and resume:
             restored, hist = restore_checkpoint(
                 checkpoints, self._ckpt_meta(), self._state_from_arrays
             )
             if restored is not None:
                 state = restored
-        return run_fit_loop(
-            self._step,
-            state,
-            self.cfg,
-            callback,
-            self.extract_F,
-            checkpoints=checkpoints,
-            state_to_arrays=self._state_to_arrays,
-            initial_hist=hist,
-            ckpt_meta=self._ckpt_meta(),
-        )
+        rebuilder = _ScaleRebuilder(self)
+        try:
+            return run_fit_loop(
+                self._step,
+                state,
+                self.cfg,
+                callback,
+                self.extract_F,
+                checkpoints=checkpoints,
+                state_to_arrays=self._state_to_arrays,
+                initial_hist=hist,
+                ckpt_meta=self._ckpt_meta(),
+                rebuild_step=rebuilder,
+            )
+        finally:
+            rebuilder.restore()
 
     def fit_state(
         self,
@@ -1038,9 +1189,14 @@ class BigClamModel:
         (final_state, final_llh, num_iters, llh_history) without fetching F
         to the host — the pod-scale entry point (fit() wraps init_state +
         host extraction around the same loop)."""
-        return run_fit_loop(
-            self._step, state, self.cfg, callback, None
-        )
+        rebuilder = _ScaleRebuilder(self)
+        try:
+            return run_fit_loop(
+                self._step, state, self.cfg, callback, None,
+                rebuild_step=rebuilder,
+            )
+        finally:
+            rebuilder.restore()
 
     def random_init(self, seed: Optional[int] = None) -> np.ndarray:
         """Bernoulli(0.5) {0,1} init, the reference's random-row distribution

@@ -81,6 +81,7 @@ def sweep_k(
     rng: Optional[np.random.Generator] = None,
     state_dir: Optional[str] = None,
     device_annealing: bool = False,
+    resume: bool = True,
 ) -> SweepResult:
     """Train across the K grid and pick KforC (bigclam4-7.scala:244-266).
 
@@ -94,7 +95,10 @@ def sweep_k(
     only restart from scratch). With cfg.checkpoint_every > 0, each K's fit
     additionally checkpoints WITHIN the K (state_dir/k_<K>/), so a crash
     hours into one K resumes inside that K instead of restarting it; a K's
-    checkpoints are deleted once its LLH is journaled.
+    checkpoints are deleted once its LLH is journaled. `resume=False`
+    (cli --resume never) ignores the existing journal and within-K
+    checkpoints — every K retrains cold — while still journaling fresh
+    results.
     """
     import json
     import os
@@ -130,7 +134,7 @@ def sweep_k(
     if state_dir is not None:
         os.makedirs(state_dir, exist_ok=True)
         state_path = os.path.join(state_dir, "sweep_state.json")
-        if os.path.exists(state_path):
+        if resume and os.path.exists(state_path):
             with open(state_path) as f:
                 llh_by_k = {int(k): v for k, v in json.load(f).items()}
 
@@ -168,7 +172,8 @@ def sweep_k(
                 from bigclam_tpu.models.quality import fit_quality_device
 
                 qres = fit_quality_device(
-                    model, F0, kick_cols=k, key_salt=k, checkpoints=ckpt_k
+                    model, F0, kick_cols=k, key_salt=k, checkpoints=ckpt_k,
+                    resume=resume,
                 )
                 res = qres.fit
             elif cfg.quality_mode:
@@ -180,11 +185,12 @@ def sweep_k(
                 from bigclam_tpu.models.quality import fit_quality
 
                 qres = fit_quality(
-                    model, F0, checkpoints=ckpt_k, kick_cols=k
+                    model, F0, checkpoints=ckpt_k, kick_cols=k,
+                    resume=resume,
                 )
                 res = qres.fit
             else:
-                res = model.fit(F0, checkpoints=ckpt_k)
+                res = model.fit(F0, checkpoints=ckpt_k, resume=resume)
             res_llh = res.llh
             llh_by_k[k] = res_llh
             best_fit = res

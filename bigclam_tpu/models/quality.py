@@ -741,6 +741,7 @@ def _repair_stage(
     callback,
     checkpoints=None,
     min_comp: int = 5,
+    resume: bool = True,
 ) -> Tuple[FitResult, int, int]:
     """The DISCRETE improvement stage shared by fit_quality and
     fit_quality_device. Each round tries (a) the atomize re-tiling
@@ -782,6 +783,8 @@ def _repair_stage(
         # different schedule on resume, ADVICE round-5) gates the restore
         stamp = _repair_stamp(cfg, anneal_llh, kc, eps, min_comp, "host")
         rep_ckpt, restored = _repair_ckpt_open(checkpoints, stamp)
+        if not resume:
+            restored = None      # cold start: keep saving, never restore
         if restored is not None:
             rr_done, arrays, meta = restored
             F_r = np.asarray(arrays["F"])
@@ -871,6 +874,7 @@ def fit_quality(
     checkpoints=None,
     kick_cols: Optional[int] = None,
     profile=None,
+    resume: bool = True,
 ) -> QualityResult:
     """Train with the quality-mode schedule (see module docstring).
 
@@ -897,6 +901,9 @@ def fit_quality(
     accumulates anneal/repair wall-clock; the report lands in
     QualityResult.stages so artifacts can attribute the quality stage's
     cost (the device loop records finer stages plus transfer counts).
+
+    `resume=False` (cli --resume never) ignores any existing cycle
+    checkpoints — cold start from F0 — while still SAVING new ones.
     """
     import time
 
@@ -916,7 +923,7 @@ def fit_quality(
     restored_gainless = 0
     max_p_q, eps = _relax_params(model, n)
 
-    if checkpoints is not None:
+    if checkpoints is not None and resume:
         restored = checkpoints.restore()
         if restored is not None:
             cyc, arrays, meta = restored
@@ -1007,11 +1014,37 @@ def fit_quality(
             # (.cfg, .g, .fit(F0, callback=), .rebuild_step()) stays
             # sufficient for duck-typed trainers unless within-cycle
             # checkpointing was explicitly requested
-            res = (
-                model.fit(F_try, callback=callback, checkpoints=cyc_ckpt)
-                if cyc_ckpt is not None
-                else model.fit(F_try, callback=callback)
-            )
+            try:
+                res = (
+                    model.fit(F_try, callback=callback, checkpoints=cyc_ckpt)
+                    if cyc_ckpt is not None
+                    else model.fit(F_try, callback=callback)
+                )
+            except FloatingPointError as e:
+                # a kick blew up past the fit loop's rollback budget
+                # (models.bigclam run_fit_loop): annealing is an OPTIONAL
+                # refinement on top of a kept-best state, so with a best in
+                # hand the right move is degrade-not-die — revert the kick,
+                # keep the best, stop annealing. Without one (cycle 0)
+                # there is nothing to fall back to: propagate.
+                if best is None:
+                    raise
+                warnings.warn(
+                    f"annealing cycle {cycle} aborted non-finite ({e}); "
+                    "keeping the best converged state and stopping the "
+                    "annealing loop"
+                )
+                from bigclam_tpu.obs import telemetry as _obs_t
+
+                tel = _obs_t.current()
+                if tel is not None:
+                    tel.event(
+                        "note",
+                        msg="quality_cycle_nonfinite_abort",
+                        cycle=cycle,
+                        kept_llh=best.llh,
+                    )
+                break
             total_iters += res.num_iters
             cycles_llh.append(res.llh)
             prev_best = best.llh if best is not None else None
@@ -1057,7 +1090,8 @@ def fit_quality(
         if cfg.quality_repair and best is not None:
             t_rep = time.perf_counter()
             best, accepted_repairs, rep_iters = _repair_stage(
-                model, best, kc, eps, callback, checkpoints=checkpoints
+                model, best, kc, eps, callback, checkpoints=checkpoints,
+                resume=resume,
             )
             total_iters += rep_iters
             profile.add_seconds("repair", time.perf_counter() - t_rep)
@@ -1089,6 +1123,7 @@ def _repair_stage_device(
     profile,
     checkpoints=None,
     min_comp: int = 5,
+    resume: bool = True,
 ):
     """DEVICE-RESIDENT discrete stage: the _repair_stage twin that keeps F
     on the chips (fit_quality_device's residency protocol; DESIGN.md
@@ -1147,6 +1182,8 @@ def _repair_stage_device(
     if checkpoints is not None:
         stamp = _repair_stamp(cfg, anneal_llh, kc, eps, min_comp, "device")
         rep_ckpt, restored = _repair_ckpt_open(checkpoints, stamp)
+        if not resume:
+            restored = None      # cold start: keep saving, never restore
         if restored is not None:
             rr_done, arrays, meta = restored
             best_state = model.init_state(np.asarray(arrays["F"]))
@@ -1349,9 +1386,11 @@ def fit_quality_device(
     key_salt: int = 0,
     checkpoints=None,
     profile=None,
+    resume: bool = True,
 ) -> QualityResult:
     """DEVICE-RESIDENT annealing + discrete stage: the pod-scale variant
-    of fit_quality.
+    of fit_quality. `resume=False` skips the repair-round restore (cold
+    start) while still saving new round checkpoints.
 
     The host loop round-trips the full (N, K) F to the host every cycle
     (res.F out, kicked F_try back in) — at com-Orkut scale (N=3.07M,
@@ -1486,7 +1525,7 @@ def fit_quality_device(
             ) = _repair_stage_device(
                 model, best_state, best_llh, best_iters, best_hist, kc,
                 eps, callback, kick_fn, base_key, profile,
-                checkpoints=checkpoints,
+                checkpoints=checkpoints, resume=resume,
             )
             total_iters += rep_iters
         with profile.stage("final_fetch"):

@@ -14,6 +14,14 @@ cancelled from Python anyway, so the job is to make the hang *visible* and
 attributable (which phase, which process, what memory state) rather than
 to kill it. Repeated stalls re-emit once per deadline, so a 30-minute hang
 produces a timeline, not one line.
+
+ESCALATION (ISSUE 5 satellite): beating forever is itself a failure mode —
+a wedged run emitting its 40th identical stall line is not recovering.
+With `escalate_after=N`, the Nth CONSECUTIVE stall (no beat in between)
+additionally emits ONE `stall_escalated` event and invokes `on_escalate`
+(the resilience supervisor's hook, which can abort-and-retry the attempt
+for host-side stalls). One escalation per silence episode: a beat resets
+the consecutive counter and re-arms it.
 """
 
 from __future__ import annotations
@@ -35,6 +43,8 @@ class Heartbeat:
         deadline_s: float,
         echo: bool = True,
         poll_s: Optional[float] = None,
+        escalate_after: int = 0,
+        on_escalate=None,
     ):
         self.telemetry = telemetry
         self.deadline_s = float(deadline_s)
@@ -43,6 +53,10 @@ class Heartbeat:
             self.deadline_s / 4.0, 0.01
         )
         self.stalls = 0
+        self.escalate_after = int(escalate_after)
+        self.on_escalate = on_escalate
+        self.escalations = 0
+        self._consecutive = 0
         self._last_beat = time.monotonic()
         self._last_emit = self._last_beat
         self._progress: dict = {}
@@ -64,6 +78,7 @@ class Heartbeat:
         with self._lock:
             self._last_beat = time.monotonic()
             self._last_emit = self._last_beat
+            self._consecutive = 0       # progress re-arms escalation
             if progress:
                 self._progress = progress
 
@@ -90,6 +105,9 @@ class Heartbeat:
         from bigclam_tpu.utils.profiling import current_rss_bytes
 
         self.stalls += 1
+        with self._lock:
+            self._consecutive += 1
+            consecutive = self._consecutive
         rss = current_rss_bytes()
         devices = self.telemetry.device_memory_snapshot()
         self.telemetry.event(
@@ -108,3 +126,25 @@ class Heartbeat:
                 file=sys.stderr,
                 flush=True,
             )
+        if self.escalate_after and consecutive == self.escalate_after:
+            self.escalations += 1
+            self.telemetry.event(
+                "stall_escalated",
+                stalls=consecutive,
+                silent_s=round(silent_s, 3),
+                progress=progress,
+            )
+            if self.echo:
+                print(
+                    f"[bigclam] STALL ESCALATED after {consecutive} "
+                    f"consecutive deadline(s)",
+                    file=sys.stderr,
+                    flush=True,
+                )
+            cb = self.on_escalate
+            if cb is not None:
+                try:
+                    cb({"silent_s": silent_s, "stalls": consecutive,
+                        "progress": progress})
+                except Exception:
+                    pass            # the watchdog must never kill the run

@@ -107,6 +107,17 @@ def merge_reports(reports: List[dict]) -> dict:
     return merged
 
 
+def _load_lineage(directory: str) -> List[dict]:
+    """resume_lineage.json written by resilience.supervisor.record_resume
+    (--resume auto); [] when absent/unreadable."""
+    try:
+        with open(os.path.join(directory, "resume_lineage.json")) as f:
+            out = json.load(f)
+        return out if isinstance(out, list) else []
+    except (OSError, ValueError):
+        return []
+
+
 def _fmt_bytes(v: Optional[int]) -> str:
     if v is None:
         return "-"
@@ -186,6 +197,61 @@ def render(directory: str) -> Tuple[str, int]:
             # counted into the exit code
             lines.append("")
             lines.append(f"STALLS: {merged['stalls']} heartbeat deadline(s) hit")
+
+        # --- recovery history (ISSUE 5): retries, rollbacks, quarantines,
+        # injected faults, escalations, resume lineage. A gave_up means the
+        # run ENDED in an unrecovered failure: counted into the exit code.
+        recovery_kinds = (
+            "retry", "recovered", "gave_up", "rollback", "quarantine",
+            "resume", "fault_injected", "stall_escalated",
+        )
+        rec_counts = {
+            k: merged["events"].get(k, 0)
+            for k in recovery_kinds
+            if merged["events"].get(k, 0)
+        }
+        lineage = _load_lineage(directory)
+        if rec_counts or lineage:
+            lines.append("")
+            lines.append(
+                "recovery: "
+                + (json.dumps(rec_counts) if rec_counts else "(clean)")
+            )
+            for e in (events or []):
+                kind = e.get("kind")
+                if kind == "gave_up":
+                    lines.append(
+                        f"  GAVE UP at {e.get('site')}: "
+                        f"{e.get('attempts')} attempt(s), "
+                        f"{e.get('error', '?')}"
+                    )
+                elif kind == "rollback":
+                    lines.append(
+                        f"  rollback #{e.get('rollbacks')} at iter "
+                        f"{e.get('iter')} -> iter {e.get('resume_iter')} "
+                        f"(step_scale {e.get('step_scale')})"
+                    )
+                elif kind == "quarantine":
+                    lines.append(
+                        f"  quarantined shard {e.get('shard')} "
+                        f"(rebuilt, crc restamped: "
+                        f"{e.get('crc_restamped')})"
+                    )
+            if lineage:
+                lines.append(
+                    f"  resume lineage: {len(lineage)} resumed attempt(s)"
+                )
+                for a in lineage:
+                    lines.append(
+                        f"    attempt {a.get('attempt_id')} run "
+                        f"{a.get('run')} resumed at step "
+                        f"{a.get('resumed_step')}"
+                    )
+            if merged["events"].get("gave_up", 0):
+                errors += 1
+                lines.append(
+                    "  ERROR: run ended in gave_up (retry budget exhausted)"
+                )
         if merged["final"]:
             lines.append("")
             lines.append("final: " + json.dumps(merged["final"]))

@@ -40,10 +40,26 @@ EVENT_KINDS = {
     "distributed_init": {"processes": (int,)},
     "cycle": {"cycle": (int,), "llh": _NUM},   # quality annealing cycle
     "stall": {"silent_s": _NUM, "rss_bytes": (int,)},  # heartbeat deadline hit
+    "stall_escalated": {"stalls": (int,)},  # N consecutive stalls: watchdog
+                                            # escalated (obs.heartbeat)
     "nonfinite": {"iter": (int,)},         # non-finite LLH sentinel fired
     "ingest": {"edges": (int,)},           # graph cache compiled
     "graph_load": {"source": (str,)},      # graph materialized on host
     "note": {},                            # freeform annotation
+    # --- resilience (bigclam_tpu/resilience, ISSUE 5) ---
+    "fault_injected": {"site": (str,), "fault": (str,)},  # harness fired
+    "retry": {"site": (str,), "attempt": (int,)},   # transient failure,
+                                                    # backing off
+    "recovered": {"site": (str,), "attempts": (int,)},  # retry succeeded
+    "gave_up": {"site": (str,), "attempts": (int,)},    # budget exhausted
+                                                    # (cli report exits 1)
+    "rollback": {"iter": (int,), "rollbacks": (int,)},  # non-finite LLH:
+                                                    # state rolled back to
+                                                    # the last finite
+                                                    # snapshot, step cut
+    "quarantine": {"shard": (int,)},       # crc-failed shard moved aside
+                                           # and rebuilt from source
+    "resume": {"step": (int,)},            # --resume auto restored a run
 }
 
 _BASE = {"v": (int,), "run": (str,), "pid": (int,), "t": _NUM, "kind": (str,)}

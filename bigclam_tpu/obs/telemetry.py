@@ -262,6 +262,7 @@ class RunTelemetry:
         quiet: bool = False,
         device_memory: bool = True,
         auto_gate: bool = True,
+        heartbeat_escalate: int = 0,
     ):
         os.makedirs(directory, exist_ok=True)
         self.directory = directory
@@ -298,7 +299,8 @@ class RunTelemetry:
             from bigclam_tpu.obs.heartbeat import Heartbeat
 
             self.heartbeat = Heartbeat(
-                self, heartbeat_s, echo=not quiet
+                self, heartbeat_s, echo=not quiet,
+                escalate_after=heartbeat_escalate,
             ).start()
         if device_memory or _jax_loaded():
             self.compiles["monitor"] = _ensure_monitor()
@@ -524,6 +526,11 @@ class RunTelemetry:
                     ),
                     "stalls": (
                         self.heartbeat.stalls
+                        if self.heartbeat is not None
+                        else 0
+                    ),
+                    "escalations": (
+                        self.heartbeat.escalations
                         if self.heartbeat is not None
                         else 0
                     ),

@@ -210,9 +210,21 @@ def load_host_shard(
     (graph/store.GraphStore): reads ONLY the shard files for the ranges
     this host's devices own — the multi-host ingest analog of
     put_process_local, and the reason no host ever materializes the global
-    CSR on the store-backed path (parallel/sharded.py)."""
+    CSR on the store-backed path (parallel/sharded.py).
+
+    The read runs under the resilience retry policy: on shared filesystems
+    (GCS/NFS) a shard blob can transiently 404/stall right after ingest
+    publishes it, and one wedged host read kills a gang-scheduled pod job.
+    Deterministic checksum failures are NOT retried here — they classify
+    fatal unless the store was opened self-healing, in which case the
+    store itself quarantines and rebuilds inside the attempt."""
+    from bigclam_tpu.resilience.retry import call_with_retry
+
     ids = host_shard_ids(store.num_shards, process_index, process_count)
-    return store.load_shard_range(ids.start, ids.stop, verify=verify)
+    return call_with_retry(
+        lambda: store.load_shard_range(ids.start, ids.stop, verify=verify),
+        site="store.load_host_shard",
+    )
 
 
 def put_host_local(

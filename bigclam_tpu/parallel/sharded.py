@@ -1008,6 +1008,8 @@ class ShardedBigClamModel:
             "node_shards": (
                 self.mesh.shape[NODES_AXIS] if self._perm is not None else 0
             ),
+            # rng lineage for --resume auto (see BigClamModel._ckpt_meta)
+            "seed": self.cfg.seed,
         }
 
     def _state_to_arrays(self, state: TrainState) -> dict:
@@ -1036,27 +1038,36 @@ class ShardedBigClamModel:
         F0: np.ndarray,
         callback: Optional[Callable[[int, float], None]] = None,
         checkpoints=None,
+        resume: bool = True,
     ) -> FitResult:
         """Train to convergence (shared loop: models.bigclam.run_fit_loop);
-        resumes from `checkpoints` when it holds a saved state."""
+        resumes from `checkpoints` when it holds a saved state (resume=
+        False forces a cold start that still saves)."""
         state, hist = self.init_state(F0), ()
-        if checkpoints is not None:
+        if checkpoints is not None and resume:
             restored, hist = restore_checkpoint(
                 checkpoints, self._ckpt_meta(), self._state_from_arrays
             )
             if restored is not None:
                 state = restored
-        return run_fit_loop(
-            self._step,
-            state,
-            self.cfg,
-            callback,
-            self.extract_F,
-            checkpoints=checkpoints,
-            state_to_arrays=self._state_to_arrays,
-            initial_hist=hist,
-            ckpt_meta=self._ckpt_meta(),
-        )
+        from bigclam_tpu.models.bigclam import _ScaleRebuilder
+
+        rebuilder = _ScaleRebuilder(self)
+        try:
+            return run_fit_loop(
+                self._step,
+                state,
+                self.cfg,
+                callback,
+                self.extract_F,
+                checkpoints=checkpoints,
+                state_to_arrays=self._state_to_arrays,
+                initial_hist=hist,
+                ckpt_meta=self._ckpt_meta(),
+                rebuild_step=rebuilder,
+            )
+        finally:
+            rebuilder.restore()
 
     def fit_state(
         self,
@@ -1066,9 +1077,16 @@ class ShardedBigClamModel:
         """State-resident convergence loop (same contract as
         models.bigclam.BigClamModel.fit_state): no all-gather of F to the
         host; only per-iteration LLH scalars cross the boundary."""
-        return run_fit_loop(
-            self._step, state, self.cfg, callback, None
-        )
+        from bigclam_tpu.models.bigclam import _ScaleRebuilder
+
+        rebuilder = _ScaleRebuilder(self)
+        try:
+            return run_fit_loop(
+                self._step, state, self.cfg, callback, None,
+                rebuild_step=rebuilder,
+            )
+        finally:
+            rebuilder.restore()
 
 
 class _StoreGraphView:

@@ -1,0 +1,56 @@
+"""Fault-tolerant fit orchestration (ISSUE 5): detection -> recovery.
+
+PR 4 made failures VISIBLE (stall/nonfinite telemetry events, checksum
+rejection); this package makes them SURVIVABLE, layer by layer:
+
+* faults.py      — deterministic seeded fault injection (kill / delay /
+                   NaN / truncate / corrupt) at instrumented sites, driven
+                   by tests, the chaos gate, and BIGCLAM_FAULTS
+* retry.py       — classified (transient vs fatal) retry with seeded
+                   exponential backoff, emitting retry/recovered/gave_up
+                   telemetry events
+* supervisor.py  — the orchestration shim: whole-fit retry that resumes
+                   from checkpoints, stall-escalation abort hook, and the
+                   resume lineage record behind `cli fit --resume auto`
+
+The in-loop recovery mechanisms live where the loops live: non-finite
+ROLLBACK in models.bigclam.run_fit_loop (snapshot ping-pong + step-scale
+cut), checkpoint payload crc + corruption-safe rotation in
+utils.checkpoint, and shard QUARANTINE + re-ingest in graph.store.
+"""
+
+from bigclam_tpu.resilience.faults import (
+    FaultPlan,
+    current_plan,
+    install_plan,
+    maybe_fire,
+)
+from bigclam_tpu.resilience.retry import (
+    FatalError,
+    RetryPolicy,
+    TransientError,
+    call_with_retry,
+    classify,
+)
+from bigclam_tpu.resilience.supervisor import (
+    StallEscalation,
+    Supervisor,
+    read_lineage,
+    record_resume,
+)
+
+__all__ = [
+    "FatalError",
+    "FaultPlan",
+    "RetryPolicy",
+    "StallEscalation",
+    "Supervisor",
+    "TransientError",
+    "call_with_retry",
+    "classify",
+    "current_plan",
+    "install_plan",
+    "maybe_fire",
+    "read_lineage",
+    "record_resume",
+]

@@ -10,6 +10,14 @@ Format: one .npz per checkpoint (atomic tmp+rename) with a JSON sidecar of
 scalar metadata; rotation keeps the newest `keep` checkpoints. No external
 dependencies (orbax users can layer it on top; this manager is deliberately
 self-contained so restores work anywhere NumPy does).
+
+Integrity (ISSUE 5 satellite): `save` stamps a crc32 PER ARRAY into the
+sidecar and `restore` verifies them, so SILENT corruption (a flipped byte
+the filesystem never reports) is distinguished from truncation (a lost
+writeback) — both fall back to the next-older checkpoint, with the cause
+named in the warning. Rotation counts only VALID checkpoints toward
+`keep`: when the newest files are corrupt, the newest readable checkpoint
+is never deleted out from under the resume path.
 """
 
 from __future__ import annotations
@@ -25,16 +33,30 @@ from typing import Any, Dict, Optional, Tuple
 import numpy as np
 
 # np.load on a truncated/corrupted .npz surfaces any of these depending on
-# where the truncation landed (zip directory, member header, deflate stream)
+# where the truncation landed (zip directory, member header, deflate stream);
+# CheckpointCorruption (a ValueError) covers the sidecar-crc mismatches
 _CORRUPT_ERRORS = (
     OSError, ValueError, EOFError, KeyError, zipfile.BadZipFile, zlib.error,
 )
+
+
+class CheckpointCorruption(ValueError):
+    """A checkpoint's payload failed its per-array crc32 (silent
+    corruption — the file reads fine, the bytes are wrong)."""
+
+
+def _array_crc32(arr: np.ndarray) -> int:
+    return zlib.crc32(np.ascontiguousarray(arr).tobytes())
 
 
 class CheckpointManager:
     def __init__(self, directory: str, keep: int = 3):
         self.directory = directory
         self.keep = keep
+        # step -> ((size, mtime_ns), valid): integrity probes are full-file
+        # reads (zip member CRCs), so results are memoized per on-disk
+        # identity — rotation then costs stats, not re-reads, per save
+        self._valid_cache: Dict[int, Tuple[Tuple[int, int], bool]] = {}
         os.makedirs(directory, exist_ok=True)
 
     def _path(self, step: int) -> str:
@@ -46,12 +68,14 @@ class CheckpointManager:
         arrays: Dict[str, np.ndarray],
         meta: Optional[Dict[str, Any]] = None,
     ) -> str:
-        """Atomically write arrays + metadata for `step`, then rotate."""
+        """Atomically write arrays + metadata for `step`, then rotate. The
+        sidecar always carries a crc32 per array (restore verifies)."""
         path = self._path(step)
+        arrays = {k: np.asarray(v) for k, v in arrays.items()}
         fd, tmp = tempfile.mkstemp(dir=self.directory, suffix=".tmp")
         try:
             with os.fdopen(fd, "wb") as f:
-                np.savez(f, **{k: np.asarray(v) for k, v in arrays.items()})
+                np.savez(f, **arrays)
                 # fsync BEFORE the rename: os.replace is atomic in the
                 # namespace but not in the page cache — a preemption between
                 # rename and writeback would leave a fully-named, truncated
@@ -63,13 +87,34 @@ class CheckpointManager:
             if os.path.exists(tmp):
                 os.unlink(tmp)
             raise
-        if meta is not None:
-            mp = path + ".json"
-            with open(mp + ".tmp", "w") as f:
-                json.dump({"step": step, **meta}, f)
-                f.flush()
-                os.fsync(f.fileno())
-            os.replace(mp + ".tmp", mp)
+        # the file we just wrote and fsynced is valid by construction:
+        # seed the probe cache so rotation never re-reads it (any later
+        # mutation — including the fault site below — changes its stat
+        # key and forces a real probe)
+        key = self._stat_key(step)
+        if key is not None:
+            self._valid_cache[step] = (key, True)
+        mp = path + ".json"
+        sidecar = {
+            "step": step,
+            "array_crc32": {k: _array_crc32(v) for k, v in arrays.items()},
+            **(meta or {}),
+        }
+        with open(mp + ".tmp", "w") as f:
+            json.dump(sidecar, f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(mp + ".tmp", mp)
+        # fault-injection site (resilience.faults): a truncate/corrupt here
+        # models a lost page-cache writeback / silent bit flip AFTER the
+        # rename — the failure class restore()'s fallback exists for
+        from bigclam_tpu.resilience import faults as _faults
+
+        spec = _faults.maybe_fire("checkpoint.save", step=step, path=path)
+        if spec is not None and spec["kind"] in (
+            "truncate_checkpoint", "corrupt_checkpoint"
+        ):
+            _faults.apply_file_fault(spec, path)
         self._rotate()
         return path
 
@@ -84,13 +129,23 @@ class CheckpointManager:
         s = self.steps()
         return s[-1] if s else None
 
+    def latest_valid_step(self) -> Optional[int]:
+        """The newest step whose archive passes the container integrity
+        probe — the step restore() will actually use (modulo meta checks).
+        The resume lineage records this, not the newest filename."""
+        for s in reversed(self.steps()):
+            if self._is_valid(s):
+                return s
+        return None
+
     def restore(
         self, step: Optional[int] = None
     ) -> Optional[Tuple[int, Dict[str, np.ndarray], Dict[str, Any]]]:
-        """Load (step, arrays, meta); newest READABLE checkpoint when step
-        is None — a corrupted/truncated newest file (e.g. the filesystem
-        lost the writeback after a preemption) falls back to the next-older
-        one with a warning instead of crashing the resume. An explicitly
+        """Load (step, arrays, meta); newest VALID checkpoint when step is
+        None — a truncated newest file (lost writeback after a preemption)
+        or a silently corrupted one (per-array crc mismatch) falls back to
+        the next-older checkpoint with a warning naming the cause, instead
+        of crashing (or worse, resuming from) the bad state. An explicitly
         requested step propagates its error."""
         if step is not None:
             return self._load(step)
@@ -98,8 +153,13 @@ class CheckpointManager:
             try:
                 return self._load(s)
             except _CORRUPT_ERRORS as e:
+                cause = (
+                    "silently corrupted"
+                    if isinstance(e, CheckpointCorruption)
+                    else "unreadable"
+                )
                 print(
-                    f"warning: checkpoint step {s} unreadable "
+                    f"warning: checkpoint step {s} {cause} "
                     f"({type(e).__name__}: {e}); trying an older one",
                     file=sys.stderr,
                 )
@@ -115,12 +175,76 @@ class CheckpointManager:
         if os.path.exists(path + ".json"):
             with open(path + ".json") as f:
                 meta = json.load(f)
+        crcs = meta.get("array_crc32")
+        if crcs:
+            for name, expect in crcs.items():
+                if name not in arrays:
+                    raise CheckpointCorruption(
+                        f"{path}: array {name!r} stamped in the sidecar is "
+                        "missing from the payload"
+                    )
+                got = _array_crc32(arrays[name])
+                if got != int(expect):
+                    raise CheckpointCorruption(
+                        f"{path}: array {name!r} checksum mismatch "
+                        f"(expected {expect}, got {got}) — silent "
+                        "corruption"
+                    )
         return step, arrays, meta
 
+    def _stat_key(self, step: int) -> Optional[Tuple[int, int]]:
+        try:
+            st = os.stat(self._path(step))
+        except OSError:
+            return None
+        return (st.st_size, st.st_mtime_ns)
+
+    def _is_valid(self, step: int) -> bool:
+        """Integrity probe for rotation: the zip container's own member
+        CRCs cover truncation AND byte flips without a numpy parse (npz
+        members are stored with per-member crc32s). The full-file read is
+        memoized against (size, mtime_ns) — any later mutation of the
+        file (truncation, in-place flip) changes the key and re-probes."""
+        key = self._stat_key(step)
+        if key is None:
+            return False
+        cached = self._valid_cache.get(step)
+        if cached is not None and cached[0] == key:
+            return cached[1]
+        try:
+            with zipfile.ZipFile(self._path(step)) as z:
+                ok = z.testzip() is None
+        except Exception:
+            ok = False
+        self._valid_cache[step] = (key, ok)
+        return ok
+
     def _rotate(self) -> None:
+        """Delete old checkpoints, keeping the newest `keep` — counting
+        only VALID ones: if the newest files are corrupt, the cutoff walks
+        back so the newest restorable checkpoint always survives. Corrupt
+        files newer than the cutoff are left in place as evidence (restore
+        skips past them)."""
+        if self.keep <= 0:
+            return
         steps = self.steps()
-        for s in steps[: -self.keep] if self.keep > 0 else []:
+        if len(steps) <= self.keep:
+            return
+        valid = 0
+        cutoff = None
+        for s in reversed(steps):
+            if self._is_valid(s):
+                valid += 1
+                if valid == self.keep:
+                    cutoff = s
+                    break
+        if cutoff is None:
+            return      # fewer than `keep` valid checkpoints: delete nothing
+        for s in steps:
+            if s >= cutoff:
+                continue
             p = self._path(s)
             os.unlink(p)
+            self._valid_cache.pop(s, None)
             if os.path.exists(p + ".json"):
                 os.unlink(p + ".json")

@@ -1,0 +1,218 @@
+"""Chaos gate (ISSUE 5 satellite): run the deterministic kill/corrupt/NaN
+matrix against the REAL CLI entry points and emit one JSON artifact line.
+
+Scenarios (all seeded, all on CPU, all through `python -m bigclam_tpu.cli`):
+
+  kill_resume     SIGKILL the fit mid-iteration (BIGCLAM_FAULTS kill fault),
+                  then rerun with the default `--resume auto`: the final F
+                  must be BIT-identical to an uninterrupted run, with the
+                  resume recorded in the telemetry lineage.
+  nan_rollback    inject a NaN into F at a chosen iteration: the fit must
+                  recover via non-finite rollback (a `rollback` event, no
+                  FloatingPointError) and complete with a finite LLH.
+  shard_quarantine corrupt a cache shard blob on disk: the fit must
+                  quarantine + rebuild it from the source edge list
+                  (`quarantine` event), complete, and leave the cache
+                  crc-valid.
+
+Every scenario's events.jsonl must validate against the telemetry schema.
+
+    python scripts/chaos_gate.py [out.json]
+
+Exit 0 iff every check passes. The committed artifact (CHAOS_r09.json) is
+the proof the recovery paths ran at the commit that shipped them; the same
+matrix runs in tier-1 (tests/test_resilience.py, `chaos` marker).
+"""
+
+import json
+import os
+import shutil
+import subprocess
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def _write_graph(path: str) -> None:
+    edges = []
+    for base in (0, 10):
+        for i in range(10):
+            for j in range(i + 1, 10):
+                edges.append((base + i, base + j))
+    edges.append((9, 10))
+    with open(path, "w") as f:
+        f.write("\n".join(f"{u} {v}" for u, v in edges))
+
+
+def _cli(*argv, faults=None, check=True):
+    env = {k: v for k, v in os.environ.items() if k != "BIGCLAM_FAULTS"}
+    if faults is not None:
+        env["BIGCLAM_FAULTS"] = json.dumps(faults)
+    r = subprocess.run(
+        [sys.executable, "-m", "bigclam_tpu.cli", *argv],
+        capture_output=True, text=True, timeout=600, env=env,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    )
+    if check and r.returncode != 0:
+        raise RuntimeError(f"cli {argv[0]} failed:\n{r.stdout}\n{r.stderr}")
+    return r
+
+
+def _schema_ok(tdir: str):
+    from bigclam_tpu.obs.schema import validate_events_file
+
+    n, errors = validate_events_file(os.path.join(tdir, "events.jsonl"))
+    return n, errors
+
+
+def _kinds(tdir: str):
+    out = {}
+    with open(os.path.join(tdir, "events.jsonl")) as f:
+        for line in f:
+            if line.strip():
+                k = json.loads(line).get("kind")
+                out[k] = out.get(k, 0) + 1
+    return out
+
+
+def main() -> int:
+    out_path = sys.argv[1] if len(sys.argv) > 1 else None
+    work = tempfile.mkdtemp(prefix="chaos_gate_")
+    graph = os.path.join(work, "g.txt")
+    _write_graph(graph)
+    base = [
+        "fit", "--graph", graph, "--k", "2", "--dtype", "float64",
+        "--max-iters", "12", "--conv-tol", "0", "--init", "random",
+        "--quiet", "--platform", "cpu",
+    ]
+    scenarios = {}
+    checks = {}
+
+    # --- reference: uninterrupted run ---
+    ref_f = os.path.join(work, "ref.npy")
+    _cli(*base, "--checkpoint-dir", os.path.join(work, "ck_ref"),
+         "--checkpoint-every", "3", "--save-f", ref_f)
+    ref = np.load(ref_f)
+
+    # --- (a) kill -9 mid-fit, then --resume auto ---
+    ck = os.path.join(work, "ck_kill")
+    tdir = os.path.join(work, "telem_kill")
+    r = _cli(
+        *base, "--checkpoint-dir", ck, "--checkpoint-every", "3",
+        "--telemetry-dir", tdir,
+        faults={"faults": [{"kind": "kill", "site": "fit.step", "at": 8}]},
+        check=False,
+    )
+    resumed_f = os.path.join(work, "resumed.npy")
+    _cli(*base, "--checkpoint-dir", ck, "--checkpoint-every", "3",
+         "--telemetry-dir", tdir, "--save-f", resumed_f)
+    from bigclam_tpu.resilience import read_lineage
+
+    lineage = read_lineage(tdir)
+    n_ev, errors = _schema_ok(tdir)
+    kinds = _kinds(tdir)
+    bit_identical = bool(np.array_equal(np.load(resumed_f), ref))
+    scenarios["kill_resume"] = {
+        "killed_rc": r.returncode,
+        "resumed_from_step": lineage[0]["resumed_step"] if lineage else None,
+        "bit_identical_F": bit_identical,
+        "events": {k: kinds[k] for k in ("fault_injected", "resume",
+                                         "checkpoint", "restore")
+                   if k in kinds},
+        "schema_errors": errors[:5],
+    }
+    checks["kill_was_sigkill"] = r.returncode != 0
+    checks["kill_resume_bit_identical"] = bit_identical
+    checks["kill_resume_lineage_recorded"] = bool(lineage)
+    checks["kill_resume_schema_valid"] = not errors
+
+    # --- (b) NaN injection -> rollback recovery ---
+    tdir = os.path.join(work, "telem_nan")
+    r = _cli(
+        *base, "--telemetry-dir", tdir,
+        faults={"faults": [{"kind": "nan_inject", "site": "fit.step",
+                            "at": 5}]},
+    )
+    rec = json.loads(r.stdout.strip().splitlines()[-1])
+    n_ev, errors = _schema_ok(tdir)
+    kinds = _kinds(tdir)
+    scenarios["nan_rollback"] = {
+        "final_llh": rec["llh"],
+        "iters": rec["iters"],
+        "rollbacks": kinds.get("rollback", 0),
+        "schema_errors": errors[:5],
+    }
+    checks["nan_recovered_finite"] = bool(np.isfinite(rec["llh"]))
+    checks["nan_rollback_event"] = kinds.get("rollback", 0) >= 1
+    checks["nan_completed_no_abort"] = rec["iters"] == 12
+    checks["nan_schema_valid"] = not errors
+
+    # --- (c) corrupted shard -> quarantine + re-ingest ---
+    cache = os.path.join(work, "g.cache")
+    _cli("ingest", "--graph", graph, "--cache-dir", cache, "--shards", "4",
+         "--chunk-bytes", "2048")
+    from bigclam_tpu.graph.store import GraphStore
+
+    store = GraphStore.open(cache)
+    blob = store.shard_files(1)[1]
+    size = os.path.getsize(blob)
+    with open(blob, "r+b") as f:
+        f.seek(size // 2)
+        b = f.read(1)
+        f.seek(size // 2)
+        f.write(bytes([b[0] ^ 0xFF]))
+    tdir = os.path.join(work, "telem_shard")
+    heal_f = os.path.join(work, "healed.npy")
+    r = _cli(*base[:2], cache, *base[3:], "--telemetry-dir", tdir,
+             "--save-f", heal_f)
+    n_ev, errors = _schema_ok(tdir)
+    kinds = _kinds(tdir)
+    # the healed cache must be crc-valid under a strict reopen
+    crc_valid = True
+    try:
+        GraphStore.open(cache).load_graph()
+    except Exception:
+        crc_valid = False
+    scenarios["shard_quarantine"] = {
+        "quarantine_events": kinds.get("quarantine", 0),
+        "quarantined_files": sorted(
+            os.listdir(os.path.join(cache, "quarantine"))
+        ),
+        "rebuilt_cache_crc_valid": crc_valid,
+        "fit_F_matches_reference": bool(
+            np.array_equal(np.load(heal_f), ref)
+        ),
+        "schema_errors": errors[:5],
+    }
+    checks["shard_quarantined"] = kinds.get("quarantine", 0) == 1
+    checks["shard_rebuilt_crc_valid"] = crc_valid
+    checks["shard_fit_matches_reference"] = scenarios["shard_quarantine"][
+        "fit_F_matches_reference"
+    ]
+    checks["shard_schema_valid"] = not errors
+
+    import jax
+
+    record = {
+        "gate": "chaos",
+        "config": "two 10-cliques + bridge, K=2 f64 cpu, max_iters=12, "
+                  "seed 0; kill@8 / nan@5 / shard-1 byte flip",
+        "scenarios": scenarios,
+        "checks": checks,
+        "jax": jax.__version__,
+        "pass": all(checks.values()),
+    }
+    line = json.dumps(record)
+    print(line)
+    if out_path:
+        with open(out_path, "w") as f:
+            f.write(line + "\n")
+    shutil.rmtree(work, ignore_errors=True)
+    return 0 if record["pass"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

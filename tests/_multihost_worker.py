@@ -16,6 +16,11 @@ Modes:
   ckpt-resume    fit max_iters=8 resuming from the SHARED ckpt_root/p0
                  (all processes read; only process 0 keeps writing);
                  process 0 writes the resumed trajectory to out.npz
+  corrupt-resume ckpt-resume minus the latest-step assert: the parent
+                 corrupted the newest checkpoint(s), so restore must fall
+                 back to the newest VALID one on every process and the
+                 resumed trajectory must still match the uninterrupted
+                 run (ISSUE 5 multi-corrupt fallback, 2-proc variant)
   store          fit through StoreShardedBigClamModel from the graph cache
                  at ckpt_root (compiled by the parent): asserts this
                  process loaded ONLY its own shard files and its own node
@@ -112,14 +117,20 @@ def main() -> None:
         jax.distributed.shutdown()
         return
 
-    if mode == "ckpt-resume":
+    if mode in ("ckpt-resume", "corrupt-resume"):
         from bigclam_tpu.utils.checkpoint import CheckpointManager
 
         cfg_r = cfg.replace(checkpoint_every=2)
         shared = os.path.join(ckpt_root, "p0")   # every process READS p0's
         model = ShardedBigClamModel(g, cfg_r, mesh)
         ckpt = CheckpointManager(shared)
-        assert ckpt.latest_step() == 4, ckpt.steps()
+        if mode == "ckpt-resume":
+            assert ckpt.latest_step() == 4, ckpt.steps()
+        else:
+            # the parent corrupted newer checkpoints: restore must fall
+            # back past them (crc/zip validation) on EVERY process
+            assert ckpt.latest_step() > 2, ckpt.steps()
+            assert ckpt.restore()[0] == 2, "fallback did not engage"
         res = model.fit(F0, checkpoints=ckpt)
         if jax.process_index() == 0:
             np.savez(

@@ -1,0 +1,907 @@
+"""Fault-tolerant fit orchestration tests (ISSUE 5, bigclam_tpu/resilience):
+deterministic fault injection, classified retry/backoff, non-finite
+rollback, checkpoint payload integrity + corruption-safe rotation, shard
+quarantine + re-ingest, heartbeat escalation, resume lineage in `cli
+report`, and the kill -9 -> `--resume auto` bit-identity contract."""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+import jax
+
+from bigclam_tpu.config import BigClamConfig
+from bigclam_tpu.graph.ingest import build_graph
+from bigclam_tpu.graph.store import (
+    GraphStore,
+    ShardCorruption,
+    compile_graph_cache,
+)
+from bigclam_tpu.models import BigClamModel
+from bigclam_tpu.obs import RunTelemetry, install, uninstall
+from bigclam_tpu.obs.telemetry import EVENTS_NAME
+from bigclam_tpu.obs.schema import validate_events_file
+from bigclam_tpu.resilience import (
+    FatalError,
+    FaultPlan,
+    RetryPolicy,
+    Supervisor,
+    TransientError,
+    call_with_retry,
+    classify,
+    install_plan,
+    record_resume,
+)
+from bigclam_tpu.utils import CheckpointManager
+
+pytestmark = pytest.mark.chaos
+
+
+def _problem(toy_graphs, k=2, max_iters=8, **kw):
+    g = toy_graphs["two_cliques"]
+    cfg = BigClamConfig(
+        num_communities=k, dtype="float64", max_iters=max_iters,
+        conv_tol=0.0, **kw,
+    )
+    F0 = np.random.default_rng(5).uniform(0.1, 1.0, size=(g.num_nodes, k))
+    return g, cfg, F0
+
+
+def _events(directory):
+    with open(os.path.join(directory, EVENTS_NAME)) as f:
+        return [json.loads(line) for line in f if line.strip()]
+
+
+@pytest.fixture
+def telem(tmp_path):
+    tel = install(RunTelemetry(str(tmp_path / "telem"), entry="test"))
+    try:
+        yield tel
+    finally:
+        tel.finalize()
+        uninstall(tel)
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_plan():
+    yield
+    install_plan(None)
+
+
+# --------------------------------------------------------------------------
+# fault harness
+# --------------------------------------------------------------------------
+
+
+def test_fault_plan_matching_is_deterministic_and_consumed():
+    plan = FaultPlan(
+        [
+            {"kind": "delay", "site": "fit.step", "at": 2, "seconds": 0.0},
+            {"kind": "corrupt_shard", "site": "store.load_shard",
+             "shard": 1},
+        ]
+    )
+    assert plan.fire("fit.step", it=0) is None
+    assert plan.fire("fit.step", it=1) is None
+    fired = plan.fire("fit.step", it=2)
+    assert fired["kind"] == "delay"
+    assert plan.fire("fit.step", it=2) is None          # consumed
+    # context-key matching: shard 0 passes untouched, shard 1 fires
+    assert plan.fire("store.load_shard", shard=0) is None
+    assert plan.fire("store.load_shard", shard=1)["kind"] == "corrupt_shard"
+
+
+def test_fault_plan_env_round_trip(tmp_path, monkeypatch):
+    spec = {"seed": 7, "faults": [{"kind": "kill", "site": "fit.step",
+                                   "at": 3}]}
+    p = tmp_path / "plan.json"
+    p.write_text(json.dumps(spec))
+    monkeypatch.setenv("BIGCLAM_FAULTS", f"@{p}")
+    plan = FaultPlan.from_env()
+    assert plan.seed == 7 and plan.faults[0]["at"] == 3
+    monkeypatch.setenv("BIGCLAM_FAULTS", json.dumps(spec))
+    assert FaultPlan.from_env().faults == plan.faults
+
+
+def test_file_faults_truncate_and_corrupt(tmp_path):
+    p = tmp_path / "blob.bin"
+    p.write_bytes(bytes(range(256)))
+    plan = FaultPlan([])
+    plan.apply_to_file({"kind": "truncate_checkpoint", "frac": 0.25},
+                       str(p))
+    assert os.path.getsize(p) == 64
+    before = p.read_bytes()
+    plan.apply_to_file({"kind": "corrupt_shard", "offset": 10}, str(p))
+    after = p.read_bytes()
+    assert after[10] == before[10] ^ 0xFF
+    assert after[:10] == before[:10] and after[11:] == before[11:]
+
+
+# --------------------------------------------------------------------------
+# retry / classification
+# --------------------------------------------------------------------------
+
+
+def test_classify_taxonomy():
+    assert classify(OSError("disk hiccup")) == "transient"
+    assert classify(TransientError("wrapped")) == "transient"
+    assert classify(ValueError("shape mismatch")) == "fatal"
+    assert classify(FloatingPointError("nan")) == "fatal"
+    assert classify(FatalError("no")) == "fatal"
+    assert classify(ShardCorruption("crc", shard=1)) == "fatal"
+
+
+def test_retry_recovers_and_emits_events(telem):
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise OSError(f"transient #{calls['n']}")
+        return "ok"
+
+    slept = []
+    out = call_with_retry(
+        flaky, "unit", RetryPolicy(transient_attempts=5, base_s=0.01),
+        sleep=slept.append,
+    )
+    assert out == "ok" and calls["n"] == 3
+    assert len(slept) == 2 and slept[1] > slept[0] > 0
+    kinds = [e["kind"] for e in _events(telem.directory)]
+    assert kinds.count("retry") == 2 and kinds.count("recovered") == 1
+
+
+def test_retry_gives_up_after_budget_and_never_retries_fatal(telem):
+    def always(exc):
+        def fn():
+            raise exc
+        return fn
+
+    with pytest.raises(OSError):
+        call_with_retry(
+            always(OSError("down")), "unit-t",
+            RetryPolicy(transient_attempts=3, base_s=0.0),
+            sleep=lambda s: None,
+        )
+    calls = {"n": 0}
+
+    def fatal():
+        calls["n"] += 1
+        raise ValueError("config mismatch")
+
+    with pytest.raises(ValueError):
+        call_with_retry(fatal, "unit-f", RetryPolicy(), sleep=lambda s: None)
+    assert calls["n"] == 1                       # fatal: exactly one attempt
+    gave = [e for e in _events(telem.directory) if e["kind"] == "gave_up"]
+    assert {e["site"] for e in gave} == {"unit-t", "unit-f"}
+    assert gave[0]["attempts"] == 3
+
+
+def test_retry_backoff_is_deterministic():
+    slept_a, slept_b = [], []
+    for slept in (slept_a, slept_b):
+        calls = {"n": 0}
+
+        def flaky():
+            calls["n"] += 1
+            if calls["n"] < 4:
+                raise OSError("x")
+            return 1
+
+        call_with_retry(
+            flaky, "same-site",
+            RetryPolicy(transient_attempts=5, base_s=0.01, seed=3),
+            sleep=slept.append,
+        )
+    assert slept_a == slept_b and len(slept_a) == 3
+
+
+def test_supervisor_run_fit_retries_with_resume(toy_graphs, tmp_path):
+    """A fit attempt that dies transiently mid-run is retried and RESUMES
+    from its checkpoints — the retried attempt's final state equals the
+    uninterrupted run's exactly."""
+    g, cfg, F0 = _problem(toy_graphs, max_iters=6)
+    cfg = cfg.replace(checkpoint_every=2)
+    full = BigClamModel(g, cfg).fit(F0)
+
+    cm = CheckpointManager(str(tmp_path / "ck"))
+    model = BigClamModel(g, cfg)
+    state = {"attempt": 0}
+
+    def fit_attempt_dying():
+        state["attempt"] += 1
+        if state["attempt"] == 1:
+            partial = BigClamModel(g, cfg.replace(max_iters=3))
+            partial.fit(F0, checkpoints=cm)
+            raise OSError("simulated I/O loss mid-fit")
+        return model.fit(F0, checkpoints=cm)
+
+    sup = Supervisor(RetryPolicy(transient_attempts=2, base_s=0.0))
+    res = sup.run_fit(fit_attempt_dying)
+    assert state["attempt"] == 2
+    assert cm.latest_step() is not None          # resumed, not restarted
+    np.testing.assert_array_equal(res.F, full.F)
+    assert res.llh_history == full.llh_history
+
+
+# --------------------------------------------------------------------------
+# non-finite rollback
+# --------------------------------------------------------------------------
+
+
+def test_nan_injection_recovers_via_rollback(toy_graphs, telem):
+    """Acceptance (b): an injected NaN at iteration t recovers via
+    rollback within budget and the fit converges finitely — no
+    FloatingPointError — emitting schema-valid rollback telemetry."""
+    g, cfg, F0 = _problem(toy_graphs, max_iters=10)
+    install_plan(
+        FaultPlan([{"kind": "nan_inject", "site": "fit.step", "at": 4}])
+    )
+    res = BigClamModel(g, cfg).fit(F0)
+    assert np.isfinite(res.llh)
+    assert np.isfinite(res.F).all()
+    assert res.num_iters == cfg.max_iters        # ran to completion
+    rb = [e for e in _events(telem.directory) if e["kind"] == "rollback"]
+    assert len(rb) == 1
+    assert rb[0]["rollbacks"] == 1
+    assert rb[0]["resume_iter"] <= rb[0]["iter"] == 4
+    assert isinstance(rb[0]["llh"], str)         # non-finite serialized
+    fi = [e for e in _events(telem.directory)
+          if e["kind"] == "fault_injected"]
+    assert fi and fi[0]["fault"] == "nan_inject"
+    n, errors = validate_events_file(
+        os.path.join(telem.directory, EVENTS_NAME)
+    )
+    assert errors == [], errors
+
+
+def test_rollback_cuts_step_scale_and_restores_model_cfg(toy_graphs):
+    g, cfg, F0 = _problem(toy_graphs, max_iters=8)
+    model = BigClamModel(g, cfg)
+    install_plan(
+        FaultPlan([{"kind": "nan_inject", "site": "fit.step", "at": 3}])
+    )
+    res = model.fit(F0)
+    assert np.isfinite(res.llh)
+    # the shrunken ladder never leaks out of the fit
+    assert model.cfg.step_scale == 1.0
+    assert model.cfg == cfg
+    # a scaled config compiles a DIFFERENT step (baked, not host-only)
+    from bigclam_tpu.models.bigclam import step_cfg_key
+
+    assert step_cfg_key(cfg) != step_cfg_key(cfg.replace(step_scale=0.1))
+    assert step_cfg_key(cfg) == step_cfg_key(
+        cfg.replace(rollback_budget=7, rollback_snapshot_every=2)
+    )
+    assert cfg.replace(step_scale=0.5).step_candidates[0] == 0.5
+
+
+def test_rollback_budget_exhaustion_escalates_to_abort(toy_graphs, telem):
+    """A persistently-poisoned state (NaN in F0 itself: every rollback
+    target is poisoned too) burns the budget then aborts through the
+    existing diagnostic path."""
+    g, cfg, F0 = _problem(toy_graphs, max_iters=20, rollback_budget=2)
+    bad = F0.copy()
+    bad[3, 1] = np.nan
+    with pytest.raises(FloatingPointError, match="rollback budget"):
+        BigClamModel(g, cfg).fit(bad)
+    ev = _events(telem.directory)
+    assert len([e for e in ev if e["kind"] == "rollback"]) == 2
+    nf = [e for e in ev if e["kind"] == "nonfinite"]
+    assert len(nf) == 1 and nf[0]["rollbacks"] == 2
+
+
+def test_rollback_disabled_keeps_abort_only_semantics(toy_graphs):
+    g, cfg, F0 = _problem(toy_graphs, max_iters=20, rollback_budget=0)
+    bad = F0.copy()
+    bad[0, 0] = np.inf
+    with pytest.raises(FloatingPointError, match="non-finite LLH"):
+        BigClamModel(g, cfg).fit(bad)
+
+
+def test_rollback_trajectory_unchanged_without_faults(toy_graphs):
+    """The snapshot machinery on the happy path is pure observation: fits
+    with rollback on/off are bit-identical (copies move storage, not
+    math), donation included."""
+    g, cfg, F0 = _problem(toy_graphs, max_iters=6)
+    r_on = BigClamModel(g, cfg).fit(F0)            # budget default 3
+    r_off = BigClamModel(g, cfg.replace(rollback_budget=0)).fit(F0)
+    np.testing.assert_array_equal(r_on.F, r_off.F)
+    assert r_on.llh_history == r_off.llh_history
+
+
+def test_rollback_in_sharded_trainer(toy_graphs, telem):
+    """run_fit_loop recovery is trainer-agnostic: the sharded trainer
+    rolls back an injected NaN too (same loop, same hook surface)."""
+    from bigclam_tpu.parallel import ShardedBigClamModel, make_mesh
+
+    g, cfg, F0 = _problem(toy_graphs, max_iters=8)
+    mesh = make_mesh((4, 1), jax.devices()[:4])
+    install_plan(
+        FaultPlan([{"kind": "nan_inject", "site": "fit.step", "at": 3}])
+    )
+    model = ShardedBigClamModel(g, cfg, mesh)
+    res = model.fit(F0)
+    assert np.isfinite(res.llh)
+    assert model.cfg == cfg
+    assert [e["kind"] for e in _events(telem.directory)].count(
+        "rollback"
+    ) == 1
+
+
+# --------------------------------------------------------------------------
+# checkpoint payload integrity + rotation
+# --------------------------------------------------------------------------
+
+
+def test_checkpoint_sidecar_stamps_per_array_crc(tmp_path):
+    cm = CheckpointManager(str(tmp_path))
+    cm.save(1, {"F": np.ones((3, 2)), "it": np.asarray(1)})
+    side = json.load(open(cm._path(1) + ".json"))
+    assert set(side["array_crc32"]) == {"F", "it"}
+    step, arrays, meta = cm.restore()
+    assert step == 1 and "array_crc32" in meta
+
+
+def test_checkpoint_silent_corruption_detected_and_skipped(tmp_path, capsys):
+    """A crc mismatch (simulated via a tampered sidecar stamp — byte flips
+    in the zip payload are additionally caught by the container) reads as
+    SILENT CORRUPTION: explicit restore raises CheckpointCorruption,
+    newest-first restore falls back past it."""
+    from bigclam_tpu.utils.checkpoint import CheckpointCorruption
+
+    cm = CheckpointManager(str(tmp_path))
+    cm.save(1, {"F": np.ones((4, 3))}, meta={"llh_history": [-5.0]})
+    cm.save(2, {"F": np.full((4, 3), 2.0)}, meta={"llh_history": [-4.0]})
+    side_path = cm._path(2) + ".json"
+    side = json.load(open(side_path))
+    side["array_crc32"]["F"] ^= 0xFFFF
+    json.dump(side, open(side_path, "w"))
+
+    with pytest.raises(CheckpointCorruption, match="checksum mismatch"):
+        cm.restore(2)
+    step, arrays, _ = cm.restore()
+    assert step == 1
+    np.testing.assert_array_equal(arrays["F"], np.ones((4, 3)))
+    assert "silently corrupted" in capsys.readouterr().err
+
+
+def test_rotation_never_deletes_newest_valid_checkpoint(tmp_path):
+    """Satellite: with the NEWEST checkpoints corrupt, rotation must keep
+    the newest VALID one alive no matter how many corrupt saves follow."""
+    cm = CheckpointManager(str(tmp_path), keep=2)
+    cm.save(1, {"F": np.full((4, 3), 1.0)})
+    cm.save(2, {"F": np.full((4, 3), 2.0)})
+    # corrupt every LATER save as it lands (simulated flaky device)
+    install_plan(
+        FaultPlan(
+            [
+                {"kind": "truncate_checkpoint", "site": "checkpoint.save",
+                 "step": 3, "frac": 0.3},
+                {"kind": "corrupt_checkpoint", "site": "checkpoint.save",
+                 "step": 4},
+            ]
+        )
+    )
+    cm.save(3, {"F": np.full((4, 3), 3.0)})
+    cm.save(4, {"F": np.full((4, 3), 4.0)})
+    install_plan(None)
+    # steps 3/4 are corrupt; the valid cutoff is {2, 1} -> nothing older
+    # than 1 exists, and 1/2 MUST both survive
+    assert set(cm.steps()) >= {1, 2}
+    step, arrays, _ = cm.restore()
+    assert step == 2
+    np.testing.assert_array_equal(arrays["F"], np.full((4, 3), 2.0))
+    # once valid saves resume, normal rotation kicks back in
+    cm.save(5, {"F": np.full((4, 3), 5.0)})
+    cm.save(6, {"F": np.full((4, 3), 6.0)})
+    assert cm.restore()[0] == 6
+    assert 1 not in cm.steps()                  # old ones finally rotated
+
+
+def test_latest_valid_step_skips_corrupt_newest(tmp_path):
+    """The resume lineage records the step restore() will USE, not the
+    newest filename: latest_valid_step walks past corrupt files."""
+    cm = CheckpointManager(str(tmp_path))
+    cm.save(2, {"F": np.ones((3, 2))})
+    cm.save(4, {"F": np.ones((3, 2)) * 2})
+    assert cm.latest_valid_step() == 4
+    p4 = cm._path(4)
+    with open(p4, "r+b") as f:
+        f.truncate(os.path.getsize(p4) // 2)
+    assert cm.latest_step() == 4                 # filename says 4...
+    assert cm.latest_valid_step() == 2           # ...restore will use 2
+
+
+def test_quality_resume_never_cold_starts(toy_graphs, tmp_path):
+    """fit_quality(resume=False) ignores an existing cycle checkpoint
+    (cold start) while still saving — the --resume never contract on the
+    quality path."""
+    from bigclam_tpu.models.quality import fit_quality
+
+    g, cfg, F0 = _problem(toy_graphs, max_iters=6)
+    qcfg = cfg.replace(
+        quality_mode=True, restart_cycles=2, restart_tol=0.0,
+        quality_repair=False,
+    )
+    cm = CheckpointManager(str(tmp_path / "q"))
+    model = BigClamModel(g, qcfg)
+
+    def counting_cb(counter):
+        def cb(it, llh):
+            counter["n"] += 1
+        return cb
+
+    c1 = {"n": 0}
+    q1 = fit_quality(model, F0, callback=counting_cb(c1), checkpoints=cm)
+    assert cm.latest_step() is not None and c1["n"] > 0
+    # resumed run restores the journaled schedule: NO fit work re-runs
+    c2 = {"n": 0}
+    fit_quality(model, F0, callback=counting_cb(c2), checkpoints=cm)
+    assert c2["n"] == 0
+    # cold start re-runs the full schedule and reproduces it
+    c3 = {"n": 0}
+    q3 = fit_quality(
+        model, F0, callback=counting_cb(c3), checkpoints=cm, resume=False
+    )
+    assert c3["n"] == c1["n"]
+    assert q3.cycles_llh == q1.cycles_llh
+
+
+def test_sweep_resume_never_retrains(tmp_path):
+    from bigclam_tpu.graph.ingest import graph_from_edges
+    from bigclam_tpu.models.model_selection import sweep_k
+
+    edges = []
+    for base in (0, 6):
+        for i in range(6):
+            for j in range(i + 1, 6):
+                edges.append((base + i, base + j))
+    edges.append((5, 6))
+    g = graph_from_edges(edges)
+    cfg = BigClamConfig(
+        num_communities=4, dtype="float64", max_iters=10,
+        min_com=2, max_com=4, div_com=2, ksweep_tol=1e-3,
+    )
+    r1 = sweep_k(g, cfg, state_dir=str(tmp_path))
+    # poison the journal: a resumed sweep would trust it, a cold sweep
+    # must retrain and overwrite it
+    bogus = {str(k): 123.0 for k in r1.llh_by_k}
+    (tmp_path / "sweep_state.json").write_text(json.dumps(bogus))
+    r2 = sweep_k(g, cfg, state_dir=str(tmp_path), resume=False)
+    assert r2.llh_by_k == r1.llh_by_k
+    journal = json.loads((tmp_path / "sweep_state.json").read_text())
+    assert journal != bogus
+
+
+def test_multi_corrupt_fallback_resume_bit_identical(toy_graphs, tmp_path):
+    """Satellite: restore past TWO bad newest checkpoints and resume a
+    trajectory BIT-identical to the uninterrupted run."""
+    g, cfg, F0 = _problem(toy_graphs, max_iters=8)
+    cfg = cfg.replace(checkpoint_every=1)
+    full = BigClamModel(g, cfg).fit(F0)
+
+    cm = CheckpointManager(str(tmp_path), keep=10)
+    BigClamModel(g, cfg.replace(max_iters=5)).fit(F0, checkpoints=cm)
+    steps = cm.steps()
+    assert len(steps) >= 3
+    # newest two checkpoints: one truncated, one silently corrupted
+    p_new = cm._path(steps[-1])
+    with open(p_new, "r+b") as f:
+        f.truncate(os.path.getsize(p_new) // 2)
+    side_path = cm._path(steps[-2]) + ".json"
+    side = json.load(open(side_path))
+    side["array_crc32"]["F"] ^= 0x1
+    json.dump(side, open(side_path, "w"))
+
+    resumed = BigClamModel(g, cfg).fit(np.zeros_like(F0), checkpoints=cm)
+    np.testing.assert_array_equal(resumed.F, full.F)
+    assert resumed.llh_history == full.llh_history
+
+
+_needs_multiproc_cpu = pytest.mark.skipif(
+    jax.__version_info__ < (0, 5, 0),
+    reason="jaxlib 0.4.x CPU backend lacks multiprocess computations",
+)
+
+
+@_needs_multiproc_cpu
+def test_true_two_process_multi_corrupt_resume(tmp_path):
+    """2-proc variant of the multi-corrupt fallback: every process falls
+    back past the corrupted newest checkpoints to the shared valid one,
+    and the resumed 2-process trajectory matches the uninterrupted
+    single-process run."""
+    from test_multihost import _run_two_workers, _worker_module
+
+    out = tmp_path / "resumed.npz"
+    ckpt_root = tmp_path / "ckpts"
+    _run_two_workers(out, mode="ckpt-write", ckpt_root=ckpt_root)
+    shared = ckpt_root / "p0"
+    cm = CheckpointManager(str(shared))
+    assert cm.steps() == [2, 4]
+    p4 = cm._path(4)
+    with open(p4, "r+b") as f:                   # corrupt newest
+        f.truncate(os.path.getsize(p4) // 2)
+    # plant a second, even newer, bogus checkpoint
+    (shared / "ckpt_000000006.npz").write_bytes(b"PK\x03\x04 bogus")
+
+    _run_two_workers(out, mode="corrupt-resume", ckpt_root=ckpt_root)
+    g, cfg, F0 = _worker_module().problem()
+    ref = BigClamModel(g, cfg).fit(F0)
+    got = np.load(out)
+    np.testing.assert_allclose(got["F"], ref.F, rtol=1e-12)
+
+
+# --------------------------------------------------------------------------
+# shard quarantine + re-ingest
+# --------------------------------------------------------------------------
+
+
+def _planted_cache(tmp_path, num_shards=4, balance=False):
+    rng = np.random.default_rng(7)
+    pairs = rng.integers(0, 300, size=(2000, 2)) * 11 + 5
+    text = tmp_path / "g.txt"
+    with open(text, "w") as f:
+        for u, v in pairs.tolist():
+            f.write(f"{u} {v}\n")
+    cache = str(tmp_path / ("bal.cache" if balance else "g.cache"))
+    store = compile_graph_cache(
+        str(text), cache, num_shards=num_shards, chunk_bytes=2048,
+        balance=balance,
+    )
+    return str(text), cache, store
+
+
+def _flip_byte(path, offset=None):
+    size = os.path.getsize(path)
+    offset = size // 2 if offset is None else offset
+    with open(path, "r+b") as f:
+        f.seek(offset)
+        b = f.read(1)
+        f.seek(offset)
+        f.write(bytes([b[0] ^ 0xFF]))
+
+
+@pytest.mark.parametrize("balance", [False, True])
+def test_corrupt_shard_quarantined_and_rebuilt(tmp_path, telem, balance):
+    """Acceptance (c): a corrupted shard is quarantined, re-ingested from
+    the source edge list, and the reload completes with the rebuilt shard
+    crc-valid — bit-identical to the clean graph, balanced caches
+    included (the rebuild maps raw ids through the baked permutation)."""
+    text, cache, store = _planted_cache(tmp_path, balance=balance)
+    ref = store.load_graph()
+    _flip_byte(store.shard_files(1)[1])          # indices blob of shard 1
+
+    healing = GraphStore.open(cache, self_heal=True)
+    g = healing.load_graph()
+    np.testing.assert_array_equal(g.indptr, ref.indptr)
+    np.testing.assert_array_equal(g.indices, ref.indices)
+    np.testing.assert_array_equal(g.raw_ids, ref.raw_ids)
+    # the bad blob was preserved in quarantine/
+    qdir = os.path.join(cache, "quarantine")
+    assert os.listdir(qdir)
+    # the rebuilt cache is crc-valid under a STRICT (non-healing) open
+    strict = GraphStore.open(cache)
+    strict.load_graph()
+    q = [e for e in _events(telem.directory) if e["kind"] == "quarantine"]
+    assert len(q) == 1 and q[0]["shard"] == 1
+    n, errors = validate_events_file(
+        os.path.join(telem.directory, EVENTS_NAME)
+    )
+    assert errors == [], errors
+
+
+def test_strict_store_still_rejects_without_self_heal(tmp_path):
+    text, cache, store = _planted_cache(tmp_path)
+    _flip_byte(store.shard_files(2)[1])
+    with pytest.raises(ShardCorruption, match="checksum"):
+        GraphStore.open(cache).load_graph()
+
+
+def test_self_heal_without_source_raises_and_leaves_cache_intact(tmp_path):
+    """A heal that CANNOT succeed must not make things worse: the corrupt
+    blobs stay in place (diagnosable checksum error on the next strict
+    open, not FileNotFoundError on files the manifest references)."""
+    text, cache, store = _planted_cache(tmp_path)
+    _flip_byte(store.shard_files(0)[1])
+    os.unlink(text)
+    with pytest.raises(ShardCorruption, match="source edge list"):
+        GraphStore.open(cache, self_heal=True).load_graph()
+    for path in store.shard_files(0):
+        assert os.path.exists(path)              # nothing was quarantined
+    assert not os.path.isdir(os.path.join(cache, "quarantine"))
+    with pytest.raises(ShardCorruption, match="checksum"):
+        GraphStore.open(cache).load_graph()
+
+
+def test_self_heal_detects_changed_source(tmp_path):
+    """A source file that no longer matches the manifest must refuse the
+    rebuild (edge-count mismatch), not silently splice a different graph
+    into the cache."""
+    text, cache, store = _planted_cache(tmp_path)
+    _flip_byte(store.shard_files(1)[1])
+    with open(text, "a") as f:
+        f.write("1 2\n")     # ids the cache's raw-id table never saw
+    with pytest.raises(ShardCorruption, match="source changed"):
+        GraphStore.open(cache, self_heal=True).load_graph()
+
+
+def test_corrupt_shard_fault_site_drives_heal(tmp_path, telem):
+    """The harness's corrupt_shard fault fires inside load_shard_range
+    itself, and the healing store recovers in the same pass."""
+    text, cache, store = _planted_cache(tmp_path)
+    ref = store.load_graph()
+    install_plan(
+        FaultPlan(
+            [{"kind": "corrupt_shard", "site": "store.load_shard",
+              "shard": 2}]
+        )
+    )
+    g = GraphStore.open(cache, self_heal=True).load_graph()
+    np.testing.assert_array_equal(g.indices, ref.indices)
+    ev = _events(telem.directory)
+    assert [e["kind"] for e in ev].count("fault_injected") == 1
+    assert [e["kind"] for e in ev].count("quarantine") == 1
+
+
+def test_build_graph_passes_self_heal(tmp_path):
+    text, cache, store = _planted_cache(tmp_path)
+    ref = store.load_graph()
+    _flip_byte(store.shard_files(3)[1])
+    with pytest.raises(ShardCorruption):
+        build_graph(cache)
+    g = build_graph(cache, self_heal=True)
+    np.testing.assert_array_equal(g.indices, ref.indices)
+
+
+# --------------------------------------------------------------------------
+# heartbeat escalation
+# --------------------------------------------------------------------------
+
+
+def test_heartbeat_escalates_after_consecutive_stalls(tmp_path):
+    hits = []
+    tel = RunTelemetry(
+        str(tmp_path / "t"), entry="test", heartbeat_s=0.05, quiet=True,
+        heartbeat_escalate=2,
+    )
+    tel.heartbeat.on_escalate = hits.append
+    time.sleep(0.5)
+    tel.finalize()
+    ev = _events(tel.directory)
+    stalls = [e for e in ev if e["kind"] == "stall"]
+    esc = [e for e in ev if e["kind"] == "stall_escalated"]
+    assert len(stalls) >= 2
+    assert len(esc) == 1 and esc[0]["stalls"] == 2   # once per episode
+    assert len(hits) == 1 and hits[0]["stalls"] == 2
+    assert tel.report()["heartbeat"]["escalations"] == 1
+    n, errors = validate_events_file(
+        os.path.join(tel.directory, EVENTS_NAME)
+    )
+    assert errors == [], errors
+
+
+def test_heartbeat_beat_rearms_escalation(tmp_path):
+    tel = RunTelemetry(
+        str(tmp_path / "t"), entry="test", heartbeat_s=0.06, quiet=True,
+        heartbeat_escalate=3,
+    )
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < 0.45:
+        tel.heartbeat.beat(iter=1)
+        time.sleep(0.01)
+    tel.finalize()
+    assert not [
+        e for e in _events(tel.directory) if e["kind"] == "stall_escalated"
+    ]
+
+
+def test_supervisor_escalation_aborts_and_classifies_transient(tmp_path):
+    """abort_on_stall: the escalation interrupt surfaces as a transient
+    StallEscalation that run_fit retries (resuming)."""
+    tel = install(
+        RunTelemetry(
+            str(tmp_path / "t"), entry="test", heartbeat_s=0.05,
+            quiet=True, heartbeat_escalate=1,
+        )
+    )
+    sup = Supervisor(
+        RetryPolicy(transient_attempts=2, base_s=0.0),
+        abort_on_stall=True,
+    ).attach(tel)
+    state = {"attempt": 0}
+
+    def wedged_then_fine():
+        state["attempt"] += 1
+        if state["attempt"] == 1:
+            time.sleep(1.0)                      # host-side stall, no beats
+            raise AssertionError("interrupt_main never landed")
+        return "done"
+
+    try:
+        assert sup.run_fit(wedged_then_fine) == "done"
+    finally:
+        tel.finalize()
+        uninstall(tel)
+    assert state["attempt"] == 2
+    kinds = [e["kind"] for e in _events(tel.directory)]
+    assert "stall_escalated" in kinds and "retry" in kinds
+
+
+# --------------------------------------------------------------------------
+# resume lineage + cli report recovery section
+# --------------------------------------------------------------------------
+
+
+def test_record_resume_lineage_and_report(tmp_path, telem):
+    from bigclam_tpu.obs.report import render
+    from bigclam_tpu.resilience import read_lineage
+
+    record_resume(telem.directory, 40)
+    record_resume(telem.directory, 90)
+    lineage = read_lineage(telem.directory)
+    assert [a["resumed_step"] for a in lineage] == [40, 90]
+    assert all(a["run"] == telem.run_id for a in lineage)
+    assert len({a["attempt_id"] for a in lineage}) == 2
+    ev = [e for e in _events(telem.directory) if e["kind"] == "resume"]
+    assert [e["step"] for e in ev] == [40, 90]
+    assert ev[1]["prev_attempts"] == 1
+    telem.finalize()
+    text, errors = render(telem.directory)
+    assert errors == 0
+    assert "resume lineage: 2 resumed attempt(s)" in text
+
+
+def test_report_exits_nonzero_on_gave_up(tmp_path, telem):
+    from bigclam_tpu.obs.report import render
+
+    with pytest.raises(OSError):
+        call_with_retry(
+            lambda: (_ for _ in ()).throw(OSError("dead disk")),
+            "fit", RetryPolicy(transient_attempts=2, base_s=0.0),
+            sleep=lambda s: None,
+        )
+    telem.finalize()
+    text, errors = render(telem.directory)
+    assert errors >= 1
+    assert "run ended in gave_up" in text
+    assert "GAVE UP at fit" in text
+
+
+def test_report_renders_recovery_counts(tmp_path, telem):
+    from bigclam_tpu.obs.report import render
+
+    call_with_retry(
+        _flaky_once(), "load",
+        RetryPolicy(transient_attempts=3, base_s=0.0),
+        sleep=lambda s: None,
+    )
+    telem.finalize()
+    text, errors = render(telem.directory)
+    assert errors == 0
+    assert "recovery:" in text and '"recovered": 1' in text
+
+
+def _flaky_once():
+    state = {"n": 0}
+
+    def fn():
+        state["n"] += 1
+        if state["n"] == 1:
+            raise OSError("once")
+        return True
+
+    return fn
+
+
+# --------------------------------------------------------------------------
+# kill -9 -> --resume auto, end to end through the CLI (acceptance a)
+# --------------------------------------------------------------------------
+
+
+def _write_cli_graph(tmp_path):
+    graph = tmp_path / "g.txt"
+    edges = []
+    for base in (0, 10):
+        for i in range(10):
+            for j in range(i + 1, 10):
+                edges.append((base + i, base + j))
+    edges.append((9, 10))
+    graph.write_text("\n".join(f"{u} {v}" for u, v in edges))
+    return graph
+
+
+def _run_cli(*argv, env_extra=None, check=True):
+    env = {k: v for k, v in os.environ.items() if k != "BIGCLAM_FAULTS"}
+    env.update(env_extra or {})
+    r = subprocess.run(
+        [sys.executable, "-m", "bigclam_tpu.cli", *argv],
+        capture_output=True, text=True, timeout=600, cwd="/root/repo",
+        env=env,
+    )
+    if check:
+        assert r.returncode == 0, r.stderr
+    return r
+
+
+def test_cli_kill9_then_resume_auto_bit_identical(tmp_path):
+    """Acceptance (a): kill -9 mid-fit, then `--resume auto` yields a
+    bit-identical final F vs the uninterrupted run, with the resume
+    recorded in telemetry lineage and `cli report` exiting 0."""
+    graph = _write_cli_graph(tmp_path)
+    base = [
+        "fit", "--graph", str(graph), "--k", "2", "--dtype", "float64",
+        "--max-iters", "12", "--conv-tol", "0", "--init", "random",
+        "--quiet", "--platform", "cpu", "--checkpoint-every", "3",
+    ]
+    # uninterrupted reference
+    _run_cli(
+        *base, "--checkpoint-dir", str(tmp_path / "ck_ref"),
+        "--save-f", str(tmp_path / "ref.npy"),
+    )
+    # killed run: SIGKILL at iteration 8 (checkpoints at 3 and 6 survive)
+    tdir = str(tmp_path / "telem")
+    r = _run_cli(
+        *base, "--checkpoint-dir", str(tmp_path / "ck"),
+        "--telemetry-dir", tdir,
+        env_extra={
+            "BIGCLAM_FAULTS": json.dumps(
+                {"faults": [{"kind": "kill", "site": "fit.step", "at": 8}]}
+            )
+        },
+        check=False,
+    )
+    assert r.returncode != 0                     # SIGKILL'd
+    assert "FAULT kill" in r.stderr
+    ck = CheckpointManager(str(tmp_path / "ck"))
+    assert ck.latest_step() == 6
+    # resume (default --resume auto): must complete and match bit for bit
+    _run_cli(
+        *base, "--checkpoint-dir", str(tmp_path / "ck"),
+        "--telemetry-dir", tdir,
+        "--save-f", str(tmp_path / "resumed.npy"),
+    )
+    ref = np.load(tmp_path / "ref.npy")
+    resumed = np.load(tmp_path / "resumed.npy")
+    np.testing.assert_array_equal(resumed, ref)
+
+    from bigclam_tpu.resilience import read_lineage
+
+    lineage = read_lineage(tdir)
+    assert len(lineage) == 1 and lineage[0]["resumed_step"] == 6
+    r2 = _run_cli("report", tdir)
+    assert "resume lineage" in r2.stdout
+    n, errors = validate_events_file(os.path.join(tdir, EVENTS_NAME))
+    assert errors == [], errors
+
+
+def test_cli_resume_never_cold_starts(tmp_path):
+    """--resume never ignores existing checkpoints (cold start from F0 —
+    NOT the journaled step-6 state a default run would restore) while
+    still saving new ones."""
+    graph = _write_cli_graph(tmp_path)
+
+    def base(iters):
+        return [
+            "fit", "--graph", str(graph), "--k", "2", "--dtype",
+            "float64", "--max-iters", str(iters), "--conv-tol", "0",
+            "--init", "random", "--quiet", "--platform", "cpu",
+            "--checkpoint-every", "2",
+            "--checkpoint-dir", str(tmp_path / "ck"),
+        ]
+
+    r1 = _run_cli(*base(6))
+    rec1 = json.loads(r1.stdout.strip().splitlines()[-1])
+    assert rec1["iters"] == 6
+    # a 4-iter rerun WITH resume would report iters=6 (restored past its
+    # own max); --resume never must cold-start and stop at 4
+    r2 = _run_cli(*base(4), "--resume", "never")
+    rec2 = json.loads(r2.stdout.strip().splitlines()[-1])
+    assert rec2["iters"] == 4
+    # and the checkpoints written by the cold run are usable
+    assert CheckpointManager(str(tmp_path / "ck")).latest_step() == 6
