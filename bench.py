@@ -68,6 +68,32 @@ BASELINE_ITERS = 3
 # crashed and the benchmark restarted itself on the CPU platform
 FALLBACK_ENV = "BIGCLAM_BENCH_CPU_FALLBACK"
 
+# observability env the re-exec MUST carry over: dropping any of these
+# would silently strip the fallback run's telemetry/perf-ledger/fault
+# plan (ISSUE 6 satellite — pinned by tests/test_trace.py)
+PROPAGATED_ENV = (
+    "BIGCLAM_TELEMETRY_DIR",
+    "BIGCLAM_PERF_LEDGER",
+    "BIGCLAM_FAULTS",
+)
+
+
+def _fallback_child_env(environ) -> dict:
+    """The exact environment the cpu-fallback re-exec runs under: a COPY
+    of the parent's (so BIGCLAM_TELEMETRY_DIR / BIGCLAM_PERF_LEDGER /
+    BIGCLAM_FAULTS and everything else propagate), with the CPU platform
+    pinned, the fallback tag set, and 8 virtual host devices so the ring
+    overlap config still runs. Factored out so the propagation contract
+    is testable without hanging a backend."""
+    env = dict(environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env[FALLBACK_ENV] = "1"
+    env["XLA_FLAGS"] = (
+        env.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=8"
+    ).strip()
+    return env
+
 # --- roofline / MFU accounting (VERDICT r5 Next #5) -----------------------
 # edges/sec/chip is a RELATIVE number until it has a denominator: the
 # fields below state how far each config sits from the chip's own limits,
@@ -226,15 +252,11 @@ def _backend_or_fallback(timeout_s: float = 180.0) -> str:
             file=sys.stderr,
         )
         sys.stderr.flush()
-        env = dict(os.environ)
-        env["JAX_PLATFORMS"] = "cpu"
-        env[FALLBACK_ENV] = "1"
-        # 8 virtual host devices so the ring overlap config still runs
-        env["XLA_FLAGS"] = (
-            env.get("XLA_FLAGS", "")
-            + " --xla_force_host_platform_device_count=8"
-        ).strip()
-        os.execvpe(sys.executable, [sys.executable] + sys.argv, env)
+        os.execvpe(
+            sys.executable,
+            [sys.executable] + sys.argv,
+            _fallback_child_env(os.environ),
+        )
     print(
         json.dumps(
             {
@@ -529,6 +551,7 @@ def _emit(jax, spec, g, cfg, F0, backend, model, configs, enron_eps,
 
     tel = _obs.current()
     if tel is not None:
+        roof = record.get("roofline") or {}
         tel.set_final(
             {
                 "metric": record["metric"],
@@ -536,6 +559,16 @@ def _emit(jax, spec, g, cfg, F0, backend, model, configs, enron_eps,
                 "vs_baseline": record["vs_baseline"],
                 "path": record["path"],
                 "backend": record["backend"],
+                # workload identity for the perf ledger: the headline
+                # metric's graph (BIGCLAM_BENCH_GRAPH can swap it — two
+                # bench runs over different graphs must never baseline
+                # against each other)
+                "n": g.num_nodes,
+                "edges": g.num_directed_edges // 2,
+                # the ledger's roofline fields (obs.ledger): hbm_frac is
+                # the denominator "is it actually fast" gates against
+                "hbm_frac": roof.get("hbm_frac"),
+                "mfu": roof.get("mfu"),
             }
         )
     print(json.dumps(record))

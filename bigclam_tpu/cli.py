@@ -7,6 +7,8 @@ covers fitting, K-sweeps and ground-truth evaluation.
     python -m bigclam_tpu.cli fit   --graph data.cache --k 100 --out cmty.txt
     python -m bigclam_tpu.cli sweep --graph data.txt --min-com 50 --max-com 200
     python -m bigclam_tpu.cli eval  --pred cmty.txt --truth truth.cmty
+    python -m bigclam_tpu.cli profile --graph data.txt --k 100 --steps 20
+    python -m bigclam_tpu.cli perf diff --ledger perf/ledger.jsonl
 
 `fit`/`sweep` accept either a SNAP text path or a graph-cache directory
 compiled by `ingest` (binary shards, mmap fast reload); passing a text path
@@ -100,6 +102,14 @@ def _add_common(p: argparse.ArgumentParser) -> None:
              "JSONL)",
     )
     p.add_argument(
+        "--perf-ledger", default=None,
+        help="append this run's perf record (step-time percentiles, eps, "
+             "compile count, per-span totals, config/host digest) to a "
+             "perf-ledger JSONL at finalize; compare runs with `cli perf "
+             "diff`. Equivalent to setting BIGCLAM_PERF_LEDGER. Requires "
+             "--telemetry-dir",
+    )
+    p.add_argument(
         "--quiet", action="store_true",
         help="silence per-step echo, engagement lines, and the heartbeat's "
              "stderr warnings (telemetry JSONL stays complete)",
@@ -170,6 +180,13 @@ def _open_telemetry(args, entry: str):
     (ingest); --distributed defers the single-writer gate until the
     process group is joined (initialize_distributed commits it)."""
     tdir = getattr(args, "telemetry_dir", None)
+    ledger = getattr(args, "perf_ledger", None)
+    if ledger and not tdir:
+        print(
+            "warning: --perf-ledger has no effect without "
+            "--telemetry-dir (no run telemetry, no perf record)",
+            file=sys.stderr,
+        )
     if not tdir:
         return None
     from bigclam_tpu.obs import RunTelemetry, install
@@ -183,6 +200,11 @@ def _open_telemetry(args, entry: str):
             device_memory=entry != "ingest",
             auto_gate=not getattr(args, "distributed", False),
             heartbeat_escalate=getattr(args, "heartbeat_escalate", 0),
+            # passed THROUGH rather than via os.environ: an env mutation
+            # would leak the ledger into later in-process main() calls
+            # and child processes (BIGCLAM_PERF_LEDGER stays the opt-in
+            # for bench/gate scripts)
+            ledger_path=ledger,
         )
     )
 
@@ -519,6 +541,12 @@ def _cmd_sweep(args, tel=None) -> int:
         "chosen_k": res.chosen_k,
         "kset": res.kset,
         "llh_by_k": {str(k): v for k, v in res.llh_by_k.items()},
+        # workload identity for the perf ledger (obs.ledger.match_key):
+        # without n/edges, sweeps over different graphs would baseline
+        # against each other. chosen_k is an OUTPUT (noisy across
+        # re-runs), so it must not ride the match key — k stays unset
+        "n": g.num_nodes,
+        "edges": g.num_directed_edges // 2,
     }
     if tel is not None:
         tel.set_final(out)
@@ -582,6 +610,162 @@ def _cmd_ingest(args, tel=None) -> int:
         tel.set_final(out)
     print(json.dumps(out))
     return 0
+
+
+def cmd_profile(args) -> int:
+    tel = _open_telemetry(args, "profile")
+    try:
+        return _cmd_profile(args, tel)
+    finally:
+        _close_telemetry(tel)
+
+
+def _cmd_profile(args, tel=None) -> int:
+    """Run N instrumented steps under a jax.profiler capture (ISSUE 6):
+    each step wrapped in a StepTraceAnnotation + span, so the captured XLA
+    timeline (tensorboard-viewable) aligns with our span names, and the
+    per-step timings land in the telemetry/ledger like a fit's would.
+
+        cli profile --graph g.txt --k 100 --steps 20 \\
+            --profile-dir prof/ --telemetry-dir run1/
+    """
+    import os
+    import statistics
+    import time
+
+    from bigclam_tpu.obs import trace as obs_trace
+    from bigclam_tpu.utils import MetricsLogger
+    from bigclam_tpu.utils.profiling import StageProfile, trace
+
+    if args.steps < 1:
+        # refuse before the expensive graph-load/model-build/warmup work
+        # (an empty timing window has no median)
+        print("error: profile --steps must be >= 1", file=sys.stderr)
+        return 2
+    if tel is None:
+        print(
+            "warning: profile without --telemetry-dir captures step "
+            "annotations only — span names need a telemetry run to "
+            "attach to, and no per-step timings land anywhere",
+            file=sys.stderr,
+        )
+    prof = StageProfile()
+    with prof.stage("graph_load"):
+        g, cfg = _build(args, args.k)
+    cfg = cfg.replace(max_iters=args.steps, conv_tol=0.0)
+    with prof.stage("model_build"):
+        model = _make_model(g, cfg, args)
+    if tel is not None:
+        tel.commit_gate()
+    with prof.stage("seeding"):
+        F0 = _init_F(g, cfg, args)
+    import jax
+
+    pdir = args.profile_dir or (
+        os.path.join(args.telemetry_dir, "profile")
+        if getattr(args, "telemetry_dir", None)
+        else "bigclam_profile"
+    )
+    mesh = getattr(model, "mesh", None)
+    n_chips = mesh.size if mesh is not None else 1
+    state = model.init_state(F0)
+    with prof.stage("warmup"):
+        for _ in range(max(args.warmup, 0)):
+            state = model._step(state)
+        jax.block_until_ready(state.F)
+    times = []
+    with MetricsLogger(args.metrics, echo=not args.quiet) as ml:
+        cb = ml.step_callback(
+            g.num_directed_edges,
+            chips=n_chips,
+            path=getattr(model, "engaged_path", ""),
+            num_nodes=g.num_nodes,
+        )
+        with prof.stage("profiled_steps"), trace(pdir):
+            for i in range(args.steps):
+                t0 = time.perf_counter()
+                with obs_trace.step_annotation(i), obs_trace.span(
+                    "step", emit=False
+                ):
+                    state = model._step(state)
+                    jax.block_until_ready(state.F)
+                times.append(time.perf_counter() - t0)
+                cb(i, float(state.llh))
+    out = {
+        "steps": args.steps,
+        "warmup": args.warmup,
+        "sec_per_step_p50": round(statistics.median(times), 6),
+        "sec_per_step_min": round(min(times), 6),
+        "profile_dir": pdir,
+        "path": getattr(model, "engaged_path", ""),
+        "n": g.num_nodes,
+        "edges": g.num_edges,
+        "k": cfg.num_communities,
+    }
+    if tel is not None:
+        tel.set_final(out)
+    print(json.dumps(out))
+    return 0
+
+
+def cmd_perf(args) -> int:
+    """Perf-ledger tooling (obs.ledger): `record` appends a record built
+    from a finished telemetry dir, `diff` gates the latest run against its
+    matched baseline (exit 2 on regression, 1 on missing data), `show`
+    lists recent records."""
+    from bigclam_tpu.obs import ledger as L
+
+    if args.action == "record":
+        try:
+            rec = L.record_from_dir(args.telemetry_dir, note=args.note)
+        except (OSError, ValueError) as e:
+            # mistyped dir / run that died before finalize: the clean
+            # exit-1 contract, not a traceback
+            print(f"perf record: {e}", file=sys.stderr)
+            return 1
+        errors = L.validate_record(rec)
+        if errors:
+            print(f"invalid record: {errors}", file=sys.stderr)
+            return 1
+        L.PerfLedger(args.ledger).append(rec)
+        print(json.dumps(rec, sort_keys=True))
+        return 0
+
+    led = L.PerfLedger(args.ledger)
+    recs = led.load()
+    if led.load_errors:
+        print(
+            f"note: {led.load_errors} unparsable ledger line(s) skipped",
+            file=sys.stderr,
+        )
+    if args.action == "show":
+        for rec in recs[-args.n:]:
+            print(json.dumps(rec, sort_keys=True))
+        if not recs:
+            print(f"{args.ledger}: no records", file=sys.stderr)
+        return 0
+
+    # diff
+    if not recs:
+        print(f"{args.ledger}: no records to diff", file=sys.stderr)
+        return 1
+    new = led.latest(recs, run=args.run)
+    if new is None:
+        print(f"run {args.run!r} not found in {args.ledger}",
+              file=sys.stderr)
+        return 1
+    base = led.baseline_for(new, recs)
+    if base is None:
+        print(
+            f"no matched baseline for run {new.get('run')} "
+            f"(entry={new.get('entry')}, cfg={new.get('cfg_digest')}, "
+            f"backend={new.get('backend')}, host={new.get('host')})",
+            file=sys.stderr,
+        )
+        return 1
+    d = L.diff_records(base, new, tolerance=args.tolerance)
+    print(L.render_diff(d))
+    return 2 if d["regression"] else 0
 
 
 def cmd_report(args) -> int:
@@ -713,6 +897,55 @@ def main(argv=None) -> int:
     )
     p_ing.add_argument("--quiet", action="store_true")
     p_ing.set_defaults(fn=cmd_ingest)
+
+    p_prof = sub.add_parser(
+        "profile",
+        help="run N instrumented steps under a jax.profiler capture: the "
+             "dump's TraceMe timeline carries the span names (obs.trace), "
+             "per-step timings land in --telemetry-dir / the perf ledger",
+    )
+    _add_common(p_prof)
+    p_prof.add_argument("--k", type=int, default=100)
+    p_prof.add_argument(
+        "--steps", type=int, default=20,
+        help="profiled steps (after --warmup un-captured steps)",
+    )
+    p_prof.add_argument("--warmup", type=int, default=2)
+    p_prof.set_defaults(fn=cmd_profile)
+
+    p_perf = sub.add_parser(
+        "perf",
+        help="perf-regression ledger: record a run, diff the latest run "
+             "against its matched baseline (nonzero exit on regression), "
+             "or show recent records",
+    )
+    perf_sub = p_perf.add_subparsers(dest="action", required=True)
+    pp_rec = perf_sub.add_parser(
+        "record",
+        help="append a record built from a finished --telemetry-dir",
+    )
+    pp_rec.add_argument("--telemetry-dir", required=True)
+    pp_rec.add_argument("--ledger", default="perf/ledger.jsonl")
+    pp_rec.add_argument("--note", default="")
+    pp_diff = perf_sub.add_parser(
+        "diff",
+        help="latest run vs its matched baseline (same entry/config/"
+             "backend/device/host) with noise bands; exit 2 on regression",
+    )
+    pp_diff.add_argument("--ledger", default="perf/ledger.jsonl")
+    pp_diff.add_argument(
+        "--tolerance", type=float, default=0.25,
+        help="minimum relative noise band (the run's own p50->p90 spread "
+             "widens it)",
+    )
+    pp_diff.add_argument(
+        "--run", default=None,
+        help="diff this run id instead of the ledger's last record",
+    )
+    pp_show = perf_sub.add_parser("show", help="print recent records")
+    pp_show.add_argument("--ledger", default="perf/ledger.jsonl")
+    pp_show.add_argument("-n", type=int, default=10)
+    p_perf.set_defaults(fn=cmd_perf)
 
     p_rep = sub.add_parser(
         "report",

@@ -369,7 +369,12 @@ def run_fit_loop(
     moves storage, not math (pinned by tests/test_donation.py).
 
     OBSERVABILITY (bigclam_tpu.obs): each iteration beats the stall
-    heartbeat of the installed RunTelemetry (progress = iter + LLH), and a
+    heartbeat of the installed RunTelemetry (progress = iter + LLH), and
+    its phases run under emit=False spans (obs.trace: fit_loop/dispatch,
+    /sync, /callback, plus per-save fit_loop/checkpoint and the final
+    fit_loop/extract_F) — per-phase totals land in the run report, the
+    per-span breakdown of `cli report`, and the perf ledger, and a stall
+    mid-collective names the open phase in its stall event. A
     NON-FINITE LLH aborts through _abort_nonfinite — F/accept-hist
     diagnostics are dumped (to the telemetry dir when one is active)
     before the FloatingPointError, instead of the loop silently iterating
@@ -394,8 +399,15 @@ def run_fit_loop(
     import math
 
     from bigclam_tpu.obs import telemetry as _obs
+    from bigclam_tpu.obs import trace as _trace
 
     tel = _obs.current()
+    # per-iteration phase spans (obs.trace, ISSUE 6): slash-named so they
+    # group under "fit_loop/" beneath whatever span encloses the fit (the
+    # CLI's "fit" stage). emit=False — exact per-phase totals in the run
+    # report/ledger, no per-iteration event lines. With telemetry off
+    # _span returns the shared no-op (zero-cost contract, test_trace.py).
+    _span = _trace.span
 
     cb_arity = 0
     if callback is not None:
@@ -441,14 +453,21 @@ def run_fit_loop(
             state = state._replace(
                 F=state.F.at[int(i0), int(j0)].set(float("nan"))
             )
-        if donate:
-            dead, scratch = scratch, None
-            if dead is None:
-                dead = donation_scratch(state)
-            new_state = donating(dead, state)
-        else:
-            new_state = step_fn(state)
-        llh_t = float(new_state.llh)           # LLH of state.F
+        with _span("fit_loop/dispatch", emit=False):
+            # enqueue the compiled step (async on real backends)
+            if donate:
+                dead, scratch = scratch, None
+                if dead is None:
+                    dead = donation_scratch(state)
+                new_state = donating(dead, state)
+            else:
+                new_state = step_fn(state)
+        with _span("fit_loop/sync", emit=False):
+            # the host block on the scalar LLH — device compute, in-step
+            # collective waits, and the D2H transfer are indistinguishable
+            # from the host, so this span IS the iteration's "collective
+            # wait + host sync" phase (DESIGN.md span taxonomy)
+            llh_t = float(new_state.llh)       # LLH of state.F
         if not math.isfinite(llh_t):
             target = snapshot if snapshot is not None else fallback
             if rollbacks >= budget or target is None:
@@ -501,16 +520,17 @@ def run_fit_loop(
         if tel is not None:
             tel.step_beat(int(state.it), llh_t)
         if callback is not None:
-            if cb_arity >= 3:
-                ah = getattr(new_state, "accept_hist", None)
-                extras = (
-                    {"accept_hist": np.asarray(ah).tolist()}
-                    if ah is not None
-                    else None
-                )
-                callback(int(state.it), llh_t, extras)
-            else:
-                callback(int(state.it), llh_t)
+            with _span("fit_loop/callback", emit=False):
+                if cb_arity >= 3:
+                    ah = getattr(new_state, "accept_hist", None)
+                    extras = (
+                        {"accept_hist": np.asarray(ah).tolist()}
+                        if ah is not None
+                        else None
+                    )
+                    callback(int(state.it), llh_t, extras)
+                else:
+                    callback(int(state.it), llh_t)
         if hist and _rel_change(llh_t, hist[-1]) < cfg.conv_tol:
             final, final_llh, iters = state, llh_t, int(state.it)
             hist.append(llh_t)
@@ -537,21 +557,23 @@ def run_fit_loop(
             # state_to_arrays may be a COLLECTIVE (fetch_global allgathers
             # across processes), so every process must enter it; only the
             # file write itself is single-writer (utils.dist)
-            arrays = state_to_arrays(state)
-            if is_primary():
-                checkpoints.save(
-                    int(state.it),
-                    arrays,
-                    meta={"llh_history": hist, **(ckpt_meta or {})},
-                )
-            if tel is not None:
-                tel.event("checkpoint", step=int(state.it))
+            with _span("fit_loop/checkpoint", it=int(state.it)):
+                arrays = state_to_arrays(state)
+                if is_primary():
+                    checkpoints.save(
+                        int(state.it),
+                        arrays,
+                        meta={"llh_history": hist, **(ckpt_meta or {})},
+                    )
+                if tel is not None:
+                    tel.event("checkpoint", step=int(state.it))
     if extract_F is None:
         # state-resident mode (fit_state / device annealing): hand back the
         # converged TrainState with NO host F fetch — the only scalars
         # crossing the host boundary were the per-iteration LLHs
         return final, final_llh, iters, tuple(hist)
-    F = extract_F(final)
+    with _span("fit_loop/extract_F"):
+        F = extract_F(final)
     return FitResult(
         F=F, sumF=F.sum(axis=0), llh=final_llh,
         num_iters=iters, llh_history=tuple(hist),

@@ -992,6 +992,8 @@ def fit_quality(
             model.rebuild_step()
             rebuilt = True
         t_anneal = time.perf_counter()
+        from bigclam_tpu.obs import trace as _trace
+
         for cycle in range(start_cycle, max_cycles):
             if gainless >= cfg.restart_patience:
                 break          # a restored run that already tripped
@@ -1015,11 +1017,17 @@ def fit_quality(
             # sufficient for duck-typed trainers unless within-cycle
             # checkpointing was explicitly requested
             try:
-                res = (
-                    model.fit(F_try, callback=callback, checkpoints=cyc_ckpt)
-                    if cyc_ckpt is not None
-                    else model.fit(F_try, callback=callback)
-                )
+                # one span per annealing cycle (obs.trace): the restart
+                # schedule's time-per-cycle rides the span breakdown next
+                # to the `cycle` events
+                with _trace.span("cycle", cycle=cycle):
+                    res = (
+                        model.fit(
+                            F_try, callback=callback, checkpoints=cyc_ckpt
+                        )
+                        if cyc_ckpt is not None
+                        else model.fit(F_try, callback=callback)
+                    )
             except FloatingPointError as e:
                 # a kick blew up past the fit loop's rollback budget
                 # (models.bigclam run_fit_loop): annealing is an OPTIONAL
@@ -1483,13 +1491,20 @@ def fit_quality_device(
             model.rebuild_step()
             rebuilt = True
         best_iters, best_hist = 0, ()
+        from bigclam_tpu.obs import trace as _trace
+
         with profile.stage("anneal"):
             for cycle in range(max_cycles):
-                F_try = kick_fn(F_cur, jax.random.fold_in(base_key, cycle))
-                final, llh, iters, hist = model.fit_state(
-                    model.reset_state(F_try), callback=callback
-                )
-                del F_try                  # free the kicked input buffer
+                # span nests under the "anneal" stage span: path
+                # ".../anneal/cycle" in the per-span breakdown
+                with _trace.span("cycle", cycle=cycle):
+                    F_try = kick_fn(
+                        F_cur, jax.random.fold_in(base_key, cycle)
+                    )
+                    final, llh, iters, hist = model.fit_state(
+                        model.reset_state(F_try), callback=callback
+                    )
+                    del F_try              # free the kicked input buffer
                 total_iters += iters
                 profile.count("anneal_cycles")
                 cycles_llh.append(llh)

@@ -16,9 +16,17 @@ entry points create and install a RunTelemetry; library code asks
 `current()` and does nothing when telemetry is off — the off path costs one
 None check, which is what keeps the fit loop's overhead pinned under 2%
 (tests/test_telemetry.py).
+
+ISSUE 6 adds the perf-observability pair on top: obs.trace (hierarchical
+span tracing — WHERE the time went, per phase, aligned with captured XLA
+profiles) and obs.ledger (a persistent perf ledger + `cli perf diff`
+regression gate with noise bands). Spans share the events.jsonl schema
+(kind `span`) and the RunTelemetry sinks; the ledger appends one compact
+record per run when BIGCLAM_PERF_LEDGER is set.
 """
 
 from bigclam_tpu.obs.heartbeat import Heartbeat
+from bigclam_tpu.obs.ledger import LEDGER_ENV, PerfLedger
 from bigclam_tpu.obs.schema import (
     EVENT_KINDS,
     SCHEMA_VERSION,
@@ -32,15 +40,22 @@ from bigclam_tpu.obs.telemetry import (
     note_step_build,
     uninstall,
 )
+from bigclam_tpu.obs.trace import add_span, open_spans, span, step_annotation
 
 __all__ = [
     "EVENT_KINDS",
     "Heartbeat",
+    "LEDGER_ENV",
+    "PerfLedger",
     "RunTelemetry",
     "SCHEMA_VERSION",
+    "add_span",
     "current",
     "install",
     "note_step_build",
+    "open_spans",
+    "span",
+    "step_annotation",
     "uninstall",
     "validate_event",
     "validate_events_file",

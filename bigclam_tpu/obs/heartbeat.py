@@ -102,6 +102,7 @@ class Heartbeat:
             self._emit(silent, progress)
 
     def _emit(self, silent_s: float, progress: dict) -> None:
+        from bigclam_tpu.obs import trace as _trace
         from bigclam_tpu.utils.profiling import current_rss_bytes
 
         self.stalls += 1
@@ -110,19 +111,26 @@ class Heartbeat:
             consecutive = self._consecutive
         rss = current_rss_bytes()
         devices = self.telemetry.device_memory_snapshot()
+        # the currently-OPEN span stack (obs.trace, ISSUE 6): a stall
+        # report answers "stuck in WHICH phase" — a hung collective shows
+        # e.g. ["fit", "fit/fit_loop", "fit/fit_loop/sync"], innermost
+        # last, instead of only "no progress for Ns"
+        spans = _trace.open_spans()
         self.telemetry.event(
             "stall",
             silent_s=round(silent_s, 3),
             rss_bytes=rss,
             progress=progress,
             devices=devices,
+            spans=spans,
         )
         if self.echo:
+            where = f"; open span: {spans[-1]}" if spans else ""
             print(
                 f"[bigclam] STALL: no step/stage completed for "
                 f"{silent_s:.0f}s (deadline {self.deadline_s:g}s); "
                 f"last progress: {progress or 'none'}; "
-                f"rss {rss >> 20} MiB",
+                f"rss {rss >> 20} MiB{where}",
                 file=sys.stderr,
                 flush=True,
             )
@@ -133,6 +141,7 @@ class Heartbeat:
                 stalls=consecutive,
                 silent_s=round(silent_s, 3),
                 progress=progress,
+                spans=spans,
             )
             if self.echo:
                 print(

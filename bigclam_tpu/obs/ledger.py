@@ -1,0 +1,420 @@
+"""Persistent perf-regression ledger (ISSUE 6 tentpole, part b).
+
+BENCH_*.json artifacts exist but nothing compares run N against run N-1 —
+a perf regression lands silently. This module gives every telemetry-
+carrying run a compact, schema'd perf record appended to a ledger JSONL
+(one line per run, append-only, human-diffable), and a diff that compares
+the latest run against its MATCHED baseline with noise bands:
+
+    BIGCLAM_PERF_LEDGER=perf/ledger.jsonl python -m bigclam_tpu.cli fit ...
+    python -m bigclam_tpu.cli perf diff --ledger perf/ledger.jsonl
+
+Record fields (LEDGER_VERSION 1): run id, wall-clock ts, entry point,
+host/platform/backend/device fingerprint, config digest (sha1 over the
+run's step_cfg_key digests — the compile by_key labels), step-time
+percentiles (p10/p50/p90/p99 over the per-iteration sec_per_iter samples
+the MetricsLogger sink forwarded), eps p50, hbm_frac (when the entry
+recorded one — bench), compile count, per-span second totals (obs.trace),
+and the final LLH.
+
+BASELINE MATCHING: a record's baseline is the MOST RECENT EARLIER record
+with the same (entry, cfg_digest, workload, backend, device_kind, host)
+— a step time is only comparable against the same work on the same
+hardware; runs with a different K, kernel-path config, or chip never
+match, and neither do runs over different GRAPHS: cfg_digest is
+config-only (step_cfg_key excludes the graph), so the workload axis is
+the (n, edges, k) triple the entry points stamp into the run's `final`
+outcome (fit/profile stamp all three; sweep and bench stamp n/edges only
+— sweep's chosen_k is a noisy OUTPUT and bench's headline graph carries
+no single K — and axes an entry does not record match on the Nones). A
+run re-recorded into the same ledger (`perf record` after an
+auto-append) is never its own baseline.
+
+NOISE BANDS: the regression threshold is max(tolerance, rel spread of
+either run), where a run's spread is (step_p90 - step_p50)/step_p50 — a
+run whose own timing wobbles 30% cannot be failed by a 25% band. `diff`
+VERDICTS on step_p50 and eps_p50 (or wall_s for steploss runs) and on
+hbm_frac when both runs recorded one; step_p99 (a single sample on short
+runs), compile growth, and per-span deltas are reported as findings, not
+failures (the compile-flatness pin lives in tests/test_telemetry.py).
+
+jax-free: the ledger must be writable/diffable on data-prep hosts and in
+CI without an accelerator.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import time
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+LEDGER_ENV = "BIGCLAM_PERF_LEDGER"
+LEDGER_VERSION = 1
+DEFAULT_PATH = os.path.join("perf", "ledger.jsonl")
+
+_NUM = (int, float)
+# field -> allowed types; None-able numerics are (type..., type(None))
+_RECORD_SCHEMA = {
+    "lv": (int,),
+    "run": (str,),
+    "ts": _NUM,
+    "entry": (str,),
+    "host": (str,),
+    "cfg_digest": (str,),
+    "wall_s": _NUM,
+    "steps": (int,),
+    "compiles": (int,),
+    "spans": (dict,),
+}
+
+
+def _percentile(vals: Sequence[float], q: float) -> Optional[float]:
+    """Nearest-rank percentile over a copy; None on empty input."""
+    if not vals:
+        return None
+    s = sorted(vals)
+    idx = min(int(round(q / 100.0 * (len(s) - 1))), len(s) - 1)
+    return s[idx]
+
+
+def validate_record(rec: Any) -> List[str]:
+    """Schema errors for one ledger record; [] when valid."""
+    if not isinstance(rec, dict):
+        return [f"record is {type(rec).__name__}, not an object"]
+    errors = []
+    for field, types in _RECORD_SCHEMA.items():
+        if field not in rec:
+            errors.append(f"missing field {field!r}")
+        elif not isinstance(rec[field], types) or isinstance(
+            rec[field], bool
+        ):
+            errors.append(
+                f"{field!r} is {type(rec[field]).__name__}, "
+                f"want {'/'.join(t.__name__ for t in types)}"
+            )
+    if not errors and rec["lv"] != LEDGER_VERSION:
+        errors.append(f"ledger version {rec['lv']} != {LEDGER_VERSION}")
+    return errors
+
+
+def build_record(
+    report: Dict[str, Any],
+    step_secs: Optional[Sequence[float]] = None,
+    step_eps: Optional[Sequence[float]] = None,
+    note: str = "",
+) -> Dict[str, Any]:
+    """One ledger record from a finalized run report (+ the per-step
+    timing samples RunTelemetry collected from the MetricsLogger sink)."""
+    fp = report.get("fingerprint", {}) or {}
+    keys = sorted((report.get("compiles", {}) or {}).get("by_key", {}))
+    digest = (
+        hashlib.sha1("|".join(keys).encode()).hexdigest()[:12]
+        if keys
+        else "none"
+    )
+    final = report.get("final", {}) or {}
+    secs = [float(v) for v in (step_secs or [])]
+    eps = [float(v) for v in (step_eps or [])]
+    rec: Dict[str, Any] = {
+        "lv": LEDGER_VERSION,
+        "run": str(report.get("run", "")),
+        "ts": round(time.time(), 3),
+        "entry": str(report.get("entry", "")),
+        "host": str(fp.get("host", "")),
+        "platform": fp.get("platform"),
+        "backend": fp.get("backend"),
+        "device_kind": fp.get("device_kind"),
+        "devices": fp.get("devices"),
+        "cfg_digest": digest,
+        "cfg_keys": keys,
+        # workload identity (see module docstring): the graph/K the entry
+        # point recorded in its final outcome — part of the match key,
+        # because cfg_digest alone cannot tell two graphs apart
+        "n": final.get("n"),
+        "edges": final.get("edges"),
+        "k": final.get("k"),
+        "wall_s": float(report.get("wall_s", 0.0) or 0.0),
+        "steps": len(secs),
+        "step_p10": _round6(_percentile(secs, 10)),
+        "step_p50": _round6(_percentile(secs, 50)),
+        "step_p90": _round6(_percentile(secs, 90)),
+        "step_p99": _round6(_percentile(secs, 99)),
+        "eps_p50": _round6(_percentile(eps, 50)),
+        "compiles": int((report.get("compiles", {}) or {}).get("count", 0)),
+        "hbm_frac": final.get("hbm_frac"),
+        "spans": {
+            k: round(float(v), 4)
+            for k, v in (report.get("spans", {}) or {})
+            .get("seconds", {})
+            .items()
+        },
+        "final_llh": final.get("llh"),
+    }
+    if note:
+        rec["note"] = note
+    return rec
+
+
+def _round6(v: Optional[float]) -> Optional[float]:
+    return None if v is None else round(v, 6)
+
+
+def match_key(rec: Dict[str, Any]) -> Tuple:
+    """Baseline identity: same entry + config + workload + hardware +
+    host (see module docstring)."""
+    return (
+        rec.get("entry"),
+        rec.get("cfg_digest"),
+        rec.get("n"),
+        rec.get("edges"),
+        rec.get("k"),
+        rec.get("backend"),
+        rec.get("device_kind"),
+        rec.get("host"),
+    )
+
+
+class PerfLedger:
+    """Append-only JSONL of perf records; unparsable lines are skipped at
+    read time (counted in .load_errors) so one corrupt line cannot take
+    down the gate."""
+
+    def __init__(self, path: str = DEFAULT_PATH):
+        self.path = path
+        self.load_errors = 0
+
+    def append(self, rec: Dict[str, Any]) -> Dict[str, Any]:
+        parent = os.path.dirname(self.path)
+        if parent:
+            os.makedirs(parent, exist_ok=True)
+        with open(self.path, "a") as f:
+            f.write(json.dumps(rec, sort_keys=True) + "\n")
+        return rec
+
+    def load(self) -> List[Dict[str, Any]]:
+        self.load_errors = 0
+        out: List[Dict[str, Any]] = []
+        try:
+            fh = open(self.path)
+        except OSError:
+            return out
+        with fh:
+            for line in fh:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    self.load_errors += 1
+                    continue
+                if isinstance(rec, dict):
+                    out.append(rec)
+                else:
+                    self.load_errors += 1
+        return out
+
+    def latest(
+        self, records: Optional[List[dict]] = None, run: Optional[str] = None
+    ) -> Optional[Dict[str, Any]]:
+        records = self.load() if records is None else records
+        if run is not None:
+            for rec in reversed(records):
+                if rec.get("run") == run:
+                    return rec
+            return None
+        return records[-1] if records else None
+
+    def baseline_for(
+        self, rec: Dict[str, Any], records: Optional[List[dict]] = None
+    ) -> Optional[Dict[str, Any]]:
+        """Most recent EARLIER record with rec's match key (ledger order =
+        append order; a record never baselines against itself or anything
+        appended after it)."""
+        records = self.load() if records is None else records
+        key = match_key(rec)
+        best = None
+        for other in records:
+            if other is rec or (
+                other.get("run") == rec.get("run")
+                and other.get("ts") == rec.get("ts")
+            ):
+                break
+            if other.get("run") == rec.get("run"):
+                # the same run re-recorded (auto-append + `perf record`
+                # on the same dir stamps a fresh ts): identical step
+                # samples, so it can never be its own baseline
+                continue
+            if match_key(other) == key:
+                best = other
+        return best
+
+
+def maybe_append_env(
+    report: Dict[str, Any],
+    step_secs: Optional[Sequence[float]] = None,
+    step_eps: Optional[Sequence[float]] = None,
+    path: Optional[str] = None,
+) -> Optional[Dict[str, Any]]:
+    """RunTelemetry.finalize hook: append this run's record when an
+    explicit ledger `path` was wired (cli --perf-ledger) or
+    BIGCLAM_PERF_LEDGER names one. Primary process only (one record per
+    run, like events.jsonl)."""
+    path = path or os.environ.get(LEDGER_ENV)
+    if not path or int(report.get("pid", 0)) != 0:
+        return None
+    rec = build_record(report, step_secs, step_eps)
+    return PerfLedger(path).append(rec)
+
+
+def record_from_dir(directory: str, note: str = "") -> Dict[str, Any]:
+    """Build a record from a finished telemetry directory (`cli perf
+    record`): the primary run report + per-step timings recovered from the
+    step events in events.jsonl."""
+    from bigclam_tpu.obs.report import load_events, load_reports
+
+    reports = load_reports(directory)
+    if not reports:
+        raise FileNotFoundError(f"{directory}: no run_report*.json")
+    events = load_events(directory) or []
+    secs = [
+        float(e["sec_per_iter"])
+        for e in events
+        if e.get("kind") == "step"
+        and isinstance(e.get("sec_per_iter"), _NUM)
+    ]
+    eps = [
+        float(e["edges_per_sec_per_chip"])
+        for e in events
+        if e.get("kind") == "step"
+        and isinstance(e.get("edges_per_sec_per_chip"), _NUM)
+    ]
+    return build_record(reports[0], secs, eps, note=note)
+
+
+# ------------------------------------------------------------------- diff
+def _rel_spread(rec: Dict[str, Any]) -> float:
+    p50, p90 = rec.get("step_p50"), rec.get("step_p90")
+    if not p50 or not p90:
+        return 0.0
+    return max((p90 - p50) / p50, 0.0)
+
+
+def diff_records(
+    base: Dict[str, Any], new: Dict[str, Any], tolerance: float = 0.25
+) -> Dict[str, Any]:
+    """Compare `new` against its baseline `base` (see module docstring for
+    band/verdict rules). Returns a JSON-ready dict; "regression" is the
+    gate verdict `cli perf diff` maps to a nonzero exit."""
+    band = max(float(tolerance), _rel_spread(base), _rel_spread(new))
+    checks: List[Dict[str, Any]] = []
+    state = {"regression": False}
+
+    def check(metric, bval, nval, worse_if_higher=True, band_mult=1.0,
+              verdicted=True):
+        if not isinstance(bval, _NUM) or not isinstance(nval, _NUM) or not bval:
+            checks.append(
+                {"metric": metric, "base": bval, "new": nval,
+                 "skipped": True}
+            )
+            return
+        ratio = nval / bval
+        b = band * band_mult
+        bad = ratio > 1.0 + b if worse_if_higher else ratio < 1.0 - b
+        checks.append(
+            {
+                "metric": metric,
+                "base": bval,
+                "new": nval,
+                "ratio": round(ratio, 4),
+                "band": round(b, 4),
+                "regression": bad,
+                "verdicted": verdicted,
+            }
+        )
+        if bad and verdicted:
+            state["regression"] = True
+
+    if new.get("steps") and base.get("steps"):
+        check("step_p50", base.get("step_p50"), new.get("step_p50"))
+        # p99 is a SINGLE sample on short runs (one GC pause or page fault
+        # owns it): reported with a doubled band, never verdicted — the
+        # gate verdict rides the median and throughput
+        check("step_p99", base.get("step_p99"), new.get("step_p99"),
+              band_mult=2.0, verdicted=False)
+        check("eps_p50", base.get("eps_p50"), new.get("eps_p50"),
+              worse_if_higher=False)
+    else:
+        # steploss entries (ingest, report-only runs): wall time is the
+        # only comparable figure
+        check("wall_s", base.get("wall_s"), new.get("wall_s"))
+    if isinstance(base.get("hbm_frac"), _NUM) and isinstance(
+        new.get("hbm_frac"), _NUM
+    ):
+        check("hbm_frac", base["hbm_frac"], new["hbm_frac"],
+              worse_if_higher=False)
+
+    # findings (reported, never verdicted): compile growth + span deltas
+    compile_growth = int(new.get("compiles", 0)) - int(
+        base.get("compiles", 0)
+    )
+    deltas = []
+    bspans, nspans = base.get("spans", {}) or {}, new.get("spans", {}) or {}
+    for path in sorted(set(bspans) & set(nspans)):
+        bs, ns = float(bspans[path]), float(nspans[path])
+        if bs > 0:
+            deltas.append(
+                {"path": path, "base_s": bs, "new_s": ns,
+                 "ratio": round(ns / bs, 4)}
+            )
+    deltas.sort(key=lambda d: -d["ratio"])
+    return {
+        "base_run": base.get("run"),
+        "new_run": new.get("run"),
+        "band": round(band, 4),
+        "checks": checks,
+        "regression": state["regression"],
+        "compile_growth": compile_growth,
+        "span_deltas": deltas[:8],
+    }
+
+
+def render_diff(d: Dict[str, Any]) -> str:
+    lines = [
+        f"perf diff: run {d['new_run']} vs baseline {d['base_run']} "
+        f"(noise band {d['band']:.0%})"
+    ]
+    for c in d["checks"]:
+        if c.get("skipped"):
+            lines.append(
+                f"  {c['metric']:<10} skipped "
+                f"(base={c['base']} new={c['new']})"
+            )
+            continue
+        verdict = (
+            "REGRESSION"
+            if c["regression"] and c.get("verdicted", True)
+            else ("slow (not verdicted)" if c["regression"] else "ok")
+        )
+        lines.append(
+            f"  {c['metric']:<10} base {c['base']:<12g} new {c['new']:<12g}"
+            f" ratio {c['ratio']:.3f} (band {c['band']:.0%})  {verdict}"
+        )
+    if d.get("compile_growth"):
+        lines.append(
+            f"  note: compile count changed by {d['compile_growth']:+d}"
+        )
+    hot = [s for s in d.get("span_deltas", []) if s["ratio"] > 1.0]
+    if hot:
+        lines.append("  slowest-growing spans:")
+        for s in hot[:3]:
+            lines.append(
+                f"    {s['path']:<32} {s['base_s']:.3f}s -> "
+                f"{s['new_s']:.3f}s ({s['ratio']:.2f}x)"
+            )
+    lines.append(
+        "  verdict: " + ("REGRESSION" if d["regression"] else "PASS")
+    )
+    return "\n".join(lines)

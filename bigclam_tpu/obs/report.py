@@ -15,17 +15,36 @@ from __future__ import annotations
 import glob
 import json
 import os
+import re
 from typing import Dict, List, Optional, Tuple
 
 from bigclam_tpu.obs.schema import summarize_kinds, validate_events_file
 from bigclam_tpu.obs.telemetry import EVENTS_NAME, REPORT_NAME
 
 
+def _report_pid(path: str) -> int:
+    """NUMERIC pid from a run_report filename: lexical sort put p10 before
+    p2, which scrambled merge order (and any per-pid rendering) past nine
+    processes."""
+    base = os.path.basename(path)
+    if base == REPORT_NAME:
+        return 0
+    m = re.match(r"run_report\.p(\d+)\.json$", base)
+    return int(m.group(1)) if m else 1 << 30
+
+
+def _pid_key(pid: str) -> int:
+    """Same numeric ordering for the string pid keys of the merged
+    per-pid dicts (p2 before p10)."""
+    return int(pid) if pid.isdigit() else 1 << 30
+
+
 def load_reports(directory: str) -> List[dict]:
-    """Every run_report*.json in the dir, primary first then by pid."""
+    """Every run_report*.json in the dir, primary first then by NUMERIC
+    pid (p2 before p10)."""
     paths = sorted(
         glob.glob(os.path.join(directory, "run_report*.json")),
-        key=lambda p: (os.path.basename(p) != REPORT_NAME, p),
+        key=lambda p: (_report_pid(p), p),
     )
     out = []
     for p in paths:
@@ -34,7 +53,22 @@ def load_reports(directory: str) -> List[dict]:
     return out
 
 
+def _event_order(e: dict) -> float:
+    """Merge-order key: the MONOTONIC elapsed_s. The `t` fallback is
+    defensive, for malformed lines missing it (schema validation still
+    reports those — v1 logs are rejected, not silently read; pinned by
+    test). Never the wall-clock `ts` — a clock jump must not reorder the
+    timeline."""
+    v = e.get("elapsed_s", e.get("t", 0.0))
+    return v if isinstance(v, (int, float)) else 0.0
+
+
 def load_events(directory: str) -> Optional[List[dict]]:
+    """events.jsonl decoded and STABLY ordered by elapsed_s: the heartbeat
+    thread and the main thread stamp their events before taking the write
+    lock, so adjacent lines can land microseconds out of order — the
+    stable sort repairs that while preserving file order for equal
+    timestamps (multi-writer interleave contract, tested)."""
     path = os.path.join(directory, EVENTS_NAME)
     if not os.path.exists(path):
         return None
@@ -47,7 +81,48 @@ def load_events(directory: str) -> Optional[List[dict]]:
                     events.append(json.loads(line))
                 except ValueError:
                     events.append({"kind": "?", "unparsed": line[:80]})
-    return events
+    # events without a numeric elapsed_s — the "?" placeholders for
+    # corrupt lines, exactly the ones whose FILE position is the evidence
+    # — inherit the previous event's key so they stay next to their
+    # neighbors; the stable sort then only repairs real out-of-order
+    # stamps (heartbeat-thread interleave)
+    last = 0.0
+    keyed = []
+    for e in events:
+        v = e.get("elapsed_s", e.get("t"))
+        if isinstance(v, (int, float)) and not isinstance(v, bool):
+            last = float(v)
+        keyed.append((last, e))
+    keyed.sort(key=lambda kv: kv[0])    # stable: ties keep file order
+    return [e for _, e in keyed]
+
+
+def run_duration_s(events: List[dict]) -> Optional[float]:
+    """Run duration from MONOTONIC event times (first -> last elapsed_s).
+    The report quotes this instead of subtracting wall clocks, so an NTP
+    step mid-run cannot corrupt the figure (ISSUE 6 satellite)."""
+    stamped = [
+        _event_order(e)
+        for e in events
+        if isinstance(e.get("elapsed_s", e.get("t")), (int, float))
+    ]
+    if not stamped:
+        return None
+    return max(stamped) - min(stamped)
+
+
+def span_coverage(report: dict) -> Optional[float]:
+    """Fraction of the run's wall time attributed by TOP-LEVEL spans
+    (paths without a '/'): children re-count their parents' time, so only
+    depth-0 spans sum against the wall. The telemetry smoke gates this at
+    >= 0.95 — unattributed time is the regression this layer exists to
+    prevent."""
+    spans = (report.get("spans", {}) or {}).get("seconds", {}) or {}
+    wall = report.get("wall_s")
+    if not wall:
+        return None
+    top = sum(v for k, v in spans.items() if "/" not in k)
+    return top / float(wall)
 
 
 def merge_reports(reports: List[dict]) -> dict:
@@ -67,6 +142,13 @@ def merge_reports(reports: List[dict]) -> dict:
             str(r.get("pid", "?")): r.get("stages", {}).get("seconds", {})
             for r in reports
         },
+        "spans_by_pid": {
+            str(r.get("pid", "?")): r.get("spans", {}).get("seconds", {})
+            for r in reports
+        },
+        "span_orphans": sum(
+            int(r.get("spans", {}).get("orphans", 0)) for r in reports
+        ),
         "stalls": sum(
             int(r.get("heartbeat", {}).get("stalls", 0)) for r in reports
         ),
@@ -155,7 +237,9 @@ def render(directory: str) -> Tuple[str, int]:
             )
         lines.append("")
         lines.append("stage seconds (per process):")
-        for pid, stages in sorted(merged["stages_by_pid"].items()):
+        for pid, stages in sorted(
+            merged["stages_by_pid"].items(), key=lambda kv: _pid_key(kv[0])
+        ):
             if not stages:
                 lines.append(f"  p{pid}: (none)")
                 continue
@@ -166,6 +250,53 @@ def render(directory: str) -> Tuple[str, int]:
             ):
                 pct = 100.0 * secs / total if total else 0.0
                 lines.append(f"    {name:<20} {secs:>9.2f}s  {pct:5.1f}%")
+        # --- per-span time breakdown (obs.trace, ISSUE 6): hierarchical
+        # attribution; only TOP-LEVEL spans sum against the wall (children
+        # re-count their parents), and the coverage line says how much of
+        # the run the taxonomy attributed at all.
+        for pid, spans in sorted(
+            merged["spans_by_pid"].items(), key=lambda kv: _pid_key(kv[0])
+        ):
+            if not spans:
+                continue
+            rep_for_pid = next(
+                (r for r in reports if str(r.get("pid", "?")) == pid),
+                reports[0],
+            )
+            counts = rep_for_pid.get("spans", {}).get("counts", {})
+            lines.append("")
+            lines.append(f"span breakdown (p{pid}):")
+            top_total = sum(v for k, v in spans.items() if "/" not in k)
+            for path in sorted(
+                spans, key=lambda p: (p.split("/")[0], p)
+            ):
+                depth = path.count("/")
+                secs = spans[path]
+                pct = (
+                    100.0 * secs / top_total
+                    if depth == 0 and top_total
+                    else None
+                )
+                name = path.rsplit("/", 1)[-1]
+                lines.append(
+                    f"  {'  ' * depth}{name:<{max(24 - 2 * depth, 4)}}"
+                    f" {secs:>10.3f}s"
+                    + (f"  {pct:5.1f}%" if pct is not None else "       ")
+                    + f"  x{counts.get(path, 0)}"
+                )
+            wall = float(rep_for_pid.get("wall_s", 0.0) or 0.0)
+            if wall:
+                lines.append(
+                    f"  top-level spans cover {top_total:.1f}s = "
+                    f"{100.0 * top_total / wall:.1f}% of wall {wall:.1f}s"
+                )
+        if merged.get("span_orphans"):
+            errors += 1
+            lines.append(
+                f"  SPAN ORPHANS: {merged['span_orphans']} span(s) were "
+                "abandoned without close (tracer repaired the stack)"
+            )
+
         lines.append("")
         lines.append("device memory watermarks (max over samples):")
         if merged["device_peak"]:
@@ -266,6 +397,11 @@ def render(directory: str) -> Tuple[str, int]:
             f"events.jsonl: {n} events "
             + json.dumps(summarize_kinds(events))
         )
+        dur = run_duration_s(events)
+        if dur is not None:
+            # monotonic, by construction: first->last elapsed_s, never a
+            # wall-clock subtraction
+            lines.append(f"  event timeline: {dur:.3f}s (monotonic)")
         if schema_errors:
             lines.append(f"  SCHEMA ERRORS ({len(schema_errors)}):")
             lines.extend(f"    {e}" for e in schema_errors[:20])
@@ -283,9 +419,12 @@ def render(directory: str) -> Tuple[str, int]:
             )
         stalls = [e for e in events if e.get("kind") == "stall"]
         for s in stalls[:5]:
+            where = s.get("spans") or []
             lines.append(
-                f"  stall at t={s.get('t')}s: silent {s.get('silent_s')}s, "
+                f"  stall at t={s.get('elapsed_s', s.get('t'))}s: "
+                f"silent {s.get('silent_s')}s, "
                 f"last progress {s.get('progress')}"
+                + (f", open span {where[-1]}" if where else "")
             )
     elif merged and merged["events"].get("start"):
         lines.append("")

@@ -5,11 +5,18 @@ tier-1 test).
 
 Base fields on EVERY event:
 
-    v     int     schema version (SCHEMA_VERSION)
-    run   str     run id — one RunTelemetry instance = one run
-    pid   int     jax process index (0 before/without jax.distributed)
-    t     float   seconds since the RunTelemetry was created
-    kind  str     one of EVENT_KINDS
+    v          int     schema version (SCHEMA_VERSION)
+    run        str     run id — one RunTelemetry instance = one run
+    pid        int     jax process index (0 before/without jax.distributed)
+    t          float   seconds since the RunTelemetry was created
+                       (kept from v1; same clock as elapsed_s)
+    ts         float   wall-clock unix time (time.time) — for correlating
+                       with external logs ONLY; never compute durations
+                       from it (NTP steps / clock jumps corrupt them)
+    elapsed_s  float   MONOTONIC seconds since the RunTelemetry was
+                       created (time.perf_counter) — the ordering and
+                       duration field consumers must use (obs.report does)
+    kind       str     one of EVENT_KINDS
 
 Kind-specific REQUIRED fields are listed in EVENT_KINDS; extra fields are
 always allowed (events stay extensible without a schema bump — consumers
@@ -21,7 +28,8 @@ from __future__ import annotations
 
 from typing import Iterable, List, Tuple
 
-SCHEMA_VERSION = 1
+# v2 (ISSUE 6): base fields ts + elapsed_s on every event; new `span` kind
+SCHEMA_VERSION = 2
 
 _NUM = (int, float)
 
@@ -60,9 +68,17 @@ EVENT_KINDS = {
     "quarantine": {"shard": (int,)},       # crc-failed shard moved aside
                                            # and rebuilt from source
     "resume": {"step": (int,)},            # --resume auto restored a run
+    # --- tracing & perf ledger (obs.trace / obs.ledger, ISSUE 6) ---
+    "span": {"name": (str,), "path": (str,), "seconds": _NUM},
+    # one closed span: `path` is the slash-joined nesting
+    # ("fit/fit_loop/dispatch"), `name` its last segment; per-iteration
+    # spans aggregate into the run report instead of emitting (emit=False)
 }
 
-_BASE = {"v": (int,), "run": (str,), "pid": (int,), "t": _NUM, "kind": (str,)}
+_BASE = {
+    "v": (int,), "run": (str,), "pid": (int,), "t": _NUM,
+    "ts": _NUM, "elapsed_s": _NUM, "kind": (str,),
+}
 
 
 def validate_event(event) -> List[str]:

@@ -237,6 +237,35 @@ def _process_count() -> int:
         return 1
 
 
+def _fingerprint() -> Dict[str, Any]:
+    """Host/device identity for the perf ledger's baseline matching
+    (obs.ledger): a step-time number is only comparable against a run on
+    the same host AND the same accelerator. Device fields are None on
+    jax-free entries and before the backend is up (_jax_ready guard —
+    fingerprinting must never initialize a backend either)."""
+    import socket
+
+    fp: Dict[str, Any] = {
+        "host": socket.gethostname(),
+        "platform": sys.platform,
+        "backend": None,
+        "device_kind": None,
+        "devices": 0,
+    }
+    if _jax_ready():
+        try:
+            import jax
+
+            fp["backend"] = jax.default_backend()
+            devs = jax.local_devices()
+            fp["devices"] = len(devs)
+            if devs:
+                fp["device_kind"] = devs[0].device_kind
+        except Exception:
+            pass
+    return fp
+
+
 class RunTelemetry:
     """One run = one instance = one telemetry directory.
 
@@ -263,10 +292,14 @@ class RunTelemetry:
         device_memory: bool = True,
         auto_gate: bool = True,
         heartbeat_escalate: int = 0,
+        ledger_path: Optional[str] = None,
     ):
         os.makedirs(directory, exist_ok=True)
         self.directory = directory
         self.entry = entry
+        # explicit perf-ledger target (cli --perf-ledger); falls back to
+        # the BIGCLAM_PERF_LEDGER env at finalize (obs.ledger)
+        self.ledger_path = ledger_path
         self.run_id = run_id or _resolve_run_id(directory)
         self.quiet = quiet
         self.device_memory = device_memory
@@ -281,6 +314,15 @@ class RunTelemetry:
         self.event_counts: Dict[str, int] = {}
         self.stage_seconds: Dict[str, float] = {}
         self.stage_counts: Dict[str, int] = {}
+        # span sinks (obs.trace): per-path running totals — the run
+        # report's span table and the perf ledger read these
+        self.span_seconds: Dict[str, float] = {}
+        self.span_counts: Dict[str, int] = {}
+        self.span_orphans = 0
+        # per-step wall-clock samples (sec_per_iter / eps forwarded by the
+        # MetricsLogger sink) — the ledger's step_p50/p99 source
+        self._step_secs: List[float] = []
+        self._step_eps: List[float] = []
         # tag -> number of watermark samples; dev -> running max stats
         self.watermark_tags: Dict[str, int] = {}
         self.device_peak: Dict[str, Dict[str, Optional[int]]] = {}
@@ -310,11 +352,18 @@ class RunTelemetry:
     def event(self, kind: str, **fields) -> None:
         """Append one schema event (obs.schema). Thread-safe; buffered
         until the primary gate is committed (see class docstring)."""
+        elapsed = time.perf_counter() - self._t0
         rec = {
             "v": SCHEMA_VERSION,
             "run": self.run_id,
             "pid": _process_index(),
-            "t": round(time.perf_counter() - self._t0, 4),
+            "t": round(elapsed, 4),
+            # wall clock for external correlation; elapsed_s (monotonic)
+            # is the ordering/duration field — obs.report never computes a
+            # duration from ts, so a mid-run clock jump cannot corrupt
+            # stage timings (ISSUE 6 satellite)
+            "ts": round(time.time(), 3),
+            "elapsed_s": round(elapsed, 6),
             "kind": kind,
             **fields,
         }
@@ -370,10 +419,52 @@ class RunTelemetry:
     def metric_record(self, record: Dict[str, Any]) -> None:
         """MetricsLogger sink: per-step records land as `step` events,
         other records (sweep per-K lines) as `metric`. The logger's own
-        relative "t" is dropped — telemetry stamps run-relative time."""
-        fields = {k: v for k, v in record.items() if k != "t"}
+        relative "t" / wall "ts" are dropped — telemetry stamps its own.
+        Per-step timings (sec_per_iter, edges/sec) are additionally folded
+        into the run's step-time distribution — the perf ledger's
+        step_p50/p99 source (obs.ledger)."""
+        fields = {k: v for k, v in record.items() if k not in ("t", "ts")}
         kind = "step" if "iter" in fields else "metric"
+        if kind == "step":
+            sec = fields.get("sec_per_iter")
+            eps = fields.get("edges_per_sec_per_chip")
+            with self._lock:
+                if isinstance(sec, (int, float)):
+                    self._step_secs.append(float(sec))
+                if isinstance(eps, (int, float)):
+                    self._step_eps.append(float(eps))
         self.event(kind, **fields)
+
+    def span_complete(
+        self,
+        path: str,
+        seconds: float,
+        ok: bool = True,
+        emit: bool = True,
+        fields: Optional[Dict[str, Any]] = None,
+        orphans: int = 0,
+    ) -> None:
+        """obs.trace sink: fold one closed span into the per-path totals
+        and (emit=True) write its `span` event. Must stay cheap — the fit
+        loop closes several emit=False spans per iteration."""
+        with self._lock:
+            self.span_seconds[path] = (
+                self.span_seconds.get(path, 0.0) + seconds
+            )
+            self.span_counts[path] = self.span_counts.get(path, 0) + 1
+            if orphans:
+                self.span_orphans += orphans
+        if emit:
+            payload = dict(fields) if fields else {}
+            if not ok:
+                payload["ok"] = False
+            self.event(
+                "span",
+                name=path.rsplit("/", 1)[-1],
+                path=path,
+                seconds=round(seconds, 6),
+                **payload,
+            )
 
     def step_beat(self, it: int, llh: float) -> None:
         """Fit-loop heartbeat hook (run_fit_loop): progress only, no event
@@ -502,6 +593,16 @@ class RunTelemetry:
                     },
                     "counts": dict(self.stage_counts),
                 },
+                "spans": {
+                    "seconds": {
+                        k: round(v, 4)
+                        for k, v in self.span_seconds.items()
+                    },
+                    "counts": dict(self.span_counts),
+                    "orphans": self.span_orphans,
+                },
+                "steps_timed": len(self._step_secs),
+                "fingerprint": _fingerprint(),
                 "memory": {
                     "host_rss_bytes": current_rss_bytes(),
                     "host_rss_peak_bytes": peak_rss_bytes(),
@@ -568,6 +669,26 @@ class RunTelemetry:
             if self._fh is not None:
                 self._fh.close()
                 self._fh = None
+        # perf ledger (obs.ledger): with BIGCLAM_PERF_LEDGER set, every
+        # finished run appends its compact perf record — the trajectory
+        # `cli perf diff` gates against. Never allowed to break finalize.
+        try:
+            from bigclam_tpu.obs import ledger as _ledger
+
+            with self._lock:
+                step_secs = list(self._step_secs)
+                step_eps = list(self._step_eps)
+            _ledger.maybe_append_env(
+                rep, step_secs, step_eps, path=self.ledger_path
+            )
+        except Exception as e:
+            if not self.quiet:
+                print(
+                    f"[telemetry] warning: perf-ledger append failed "
+                    f"({type(e).__name__}: {e}) — run report is intact, "
+                    f"but `cli perf diff` will not see this run",
+                    file=sys.stderr,
+                )
         return rep
 
     # ------------------------------------------------------- context mgmt

@@ -1,0 +1,241 @@
+"""Hierarchical span tracing (ISSUE 6 tentpole, part a): WHERE the time went.
+
+PR 4's telemetry records what happened (events, watermarks, compile counts)
+but not where the time went — and the step-time pushes on the roadmap
+(store-native compute, fused Pallas edge kernel) cannot be claimed or
+defended without per-phase attribution ("Speeding Up BigClam",
+arXiv:1712.01209, got its wins precisely by knowing which phase dominated).
+A `span` is a named, nested wall-clock interval:
+
+    with span("fit_loop"):
+        with span("dispatch", emit=False):
+            ...
+
+Spans nest by a per-thread stack: a span's PATH is its parent's path plus
+its own name ("fit/fit_loop/dispatch" when the CLI's "fit" stage encloses
+the loop), so the same instrumentation yields stable, hierarchical
+attribution from every entry point. Two sinks, both on the installed
+RunTelemetry:
+
+* running per-path totals (seconds + counts) — always, one dict update
+  under the telemetry lock; these feed the run report's span table,
+  `cli report`'s breakdown, and the perf ledger (obs.ledger);
+* a `span` event in events.jsonl on close — only for `emit=True` spans.
+  High-frequency spans (the fit loop's per-iteration phases) use
+  `emit=False`: exact totals, no per-occurrence event, so a 10^5-iteration
+  fit does not write 4x10^5 event lines.
+
+COST CONTRACT (pinned by tests/test_trace.py): with telemetry off,
+`span()` returns one shared no-op object — no event, no dict, no stack
+touch (the off path is a current()-is-None check). With telemetry on and
+no profiler capture, the whole per-iteration span set costs <2% of the
+step time.
+
+XLA-PROFILE ALIGNMENT: when jax is already loaded (sys.modules probe —
+this module must stay importable on jax-free entry points like `cli
+ingest`), every span additionally opens a jax.profiler.TraceAnnotation
+with the span's path, so a captured device profile (`cli profile`,
+--profile-dir) carries OUR phase names on the TraceMe timeline. The shim
+resolves lazily and tolerates jax builds without the API.
+
+THREAD MODEL: the span stack is per-thread (plain dict keyed by thread id;
+list push/pop are GIL-atomic). `open_spans()` snapshots every thread's
+open stack — the stall heartbeat embeds it so a stall report answers
+"stuck in which phase" instead of only "no progress for Ns".
+
+CLOSE INVARIANTS: closes are exception-safe (the context manager records
+the interval with ok=False and still pops). A close that finds younger
+spans still open above it (a span entered and abandoned without exit)
+repairs the stack — the abandoned entries are dropped and counted in the
+telemetry's span_orphans counter, so misuse is visible, never corrupting.
+"""
+
+from __future__ import annotations
+
+import sys
+import threading
+import time
+from typing import Dict, List
+
+from bigclam_tpu.obs import telemetry as _telemetry
+
+# thread id -> stack of open span PATHS (innermost last). Mutations are
+# single-owner (each thread touches only its own list) and list append/pop
+# are atomic under the GIL; readers (heartbeat, tests) take snapshots.
+_STACKS: Dict[int, List[str]] = {}
+
+# jax.profiler TraceAnnotation / StepTraceAnnotation, resolved lazily and
+# only when jax is ALREADY imported (never triggers the import)
+_ANN = {"resolved": False, "cls": None, "step_cls": None}
+
+# profiler-capture refcount, flipped by utils.profiling.trace (every
+# capture in this repo goes through it: --profile-dir, `cli profile`).
+# emit=False spans only pay the TraceAnnotation construction while a
+# capture is live — that object is the dominant per-span cost, and the
+# no-capture overhead contract (<2% of step time) is what per-iteration
+# spans are held to. emit=True spans (stages, cycles — low frequency)
+# always annotate, so an externally-started capture still sees them.
+_CAPTURE = {"active": 0}
+
+
+def capture_started() -> None:
+    _CAPTURE["active"] += 1
+
+
+def capture_stopped() -> None:
+    _CAPTURE["active"] = max(_CAPTURE["active"] - 1, 0)
+
+
+def capture_active() -> bool:
+    return _CAPTURE["active"] > 0
+
+
+class _NullSpan:
+    """The telemetry-off span: one shared instance, no state, no work."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+    def set(self, **fields) -> None:
+        pass
+
+
+NULL_SPAN = _NullSpan()
+
+
+def _resolve_annotations():
+    if _ANN["resolved"]:
+        return
+    if "jax" not in sys.modules:
+        return                   # stay unresolved; maybe jax loads later
+    try:
+        from jax import profiler as _prof
+
+        _ANN["cls"] = getattr(_prof, "TraceAnnotation", None)
+        _ANN["step_cls"] = getattr(_prof, "StepTraceAnnotation", None)
+    except Exception:
+        _ANN["cls"] = _ANN["step_cls"] = None
+    _ANN["resolved"] = True
+
+
+def step_annotation(step_num: int, name: str = "train"):
+    """jax.profiler.StepTraceAnnotation for one profiled step (the profiler
+    UI groups TraceMes under step boundaries), or the no-op span when jax
+    is not loaded / the API is absent. `cli profile` wraps each timed step
+    in one of these so the XLA timeline and our span names align."""
+    _resolve_annotations()
+    cls = _ANN["step_cls"]
+    if cls is None:
+        return NULL_SPAN
+    try:
+        return cls(name, step_num=int(step_num))
+    except Exception:
+        return NULL_SPAN
+
+
+class Span:
+    """One open span (use via `span(...)`, not directly). Context-manager
+    only; `set(**fields)` attaches extra fields to the close event."""
+
+    __slots__ = ("_tel", "name", "emit", "fields", "path", "_t0", "_ann")
+
+    def __init__(self, tel, name: str, emit: bool, fields: dict):
+        self._tel = tel
+        self.name = name
+        self.emit = emit
+        self.fields = fields
+        self.path = name
+        self._t0 = 0.0
+        self._ann = None
+
+    def set(self, **fields) -> None:
+        self.fields.update(fields)
+
+    def __enter__(self) -> "Span":
+        tid = threading.get_ident()
+        stack = _STACKS.get(tid)
+        if stack is None:
+            stack = _STACKS.setdefault(tid, [])
+        self.path = f"{stack[-1]}/{self.name}" if stack else self.name
+        stack.append(self.path)
+        if self.emit or _CAPTURE["active"]:
+            _resolve_annotations()
+            cls = _ANN["cls"]
+            if cls is not None:
+                try:
+                    self._ann = cls(self.path)
+                    self._ann.__enter__()
+                except Exception:
+                    self._ann = None
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, et, ev, tb):
+        dt = time.perf_counter() - self._t0
+        if self._ann is not None:
+            try:
+                self._ann.__exit__(et, ev, tb)
+            except Exception:
+                pass
+        orphans = 0
+        stack = _STACKS.get(threading.get_ident())
+        if stack and stack[-1] == self.path:
+            stack.pop()
+        elif stack and self.path in stack:
+            # younger spans were entered and never exited: repair — drop
+            # them (counted), then pop ourselves
+            idx = len(stack) - 1 - stack[::-1].index(self.path)
+            orphans = len(stack) - idx - 1
+            del stack[idx:]
+        # else: our entry is already gone (an enclosing span repaired past
+        # us) — the interval is still real, record it without re-counting
+        self._tel.span_complete(
+            self.path, dt, ok=et is None, emit=self.emit,
+            fields=self.fields, orphans=orphans,
+        )
+        return False
+
+
+def span(name: str, emit: bool = True, **fields):
+    """Open a span named `name` under the installed telemetry.
+
+    Returns the shared no-op object when telemetry is off — the zero-cost
+    contract (no Span construction, no stack or dict touch). `emit=False`
+    keeps exact per-path totals but writes no per-occurrence event (for
+    per-iteration phases). Extra keyword `fields` ride the close event."""
+    tel = _telemetry.current()
+    if tel is None:
+        return NULL_SPAN
+    return Span(tel, name, emit, fields)
+
+
+def add_span(name: str, seconds: float, emit: bool = True, **fields) -> None:
+    """Record an already-measured interval as a span completion at the
+    current stack position (StageProfile.add_seconds' bridge: loops that
+    time themselves still land in the span taxonomy). No-op when off."""
+    tel = _telemetry.current()
+    if tel is None:
+        return
+    stack = _STACKS.get(threading.get_ident())
+    path = f"{stack[-1]}/{name}" if stack else name
+    tel.span_complete(path, seconds, ok=True, emit=emit, fields=fields)
+
+
+def open_spans() -> List[str]:
+    """Snapshot of every thread's open span paths, innermost last per
+    thread — what the stall heartbeat embeds in `stall` events."""
+    out: List[str] = []
+    for stack in list(_STACKS.values()):
+        out.extend(list(stack))
+    return out
+
+
+def current_path() -> str:
+    """The calling thread's innermost open span path ('' when none)."""
+    stack = _STACKS.get(threading.get_ident())
+    return stack[-1] if stack else ""

@@ -174,40 +174,47 @@ def ring_shard_edges(
     SPMD shapes; power-law skew shows up as padding, mitigated by the
     degree-bucketing planned in PARITY.md).
     """
-    shard_rows = n_pad // dp
-    src_shard = g.src // shard_rows
-    dst_shard = g.dst // shard_rows
-    phase = (dst_shard - src_shard) % dp
-    max_count = max(ring_bucket_imbalance(g, dp, n_pad)[0], 1)
-    _warn_bucket_imbalance(g, dp, max_count)
-    chunk = min(chunk_bound or cfg.edge_chunk, max_count)
-    c = -(-max_count // chunk)
-    padded = c * chunk
-    src = np.full((dp, dp, padded), shard_rows - 1, dtype=np.int32)
-    dst = np.zeros((dp, dp, padded), dtype=np.int32)
-    mask = np.zeros((dp, dp, padded), dtype=np.float32)
-    # stable bucket fill preserving CSR (src-sorted) order per bucket
-    order = np.lexsort((np.arange(g.src.size), phase, src_shard))
-    s_sorted = g.src[order]
-    d_sorted = g.dst[order]
-    ss = src_shard[order]
-    ph = phase[order]
-    # walk contiguous (shard, phase) runs
-    run_starts = np.flatnonzero(
-        np.r_[True, (ss[1:] != ss[:-1]) | (ph[1:] != ph[:-1])]
-    )
-    run_ends = np.r_[run_starts[1:], ss.size]
-    for lo, hi in zip(run_starts, run_ends):
-        i, r = int(ss[lo]), int(ph[lo])
-        m = hi - lo
-        src[i, r, :m] = s_sorted[lo:hi] - i * shard_rows
-        dst[i, r, :m] = d_sorted[lo:hi] - ((i + r) % dp) * shard_rows
-        mask[i, r, :m] = 1.0
-    return EdgeChunks(
-        src=src.reshape(dp, dp, c, chunk),
-        dst=dst.reshape(dp, dp, c, chunk),
-        mask=mask.reshape(dp, dp, c, chunk).astype(dtype),
-    )
+    from bigclam_tpu.obs import trace as _trace
+
+    # span (obs.trace): the host-side bucket build is a real model-build
+    # cost at pod shard counts — attribute it next to the ring's other
+    # phases instead of folding it into an opaque model_build stage
+    with _trace.span("ring/bucket_build", dp=dp) as _sp:
+        shard_rows = n_pad // dp
+        src_shard = g.src // shard_rows
+        dst_shard = g.dst // shard_rows
+        phase = (dst_shard - src_shard) % dp
+        max_count = max(ring_bucket_imbalance(g, dp, n_pad)[0], 1)
+        _warn_bucket_imbalance(g, dp, max_count)
+        chunk = min(chunk_bound or cfg.edge_chunk, max_count)
+        c = -(-max_count // chunk)
+        padded = c * chunk
+        _sp.set(max_bucket=int(max_count), padded_slots=int(padded * dp * dp))
+        src = np.full((dp, dp, padded), shard_rows - 1, dtype=np.int32)
+        dst = np.zeros((dp, dp, padded), dtype=np.int32)
+        mask = np.zeros((dp, dp, padded), dtype=np.float32)
+        # stable bucket fill preserving CSR (src-sorted) order per bucket
+        order = np.lexsort((np.arange(g.src.size), phase, src_shard))
+        s_sorted = g.src[order]
+        d_sorted = g.dst[order]
+        ss = src_shard[order]
+        ph = phase[order]
+        # walk contiguous (shard, phase) runs
+        run_starts = np.flatnonzero(
+            np.r_[True, (ss[1:] != ss[:-1]) | (ph[1:] != ph[:-1])]
+        )
+        run_ends = np.r_[run_starts[1:], ss.size]
+        for lo, hi in zip(run_starts, run_ends):
+            i, r = int(ss[lo]), int(ph[lo])
+            m = hi - lo
+            src[i, r, :m] = s_sorted[lo:hi] - i * shard_rows
+            dst[i, r, :m] = d_sorted[lo:hi] - ((i + r) % dp) * shard_rows
+            mask[i, r, :m] = 1.0
+        return EdgeChunks(
+            src=src.reshape(dp, dp, c, chunk),
+            dst=dst.reshape(dp, dp, c, chunk),
+            mask=mask.reshape(dp, dp, c, chunk).astype(dtype),
+        )
 
 
 def make_ring_train_step(
@@ -700,14 +707,19 @@ class RingBigClamModel(ShardedBigClamModel):
         balance=None,
     ):
         if balance is None:
+            from bigclam_tpu.obs import trace as _trace
+
             dp = mesh.shape[NODES_AXIS]
             # the pre-CSR n_pad: the CSR layout may round shard_rows up
             # further, but the imbalance statistic is a 4x-threshold
             # heuristic — the small padding shift cannot flip a
             # locality-ordered graph across it
             n_pad = _round_up(max(g.num_nodes, dp), dp)
-            mx, mean = ring_bucket_imbalance(g, dp, n_pad)
-            balance = dp > 1 and mx > RING_IMBALANCE_FACTOR * mean
+            with _trace.span("ring/auto_balance_probe", dp=dp) as _sp:
+                mx, mean = ring_bucket_imbalance(g, dp, n_pad)
+                balance = dp > 1 and mx > RING_IMBALANCE_FACTOR * mean
+                _sp.set(max_bucket=int(mx), mean_bucket=float(mean),
+                        engaged=bool(balance))
             if balance:
                 import os
                 import sys

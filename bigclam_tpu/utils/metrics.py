@@ -67,7 +67,14 @@ class MetricsLogger:
     def log(self, record: Dict[str, Any]) -> None:
         first = not self._gated
         self._gate()
-        record = {"t": round(time.perf_counter() - self._t0, 4), **record}
+        # "t" is MONOTONIC (perf_counter) and is what durations derive
+        # from; "ts" is the wall clock for correlating with external logs
+        # only — the same split the telemetry events carry (obs.schema v2)
+        record = {
+            "t": round(time.perf_counter() - self._t0, 4),
+            "ts": round(time.time(), 3),
+            **record,
+        }
         if first:
             record["load_s"] = self.load_s
         line = json.dumps(record)

@@ -12,14 +12,23 @@ from typing import Iterator, Optional
 @contextlib.contextmanager
 def trace(log_dir: Optional[str]) -> Iterator[None]:
     """Capture a jax.profiler trace into log_dir (tensorboard-viewable);
-    no-op when log_dir is None."""
+    no-op when log_dir is None. Flags the capture to the span tracer
+    (obs.trace) so per-iteration emit=False spans open TraceAnnotations
+    for the duration — the captured timeline then carries the span names
+    while the no-capture fast path stays annotation-free."""
     if log_dir is None:
         yield
         return
     import jax
 
+    from bigclam_tpu.obs import trace as _trace
+
     with jax.profiler.trace(log_dir):
-        yield
+        _trace.capture_started()
+        try:
+            yield
+        finally:
+            _trace.capture_stopped()
 
 
 @contextlib.contextmanager
@@ -65,18 +74,29 @@ class StageProfile:
     def stage(self, name: str) -> Iterator[None]:
         import time
 
-        t0 = time.perf_counter()
-        try:
-            yield
-        finally:
-            dt = time.perf_counter() - t0
-            self.seconds[name] = self.seconds.get(name, 0.0) + dt
-            _telemetry_stage(name, dt)
+        from bigclam_tpu.obs import trace as _trace
+
+        # every stage is ALSO a span (obs.trace): same name, nested under
+        # whatever span is open, so stage buckets and the hierarchical
+        # span taxonomy agree by construction (ISSUE 6 acceptance)
+        with _trace.span(name):
+            t0 = time.perf_counter()
+            try:
+                yield
+            finally:
+                dt = time.perf_counter() - t0
+                self.seconds[name] = self.seconds.get(name, 0.0) + dt
+                _telemetry_stage(name, dt)
 
     def add_seconds(self, name: str, s: float) -> None:
         """Accumulate into a stage bucket without the context manager
-        (for loops whose body already lives inside another `with`)."""
+        (for loops whose body already lives inside another `with`).
+        Bridges into the span taxonomy too (trace.add_span) so
+        self-timed stages still appear in the per-span breakdown."""
+        from bigclam_tpu.obs import trace as _trace
+
         self.seconds[name] = self.seconds.get(name, 0.0) + s
+        _trace.add_span(name, s)
         _telemetry_stage(name, s)
 
     def count(self, name: str, inc: int = 1) -> None:
@@ -227,19 +247,30 @@ def overlap_report(model, state, steps: int = 5, warmup: int = 1) -> dict:
     Returns {"sec_per_step": {"overlap": s, "serial": s},
              "comm_hidden_fraction": f}.
     """
+    from bigclam_tpu.obs import trace as _trace
+
     cfg0 = model.cfg
     times = {}
-    try:
-        for name, flag in (("overlap", True), ("serial", False)):
-            model.cfg = cfg0.replace(ring_overlap=flag)
+    # the probe IS the ring's wait-vs-compute measurement (rotation waits
+    # cannot be timed from inside the jitted scan): fold it into the span
+    # taxonomy — one parent span carrying the verdict fields, one child
+    # per schedule timing (ISSUE 6: overlap_report rides the span log)
+    with _trace.span("ring_overlap_probe") as probe:
+        try:
+            for name, flag in (("overlap", True), ("serial", False)):
+                with _trace.span(name):
+                    model.cfg = cfg0.replace(ring_overlap=flag)
+                    model.rebuild_step()
+                    times[name] = step_time(model._step, state, steps,
+                                            warmup)
+        finally:
+            model.cfg = cfg0
             model.rebuild_step()
-            times[name] = step_time(model._step, state, steps, warmup)
-    finally:
-        model.cfg = cfg0
-        model.rebuild_step()
-    return {
-        "sec_per_step": {k: round(v, 6) for k, v in times.items()},
-        "comm_hidden_fraction": comm_hidden_fraction(
-            times["overlap"], times["serial"]
-        ),
-    }
+        rep = {
+            "sec_per_step": {k: round(v, 6) for k, v in times.items()},
+            "comm_hidden_fraction": comm_hidden_fraction(
+                times["overlap"], times["serial"]
+            ),
+        }
+        probe.set(**rep)
+    return rep

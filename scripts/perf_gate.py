@@ -1,0 +1,168 @@
+"""Perf-ledger regression gate (ISSUE 6 satellite): prove the ledger +
+diff machinery end to end on CPU, fast enough for CI.
+
+Three runs of the SAME tiny fit config, all appending to one fresh ledger
+(BIGCLAM_PERF_LEDGER is set for the whole gate, so the records flow
+through the real RunTelemetry.finalize auto-append path):
+
+  A  baseline           — recorded; `cli perf diff` correctly refuses
+                          (no earlier matched record to compare against)
+  B  identical re-run   — `cli perf diff` matches it against A and PASSES
+                          within the noise bands (exit 0)
+  C  injected slowdown  — the existing fault-injection harness
+                          (resilience.faults) fires a `delay` at site
+                          "fit.step" on EVERY iteration, multiplying the
+                          per-step time by >> the noise band; `cli perf
+                          diff` flags the regression with a NONZERO exit
+
+plus record-schema validation and a baseline-matching check (a run with a
+different K must NOT match A/B). Emits one JSON artifact line
+(PERF_r10.json); exit 0 iff every check passes.
+
+    python scripts/perf_gate.py [out.json]
+"""
+
+import json
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def main() -> int:
+    out_path = sys.argv[1] if len(sys.argv) > 1 else None
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_enable_x64", True)
+
+    from bigclam_tpu.cli import main as cli_main
+    from bigclam_tpu.config import BigClamConfig
+    from bigclam_tpu.models import BigClamModel
+    from bigclam_tpu.models.agm import sample_planted_graph
+    from bigclam_tpu.obs import RunTelemetry, install, uninstall
+    from bigclam_tpu.obs import ledger as L
+    from bigclam_tpu.resilience import FaultPlan, install_plan
+    from bigclam_tpu.utils.metrics import MetricsLogger
+    from bigclam_tpu.utils.profiling import StageProfile
+
+    g, _ = sample_planted_graph(240, 4, p_in=0.3, rng=np.random.default_rng(0))
+    iters = 30
+    cfg = BigClamConfig(
+        num_communities=4, dtype="float64", max_iters=iters, conv_tol=0.0
+    )
+    F0 = np.random.default_rng(1).uniform(0.1, 1.0, size=(g.num_nodes, 4))
+
+    root = tempfile.mkdtemp(prefix="perf_gate_")
+    ledger_path = os.path.join(root, "ledger.jsonl")
+    os.environ["BIGCLAM_PERF_LEDGER"] = ledger_path
+    checks = {}
+
+    def one_run(tag, delay_s=None, k=4, f0=None):
+        tel = install(
+            RunTelemetry(
+                os.path.join(root, tag), entry="fit", quiet=True
+            )
+        )
+        try:
+            if delay_s is not None:
+                install_plan(
+                    FaultPlan(
+                        [
+                            {"kind": "delay", "site": "fit.step",
+                             "at": i, "seconds": delay_s}
+                            for i in range(iters + 1)
+                        ]
+                    )
+                )
+            prof = StageProfile()
+            c = cfg.replace(num_communities=k)
+            f = F0 if f0 is None else f0
+            with prof.stage("model_build"):
+                model = BigClamModel(g, c)
+            with prof.stage("fit"), MetricsLogger(None, echo=False) as ml:
+                res = model.fit(
+                    f,
+                    callback=ml.step_callback(
+                        g.num_directed_edges, num_nodes=g.num_nodes
+                    ),
+                )
+            tel.set_final({"llh": res.llh})
+            tel.finalize()
+        finally:
+            install_plan(None)
+            uninstall(tel)
+
+    def diff_rc():
+        try:
+            return cli_main(["perf", "diff", "--ledger", ledger_path])
+        except SystemExit as e:      # argparse never exits here, but safe
+            return int(e.code or 0)
+
+    # A: baseline — diff must refuse (nothing matched before it)
+    one_run("a")
+    checks["no_baseline_refused"] = diff_rc() == 1
+
+    # B: identical config — must PASS within noise bands
+    one_run("b")
+    rc_b = diff_rc()
+    checks["identical_rerun_passes"] = rc_b == 0
+
+    # record schema + baseline matching sanity
+    recs = L.PerfLedger(ledger_path).load()
+    checks["records_schema_valid"] = all(
+        L.validate_record(r) == [] for r in recs
+    )
+    checks["baseline_matched_pair"] = (
+        len(recs) == 2
+        and L.match_key(recs[0]) == L.match_key(recs[1])
+        and recs[0].get("step_p50") is not None
+    )
+
+    # different K must NOT match the A/B baseline chain
+    F3 = np.random.default_rng(2).uniform(0.1, 1.0, size=(g.num_nodes, 3))
+    one_run("k3", k=3, f0=F3)
+    checks["different_config_refused"] = diff_rc() == 1
+
+    # C: synthetic slowdown via the resilience delay site — the injected
+    # per-step delay is sized from the MEASURED baseline p50 so the gate
+    # is robust on any host: >= 4x p50 clears every noise band
+    base_p50 = recs[0].get("step_p50") or 0.005
+    delay = max(4.0 * base_p50, 0.02)
+    one_run("c", delay_s=delay)
+    rc_c = diff_rc()
+    checks["injected_slowdown_flagged_nonzero"] = rc_c == 2
+    recs = L.PerfLedger(ledger_path).load()
+    slow = recs[-1]
+    checks["slowdown_visible_in_record"] = (
+        slow.get("step_p50", 0) > (recs[0].get("step_p50") or 0) * 2
+    )
+
+    record = {
+        "gate": "perf-ledger",
+        "config": f"planted AGM N={g.num_nodes} K=4 "
+                  f"2E={g.num_directed_edges}, max_iters={iters}",
+        "ledger_records": len(recs),
+        "baseline_step_p50": recs[0].get("step_p50"),
+        "slowdown_step_p50": slow.get("step_p50"),
+        "injected_delay_s": round(delay, 4),
+        "diff_rc": {"no_baseline": 1, "identical": rc_b, "slow": rc_c},
+        "checks": checks,
+        "device": str(jax.devices()[0]),
+        "jax": jax.__version__,
+        "pass": all(checks.values()),
+    }
+    line = json.dumps(record)
+    print(line)
+    if out_path:
+        with open(out_path, "w") as f:
+            f.write(line + "\n")
+    return 0 if record["pass"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,389 @@
+"""Span-tracer tests (ISSUE 6, bigclam_tpu.obs.trace): nesting/path
+invariants (exception-safe close, orphan repair), the zero-cost-off and
+<2%-overhead-on pins, heartbeat span-stack embedding, fit-loop phase
+spans, profiler-capture gating, report merge ordering (numeric pids,
+stable elapsed_s event sort), and the bench cpu-fallback env propagation
+satellite."""
+
+import json
+import math
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from bigclam_tpu.config import BigClamConfig
+from bigclam_tpu.models import BigClamModel
+from bigclam_tpu.obs import (
+    RunTelemetry,
+    current,
+    install,
+    uninstall,
+    validate_events_file,
+)
+from bigclam_tpu.obs import trace
+from bigclam_tpu.obs.report import (
+    _event_order,
+    load_events,
+    load_reports,
+    render,
+    run_duration_s,
+    span_coverage,
+)
+from bigclam_tpu.obs.telemetry import EVENTS_NAME
+
+
+def _events(directory):
+    with open(os.path.join(directory, EVENTS_NAME)) as f:
+        return [json.loads(line) for line in f if line.strip()]
+
+
+def _problem(toy_graphs, k=2, max_iters=5):
+    g = toy_graphs["two_cliques"]
+    cfg = BigClamConfig(
+        num_communities=k, dtype="float64", max_iters=max_iters,
+        conv_tol=0.0,
+    )
+    F0 = np.random.default_rng(5).uniform(0.1, 1.0, size=(g.num_nodes, k))
+    return g, cfg, F0
+
+
+@pytest.fixture
+def telem(tmp_path):
+    tel = install(RunTelemetry(str(tmp_path / "telem"), entry="test"))
+    try:
+        yield tel
+    finally:
+        tel.finalize()
+        uninstall(tel)
+
+
+# ------------------------------------------------------------ invariants
+
+def test_span_off_is_shared_noop():
+    """Zero-cost contract: with telemetry off span() returns ONE shared
+    no-op object — no Span construction, no stack mutation, no event."""
+    assert current() is None
+    s = trace.span("anything", field=1)
+    assert s is trace.span("other") is trace.NULL_SPAN
+    with s:
+        assert trace.open_spans() == []
+    trace.add_span("x", 1.0)           # also a no-op off
+
+
+def test_span_nesting_paths_totals_and_events(telem):
+    with trace.span("outer"):
+        time.sleep(0.01)
+        with trace.span("inner", tag="a"):
+            time.sleep(0.01)
+            assert trace.current_path() == "outer/inner"
+            assert trace.open_spans() == ["outer", "outer/inner"]
+    assert trace.open_spans() == []
+    assert set(telem.span_seconds) == {"outer", "outer/inner"}
+    assert telem.span_seconds["outer"] >= telem.span_seconds["outer/inner"]
+    assert telem.span_counts == {"outer": 1, "outer/inner": 1}
+    spans = [e for e in telem.report()["events"].items() if e[0] == "span"]
+    assert spans and spans[0][1] == 2
+    telem.finalize()
+    events = [e for e in _events(telem.directory) if e["kind"] == "span"]
+    inner = next(e for e in events if e["path"] == "outer/inner")
+    assert inner["name"] == "inner" and inner["tag"] == "a"
+    assert inner["seconds"] >= 0.01
+    n, errors = validate_events_file(
+        os.path.join(telem.directory, EVENTS_NAME)
+    )
+    assert errors == [], errors
+
+
+def test_span_exception_safe_close(telem):
+    """A raise inside nested spans closes BOTH (stack empty afterwards),
+    records their intervals, and marks the events ok=False."""
+    with pytest.raises(RuntimeError):
+        with trace.span("outer"):
+            with trace.span("inner"):
+                raise RuntimeError("boom")
+    assert trace.open_spans() == []
+    assert set(telem.span_seconds) == {"outer", "outer/inner"}
+    assert telem.span_orphans == 0
+    telem.finalize()
+    events = [e for e in _events(telem.directory) if e["kind"] == "span"]
+    assert all(e.get("ok") is False for e in events)
+
+
+def test_span_orphan_close_repaired_and_flagged(telem, tmp_path):
+    """A span entered and abandoned (no exit) must not corrupt the stack:
+    the enclosing close repairs it, the orphan is counted, and `cli
+    report` flags it as a problem."""
+    with trace.span("outer"):
+        trace.span("abandoned").__enter__()     # never exited
+    assert trace.open_spans() == []             # repaired
+    assert telem.span_orphans == 1
+    assert "outer" in telem.span_seconds
+    rep = telem.finalize()
+    assert rep["spans"]["orphans"] == 1
+    text, errors = render(telem.directory)
+    assert errors >= 1 and "SPAN ORPHANS" in text
+
+
+def test_add_span_lands_at_current_stack_position(telem):
+    with trace.span("parent"):
+        trace.add_span("timed", 1.25, emit=False)
+    assert telem.span_seconds["parent/timed"] == 1.25
+
+
+def test_span_thread_stacks_are_independent(telem):
+    seen = {}
+
+    def worker():
+        with trace.span("worker_phase"):
+            seen["path"] = trace.current_path()
+            seen["open"] = sorted(trace.open_spans())
+            time.sleep(0.02)
+
+    with trace.span("main_phase"):
+        t = threading.Thread(target=worker)
+        t.start()
+        t.join()
+    assert seen["path"] == "worker_phase"    # no cross-thread nesting
+    assert "main_phase" in seen["open"] and "worker_phase" in seen["open"]
+
+
+# ------------------------------------------------------- stage/loop wiring
+
+def test_stage_opens_matching_span(telem):
+    from bigclam_tpu.utils.profiling import StageProfile
+
+    prof = StageProfile()
+    with prof.stage("outer_stage"):
+        with prof.stage("inner_stage"):
+            time.sleep(0.005)
+    prof.add_seconds("self_timed", 0.5)
+    assert "outer_stage" in telem.span_seconds
+    assert "outer_stage/inner_stage" in telem.span_seconds
+    assert telem.span_seconds["self_timed"] == 0.5
+    # stage buckets unchanged (flat, not a tree)
+    assert set(prof.seconds) == {"outer_stage", "inner_stage", "self_timed"}
+
+
+def test_fit_loop_phase_spans(toy_graphs, telem, tmp_path):
+    """Every iteration contributes to the fit_loop phase spans; checkpoint
+    saves get their own emitted span; totals land in the report."""
+    from bigclam_tpu.utils.checkpoint import CheckpointManager
+
+    g, cfg, F0 = _problem(toy_graphs, max_iters=6)
+    cfg = cfg.replace(checkpoint_every=2)
+    model = BigClamModel(g, cfg)
+    model.fit(F0, checkpoints=CheckpointManager(str(tmp_path / "ck")))
+    spans = telem.span_seconds
+    for phase in ("fit_loop/dispatch", "fit_loop/sync",
+                  "fit_loop/extract_F"):
+        assert phase in spans, spans
+    # one dispatch/sync per iteration (max_iters+1 loop entries)
+    assert telem.span_counts["fit_loop/dispatch"] == cfg.max_iters + 1
+    assert telem.span_counts["fit_loop/dispatch"] == telem.span_counts[
+        "fit_loop/sync"
+    ]
+    assert telem.span_counts["fit_loop/checkpoint"] >= 2
+    telem.finalize()
+    ck_events = [
+        e for e in _events(telem.directory)
+        if e["kind"] == "span" and e["path"] == "fit_loop/checkpoint"
+    ]
+    assert ck_events and all("it" in e for e in ck_events)
+    n, errors = validate_events_file(
+        os.path.join(telem.directory, EVENTS_NAME)
+    )
+    assert errors == [], errors
+
+
+def test_overlap_report_folds_into_spans(toy_graphs, telem):
+    """overlap_report (the ring wait-vs-compute probe) records one parent
+    span carrying the verdict fields plus a child span per schedule."""
+    from bigclam_tpu.utils.profiling import overlap_report
+
+    g, cfg, F0 = _problem(toy_graphs, max_iters=3)
+    model = BigClamModel(g, cfg)
+    rep = overlap_report(model, model.init_state(F0), steps=2, warmup=1)
+    assert set(rep["sec_per_step"]) == {"overlap", "serial"}
+    spans = telem.span_seconds
+    assert "ring_overlap_probe" in spans
+    assert "ring_overlap_probe/overlap" in spans
+    assert "ring_overlap_probe/serial" in spans
+    telem.finalize()
+    probe = next(
+        e for e in _events(telem.directory)
+        if e["kind"] == "span" and e["path"] == "ring_overlap_probe"
+    )
+    assert "comm_hidden_fraction" in probe and "sec_per_step" in probe
+
+
+def test_heartbeat_stall_reports_open_span_stack(tmp_path):
+    """Satellite: a stall emitted while a span is open answers 'stuck in
+    which phase' — the stall event carries the open span stack."""
+    tel = install(
+        RunTelemetry(str(tmp_path / "t"), entry="test", heartbeat_s=0.08,
+                     quiet=True)
+    )
+    try:
+        with trace.span("fit"):
+            with trace.span("wedged_collective", emit=False):
+                time.sleep(0.5)
+    finally:
+        tel.finalize()
+        uninstall(tel)
+    stalls = [e for e in _events(tel.directory) if e["kind"] == "stall"]
+    assert stalls, "no stall fired"
+    assert stalls[0]["spans"] == ["fit", "fit/wedged_collective"]
+    n, errors = validate_events_file(
+        os.path.join(tel.directory, EVENTS_NAME)
+    )
+    assert errors == [], errors
+
+
+# ------------------------------------------------------------------ cost
+
+def test_tracing_overhead_under_2pct_with_spans_on(tmp_path):
+    """Acceptance pin: the fit loop's per-iteration span set (3 emit=False
+    spans), telemetry ON, NO profiler capture, costs <2% of the step time
+    of a small-but-real model. (The 16-node toy step sits below the jit
+    dispatch floor — per-span cost is fixed ~2us, so the fraction only
+    shrinks on real configs.)"""
+    from bigclam_tpu.models.agm import sample_planted_graph
+    from bigclam_tpu.utils.profiling import step_time
+
+    g, _ = sample_planted_graph(
+        240, 4, p_in=0.3, rng=np.random.default_rng(0)
+    )
+    cfg = BigClamConfig(
+        num_communities=4, dtype="float64", max_iters=5, conv_tol=0.0
+    )
+    F0 = np.random.default_rng(1).uniform(0.1, 1.0, size=(g.num_nodes, 4))
+    model = BigClamModel(g, cfg)
+    sec_per_step = step_time(
+        model._step, model.init_state(F0), steps=15, warmup=2
+    )
+
+    tel = install(RunTelemetry(str(tmp_path / "t"), entry="t", quiet=True))
+    try:
+        assert not trace.capture_active()
+        iters = 20000
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            with trace.span("fit_loop/dispatch", emit=False):
+                pass
+            with trace.span("fit_loop/sync", emit=False):
+                pass
+            with trace.span("fit_loop/callback", emit=False):
+                pass
+        per_iter = (time.perf_counter() - t0) / iters
+    finally:
+        tel.finalize()
+        uninstall(tel)
+    assert per_iter < 0.02 * sec_per_step, (
+        f"span overhead {per_iter:.3e}s/iter vs step {sec_per_step:.3e}s "
+        f"({100 * per_iter / sec_per_step:.2f}%)"
+    )
+    # and no per-iteration event lines were written (emit=False)
+    events = _events(str(tmp_path / "t"))
+    assert not [e for e in events if e["kind"] == "span"]
+
+
+def test_emit_false_spans_skip_annotations_outside_capture(telem):
+    """emit=False spans must not construct TraceAnnotations unless a
+    profiler capture is live (utils.profiling.trace flips the flag)."""
+    with trace.span("hot", emit=False) as sp:
+        assert sp._ann is None
+    trace.capture_started()
+    try:
+        with trace.span("hot", emit=False) as sp:
+            captured_ann = sp._ann
+    finally:
+        trace.capture_stopped()
+    # under capture the annotation engages (when jax.profiler has the API)
+    if trace._ANN["cls"] is not None:
+        assert captured_ann is not None
+    assert not trace.capture_active()
+
+
+# ------------------------------------------- report ordering (satellite)
+
+def test_load_reports_numeric_pid_order(tmp_path):
+    """run_report.p10 must sort AFTER p2 (lexical sort scrambled >= 10
+    processes)."""
+    for name, pid in (
+        ("run_report.json", 0),
+        ("run_report.p1.json", 1),
+        ("run_report.p2.json", 2),
+        ("run_report.p10.json", 10),
+    ):
+        (tmp_path / name).write_text(json.dumps({"pid": pid}))
+    reports = load_reports(str(tmp_path))
+    assert [r["pid"] for r in reports] == [0, 1, 2, 10]
+
+
+def test_load_events_stable_merge_on_interleaved_and_equal_times(tmp_path):
+    """Events are ordered by MONOTONIC elapsed_s; equal timestamps keep
+    file order (stable) — the heartbeat-thread interleave contract."""
+    base = {"v": 2, "run": "r", "pid": 0, "ts": 1.0}
+    lines = [
+        {**base, "t": 0.3, "elapsed_s": 0.3, "kind": "note", "i": 2},
+        {**base, "t": 0.1, "elapsed_s": 0.1, "kind": "note", "i": 0},
+        {**base, "t": 0.2, "elapsed_s": 0.2, "kind": "note", "i": 1},
+        # equal elapsed_s: file order must be preserved
+        {**base, "t": 0.2, "elapsed_s": 0.2, "kind": "note", "i": 1.5},
+    ]
+    with open(tmp_path / EVENTS_NAME, "w") as f:
+        for rec in lines:
+            f.write(json.dumps(rec) + "\n")
+    events = load_events(str(tmp_path))
+    assert [e["i"] for e in events] == [0, 1, 1.5, 2]
+    # ordering key is monotonic time, never the wall clock
+    assert _event_order({"elapsed_s": 5.0, "ts": 1.0, "t": 2.0}) == 5.0
+
+
+def test_run_duration_ignores_wall_clock_jumps():
+    """Satellite: durations derive from elapsed_s — a wall-clock jump
+    (NTP step) between events cannot corrupt the figure."""
+    events = [
+        {"elapsed_s": 0.0, "ts": 1000.0, "kind": "start"},
+        {"elapsed_s": 2.5, "ts": 5000000.0, "kind": "end"},  # ts jumped
+    ]
+    assert run_duration_s(events) == 2.5
+    assert run_duration_s([{"kind": "x"}]) is None
+
+
+def test_span_coverage_top_level_only():
+    rep = {
+        "wall_s": 10.0,
+        "spans": {"seconds": {"a": 6.0, "b": 3.5, "a/child": 5.9}},
+    }
+    assert math.isclose(span_coverage(rep), 0.95)
+    assert span_coverage({"wall_s": 0, "spans": {"seconds": {}}}) is None
+
+
+# ------------------------------------------------- bench env (satellite)
+
+def test_bench_cpu_fallback_env_propagates_observability():
+    """Satellite: the cpu-fallback re-exec must carry the telemetry dir,
+    perf ledger, and fault-plan env through to the child — dropping any
+    would silently strip the fallback run's observability."""
+    import bench
+
+    parent = {
+        "BIGCLAM_TELEMETRY_DIR": "/tmp/t",
+        "BIGCLAM_PERF_LEDGER": "/tmp/ledger.jsonl",
+        "BIGCLAM_FAULTS": '{"faults": []}',
+        "XLA_FLAGS": "--xla_foo=1",
+        "PATH": "/usr/bin",
+    }
+    env = bench._fallback_child_env(parent)
+    for key in bench.PROPAGATED_ENV:
+        assert env[key] == parent[key], key
+    assert env["PATH"] == "/usr/bin"
+    assert env["JAX_PLATFORMS"] == "cpu"
+    assert env[bench.FALLBACK_ENV] == "1"
+    assert "--xla_foo=1" in env["XLA_FLAGS"]
+    assert "--xla_force_host_platform_device_count=8" in env["XLA_FLAGS"]
+    assert "JAX_PLATFORMS" not in parent      # input not mutated
