@@ -137,6 +137,34 @@ def roofline_model(k: int) -> dict:
     }
 
 
+def roofline_model_sparse(m: int) -> dict:
+    """Sparse-representation cost model (ISSUE 7 satellite): bytes and
+    FLOPs per directed edge scale with the top-M slot count, NOT K —
+    quoting the dense model against a sparse run would overstate
+    hbm_frac by K/M.
+
+    bytes: a sparse row is M (int32 id, f32 weight) pairs = 8*M B; every
+    sweep streams both endpoint rows (2*M*8), the grad sweep scatters one
+    M-slot row back (M*4). flops: the merge lookup is a vmapped binary
+    search (~M*log2(M) comparisons, counted as M*ceil(log2 M) flops)
+    plus the M-length dot (2M) per visit; candidate construction
+    clip(w + eta*g) adds 2M on the 16 candidate sweeps — the same form
+    as the dense model with K -> M plus the search term.
+    """
+    import math
+
+    logm = max(int(math.ceil(math.log2(max(m, 2)))), 1)
+    bytes_iter = SWEEPS_PER_ITER * (2 * m * 8) + m * 4
+    flops_iter = SWEEPS_PER_ITER * (2 * m + m * logm) + 16 * (2 * m)
+    return {
+        "bytes_per_edge_iter": bytes_iter,
+        "flops_per_edge_iter": flops_iter,
+        "sweeps_per_iter": SWEEPS_PER_ITER,
+        "representation": "sparse",
+        "sparse_m": m,
+    }
+
+
 def device_peaks(device_kind: str):
     """(hbm_gbs, bf16_tflops) for a device kind, or (None, None) when the
     chip is not in the table (CPU fallback, future TPUs)."""
@@ -147,11 +175,15 @@ def device_peaks(device_kind: str):
     return None, None
 
 
-def roofline_position(eps: float, k: int, device_kind: str) -> dict:
+def roofline_position(
+    eps: float, k: int, device_kind: str, sparse_m: int = 0
+) -> dict:
     """The artifact's roofline record for one config: the cost model, the
     achieved HBM-bandwidth fraction (`hbm_frac`) and MXU utilization
-    (`mfu`), or None fractions off the peaks table."""
-    model = roofline_model(k)
+    (`mfu`), or None fractions off the peaks table. sparse_m > 0 selects
+    the sparse cost model (bytes/FLOPs per edge ∝ M, not K) so hbm_frac
+    stays honest on the sparse path."""
+    model = roofline_model_sparse(sparse_m) if sparse_m else roofline_model(k)
     hbm_gbs, tflops = device_peaks(device_kind)
     achieved_gbs = eps * model["bytes_per_edge_iter"] / 1e9
     achieved_tflops = eps * model["flops_per_edge_iter"] / 1e12
@@ -372,6 +404,7 @@ def _main(backend, cpu_fallback) -> None:
     if cpu_fallback:
         configs["large"] = {"skipped": "cpu-fallback (reduced run)"}
         configs["xl_k"] = {"skipped": "cpu-fallback (reduced run)"}
+        configs["sparse"] = {"skipped": "cpu-fallback (reduced run)"}
         _ring_overlap_config(configs, jax, BigClamConfig,
                              sample_planted_graph)
         _emit(jax, spec, g, cfg, F0, backend, model, configs,
@@ -452,6 +485,41 @@ def _main(backend, cpu_fallback) -> None:
     except Exception as e:           # noqa: BLE001 — recorded, not silent
         configs["xl_k"] = {"error": f"{type(e).__name__}: {e}"}
 
+    # --- sparse top-M representation at the large-K config (ISSUE 7) ---
+    # same graph + K as "large", affiliation state in top-M member lists:
+    # the eps ratio against large's XLA run shows what M-not-K bytes/edge
+    # buys, and the roofline uses the SPARSE cost model so hbm_frac is
+    # quoted against the bytes the path actually moves
+    try:
+        from bigclam_tpu.models import SparseBigClamModel
+
+        sparse_m = 64
+        cfg_s = cfg_l.replace(
+            representation="sparse", sparse_m=sparse_m,
+            use_pallas_csr=False, use_pallas=False,
+        )
+        model_s = SparseBigClamModel(gl, cfg_s)
+        sparse_eps, sparse_windows, _ = time_windows(
+            model_s, Fl, 2, LARGE_ITERS_PER_WINDOW, warmup=1
+        )
+        configs["sparse"] = {
+            "config": f"AGM planted N={gl.num_nodes} "
+                      f"2E={gl.num_directed_edges} K={LARGE_K} "
+                      f"M={model_s.m} (sparse top-M)",
+            "representation": "sparse",
+            "sparse_m": model_s.m,
+            "sparse": {"eps": sparse_eps, "path": model_s.engaged_path,
+                       "windows": sparse_windows},
+            "sparse_over_xla": round(sparse_eps / large_xla_eps, 2),
+            "affiliation_state_bytes": model_s.state_nbytes(),
+            "affiliation_state_bytes_dense": gl.num_nodes * LARGE_K * 4,
+            "roofline": roofline_position(
+                sparse_eps, LARGE_K, kind, sparse_m=model_s.m
+            ),
+        }
+    except Exception as e:           # noqa: BLE001 — recorded, not silent
+        configs["sparse"] = {"error": f"{type(e).__name__}: {e}"}
+
     _ring_overlap_config(configs, jax, BigClamConfig, sample_planted_graph)
     _emit(jax, spec, g, cfg, F0, backend, model, configs, enron_eps,
           llh_last)
@@ -529,6 +597,10 @@ def _emit(jax, spec, g, cfg, F0, backend, model, configs, enron_eps,
         "unit": "edges/sec/chip",
         "vs_baseline": round(enron_eps / base_eps, 2),
         "path": model.engaged_path,
+        # headline runs the dense reference representation; the sparse
+        # top-M measurement lives in configs["sparse"] with its own
+        # bytes/edge model
+        "representation": "dense",
         "backend": backend,
         "config": configs["enron"]["config"],
         "graph_source": configs["enron"].get("graph_source"),
@@ -565,6 +637,7 @@ def _emit(jax, spec, g, cfg, F0, backend, model, configs, enron_eps,
                 # against each other)
                 "n": g.num_nodes,
                 "edges": g.num_directed_edges // 2,
+                "representation": record["representation"],
                 # the ledger's roofline fields (obs.ledger): hbm_frac is
                 # the denominator "is it actually fast" gates against
                 "hbm_frac": roof.get("hbm_frac"),

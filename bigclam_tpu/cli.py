@@ -78,6 +78,26 @@ def _add_common(p: argparse.ArgumentParser) -> None:
              "when the layout fits; on: require, error if unsupported)",
     )
     p.add_argument(
+        "--representation", default="dense", choices=["dense", "sparse"],
+        help="affiliation-state representation: dense (N, K) F (the "
+             "reference semantics, default) or sparse per-node top-M "
+             "member lists (ops/sparse_members.py) — HBM and bytes/edge "
+             "scale with --sparse-m instead of K, turning K into a "
+             "capacity knob. Dense stays the default until the TPU "
+             "artifact lands",
+    )
+    p.add_argument(
+        "--sparse-m", type=int, default=64,
+        help="member slots per node on --representation sparse (M; "
+             "clamped to K — M >= K reproduces the dense trajectory)",
+    )
+    p.add_argument(
+        "--support-every", type=int, default=1,
+        help="iterations between sparse support updates (candidate-"
+             "community admission from neighbor lists; 1 = every step, "
+             "required for dense parity)",
+    )
+    p.add_argument(
         "--seeding-degree-cap", type=int, default=None,
         help="sample at most this many neighbors per node in conductance "
              "seeding (exact pass is edge-quadratic on hubs; exact when "
@@ -270,18 +290,38 @@ def _build(args, k: int):
             args.csr_kernels
         ],
         seeding_degree_cap=args.seeding_degree_cap,
+        representation=getattr(args, "representation", "dense"),
+        sparse_m=getattr(args, "sparse_m", 64),
+        support_every=getattr(args, "support_every", 1),
     )
     g = _load_graph(args)
     return g, cfg
 
 
 def _make_model(g, cfg, args):
+    if cfg.representation == "sparse" and getattr(args, "quality", False):
+        # quality mode's annealing drives dense reset_state(F) cycles —
+        # not refactored onto slot arrays yet
+        raise SystemExit(
+            "error: --quality is not supported with --representation "
+            "sparse yet (the annealing schedule is dense-state-resident)"
+        )
+    if cfg.representation == "sparse" and cfg.use_pallas_csr:
+        # "on" means require — the sparse trainers only have the XLA
+        # member-list merge (MXU kernel is an open ROADMAP item), so
+        # honoring the contract means refusing, not silently falling back
+        raise SystemExit(
+            "error: --csr-kernels on is not supported with "
+            "--representation sparse yet (member-list kernels run the "
+            "XLA searchsorted path; use --csr-kernels auto)"
+        )
     if args.mesh or args.distributed:
         import jax
 
         from bigclam_tpu.parallel import (
             RingBigClamModel,
             ShardedBigClamModel,
+            SparseShardedBigClamModel,
             make_mesh,
             make_multihost_mesh,
         )
@@ -304,8 +344,22 @@ def _make_model(g, cfg, args):
         else:
             dp, tp = (int(x) for x in args.mesh.split(","))
             mesh = make_mesh((dp, tp), jax.devices()[: dp * tp])
+        if cfg.representation == "sparse":
+            if args.schedule == "ring":
+                raise SystemExit(
+                    "error: --schedule ring is not supported with "
+                    "--representation sparse yet (the sparse exchange is "
+                    "an M-column all_gather + sparse allreduce)"
+                )
+            return SparseShardedBigClamModel(
+                g, cfg, mesh, balance=args.balance
+            )
         cls = RingBigClamModel if args.schedule == "ring" else ShardedBigClamModel
         return cls(g, cfg, mesh, balance=args.balance)
+    if cfg.representation == "sparse":
+        from bigclam_tpu.models import SparseBigClamModel
+
+        return SparseBigClamModel(g, cfg)
     from bigclam_tpu.models import BigClamModel
 
     return BigClamModel(g, cfg, k_multiple=128 if cfg.dtype == "float32" else 1)
@@ -447,7 +501,16 @@ def _cmd_fit(args, tel=None) -> int:
         "n": g.num_nodes,
         "edges": g.num_edges,
         "k": cfg.num_communities,
+        # representation identity: the perf ledger refuses to baseline a
+        # sparse run against a dense one (obs.ledger.match_key), and the
+        # bench/ledger rows must say which bytes/edge model applies
+        "representation": cfg.representation,
     }
+    if cfg.representation == "sparse":
+        out["sparse_m"] = getattr(model, "m", cfg.sparse_m)
+        if hasattr(model, "comm_mode"):
+            out["sparse_comm"] = model.comm_mode
+            out["sparse_comm_cap"] = model.comm_cap
     if qres is not None:
         out["quality_cycles"] = qres.num_cycles
         out["quality_total_iters"] = qres.total_iters
@@ -503,7 +566,7 @@ def _cmd_sweep(args, tel=None) -> int:
 
     factory = (
         (lambda c: _make_model(g, c, args))
-        if (args.mesh or args.distributed)
+        if (args.mesh or args.distributed or cfg.representation == "sparse")
         else None
     )
     with MetricsLogger(args.metrics, echo=not args.quiet) as ml:
@@ -547,6 +610,7 @@ def _cmd_sweep(args, tel=None) -> int:
         # re-runs), so it must not ride the match key — k stays unset
         "n": g.num_nodes,
         "edges": g.num_directed_edges // 2,
+        "representation": cfg.representation,
     }
     if tel is not None:
         tel.set_final(out)
@@ -701,6 +765,7 @@ def _cmd_profile(args, tel=None) -> int:
         "n": g.num_nodes,
         "edges": g.num_edges,
         "k": cfg.num_communities,
+        "representation": cfg.representation,
     }
     if tel is not None:
         tel.set_final(out)

@@ -187,6 +187,59 @@ class BigClamConfig:
                                         # rollback path drives it via
                                         # rebuild_step — not a user knob
 
+    # --- sparse membership representation (ops/sparse_members.py;
+    # DESIGN.md "Sparse membership representation") ---
+    representation: str = "dense"       # "dense" = (N, K) F everywhere (the
+                                        # reference semantics, default until
+                                        # the TPU artifact lands); "sparse" =
+                                        # per-node top-M member lists
+                                        # (member ids + weights) — HBM and
+                                        # bytes/edge scale with M, not K,
+                                        # turning K into a capacity knob.
+                                        # Step-baked: two runs differing here
+                                        # can never share a compiled step or
+                                        # a perf-ledger baseline
+    sparse_m: int = 64                  # M: member slots per node (clamped
+                                        # to K; M >= K reproduces the dense
+                                        # trajectory, M < K is the capacity-
+                                        # bounded approximation the LLH-band
+                                        # gates cover)
+    support_every: int = 1              # iterations between support updates
+                                        # (admit candidate communities from
+                                        # neighbor member lists, keep top-M
+                                        # by weight). 1 = admit every step —
+                                        # required for dense parity; larger
+                                        # values amortize the admission
+                                        # scatter on huge graphs
+    sparse_score_block: int = 1 << 22   # support-update scratch budget in
+                                        # ELEMENTS: the sort-based
+                                        # admission pass works on the
+                                        # candidate entries of one node
+                                        # block (~block_b*(1+deg)*M of
+                                        # them) — block size is picked to
+                                        # keep that near this budget. No
+                                        # K-sized axis anywhere: the
+                                        # support pass stays flat in K
+    sparse_comm_cap: int = 0            # sparse-allreduce buffer capacity
+                                        # (touched community ids exchanged
+                                        # per shard). 0 = auto: sized from
+                                        # the initial state's per-shard
+                                        # touched counts x
+                                        # sparse_cap_slack at init_state
+    sparse_cap_slack: float = 2.0       # auto-cap headroom over the initial
+                                        # per-shard touched-id count (the
+                                        # support only grows by neighbor
+                                        # admission, so 2x covers the
+                                        # planted/power-law workloads;
+                                        # runtime overflow falls back to a
+                                        # dense psum for that step)
+    sparse_dense_fallback: float = 0.5  # density threshold: when the
+                                        # exchange cap exceeds this fraction
+                                        # of K, the sparse allreduce would
+                                        # move more bytes than the dense
+                                        # psum — the trainer statically
+                                        # keeps psum(sumF) and records why
+
     # --- numerics ---
     dtype: str = "float32"              # F / gradient dtype on device
     accum_dtype: Optional[str] = None   # LLH accumulation dtype; None = dtype

@@ -10,9 +10,11 @@ from bigclam_tpu.models.quality import (
     fit_quality,
     fit_quality_device,
 )
+from bigclam_tpu.models.sparse import SparseBigClamModel
 
 __all__ = [
     "BigClamModel",
+    "SparseBigClamModel",
     "TrainState",
     "FitResult",
     "prepare_graph",

@@ -251,6 +251,17 @@ def _round_up(x: int, m: int) -> int:
     return ((x + m - 1) // m) * m
 
 
+def random_init_F(g, cfg: BigClamConfig, seed: Optional[int] = None) -> np.ndarray:
+    """Bernoulli(0.5) {0,1} init, the reference's random-row distribution
+    (Bigclamv2.scala:62) — the one implementation every trainer
+    (dense, sparse, sharded) delegates to so the distribution can never
+    diverge between representations."""
+    rng = np.random.default_rng(cfg.seed if seed is None else seed)
+    return rng.integers(
+        0, 2, size=(g.num_nodes, cfg.num_communities)
+    ).astype(np.float64)
+
+
 def _lcm(a: int, b: int) -> int:
     import math
 
@@ -1223,7 +1234,4 @@ class BigClamModel:
     def random_init(self, seed: Optional[int] = None) -> np.ndarray:
         """Bernoulli(0.5) {0,1} init, the reference's random-row distribution
         (Bigclamv2.scala:62). Conductance-seeded init lives in ops.seeding."""
-        rng = np.random.default_rng(self.cfg.seed if seed is None else seed)
-        return rng.integers(
-            0, 2, size=(self.g.num_nodes, self.cfg.num_communities)
-        ).astype(np.float64)
+        return random_init_F(self.g, self.cfg, seed)

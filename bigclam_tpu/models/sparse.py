@@ -1,0 +1,378 @@
+"""Single-chip BigCLAM trainer on the sparse top-M membership
+representation (ops.sparse_members; DESIGN.md "Sparse membership
+representation").
+
+Mirrors models.bigclam.BigClamModel's surface — init_state / fit /
+fit_state / rebuild_step / checkpointing — over the two-array sparse
+state (member ids + weights). The shared fit loop (run_fit_loop),
+buffer donation, non-finite rollback snapshots, and the fault-injection
+sites all work unchanged: SparseTrainState names its weight array `F`
+and is a flat NamedTuple the donation/snapshot tree-maps recycle like
+any other state.
+
+One outer iteration:
+
+    [support update every cfg.support_every iters: admit candidate
+     communities from neighbor lists, keep top-M]
+    -> sparse grad/LLH pass -> 16-candidate Armijo pass (member lookup
+       shared) -> masked Jacobi update -> sparse sumF scatter
+
+all inside one jitted step; the support update rides a lax.cond keyed
+on the iteration counter so the host loop stays oblivious.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from bigclam_tpu.config import BigClamConfig
+from bigclam_tpu.graph.csr import Graph
+from bigclam_tpu.models.bigclam import (
+    FitResult,
+    _round_up,
+    _ScaleRebuilder,
+    finalize_step,
+    log_engaged_path,
+    prepare_graph,
+    random_init_F,
+    run_fit_loop,
+    step_cfg_key,
+)
+from bigclam_tpu.ops import sparse_members as sm
+from bigclam_tpu.ops.sparse_members import SparseTrainState
+
+
+def effective_m(cfg: BigClamConfig) -> int:
+    """The per-node slot count actually allocated: cfg.sparse_m clamped
+    to K (more slots than communities cannot hold anything; M >= K is
+    exactly the dense-parity regime)."""
+    return max(1, min(int(cfg.sparse_m), int(cfg.num_communities)))
+
+
+def make_sparse_train_step(
+    edges, blocks, cfg: BigClamConfig, k_pad: int, m: int
+):
+    """One jitted sparse iteration (support update -> grad/LLH ->
+    candidates -> Armijo -> sparse sumF); same step_fn contract as
+    make_train_step (finalize_step attaches .jitted / .donating)."""
+    sup_every = max(int(cfg.support_every), 1)
+
+    def step(state: SparseTrainState) -> SparseTrainState:
+        ids, w, it = state.ids, state.F, state.it
+
+        def do_support(args):
+            i, ww = args
+            return sm.support_update(i, ww, blocks, m, k_pad)
+
+        ids, w = jax.lax.cond(
+            it % sup_every == 0, do_support, lambda a: a, (ids, w)
+        )
+        # recompute rather than carry: a support update may DROP members
+        # (M < K), and the O(K) scatter is noise next to the edge sweep
+        sumF = sm.sparse_sumF(ids, w, k_pad)
+        grad, node_llh = sm.sparse_grad_llh(
+            ids, w, sumF, edges, cfg, k_pad
+        )
+        llh_cur = node_llh.sum()
+        cand_nbr = sm.sparse_candidates(ids, w, grad, edges, cfg, k_pad)
+        w_new, hist = sm.sparse_armijo_update(
+            ids, w, sumF, grad, node_llh, cand_nbr, cfg, k_pad
+        )
+        return SparseTrainState(
+            F=w_new,
+            ids=ids,
+            sumF=sm.sparse_sumF(ids, w_new, k_pad),
+            llh=llh_cur.astype(w.dtype),
+            it=it + 1,
+            accept_hist=hist,
+            comm_ids=state.comm_ids,
+            comm_dense=state.comm_dense,
+        )
+
+    return finalize_step(step), "sparse_xla"
+
+
+class SparseBigClamModel:
+    """Single-chip sparse-representation trainer.
+
+    Usage:
+        model = SparseBigClamModel(graph, cfg)   # cfg.representation="sparse"
+        result = model.fit(F0)                   # F0: dense (N, K) init,
+                                                 # sparsified to top-M rows
+    """
+
+    def __init__(self, g: Graph, cfg: BigClamConfig, dtype=None):
+        if cfg.representation != "sparse":
+            raise ValueError(
+                "SparseBigClamModel requires cfg.representation='sparse' "
+                f"(got {cfg.representation!r})"
+            )
+        if cfg.min_f != 0.0:
+            # sentinel slots rely on clip(0 + eta*0) staying 0, exactly
+            # like dense padding inertness
+            raise ValueError(
+                f"sparse representation requires min_f == 0.0 "
+                f"(got {cfg.min_f})"
+            )
+        self.g = g
+        self.cfg = cfg
+        self.dtype = dtype or (
+            jnp.float64 if cfg.dtype == "float64" else jnp.float32
+        )
+        self.m = effective_m(cfg)
+        self.k_pad = cfg.num_communities
+        self.block_b = sm.pick_block_b(
+            cfg.sparse_score_block, g.num_nodes, self.m,
+            g.num_directed_edges / max(g.num_nodes, 1),
+        )
+        self._setup()
+        self._step_cache = {self._step_key(): (self._step, self.engaged_path)}
+        self.path_reason = self._path_reason()
+        from bigclam_tpu.obs import note_step_build
+
+        note_step_build(cfg, type(self).__name__)
+        log_engaged_path(
+            type(self).__name__, self.engaged_path, self.path_reason
+        )
+
+    def _setup(self) -> None:
+        """Build padding, device edge/block buffers, and the train step
+        (subclass hook: the sharded trainer swaps the whole schedule)."""
+        g, cfg = self.g, self.cfg
+        self.n_pad = _round_up(max(g.num_nodes, 1), self.block_b)
+        # edge chunks bound by the (chunk, M) gather width — M, not K
+        self._edges, n_pad = prepare_graph(
+            g, cfg, node_multiple=self.block_b, dtype=self.dtype,
+            k_pad=self.m,
+        )
+        assert n_pad == self.n_pad, (n_pad, self.n_pad)
+        self._blocks = sm.build_support_blocks(
+            g, self.n_pad, self.block_b, dtype=self.dtype
+        )
+        self._step, self.engaged_path = self._make_step()
+
+    def _path_reason(self) -> str:
+        return f"representation=sparse M={self.m}"
+
+    def _make_step(self):
+        return make_sparse_train_step(
+            self._edges, self._blocks, self.cfg, self.k_pad, self.m
+        )
+
+    def _step_key(self):
+        return step_cfg_key(self.cfg)
+
+    def rebuild_step(self) -> None:
+        """Same contract as BigClamModel.rebuild_step (step cache keyed
+        by step_cfg_key; used by the rollback ladder's step_scale)."""
+        key = self._step_key()
+        if key not in self._step_cache:
+            self._step_cache[key] = self._make_step()
+            from bigclam_tpu.obs import note_step_build
+
+            note_step_build(self.cfg, type(self).__name__)
+        self._step, self.engaged_path = self._step_cache[key]
+
+    # ------------------------------------------------------------ state
+    def init_state(self, F0: np.ndarray) -> SparseTrainState:
+        n, k = self.g.num_nodes, self.cfg.num_communities
+        assert F0.shape == (n, k), (F0.shape, (n, k))
+        ids, w, truncated = sm.from_dense(
+            np.asarray(F0), self.m, self.k_pad, self.n_pad
+        )
+        if truncated:
+            import sys
+
+            from bigclam_tpu.obs import telemetry as _obs
+
+            tel = _obs.current()
+            if tel is not None:
+                tel.event(
+                    "model_build", model="SparseBigClamModel",
+                    path="init_truncated", reason=f"{truncated} entries",
+                )
+            import os
+
+            if os.environ.get("BIGCLAM_QUIET") != "1":
+                print(
+                    f"[bigclam] sparse init: {truncated} positive F0 "
+                    f"entries beyond top-{self.m} dropped",
+                    file=sys.stderr,
+                )
+        self._on_init_sparsified(ids)
+        return self.reset_state(*self._place(ids, w))
+
+    def _place(self, ids: np.ndarray, w: np.ndarray):
+        """Host arrays -> device (subclass hook: sharded placement)."""
+        return jnp.asarray(ids), jnp.asarray(w, self.dtype)
+
+    def _on_init_sparsified(self, ids: np.ndarray) -> None:
+        """Hook: the sharded trainer sizes its sparse-allreduce buffers
+        from the initial per-shard touched counts here."""
+
+    def reset_state(self, ids: jax.Array, w: jax.Array) -> SparseTrainState:
+        return SparseTrainState(
+            F=w,
+            ids=ids,
+            sumF=sm.sparse_sumF(ids, w, self.k_pad),
+            llh=jnp.asarray(-jnp.inf, w.dtype),
+            it=jnp.zeros((), jnp.int32),
+            accept_hist=jnp.zeros(
+                len(self.cfg.step_candidates) + 1, jnp.int32
+            ),
+            comm_ids=jnp.zeros((), jnp.int32),
+            comm_dense=jnp.zeros((), jnp.int32),
+        )
+
+    def extract_F(self, state: SparseTrainState) -> np.ndarray:
+        """Densify the live (num_nodes, K) block on the host (the
+        extraction/eval pipelines are dense consumers)."""
+        return sm.to_dense(
+            np.asarray(state.ids), np.asarray(state.F),
+            self.g.num_nodes, self.cfg.num_communities,
+        )
+
+    # ------------------------------------------------------ checkpoints
+    def _ckpt_meta(self) -> dict:
+        return {
+            "num_nodes": self.g.num_nodes,
+            "num_directed_edges": self.g.num_directed_edges,
+            "k": self.cfg.num_communities,
+            "n_pad": self.n_pad,
+            "k_pad": self.k_pad,
+            "seed": self.cfg.seed,
+            # two-array sparse state: a dense-run checkpoint (or a
+            # different M) must refuse, not silently densify
+            "representation": "sparse",
+            "sparse_m": self.m,
+        }
+
+    def _state_to_arrays(self, state: SparseTrainState) -> dict:
+        return {
+            "F": np.asarray(state.F),
+            "ids": np.asarray(state.ids),
+            "sumF": np.asarray(state.sumF),
+            "llh": np.asarray(state.llh),
+            "it": np.asarray(state.it),
+        }
+
+    def _state_from_arrays(self, arrays: dict) -> SparseTrainState:
+        if "ids" not in arrays:
+            raise ValueError(
+                "checkpoint holds no member-id array: dense-representation "
+                "checkpoints cannot resume a sparse fit"
+            )
+        ids = jnp.asarray(arrays["ids"], jnp.int32)
+        w = jnp.asarray(arrays["F"], self.dtype)
+        return SparseTrainState(
+            F=w,
+            ids=ids,
+            sumF=sm.sparse_sumF(ids, w, self.k_pad),
+            llh=jnp.asarray(arrays["llh"], self.dtype),
+            it=jnp.asarray(arrays["it"], jnp.int32),
+            accept_hist=jnp.zeros(
+                len(self.cfg.step_candidates) + 1, jnp.int32
+            ),
+            comm_ids=jnp.zeros((), jnp.int32),
+            comm_dense=jnp.zeros((), jnp.int32),
+        )
+
+    def _restore(self, checkpoints):
+        """Sparse restore: strict meta equality (representation, M, K,
+        graph, padding, seed) — the dense path's cross-padding re-pad
+        nicety does not apply to slot arrays. Emits the same `restore`
+        telemetry event as models.bigclam.restore_checkpoint."""
+        restored = checkpoints.restore()
+        if restored is None:
+            return None, ()
+        ckpt_step, arrays, meta = restored
+        from bigclam_tpu.obs import telemetry as _obs
+
+        tel = _obs.current()
+        if tel is not None:
+            tel.event("restore", step=int(ckpt_step))
+        expected = self._ckpt_meta()
+        for key, val in expected.items():
+            got = meta.get(key)
+            if got is None and not val:
+                continue
+            if got != val:
+                raise ValueError(
+                    f"checkpoint incompatible with this sparse run: "
+                    f"{key}={got} in checkpoint vs {val} expected "
+                    f"(dir: {checkpoints.directory})"
+                )
+        return (
+            self._state_from_arrays(arrays),
+            tuple(meta.get("llh_history", ())),
+        )
+
+    # -------------------------------------------------------------- fit
+    def fit(
+        self,
+        F0: np.ndarray,
+        callback: Optional[Callable[[int, float], None]] = None,
+        checkpoints=None,
+        resume: bool = True,
+    ) -> FitResult:
+        state, hist = self.init_state(F0), ()
+        if checkpoints is not None and resume:
+            restored, hist = self._restore(checkpoints)
+            if restored is not None:
+                state = restored
+        rebuilder = _ScaleRebuilder(self)
+        try:
+            return run_fit_loop(
+                self._step,
+                state,
+                self.cfg,
+                callback,
+                self.extract_F,
+                checkpoints=checkpoints,
+                state_to_arrays=self._state_to_arrays,
+                initial_hist=hist,
+                ckpt_meta=self._ckpt_meta(),
+                rebuild_step=rebuilder,
+            )
+        finally:
+            rebuilder.restore()
+
+    def fit_state(
+        self,
+        state: SparseTrainState,
+        callback: Optional[Callable[[int, float], None]] = None,
+    ):
+        """State-resident convergence loop: the converged SparseTrainState
+        comes back with NO dense materialization anywhere."""
+        rebuilder = _ScaleRebuilder(self)
+        try:
+            return run_fit_loop(
+                self._step, state, self.cfg, callback, None,
+                rebuild_step=rebuilder,
+            )
+        finally:
+            rebuilder.restore()
+
+    def random_init(self, seed: Optional[int] = None) -> np.ndarray:
+        return random_init_F(self.g, self.cfg, seed)
+
+    def state_nbytes(self, state: Optional[SparseTrainState] = None) -> int:
+        """Affiliation-state footprint in bytes (ids + weights + sumF):
+        the figure the memory-pinned gate asserts scales with M, not K.
+        Without a state it is computed from the model's shapes — same
+        figure, no host-side sparsification pass needed."""
+        if state is None:
+            isz = np.dtype(self.dtype).itemsize
+            return int(
+                self.n_pad * self.m * (isz + 4)   # weights f32/f64 + int32 ids
+                + self.k_pad * isz                # sumF
+            )
+        return int(
+            state.F.size * state.F.dtype.itemsize
+            + state.ids.size * state.ids.dtype.itemsize
+            + state.sumF.size * state.sumF.dtype.itemsize
+        )

@@ -26,7 +26,11 @@ config-only (step_cfg_key excludes the graph), so the workload axis is
 the (n, edges, k) triple the entry points stamp into the run's `final`
 outcome (fit/profile stamp all three; sweep and bench stamp n/edges only
 — sweep's chosen_k is a noisy OUTPUT and bench's headline graph carries
-no single K — and axes an entry does not record match on the Nones). A
+no single K — and axes an entry does not record match on the Nones).
+The affiliation representation ("dense" | "sparse", + sparse_m) is part
+of the key too: a sparse top-M run does O(M) work per edge where dense
+does O(K), so a same-K cross-baseline would be meaningless ("dense"
+normalizes to None so pre-field dense records keep matching). A
 run re-recorded into the same ledger (`perf record` after an
 auto-append) is never its own baseline.
 
@@ -135,6 +139,13 @@ def build_record(
         "n": final.get("n"),
         "edges": final.get("edges"),
         "k": final.get("k"),
+        # affiliation-state representation (ISSUE 7): a sparse top-M run
+        # and a dense run at the same K do different work per edge —
+        # match_key refuses the cross-baseline even when an entry point
+        # leaves these unset in its final outcome (None == dense by
+        # construction: the sparse trainers always stamp them)
+        "representation": final.get("representation"),
+        "sparse_m": final.get("sparse_m"),
         "wall_s": float(report.get("wall_s", 0.0) or 0.0),
         "steps": len(secs),
         "step_p10": _round6(_percentile(secs, 10)),
@@ -162,14 +173,24 @@ def _round6(v: Optional[float]) -> Optional[float]:
 
 
 def match_key(rec: Dict[str, Any]) -> Tuple:
-    """Baseline identity: same entry + config + workload + hardware +
-    host (see module docstring)."""
+    """Baseline identity: same entry + config + workload + representation
+    + hardware + host (see module docstring). "dense" normalizes to None
+    so records from entry points that never stamp a representation in
+    their final outcome (always dense — the sparse trainers always
+    stamp) match explicitly-stamped dense records; sparse records never
+    match either. Note this does NOT resurrect pre-r11 baselines: the
+    new config fields changed cfg_digest for every run, so old records
+    stop matching on the digest regardless — by design, cfg-schema
+    changes rebaseline."""
+    rep = rec.get("representation")
     return (
         rec.get("entry"),
         rec.get("cfg_digest"),
         rec.get("n"),
         rec.get("edges"),
         rec.get("k"),
+        None if rep == "dense" else rep,
+        rec.get("sparse_m"),
         rec.get("backend"),
         rec.get("device_kind"),
         rec.get("host"),

@@ -1,0 +1,495 @@
+"""Sparse top-M membership representation: the last O(N*K) wall.
+
+Dense F is ~6.5 TB at the Friendster target (N=65M, K=25K) — no HBM
+budget survives it, which is why the reference's own v3 went sparse
+(PAPER.md §0, bigclamv3-7.scala at K=8385). Real memberships are
+power-law sparse, so each node keeps only its top-M communities:
+
+  ids  (N_pad, M) int32 — member community ids, sorted ascending per row,
+                          empty slots hold the sentinel K_pad (sorts last)
+  w    (N_pad, M) float — member weights, 0.0 in sentinel slots
+
+HBM for the affiliation state and bytes-per-edge both scale with M, not
+K — K becomes a pure capacity knob. The kernels here mirror
+ops.objective / ops.linesearch exactly, restricted to the support:
+
+  * edge dot F_u.F_v  = merge of the two SORTED member lists (a vmapped
+    searchsorted per edge — O(M log M), no (M, M) compare matrix)
+  * gradient          = gather of neighbor weights at u's member ids +
+    segment_sum over member slots (slot space, (N, M))
+  * ||grad||^2        = slot terms + the closed-form off-support
+    correction sum_{c not in S} sumF[c]^2 (exact whenever off-support
+    columns carry no neighbor mass — guaranteed right after a support
+    update, see below), so the Armijo acceptance rule matches the dense
+    path's semantics instead of silently relaxing it
+  * support update    = every cfg.support_every iterations: admit
+    candidate communities from neighbor member lists (scored by neighbor
+    weight mass), keep top-M by weight+mass. Sort-based over the
+    candidate ENTRIES of each node block (own slots + neighbor slots,
+    (block_b + eb) * M of them, bounded by cfg.sparse_score_block) —
+    O((N + E) * M log) total with no K-sized axis, so the support pass
+    stays flat in K like everything else here.
+
+PARITY: with M >= K and support_every=1 the restricted dynamics equal
+the dense dynamics: a community with zero neighbor mass has
+grad = -sumF[c] <= 0 at F_u[c] = 0, which the box clip pins at zero — so
+admission-from-neighbor-lists loses nothing, and admission runs BEFORE
+the gradient pass so same-step dense growth is captured. Pinned by
+tests/test_sparse.py against the dense trajectory.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from bigclam_tpu.config import BigClamConfig
+from bigclam_tpu.ops.linesearch import accept_stats
+from bigclam_tpu.ops.objective import EdgeChunks, edge_terms
+
+
+class SparseTrainState(NamedTuple):
+    """TrainState twin for the sparse path. `F` holds the (N_pad, M)
+    member WEIGHTS (named F so the shared fit-loop machinery —
+    nan-injection faults, non-finite diagnostics, rollback snapshots —
+    keeps working unchanged); `ids` is the second array of the two-array
+    sparse state the checkpoint sidecar crc-stamps."""
+
+    F: jax.Array                 # (N_pad, M) member weights
+    ids: jax.Array               # (N_pad, M) int32 sorted member ids
+    sumF: jax.Array              # (K_pad,) dense column sums (O(K) only)
+    llh: jax.Array               # scalar: LLH of the PREVIOUS state
+    it: jax.Array
+    accept_hist: Optional[jax.Array] = None
+    # sparse-collective observability (sharded trainer only; zeros on a
+    # single chip): ids exchanged by the last sparse allreduce (max over
+    # shards) and whether that step fell back to the dense psum
+    comm_ids: Optional[jax.Array] = None
+    comm_dense: Optional[jax.Array] = None
+
+
+class SupportBlocks(NamedTuple):
+    """Per-node-block edge layout for the support-update scatter: block b
+    owns src rows [b*block_b, (b+1)*block_b), src stored block-local.
+    Shapes (n_blocks, eb) host-padded to the max per-block edge count
+    (mask 0 on padding, dst 0, src_local block_b - 1)."""
+
+    src_local: jax.Array         # int32
+    dst: jax.Array               # int32 (global)
+    mask: jax.Array              # float
+    block_b: int
+
+
+def _round_up(x: int, m: int) -> int:
+    return ((x + m - 1) // m) * m
+
+
+def pick_block_b(budget_elems: int, n: int, m: int, avg_deg: float) -> int:
+    """Support-update block size: the sort kernel works on
+    ~(block_b * (1 + avg_deg)) * M candidate entries per block, so size
+    block_b to keep that near the element budget — K plays no part.
+    Clamped to [8, 1024] and rounded to 8."""
+    per_row = max(int(m) * (1.0 + max(avg_deg, 0.0)), 1.0)
+    b = max(int(budget_elems / per_row), 8)
+    b = min(b, 1024, _round_up(max(n, 8), 8))
+    return _round_up(b, 8) if b % 8 else b
+
+
+def from_dense(
+    F: np.ndarray, m: int, k_pad: int, n_pad: int
+) -> Tuple[np.ndarray, np.ndarray, int]:
+    """Sparsify a dense (N, K) init: per-row top-m entries by weight
+    (ties to the LOWEST community id via the stable sort), ids sorted
+    ascending with sentinel k_pad padding. Returns (ids, w, truncated) —
+    `truncated` counts positive entries dropped because a row held more
+    than m (0 whenever m >= max row support; the M >= K parity regime)."""
+    F = np.asarray(F)
+    n, k = F.shape
+    assert k <= k_pad, (k, k_pad)
+    order = np.argsort(-F, axis=1, kind="stable")[:, :m]
+    vals = np.take_along_axis(F, order, axis=1)
+    keep = vals > 0
+    truncated = int((F > 0).sum() - keep.sum())
+    sel_ids = np.where(keep, order, k_pad)
+    srt = np.argsort(sel_ids, axis=1, kind="stable")
+    ids = np.full((n_pad, m), k_pad, dtype=np.int32)
+    w = np.zeros((n_pad, m), dtype=F.dtype)
+    ids[:n] = np.take_along_axis(sel_ids, srt, axis=1)
+    w[:n] = np.take_along_axis(np.where(keep, vals, 0.0), srt, axis=1)
+    return ids, w, truncated
+
+
+def to_dense(
+    ids: np.ndarray, w: np.ndarray, n: int, k: int
+) -> np.ndarray:
+    """Densify the live (n, k) block of a sparse state (host side; the
+    extraction/eval pipelines consume dense F)."""
+    ids = np.asarray(ids)[:n]
+    w = np.asarray(w)[:n]
+    out = np.zeros((n, k), dtype=w.dtype)
+    valid = ids < k
+    rows = np.broadcast_to(np.arange(n)[:, None], ids.shape)
+    np.add.at(out, (rows[valid], ids[valid]), w[valid])
+    return out
+
+
+def support_blocks_host(
+    g, n_pad: int, block_b: int
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Host arrays of the per-block edge layout: (src_local, dst, mask),
+    each (n_blocks, eb) with eb the graph-wide max per-block edge count
+    (uniform so the sharded trainer can reshape to (dp, blocks/dp, eb)).
+    CSR order means each block's edges are one contiguous src slice."""
+    assert n_pad % block_b == 0, (n_pad, block_b)
+    n_blocks = n_pad // block_b
+    src, dst = g.src, g.dst
+    bounds = np.searchsorted(src, np.arange(0, n_pad + block_b, block_b))
+    counts = np.diff(bounds)
+    eb = _round_up(max(int(counts.max()) if counts.size else 1, 1), 8)
+    sl = np.full((n_blocks, eb), block_b - 1, dtype=np.int32)
+    dd = np.zeros((n_blocks, eb), dtype=np.int32)
+    mm = np.zeros((n_blocks, eb), dtype=np.float32)
+    for b in range(n_blocks):
+        e0, e1 = int(bounds[b]), int(bounds[b + 1])
+        cnt = e1 - e0
+        sl[b, :cnt] = src[e0:e1] - b * block_b
+        dd[b, :cnt] = dst[e0:e1]
+        mm[b, :cnt] = 1.0
+    return sl, dd, mm
+
+
+def build_support_blocks(
+    g, n_pad: int, block_b: int, dtype=np.float32
+) -> SupportBlocks:
+    """Device-resident SupportBlocks over the whole graph (single-chip)."""
+    sl, dd, mm = support_blocks_host(g, n_pad, block_b)
+    return SupportBlocks(
+        src_local=jnp.asarray(sl),
+        dst=jnp.asarray(dd),
+        mask=jnp.asarray(mm, dtype),
+        block_b=block_b,
+    )
+
+
+def member_lookup(
+    iv: jax.Array, wv: jax.Array, iu: jax.Array, k_pad: int
+) -> jax.Array:
+    """For each (edge, slot): the neighbor's weight in community iu, or
+    0.0 when the neighbor is not a member. iv/wv/iu are (E, M) with iv
+    sorted ascending per row (sentinels sort last and never match)."""
+    m = iv.shape[-1]
+    pos = jax.vmap(jnp.searchsorted)(iv, iu)
+    pos = jnp.minimum(pos, m - 1)
+    hit = jnp.take_along_axis(iv, pos, axis=-1) == iu
+    hit = hit & (iu < k_pad)
+    return jnp.where(hit, jnp.take_along_axis(wv, pos, axis=-1), 0.0)
+
+
+def sparse_sumF(ids: jax.Array, w: jax.Array, k_pad: int) -> jax.Array:
+    """Dense (K_pad,) column sums from the sparse state — a scatter-add
+    of N*M values, never an (N, K) array. Sentinel ids (== k_pad) are
+    out of bounds and dropped by the scatter."""
+    return (
+        jnp.zeros(k_pad, w.dtype)
+        .at[ids.reshape(-1)]
+        .add(w.reshape(-1), mode="drop")
+    )
+
+
+def presence(ids: jax.Array, k_pad: int) -> jax.Array:
+    """(K_pad,) bool: communities present in ANY member list (the
+    'touched' set the sparse allreduce exchanges)."""
+    return (
+        jnp.zeros(k_pad, bool)
+        .at[ids.reshape(-1)]
+        .set(True, mode="drop")
+    )
+
+
+def masked_sumF_at(
+    ids: jax.Array, sumF: jax.Array, k_pad: int
+) -> Tuple[jax.Array, jax.Array]:
+    """(valid, sumF gathered at each member slot — 0 in sentinel slots)."""
+    valid = ids < k_pad
+    at = jnp.where(
+        valid, sumF[jnp.minimum(ids, k_pad - 1)], jnp.zeros((), sumF.dtype)
+    )
+    return valid, at
+
+
+def sparse_node_tail(w: jax.Array, sumF_at: jax.Array) -> jax.Array:
+    """-F_u.sumF + F_u.F_u restricted to the support (exact: off-support
+    entries of F_u are zero)."""
+    return -jnp.einsum("nm,nm->n", w, sumF_at) + jnp.einsum(
+        "nm,nm->n", w, w
+    )
+
+
+def sparse_grad_llh(
+    ids: jax.Array,
+    w: jax.Array,
+    sumF: jax.Array,
+    edges: EdgeChunks,
+    cfg: BigClamConfig,
+    k_pad: int,
+    ids_dst: Optional[jax.Array] = None,
+    w_dst: Optional[jax.Array] = None,
+) -> Tuple[jax.Array, jax.Array]:
+    """Fused per-node slot-space gradient + per-node LLH (one edge
+    sweep), the sparse twin of ops.objective.grad_llh. Returns
+    (grad (N, M) — 0 in sentinel slots, node_llh (N,)). On the sharded
+    path `ids`/`w` are the LOCAL rows edge src indexes (rebased) and
+    `ids_dst`/`w_dst` the all-gathered global rows dst indexes."""
+    if ids_dst is None:
+        ids_dst, w_dst = ids, w
+    n = ids.shape[0]
+    adt = jnp.dtype(cfg.accum_dtype) if cfg.accum_dtype else w.dtype
+
+    def body(carry, sdm):
+        nbr_llh, nbr_grad = carry
+        s, d, m = sdm
+        iu, wu = ids[s], w[s]
+        vals = member_lookup(ids_dst[d], w_dst[d], iu, k_pad)  # (chunk, M)
+        x = jnp.einsum("em,em->e", wu, vals)
+        omp, ell = edge_terms(x, cfg)
+        coeff = m / omp
+        nbr_llh = nbr_llh + jax.ops.segment_sum(
+            (ell * m).astype(adt), s, num_segments=n,
+            indices_are_sorted=True,
+        )
+        nbr_grad = nbr_grad + jax.ops.segment_sum(
+            vals * coeff[:, None], s, num_segments=n,
+            indices_are_sorted=True,
+        )
+        return (nbr_llh, nbr_grad), None
+
+    init = (jnp.zeros(n, adt), jnp.zeros_like(w))
+    (nbr_llh, nbr_grad), _ = lax.scan(body, init, edges)
+    valid, sumF_at = masked_sumF_at(ids, sumF, k_pad)
+    grad = jnp.where(valid, nbr_grad - sumF_at + w, 0.0)
+    node_llh = nbr_llh + sparse_node_tail(w, sumF_at).astype(adt)
+    return grad, node_llh
+
+
+def sparse_candidates(
+    ids: jax.Array,
+    w: jax.Array,
+    grad: jax.Array,
+    edges: EdgeChunks,
+    cfg: BigClamConfig,
+    k_pad: int,
+    ids_dst: Optional[jax.Array] = None,
+    w_dst: Optional[jax.Array] = None,
+) -> jax.Array:
+    """Neighbor-sum candidate terms for every Armijo step, shape (S, N)
+    — the sparse twin of ops.linesearch.candidates_pass. The member
+    lookup is done ONCE per chunk and reused by all 16 candidates (the
+    support does not move within a step). ids_dst/w_dst as in
+    sparse_grad_llh."""
+    if ids_dst is None:
+        ids_dst, w_dst = ids, w
+    n = ids.shape[0]
+    adt = jnp.dtype(cfg.accum_dtype) if cfg.accum_dtype else w.dtype
+    etas = jnp.asarray(cfg.step_candidates, w.dtype)
+    num_s = len(cfg.step_candidates)
+
+    def body(acc, sdm):
+        s, d, m = sdm
+        iu, wu, gu = ids[s], w[s], grad[s]
+        vals = member_lookup(ids_dst[d], w_dst[d], iu, k_pad)
+
+        def one_eta(eta):
+            nw = jnp.clip(wu + eta * gu, cfg.min_f, cfg.max_f)
+            x = jnp.einsum("em,em->e", nw, vals)
+            _, ell = edge_terms(x, cfg)
+            return ell * m
+
+        terms = lax.map(one_eta, etas)                  # (S, chunk)
+        parts = jax.vmap(
+            lambda v: jax.ops.segment_sum(
+                v.astype(adt), s, num_segments=n, indices_are_sorted=True
+            )
+        )(terms)
+        return acc + parts, None
+
+    acc, _ = lax.scan(body, jnp.zeros((num_s, n), adt), edges)
+    return acc
+
+
+def sparse_armijo_update(
+    ids: jax.Array,
+    w: jax.Array,
+    sumF: jax.Array,
+    grad: jax.Array,
+    node_llh: jax.Array,
+    cand_nbr: jax.Array,
+    cfg: BigClamConfig,
+    k_pad: int,
+):
+    """Armijo acceptance + max-accepted-step Jacobi update on the slot
+    arrays — the sparse twin of ops.linesearch.armijo_update. ||grad||^2
+    carries the exact off-support correction (module docstring), so the
+    acceptance rule is the dense path's, not a laxer one. Returns
+    (w_new, accept_hist)."""
+    adt = jnp.dtype(cfg.accum_dtype) if cfg.accum_dtype else w.dtype
+    etas = jnp.asarray(cfg.step_candidates, w.dtype)
+    _, sumF_at = masked_sumF_at(ids, sumF, k_pad)
+    gg_slots = jnp.einsum("nm,nm->n", grad, grad)
+    off_support = (sumF @ sumF) - jnp.einsum(
+        "nm,nm->n", sumF_at, sumF_at
+    )
+    gg = (gg_slots + off_support).astype(adt)
+
+    def tail_for(eta):
+        nf = jnp.clip(w + eta * grad, cfg.min_f, cfg.max_f)
+        sf_adj = sumF_at - w + nf
+        return (
+            -jnp.einsum("nm,nm->n", nf, sf_adj)
+            + jnp.einsum("nm,nm->n", nf, nf)
+        ).astype(adt)
+
+    tails = lax.map(tail_for, etas)                     # (S, N)
+    cand_llh = cand_nbr + tails
+    ok = cand_llh >= node_llh[None, :] + cfg.alpha * etas[:, None] * gg[None, :]
+    best_eta = jnp.max(jnp.where(ok, etas[:, None], 0.0), axis=0)
+    accepted = jnp.any(ok, axis=0)
+    w_new = jnp.where(
+        accepted[:, None],
+        jnp.clip(w + best_eta[:, None] * grad, cfg.min_f, cfg.max_f),
+        w,
+    )
+    return w_new, accept_stats(ok)
+
+
+def support_update(
+    ids: jax.Array,
+    w: jax.Array,
+    blocks: SupportBlocks,
+    m: int,
+    k_pad: int,
+    ids_nbr: Optional[jax.Array] = None,
+    w_nbr: Optional[jax.Array] = None,
+) -> Tuple[jax.Array, jax.Array]:
+    """One support-update pass: per node, admit candidate communities
+    from neighbor member lists and keep the top-M by weight + neighbor
+    mass. rank(c) = w_u[c] + sum_{v in N(u)} w_v[c]; only rank > 0
+    entries keep a slot (everything else is sentinel), surviving members
+    keep their weight EXACTLY, admissions start at weight 0 (their first
+    gradient step is then identical to the dense path's).
+
+    Sort-based, no K-sized axis: each block's candidate ENTRIES — own
+    member slots + one entry per (edge, neighbor slot), (block_b + eb)*M
+    of them — are lex-sorted by (node, community), duplicate runs
+    segment-summed into ranks, then ordered by descending rank (stable:
+    ties keep the lower community id, matching what lax.top_k over a
+    dense rank row would pick) and cut to the first M per node. The
+    support pass therefore costs O((N + E) * M log), flat in K — a
+    dense (block, K) scratch + top_k(K) here would make the *sparse*
+    step itself scale with K and forfeit the representation's whole
+    point. `ids_nbr`/`w_nbr` supply the rows `blocks.dst` indexes (the
+    ALL-GATHERED global rows on the sharded path, where `ids`/`w` are
+    this shard's local rows and `blocks` covers exactly that row range;
+    defaults to ids/w single-chip).
+    """
+    if ids_nbr is None:
+        ids_nbr, w_nbr = ids, w
+    block_b = blocks.block_b
+    n_rows = ids.shape[0]
+    n_blocks = n_rows // block_b
+    assert n_blocks * block_b == n_rows, (n_rows, block_b)
+    dtype = w.dtype
+    eb = blocks.dst.shape[1]
+    p = (block_b + eb) * m
+
+    def block_fn(xs):
+        sl, dd, mm, b = xs
+        rows_ids = lax.dynamic_slice(ids, (b * block_b, 0), (block_b, m))
+        rows_w = lax.dynamic_slice(w, (b * block_b, 0), (block_b, m))
+        iv = ids_nbr[dd]                                # (eb, M)
+        wv = w_nbr[dd] * mm[:, None]
+        own_node = jnp.broadcast_to(
+            jnp.arange(block_b, dtype=jnp.int32)[:, None], (block_b, m)
+        )
+        nbr_node = jnp.broadcast_to(sl[:, None], (eb, m))
+        node = jnp.concatenate(
+            [own_node.reshape(-1), nbr_node.reshape(-1)]
+        )
+        cid = jnp.concatenate([rows_ids.reshape(-1), iv.reshape(-1)])
+        rc = jnp.concatenate([rows_w.reshape(-1), wv.reshape(-1)])
+        wc = jnp.concatenate(
+            [rows_w.reshape(-1), jnp.zeros(eb * m, dtype)]
+        )
+        # lexicographic (node asc, community asc) via two stable sorts;
+        # duplicate (node, community) entries land in one contiguous run
+        o1 = jnp.argsort(cid, stable=True)
+        node, cid, rc, wc = node[o1], cid[o1], rc[o1], wc[o1]
+        o2 = jnp.argsort(node, stable=True)
+        node, cid, rc, wc = node[o2], cid[o2], rc[o2], wc[o2]
+        first = jnp.concatenate([
+            jnp.ones((1,), bool),
+            (node[1:] != node[:-1]) | (cid[1:] != cid[:-1]),
+        ])
+        seg = jnp.cumsum(first) - 1
+        rank = jax.ops.segment_sum(
+            rc, seg, num_segments=p, indices_are_sorted=True
+        )[seg]
+        wsum = jax.ops.segment_sum(
+            wc, seg, num_segments=p, indices_are_sorted=True
+        )[seg]
+        # a NaN/inf member weight must SURVIVE the top-M cut: ranking by
+        # `> 0` alone would silently drop it (NaN > 0 is False),
+        # laundering poisoned state before the fit loop's non-finite
+        # detection (rollback/abort, models.bigclam.run_fit_loop) ever
+        # sees the LLH go non-finite — rank it +inf instead so it keeps
+        # a slot and the poison propagates to the LLH like on the dense
+        # path
+        rank = jnp.where(jnp.isfinite(rank), rank, jnp.inf)
+        keep = first & (cid < k_pad) & (rank > 0)
+        # order candidates by (node, rank desc): stable sort on -rank
+        # (ties keep the (node, community)-asc order = lower id first),
+        # then stable sort on node to group rows back together
+        prio = jnp.where(keep, -rank, jnp.inf)
+        o3 = jnp.argsort(prio, stable=True)
+        node, cid, wsum, keep = node[o3], cid[o3], wsum[o3], keep[o3]
+        o4 = jnp.argsort(node, stable=True)
+        node, cid, wsum, keep = node[o4], cid[o4], wsum[o4], keep[o4]
+        idxp = jnp.arange(p)
+        row_start = lax.cummax(
+            jnp.where(
+                jnp.concatenate(
+                    [jnp.ones((1,), bool), node[1:] != node[:-1]]
+                ),
+                idxp,
+                0,
+            )
+        )
+        pos = idxp - row_start                  # slot within the node's run
+        take = keep & (pos < m)
+        row = jnp.where(take, node, block_b)    # block_b is out of bounds:
+        col = jnp.where(take, pos, 0)           # non-kept entries drop
+        new_ids = (
+            jnp.full((block_b, m), k_pad, jnp.int32)
+            .at[row, col]
+            .set(cid.astype(jnp.int32), mode="drop")
+        )
+        new_w = (
+            jnp.zeros((block_b, m), dtype)
+            .at[row, col]
+            .set(wsum, mode="drop")
+        )
+        order = jnp.argsort(new_ids, axis=1)
+        return (
+            jnp.take_along_axis(new_ids, order, axis=1),
+            jnp.take_along_axis(new_w, order, axis=1),
+        )
+
+    xs = (
+        blocks.src_local, blocks.dst, blocks.mask,
+        jnp.arange(n_blocks),
+    )
+    ids2, w2 = lax.map(block_fn, xs)
+    return ids2.reshape(n_rows, m), w2.reshape(n_rows, m)

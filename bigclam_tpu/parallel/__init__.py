@@ -10,6 +10,7 @@ from bigclam_tpu.parallel.sharded import (
     ShardedBigClamModel,
     StoreShardedBigClamModel,
 )
+from bigclam_tpu.parallel.sparse_sharded import SparseShardedBigClamModel
 
 __all__ = [
     "initialize_distributed",
@@ -19,5 +20,6 @@ __all__ = [
     "put_sharded",
     "RingBigClamModel",
     "ShardedBigClamModel",
+    "SparseShardedBigClamModel",
     "StoreShardedBigClamModel",
 ]

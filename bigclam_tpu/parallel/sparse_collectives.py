@@ -1,0 +1,107 @@
+"""Sparse allreduce for power-law membership data (arXiv:1312.3020).
+
+The dense sharded trainers psum a (K,) sumF every iteration. On the
+sparse representation each shard's contribution touches only the
+communities present in ITS member lists — power-law sparse — so the
+collective here exchanges (id, value) pairs of the touched communities
+only, in fixed-capacity buffers:
+
+    compact:   local dense (K,) contribution -> (cap,) touched ids +
+               values (jnp.nonzero with a static size; sentinel-padded)
+    exchange:  ONE all_gather of the (cap,) id/value buffers over the
+               "nodes" axis — 2 * cap * dp slots on the wire instead of
+               the K-length psum lattice
+    combine:   scatter-add every shard's pairs into a local dense (K,)
+               accumulator (O(K) scratch is fine — sumF itself is O(K);
+               it is the WIRE and the O(N*K) state that sparsity wins)
+
+The result equals lax.psum(vals) up to float summation order (exactly,
+for exactly-representable sums — pinned by tests/test_sparse.py).
+
+OVERFLOW: the touched set only changes at support updates, but a
+runtime admission burst can exceed the build-time cap. The compact pass
+counts its touched ids, a pmax replicates the worst shard's count, and
+a lax.cond falls back to the dense psum FOR THAT STEP — correctness
+never depends on the cap, only the exchange volume does. Callers above
+a density threshold (cfg.sparse_dense_fallback) should not build the
+sparse collective at all (static_mode below decides).
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def _round_up(x: int, m: int) -> int:
+    return ((x + m - 1) // m) * m
+
+
+def auto_cap(
+    touched_per_shard: int, k_pad: int, slack: float, m: int
+) -> int:
+    """Exchange-buffer capacity from the initial worst-shard touched
+    count: slack headroom for support growth, at least one M row, never
+    beyond K (cap == K degenerates to a dense-sized exchange)."""
+    est = max(int(touched_per_shard), 1)
+    return min(k_pad, _round_up(max(int(slack * est), m, 8), 8))
+
+
+def static_mode(cap: int, k_pad: int, density_threshold: float) -> str:
+    """'sparse' when the capped exchange is worth it, 'dense' when the
+    cap already covers >= density_threshold of K (the psum moves fewer
+    bytes than 2*cap id/value pairs would)."""
+    if k_pad <= 0 or cap >= max(1.0, density_threshold * k_pad):
+        return "dense"
+    return "sparse"
+
+
+def compact_touched(
+    vals: jax.Array, pres: jax.Array, cap: int, k_pad: int
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """(touched ids (cap,) int32 sentinel-padded with k_pad, their
+    values (cap,), touched count). Ids beyond cap are DROPPED here —
+    the caller's overflow cond is what keeps that correct."""
+    (tids,) = jnp.nonzero(pres, size=cap, fill_value=k_pad)
+    tids = tids.astype(jnp.int32)
+    ok = tids < k_pad
+    tvals = jnp.where(
+        ok, vals[jnp.minimum(tids, k_pad - 1)], jnp.zeros((), vals.dtype)
+    )
+    return tids, tvals, pres.sum().astype(jnp.int32)
+
+
+def sparse_allreduce_sum(
+    vals: jax.Array,
+    pres: jax.Array,
+    cap: int,
+    axis_name: str,
+    k_pad: int,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Allreduce of per-shard dense (K_pad,) contributions exchanging
+    only touched community ids (shard_map body helper; `pres` is this
+    shard's presence mask). Returns (global sums (K_pad,), max touched
+    count over shards, dense_fallback flag) — the last two are the
+    exchange-volume counters the gates assert on.
+    """
+    tids, tvals, count = compact_touched(vals, pres, cap, k_pad)
+    max_count = lax.pmax(count, axis_name)
+    overflow = max_count > cap
+
+    def dense_branch(_):
+        return lax.psum(vals, axis_name)
+
+    def sparse_branch(_):
+        ai = lax.all_gather(tids, axis_name)        # (dp, cap)
+        av = lax.all_gather(tvals, axis_name)
+        return (
+            jnp.zeros(k_pad, vals.dtype)
+            .at[ai.reshape(-1)]
+            .add(av.reshape(-1), mode="drop")
+        )
+
+    out = lax.cond(overflow, dense_branch, sparse_branch, operand=None)
+    return out, max_count, overflow.astype(jnp.int32)

@@ -1,0 +1,400 @@
+"""Sharded sparse-representation BigCLAM trainer: top-M member lists
+over the "nodes" mesh axis + the sparse allreduce (ISSUE 7 tentpole).
+
+The dense sharded trainers exchange O(K) per node pair step: one
+all_gather of the (N_loc, K) F shard plus a (K,) psum of sumF. On the
+sparse representation both collectives scale with M instead:
+
+  state     ids/w (N_pad, M) sharded P("nodes") — per-shard HBM is
+            O(N_loc * M), K appears only in the (K,) sumF accumulator
+  exchange  all_gather of the (N_loc, M) id/weight shards (the edge
+            sweeps look up neighbor rows in the gathered copy), and
+            parallel.sparse_collectives.sparse_allreduce_sum for sumF:
+            only the TOUCHED community ids travel, in fixed (cap,)
+            buffers sized from the initial per-shard touched counts
+            (cfg.sparse_comm_cap / sparse_cap_slack) — the pattern of
+            "Sparse Allreduce" (arXiv:1312.3020) for power-law data
+
+Above the density threshold (cfg.sparse_dense_fallback) the capped
+exchange would move more bytes than the (K,) psum, so the step is built
+with the dense psum instead (STATIC choice, recorded in engaged_path);
+a runtime admission burst past the cap falls back to the dense psum for
+that step only (the overflow cond inside sparse_allreduce_sum).
+Exchange-volume counters ride the state (comm_ids = max touched ids
+over shards, comm_dense = 1 when a step fell back) so gates can assert
+the wire volume, not just the result.
+
+The K axis is NOT sharded here: sparse rows have no K dimension to
+split (that is the point), and sumF is O(K) — the axis K-sharding
+existed to shrink is gone. A mesh with tp > 1 is refused.
+
+Math: identical to models.sparse.SparseBigClamModel per iteration
+(support update -> sparse grad/LLH -> candidates -> Armijo), with the
+per-shard sums psum'd exactly like parallel.sharded does for the dense
+path — trajectories match the single-chip sparse trainer to float
+summation order (pinned by tests/test_sparse.py).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from bigclam_tpu.config import BigClamConfig
+from bigclam_tpu.graph.csr import Graph
+from bigclam_tpu.models.bigclam import (
+    _round_up,
+    attach_donating,
+    edge_chunk_bound,
+    log_engaged_path,
+    step_cfg_key,
+)
+from bigclam_tpu.models.sparse import SparseBigClamModel
+from bigclam_tpu.ops import sparse_members as sm
+from bigclam_tpu.ops.objective import EdgeChunks
+from bigclam_tpu.ops.sparse_members import SparseTrainState
+from bigclam_tpu.parallel.mesh import K_AXIS, NODES_AXIS
+from bigclam_tpu.parallel.multihost import fetch_global, put_sharded
+from bigclam_tpu.parallel.sharded import shard_edges
+from bigclam_tpu.parallel.sparse_collectives import (
+    auto_cap,
+    sparse_allreduce_sum,
+    static_mode,
+)
+from bigclam_tpu.utils.compat import shard_map
+
+
+def shard_touched_counts(ids: np.ndarray, dp: int, k_pad: int) -> np.ndarray:
+    """(dp,) number of distinct communities present in each shard's rows
+    of a host (n_pad, M) id array — the figure the sparse-allreduce cap
+    is sized from (auto_cap over the max)."""
+    n_pad = ids.shape[0]
+    rows = n_pad // dp
+    return np.array(
+        [
+            np.unique(
+                ids[i * rows : (i + 1) * rows][
+                    ids[i * rows : (i + 1) * rows] < k_pad
+                ]
+            ).size
+            for i in range(dp)
+        ],
+        dtype=np.int64,
+    )
+
+
+def make_sparse_sharded_step(
+    mesh: Mesh,
+    edges: EdgeChunks,
+    blocks,
+    cfg: BigClamConfig,
+    k_pad: int,
+    m: int,
+    cap: int,
+    mode: str,
+    block_b: int,
+):
+    """One jitted sharded sparse iteration. `blocks` is the
+    (src_local, dst, mask) triple of (dp, blocks_per_shard, eb) support
+    arrays (dst GLOBAL — it indexes the gathered rows); `mode` is the
+    static collective choice from sparse_collectives.static_mode."""
+    sup_every = max(int(cfg.support_every), 1)
+    use_sparse = mode == "sparse"
+
+    def allreduce(vals, pres):
+        if use_sparse:
+            return sparse_allreduce_sum(vals, pres, cap, NODES_AXIS, k_pad)
+        return (
+            lax.psum(vals, NODES_AXIS),
+            lax.pmax(pres.sum().astype(jnp.int32), NODES_AXIS),
+            jnp.ones((), jnp.int32),
+        )
+
+    def step_shard(ids_loc, w_loc, it, esrc, edst, emask, bsl, bdd, bmm):
+        esrc, edst, emask = esrc[0], edst[0], emask[0]
+        bsl, bdd, bmm = bsl[0], bdd[0], bmm[0]
+
+        def do_support(op):
+            i0, w0 = op
+            # the admission pass scores against PRE-update neighbor
+            # rows, exactly like the single-chip path's defaulted
+            # ids_nbr — gathered here because neighbors live on other
+            # shards. The predicate is replicated over shards, so the
+            # branch collectives are uniform.
+            i_full = lax.all_gather(i0, NODES_AXIS, axis=0, tiled=True)
+            wn_full = lax.all_gather(w0, NODES_AXIS, axis=0, tiled=True)
+            blk = sm.SupportBlocks(
+                src_local=bsl, dst=bdd, mask=bmm, block_b=block_b
+            )
+            return sm.support_update(
+                i0, w0, blk, m, k_pad, ids_nbr=i_full, w_nbr=wn_full
+            )
+
+        ids_loc, w_loc = lax.cond(
+            it % sup_every == 0, do_support, lambda op: op, (ids_loc, w_loc)
+        )
+        # ONE post-support gather pair feeds the grad AND all 16
+        # candidate sweeps (the dense trainers' single all_gather of F,
+        # at M columns instead of K)
+        ids_full = lax.all_gather(ids_loc, NODES_AXIS, axis=0, tiled=True)
+        w_full = lax.all_gather(w_loc, NODES_AXIS, axis=0, tiled=True)
+        pres = sm.presence(ids_loc, k_pad)
+        sumF, cnt, fb = allreduce(
+            sm.sparse_sumF(ids_loc, w_loc, k_pad), pres
+        )
+        ec = EdgeChunks(src=esrc, dst=edst, mask=emask)
+        grad, node_llh = sm.sparse_grad_llh(
+            ids_loc, w_loc, sumF, ec, cfg, k_pad,
+            ids_dst=ids_full, w_dst=w_full,
+        )
+        llh_cur = lax.psum(node_llh.sum(), NODES_AXIS)
+        cand_nbr = sm.sparse_candidates(
+            ids_loc, w_loc, grad, ec, cfg, k_pad,
+            ids_dst=ids_full, w_dst=w_full,
+        )
+        w_new, hist = sm.sparse_armijo_update(
+            ids_loc, w_loc, sumF, grad, node_llh, cand_nbr, cfg, k_pad
+        )
+        hist = lax.psum(hist, NODES_AXIS)
+        # state sumF from the UPDATED weights (ids unchanged since the
+        # support pass, so the touched set — and the cap pressure — is
+        # the same; counters take the max over both exchanges)
+        sumF_new, cnt2, fb2 = allreduce(
+            sm.sparse_sumF(ids_loc, w_new, k_pad), pres
+        )
+        return (
+            w_new,
+            ids_loc,
+            sumF_new,
+            llh_cur.astype(w_loc.dtype),
+            it + 1,
+            hist,
+            jnp.maximum(cnt, cnt2),
+            jnp.maximum(fb, fb2),
+        )
+
+    espec = P(NODES_AXIS, None, None)
+
+    def step(state: SparseTrainState, esrc, edst, emask, bsl, bdd, bmm):
+        # check_vma=False: the shared sparse kernels build their scan
+        # carries/scatter targets as replicated zeros accumulated with
+        # shard-varying values, which the replication checker cannot
+        # type; the semantics are pinned by the single-chip-equivalence
+        # tests (tests/test_sparse.py)
+        w, ids, sumF, llh, it, hist, cnt, fb = shard_map(
+            step_shard,
+            mesh=mesh,
+            in_specs=(
+                P(NODES_AXIS, None),
+                P(NODES_AXIS, None),
+                P(),
+                espec, espec, espec,
+                espec, espec, espec,
+            ),
+            out_specs=(
+                P(NODES_AXIS, None), P(NODES_AXIS, None),
+                P(), P(), P(), P(), P(), P(),
+            ),
+            check_vma=False,
+        )(state.ids, state.F, state.it, esrc, edst, emask, bsl, bdd, bmm)
+        return SparseTrainState(
+            F=w, ids=ids, sumF=sumF, llh=llh, it=it,
+            accept_hist=hist, comm_ids=cnt, comm_dense=fb,
+        )
+
+    # edge/block arrays as jit ARGUMENTS (multi-controller: no closing
+    # over non-addressable-device arrays; see make_sharded_train_step)
+    jitted = jax.jit(step)
+    fixed = (
+        edges.src, edges.dst, edges.mask,
+        blocks[0], blocks[1], blocks[2],
+    )
+
+    def step_fn(state):
+        return jitted(state, *fixed)
+
+    step_fn.jitted = jitted
+    step_fn.jit_args = fixed
+    return attach_donating(step_fn, step, fixed_args=fixed)
+
+
+class SparseShardedBigClamModel(SparseBigClamModel):
+    """Multi-chip sparse-representation trainer over the "nodes" axis.
+
+    Usage:
+        mesh = make_mesh((dp, 1))
+        model = SparseShardedBigClamModel(graph, cfg, mesh)
+        result = model.fit(F0)       # F0: dense (N, K) init, sparsified
+    """
+
+    def __init__(
+        self, g: Graph, cfg: BigClamConfig, mesh: Mesh, dtype=None,
+        balance: bool = False,
+    ):
+        if mesh.shape[K_AXIS] != 1:
+            raise ValueError(
+                "the sparse representation does not shard the K axis "
+                f"(mesh has tp={mesh.shape[K_AXIS]}): member rows are "
+                "M-wide regardless of K — use a (dp, 1) mesh"
+            )
+        if balance:
+            raise ValueError(
+                "balance=True is not supported on the sparse sharded "
+                "trainer yet; pre-balance at ingest (cli ingest "
+                "--balance) instead"
+            )
+        self.mesh = mesh
+        self.dp = mesh.shape[NODES_AXIS]
+        super().__init__(g, cfg, dtype=dtype)
+
+    def _path_reason(self) -> str:
+        return (
+            f"representation=sparse M={self.m} comm={self.comm_mode} "
+            f"cap={self.comm_cap}"
+        )
+
+    # ------------------------------------------------------------ build
+    def _setup(self) -> None:
+        g, cfg, dp = self.g, self.cfg, self.dp
+        # support blocks cannot straddle shards: cap the block size at
+        # the per-shard row count (the parent sized it against the whole
+        # graph, which would hand shard 0 every row on small graphs)
+        self.block_b = sm.pick_block_b(
+            cfg.sparse_score_block, -(-g.num_nodes // dp), self.m,
+            g.num_directed_edges / max(g.num_nodes, 1),
+        )
+        # whole support blocks per shard: every shard owns an equal
+        # number of block_b-row blocks
+        self.n_pad = _round_up(max(g.num_nodes, dp), dp * self.block_b)
+        espec = NamedSharding(self.mesh, P(NODES_AXIS, None, None))
+        bound = edge_chunk_bound(cfg, self.m, self.dtype)
+        eh = shard_edges(
+            g, cfg, dp, self.n_pad, np.float32, chunk_bound=bound
+        )
+        self._edges = EdgeChunks(
+            src=put_sharded(eh.src, espec),
+            dst=put_sharded(eh.dst, espec),
+            mask=put_sharded(eh.mask.astype(self.dtype), espec),
+        )
+        sl, dd, mm = sm.support_blocks_host(g, self.n_pad, self.block_b)
+        bps = (self.n_pad // self.block_b) // dp
+        eb = sl.shape[1]
+        self._blocks = (
+            put_sharded(sl.reshape(dp, bps, eb), espec),
+            put_sharded(dd.reshape(dp, bps, eb), espec),
+            put_sharded(mm.reshape(dp, bps, eb).astype(self.dtype), espec),
+        )
+        # collective capacity: a build-time guess of one M row per shard
+        # with slack; _on_init_sparsified refines it from the REAL
+        # initial touched counts and rebuilds the step when it moves
+        self._set_comm(max(self.m, 8))
+        self._step, self.engaged_path = self._make_step()
+
+    def _set_comm(self, touched_per_shard: int) -> None:
+        cfg = self.cfg
+        if cfg.sparse_comm_cap > 0:
+            self.comm_cap = min(
+                _round_up(cfg.sparse_comm_cap, 8), self.k_pad
+            )
+        else:
+            self.comm_cap = auto_cap(
+                touched_per_shard, self.k_pad, cfg.sparse_cap_slack, self.m
+            )
+        self.comm_mode = static_mode(
+            self.comm_cap, self.k_pad, cfg.sparse_dense_fallback
+        )
+
+    def _make_step(self):
+        return (
+            make_sparse_sharded_step(
+                self.mesh, self._edges, self._blocks, self.cfg,
+                self.k_pad, self.m, self.comm_cap, self.comm_mode,
+                self.block_b,
+            ),
+            f"sparse_xla_{'spall' if self.comm_mode == 'sparse' else 'psum'}",
+        )
+
+    def _step_key(self):
+        # the collective layout is baked into the compiled step but not
+        # into the config (auto cap): key it explicitly so rebuild_step
+        # caches per (cfg, cap, mode)
+        return (step_cfg_key(self.cfg), self.comm_cap, self.comm_mode)
+
+    def _on_init_sparsified(self, ids: np.ndarray) -> None:
+        """Size the exchange cap from the initial per-shard touched
+        counts (sparse_cap_slack headroom for support growth), then
+        rebuild the step if the collective layout moved."""
+        counts = shard_touched_counts(ids, self.dp, self.k_pad)
+        worst = int(counts.max()) if counts.size else 1
+        old = (self.comm_cap, self.comm_mode)
+        self._set_comm(worst)
+        if (self.comm_cap, self.comm_mode) != old:
+            self.rebuild_step()
+            self.path_reason = (
+                f"representation=sparse M={self.m} comm={self.comm_mode} "
+                f"cap={self.comm_cap} (auto from {worst} touched/shard)"
+            )
+            log_engaged_path(
+                type(self).__name__, self.engaged_path, self.path_reason
+            )
+
+    # ------------------------------------------------------------ state
+    def _place(self, ids: np.ndarray, w: np.ndarray):
+        spec = NamedSharding(self.mesh, P(NODES_AXIS, None))
+        return (
+            put_sharded(np.asarray(ids, np.int32), spec),
+            put_sharded(np.asarray(w, self.dtype), spec),
+        )
+
+    def extract_F(self, state: SparseTrainState) -> np.ndarray:
+        return sm.to_dense(
+            fetch_global(state.ids), fetch_global(state.F),
+            self.g.num_nodes, self.cfg.num_communities,
+        )
+
+    def last_comm(self, state: SparseTrainState):
+        """(max touched ids exchanged, dense-fallback flag) of the last
+        step — the exchange-volume counters the gates assert."""
+        return int(state.comm_ids), bool(int(state.comm_dense))
+
+    # ------------------------------------------------------ checkpoints
+    def _ckpt_meta(self) -> dict:
+        meta = super()._ckpt_meta()
+        # a different shard count pads rows differently; slot arrays are
+        # cropped nowhere, so refuse rather than re-pad
+        meta["node_shards"] = self.dp
+        return meta
+
+    def _state_to_arrays(self, state: SparseTrainState) -> dict:
+        return {
+            "F": fetch_global(state.F),
+            "ids": fetch_global(state.ids),
+            "sumF": np.asarray(state.sumF),
+            "llh": np.asarray(state.llh),
+            "it": np.asarray(state.it),
+        }
+
+    def _state_from_arrays(self, arrays: dict) -> SparseTrainState:
+        if "ids" not in arrays:
+            raise ValueError(
+                "checkpoint holds no member-id array: dense-representation "
+                "checkpoints cannot resume a sparse fit"
+            )
+        ids, w = self._place(arrays["ids"], arrays["F"])
+        return SparseTrainState(
+            F=w,
+            ids=ids,
+            sumF=jnp.asarray(arrays["sumF"], self.dtype),
+            llh=jnp.asarray(arrays["llh"], self.dtype),
+            it=jnp.asarray(arrays["it"], jnp.int32),
+            accept_hist=jnp.zeros(
+                len(self.cfg.step_candidates) + 1, jnp.int32
+            ),
+            comm_ids=jnp.zeros((), jnp.int32),
+            comm_dense=jnp.zeros((), jnp.int32),
+        )

@@ -1,0 +1,602 @@
+"""Sparse top-M affiliation representation tests (ISSUE 7): dense parity
+at M >= K, the M < K LLH band, the sparse allreduce == dense psum
+contract, exchange-volume counters, M-not-K memory scaling, the two-array
+checkpoint/rollback satellites, and the perf-ledger representation axis.
+
+All single-process on the 8-device CPU fake (conftest) — the collective
+equivalence tests run despite the jax 0.4.37 two-process skip.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from bigclam_tpu.config import BigClamConfig
+from bigclam_tpu.models import BigClamModel, SparseBigClamModel
+from bigclam_tpu.models.agm import sample_planted_graph
+from bigclam_tpu.models.bigclam import step_cfg_key
+from bigclam_tpu.ops import sparse_members as sm
+from bigclam_tpu.parallel import SparseShardedBigClamModel, make_mesh
+from bigclam_tpu.parallel.sparse_collectives import (
+    auto_cap,
+    sparse_allreduce_sum,
+    static_mode,
+)
+from bigclam_tpu.parallel.sparse_sharded import shard_touched_counts
+from bigclam_tpu.utils import CheckpointManager
+from bigclam_tpu.utils.compat import shard_map
+
+
+def _cfg(k, **kw):
+    kw.setdefault("dtype", "float64")
+    kw.setdefault("max_iters", 6)
+    kw.setdefault("conv_tol", 0.0)
+    kw.setdefault("use_pallas", False)
+    kw.setdefault("use_pallas_csr", False)
+    return BigClamConfig(num_communities=k, **kw)
+
+
+def _sparse_cfg(k, m, **kw):
+    return _cfg(k, representation="sparse", sparse_m=m, **kw)
+
+
+@pytest.fixture(scope="module")
+def planted():
+    """Planted AGM blocks + a community-localized init: each node starts
+    in exactly its planted community (the power-law-sparse membership
+    regime the representation targets)."""
+    g, truth = sample_planted_graph(
+        1024, 256, p_in=0.6, rng=np.random.default_rng(11)
+    )
+    F0 = np.zeros((g.num_nodes, 256))
+    for c, nodes in enumerate(truth):
+        F0[nodes, c] = 1.0
+    return g, F0
+
+
+@pytest.fixture(scope="module")
+def small(toy_graphs):
+    g = toy_graphs["two_cliques"]
+    F0 = np.random.default_rng(5).uniform(0.1, 1.0, size=(g.num_nodes, 4))
+    return g, F0
+
+
+# --------------------------------------------------------------------------
+# representation primitives
+# --------------------------------------------------------------------------
+
+
+def test_from_dense_to_dense_roundtrip():
+    rng = np.random.default_rng(0)
+    F = rng.uniform(0.0, 1.0, size=(13, 9))
+    F[F < 0.4] = 0.0                           # sparse rows
+    ids, w, truncated = sm.from_dense(F, m=9, k_pad=9, n_pad=16)
+    assert truncated == 0
+    assert ids.shape == (16, 9) and w.shape == (16, 9)
+    # ids sorted ascending per row, sentinels (== k_pad) last
+    assert np.all(np.diff(ids, axis=1) >= 0)
+    back = sm.to_dense(ids, w, 13, 9)
+    np.testing.assert_array_equal(back, F)
+
+
+def test_from_dense_truncation_keeps_top_m():
+    F = np.array([[0.9, 0.1, 0.5, 0.3]])
+    ids, w, truncated = sm.from_dense(F, m=2, k_pad=4, n_pad=1)
+    assert truncated == 2
+    back = sm.to_dense(ids, w, 1, 4)
+    np.testing.assert_array_equal(back, [[0.9, 0.0, 0.5, 0.0]])
+
+
+def test_sparse_sumf_and_presence_match_dense():
+    rng = np.random.default_rng(1)
+    F = rng.uniform(0.0, 1.0, size=(40, 12))
+    F[F < 0.6] = 0.0
+    ids, w, _ = sm.from_dense(F, m=12, k_pad=12, n_pad=40)
+    sumF = np.asarray(sm.sparse_sumF(jnp.asarray(ids), jnp.asarray(w), 12))
+    np.testing.assert_allclose(sumF, F.sum(axis=0), rtol=1e-6)
+    pres = np.asarray(sm.presence(jnp.asarray(ids), 12))
+    np.testing.assert_array_equal(pres, (F > 0).any(axis=0))
+
+
+def test_support_update_admits_neighbor_communities(toy_graphs):
+    """A node whose neighbor holds community c gains a slot for c (at
+    weight 0 — its first gradient step then matches the dense path)."""
+    g = toy_graphs["star"]                     # 0 -- {1,2,3,4}
+    k_pad, m = 6, 4
+    F = np.zeros((g.num_nodes, k_pad))
+    F[1, 2] = 0.7                              # only node 1 has mass, in c=2
+    ids, w, _ = sm.from_dense(F, m, k_pad, 8)
+    blocks = sm.build_support_blocks(g, 8, 8)
+    ids2, w2 = sm.support_update(
+        jnp.asarray(ids), jnp.asarray(w), blocks, m, k_pad
+    )
+    ids2, w2 = np.asarray(ids2), np.asarray(w2)
+    assert 2 in ids2[0]                        # hub admitted c=2
+    assert w2[0][ids2[0] == 2] == 0.0          # at zero weight
+    assert 2 in ids2[1] and w2[1][ids2[1] == 2] == 0.7   # kept exactly
+    assert 2 not in ids2[3]                    # leaves 2..4 see no mass at
+    # their own row BUT their neighbor (the hub) has none either — only
+    # node 1's neighbors (the hub) admit
+
+
+# --------------------------------------------------------------------------
+# parity: M >= K reproduces the dense trajectory
+# --------------------------------------------------------------------------
+
+
+def test_m_ge_k_trajectory_matches_dense(small):
+    g, F0 = small
+    iters = 8
+    dm = BigClamModel(g, _cfg(4, max_iters=iters))
+    ds = dm.init_state(F0)
+    sp = SparseBigClamModel(g, _sparse_cfg(4, 4, max_iters=iters))
+    ss = sp.init_state(F0)
+    for _ in range(iters):
+        ds = dm._step(ds)
+        ss = sp._step(ss)
+        np.testing.assert_allclose(
+            float(ss.llh), float(ds.llh), rtol=1e-11
+        )
+    np.testing.assert_allclose(
+        sp.extract_F(ss), dm.extract_F(ds), rtol=1e-10, atol=1e-12
+    )
+
+
+def test_m_ge_k_fit_parity_and_convergence(small):
+    g, F0 = small
+    cfg_d = _cfg(4, max_iters=60, conv_tol=1e-6)
+    rd = BigClamModel(g, cfg_d).fit(F0)
+    rs = SparseBigClamModel(
+        g, _sparse_cfg(4, 7, max_iters=60, conv_tol=1e-6)   # M > K clamps
+    ).fit(F0)
+    assert rs.num_iters == rd.num_iters
+    np.testing.assert_allclose(rs.llh, rd.llh, rtol=1e-11)
+    np.testing.assert_allclose(rs.F, rd.F, rtol=1e-9, atol=1e-12)
+    np.testing.assert_allclose(
+        rs.llh_history, rd.llh_history, rtol=1e-11
+    )
+
+
+def test_m_lt_k_llh_band(planted):
+    """Capacity-bounded M < K on the planted-anchor graph: the sparse
+    fit's LLH stays within a few percent of the dense fit's."""
+    g, F0 = planted
+    k = 256
+    cfg_d = _cfg(k, dtype="float32", max_iters=10)
+    rd = BigClamModel(g, cfg_d).fit(F0)
+    rs = SparseBigClamModel(
+        g, _sparse_cfg(k, 8, dtype="float32", max_iters=10)
+    ).fit(F0)
+    assert np.isfinite(rs.llh)
+    assert abs(1.0 - rs.llh / rd.llh) < 0.05
+
+
+def test_effective_m_clamps_to_k():
+    from bigclam_tpu.models.sparse import effective_m
+
+    assert effective_m(_sparse_cfg(4, 64)) == 4
+    assert effective_m(_sparse_cfg(100, 64)) == 64
+
+
+def test_sparse_requires_min_f_zero(small):
+    g, _ = small
+    with pytest.raises(ValueError, match="min_f"):
+        SparseBigClamModel(g, _sparse_cfg(4, 4).replace(min_f=0.1))
+    with pytest.raises(ValueError, match="representation"):
+        SparseBigClamModel(g, _cfg(4))
+
+
+def test_donation_bit_identity(small):
+    g, F0 = small
+    r_on = SparseBigClamModel(
+        g, _sparse_cfg(4, 4, donate_state=True, max_iters=10)
+    ).fit(F0)
+    r_off = SparseBigClamModel(
+        g, _sparse_cfg(4, 4, donate_state=False, max_iters=10)
+    ).fit(F0)
+    np.testing.assert_array_equal(r_on.F, r_off.F)
+    assert r_on.llh_history == r_off.llh_history
+
+
+# --------------------------------------------------------------------------
+# memory: HBM scales with M, not K
+# --------------------------------------------------------------------------
+
+
+def test_affiliation_state_bytes_scale_with_m_not_k():
+    g, _ = sample_planted_graph(
+        10_000, 1000, p_in=0.6, rng=np.random.default_rng(2)
+    )
+    sizes = {}
+    for k in (1000, 5000):
+        cfg = _sparse_cfg(k, 64, dtype="float32")
+        model = SparseBigClamModel(g, cfg)
+        F0 = np.zeros((g.num_nodes, k), np.float32)
+        F0[:, :8] = np.random.default_rng(0).uniform(
+            0.1, 1.0, size=(g.num_nodes, 8)
+        )
+        state = model.init_state(F0)
+        assert state.F.shape[1] == 64 and state.ids.shape[1] == 64
+        sizes[k] = model.state_nbytes(state)
+        # shape-based figure (what bench quotes without materializing a
+        # state) must agree with the measured one
+        assert model.state_nbytes() == sizes[k]
+    # ids+w are K-independent; only the (K,) sumF grows — 16 KB on MBs
+    assert sizes[5000] / sizes[1000] < 1.05, sizes
+    dense_ratio = (10_000 * 5000 * 4) / (10_000 * 1000 * 4)
+    assert dense_ratio == 5.0
+
+
+# --------------------------------------------------------------------------
+# sparse allreduce == dense psum
+# --------------------------------------------------------------------------
+
+
+def _run_allreduce(vals, pres, cap, k_pad, dp=4):
+    mesh = Mesh(np.asarray(jax.devices()[:dp]).reshape(dp, 1),
+                ("nodes", "k"))
+
+    def body(v, p):
+        out, cnt, fb = sparse_allreduce_sum(
+            v[0], p[0], cap, "nodes", k_pad
+        )
+        return out, cnt, fb
+
+    f = shard_map(
+        body, mesh=mesh,
+        in_specs=(P("nodes", None), P("nodes", None)),
+        out_specs=(P(), P(), P()),
+        check_vma=False,
+    )
+    out, cnt, fb = jax.jit(f)(jnp.asarray(vals), jnp.asarray(pres))
+    return np.asarray(out), int(cnt), int(fb)
+
+
+def test_sparse_allreduce_matches_psum_exactly():
+    rng = np.random.default_rng(3)
+    dp, k_pad, cap = 4, 64, 24
+    # integer-valued floats: addition is exact, so == is meaningful
+    vals = np.zeros((dp, k_pad))
+    pres = np.zeros((dp, k_pad), bool)
+    for i in range(dp):
+        touched = rng.choice(k_pad, size=10, replace=False)
+        pres[i, touched] = True
+        vals[i, touched] = rng.integers(1, 100, size=10).astype(float)
+    out, cnt, fb = _run_allreduce(vals, pres, cap, k_pad)
+    np.testing.assert_array_equal(out, vals.sum(axis=0))
+    assert cnt == 10 and fb == 0
+
+
+def test_sparse_allreduce_overflow_falls_back_dense():
+    rng = np.random.default_rng(4)
+    dp, k_pad, cap = 4, 64, 8            # cap < touched: must overflow
+    vals = rng.integers(0, 50, size=(dp, k_pad)).astype(float)
+    pres = vals > 0
+    out, cnt, fb = _run_allreduce(vals, pres, cap, k_pad)
+    np.testing.assert_array_equal(out, vals.sum(axis=0))   # still exact
+    assert fb == 1 and cnt > cap
+
+
+def test_auto_cap_and_static_mode():
+    assert auto_cap(10, 1000, 2.0, 64) == 64      # never below one M row
+    assert auto_cap(100, 1000, 2.0, 64) == 200
+    assert auto_cap(900, 1000, 2.0, 64) == 1000   # clamped to K
+    assert static_mode(200, 1000, 0.5) == "sparse"
+    assert static_mode(600, 1000, 0.5) == "dense"
+    assert static_mode(16, 16, 0.5) == "dense"
+
+
+# --------------------------------------------------------------------------
+# sharded trainer
+# --------------------------------------------------------------------------
+
+
+def test_sharded_matches_single_chip(planted):
+    g, F0 = planted
+    k = 256
+    cfg = _sparse_cfg(k, 16, max_iters=4)
+    single = SparseBigClamModel(g, cfg)
+    rs1 = single.fit(F0)
+    mesh = make_mesh((8, 1), jax.devices())
+    sharded = SparseShardedBigClamModel(g, cfg, mesh)
+    rs8 = sharded.fit(F0)
+    assert sharded.comm_mode == "sparse"           # the collective engaged
+    np.testing.assert_allclose(rs8.llh, rs1.llh, rtol=1e-11)
+    np.testing.assert_allclose(rs8.F, rs1.F, rtol=1e-9, atol=1e-12)
+
+
+def test_sharded_exchange_volume_much_less_than_k(planted):
+    """The sparse allreduce exchanges only touched community ids: the
+    counter stays well under K on the planted workload, with no dense
+    fallback."""
+    g, F0 = planted
+    k = 256
+    mesh = make_mesh((8, 1), jax.devices())
+    model = SparseShardedBigClamModel(g, _sparse_cfg(k, 16), mesh)
+    state = model.init_state(F0)
+    assert model.comm_mode == "sparse"
+    for _ in range(3):
+        state = model._step(state)
+    exchanged, fell_back = model.last_comm(state)
+    assert not fell_back
+    assert 0 < exchanged <= model.comm_cap
+    assert exchanged < k // 2, (exchanged, k)
+
+
+def test_sharded_collective_paths_bit_identical(planted):
+    """Forcing the dense psum (sparse_dense_fallback=0) changes the wire
+    pattern, not the math."""
+    g, F0 = planted
+    k = 256
+    mesh = make_mesh((4, 1), jax.devices()[:4])
+    cfg = _sparse_cfg(k, 16, max_iters=3)
+    m_sp = SparseShardedBigClamModel(g, cfg, mesh)
+    m_ps = SparseShardedBigClamModel(
+        g, cfg.replace(sparse_dense_fallback=0.0), mesh
+    )
+    assert m_sp.engaged_path == "sparse_xla_spall"
+    assert m_ps.engaged_path == "sparse_xla_psum"
+    r_sp, r_ps = m_sp.fit(F0), m_ps.fit(F0)
+    np.testing.assert_array_equal(r_sp.F, r_ps.F)
+    assert r_sp.llh_history == r_ps.llh_history
+
+
+def test_sharded_refuses_k_axis_and_balance(planted):
+    g, F0 = planted
+    with pytest.raises(ValueError, match="K axis"):
+        SparseShardedBigClamModel(
+            g, _sparse_cfg(256, 16), make_mesh((4, 2), jax.devices())
+        )
+    with pytest.raises(ValueError, match="balance"):
+        SparseShardedBigClamModel(
+            g, _sparse_cfg(256, 16),
+            make_mesh((4, 1), jax.devices()[:4]), balance=True,
+        )
+
+
+def test_shard_touched_counts():
+    ids = np.array(
+        [[0, 1, 8], [1, 2, 8], [4, 8, 8], [4, 5, 6]], dtype=np.int32
+    )
+    np.testing.assert_array_equal(
+        shard_touched_counts(ids, 2, 8), [3, 3]
+    )
+    np.testing.assert_array_equal(
+        shard_touched_counts(ids, 4, 8), [2, 2, 1, 3]
+    )
+
+
+# --------------------------------------------------------------------------
+# checkpoint / rollback satellites (two-array sparse state)
+# --------------------------------------------------------------------------
+
+
+def test_checkpoint_resume_bit_identity(small, tmp_path):
+    g, F0 = small
+    cfg = _sparse_cfg(4, 4, max_iters=10, checkpoint_every=3)
+    full = SparseBigClamModel(g, cfg).fit(
+        F0, checkpoints=CheckpointManager(str(tmp_path / "a"))
+    )
+    # interrupted twin: run to iter 6, then a FRESH model resumes from
+    # the saved two-array state and finishes — bit-identical F
+    ckpt = CheckpointManager(str(tmp_path / "b"))
+    SparseBigClamModel(g, cfg.replace(max_iters=6)).fit(F0, checkpoints=ckpt)
+    assert ckpt.latest_valid_step() == 6
+    resumed = SparseBigClamModel(g, cfg).fit(F0, checkpoints=ckpt)
+    np.testing.assert_array_equal(resumed.F, full.F)
+    assert resumed.llh == full.llh
+
+
+def test_checkpoint_sidecar_crcs_cover_both_arrays(small, tmp_path):
+    g, F0 = small
+    cfg = _sparse_cfg(4, 4, max_iters=4, checkpoint_every=2)
+    ckpt = CheckpointManager(str(tmp_path / "c"))
+    SparseBigClamModel(g, cfg).fit(F0, checkpoints=ckpt)
+    step = ckpt.latest_step()
+    with open(ckpt._path(step) + ".json") as f:
+        sidecar = json.load(f)
+    assert {"F", "ids", "sumF"} <= set(sidecar["array_crc32"])
+    assert sidecar["representation"] == "sparse"
+    assert sidecar["sparse_m"] == 4
+
+
+def test_corrupted_newest_checkpoint_falls_back(small, tmp_path):
+    g, F0 = small
+    cfg = _sparse_cfg(4, 4, max_iters=8, checkpoint_every=2)
+    ckpt = CheckpointManager(str(tmp_path / "d"))
+    SparseBigClamModel(g, cfg).fit(F0, checkpoints=ckpt)
+    newest = ckpt.latest_step()
+    # flip bytes mid-payload: the per-array crc catches it and restore
+    # falls back to the next-older checkpoint
+    path = ckpt._path(newest)
+    blob = bytearray(open(path, "rb").read())
+    blob[len(blob) // 2] ^= 0xFF
+    open(path, "wb").write(bytes(blob))
+    ckpt2 = CheckpointManager(str(tmp_path / "d"))
+    restored = ckpt2.restore()
+    assert restored is not None
+    assert restored[0] < newest
+
+
+def test_dense_checkpoint_refuses_sparse_resume(small, tmp_path):
+    g, F0 = small
+    dense_ckpt = CheckpointManager(str(tmp_path / "e"))
+    BigClamModel(g, _cfg(4, max_iters=4, checkpoint_every=2)).fit(
+        F0, checkpoints=dense_ckpt
+    )
+    with pytest.raises(ValueError, match="representation|member-id"):
+        SparseBigClamModel(g, _sparse_cfg(4, 4, max_iters=6)).fit(
+            F0, checkpoints=dense_ckpt
+        )
+
+
+def test_sparse_checkpoint_refuses_different_m(small, tmp_path):
+    g, F0 = small
+    ckpt = CheckpointManager(str(tmp_path / "f"))
+    SparseBigClamModel(
+        g, _sparse_cfg(4, 4, max_iters=4, checkpoint_every=2)
+    ).fit(F0, checkpoints=ckpt)
+    with pytest.raises(ValueError, match="sparse_m"):
+        SparseBigClamModel(
+            g, _sparse_cfg(4, 2, max_iters=6)
+        ).fit(F0, checkpoints=ckpt)
+
+
+def test_sharded_checkpoint_roundtrip(planted, tmp_path):
+    g, F0 = planted
+    cfg = _sparse_cfg(256, 16, max_iters=4, checkpoint_every=2)
+    mesh = make_mesh((4, 1), jax.devices()[:4])
+    ckpt = CheckpointManager(str(tmp_path / "g"))
+    full = SparseShardedBigClamModel(g, cfg, mesh).fit(
+        F0, checkpoints=CheckpointManager(str(tmp_path / "h"))
+    )
+    SparseShardedBigClamModel(g, cfg.replace(max_iters=2), mesh).fit(
+        F0, checkpoints=ckpt
+    )
+    resumed = SparseShardedBigClamModel(g, cfg, mesh).fit(
+        F0, checkpoints=ckpt
+    )
+    np.testing.assert_array_equal(resumed.F, full.F)
+
+
+@pytest.mark.chaos
+def test_nan_rollback_recovers_sparse_fit(small):
+    """The in-HBM rollback snapshot ping-pong handles the two-array
+    sparse state: an injected NaN rolls back and the fit converges
+    finitely."""
+    from bigclam_tpu.resilience import FaultPlan, install_plan
+
+    g, F0 = small
+    cfg = _sparse_cfg(
+        4, 4, max_iters=12,
+        rollback_budget=3, rollback_snapshot_every=2,
+    )
+    from bigclam_tpu.obs import RunTelemetry, install, uninstall
+
+    import tempfile
+
+    tdir = tempfile.mkdtemp(prefix="sparse_rb_")
+    tel = install(RunTelemetry(tdir, entry="test", quiet=True))
+    install_plan(
+        FaultPlan([{"kind": "nan_inject", "site": "fit.step", "at": 5}])
+    )
+    try:
+        res = SparseBigClamModel(g, cfg).fit(F0)
+    finally:
+        install_plan(None)
+        tel.finalize()
+        uninstall(tel)
+    assert np.isfinite(res.llh)
+    assert np.isfinite(res.F).all()
+    from bigclam_tpu.obs.telemetry import EVENTS_NAME
+
+    events = [
+        json.loads(line)
+        for line in open(os.path.join(tdir, EVENTS_NAME))
+        if line.strip()
+    ]
+    rb = [e for e in events if e["kind"] == "rollback"]
+    assert len(rb) == 1 and rb[0]["rollbacks"] == 1
+    # the rollback's cut Armijo ladder changes the replayed trajectory —
+    # no clean-run bit comparison; the contract is finite recovery on the
+    # TWO-ARRAY state (F + ids both restored from the snapshot ping-pong)
+
+
+# --------------------------------------------------------------------------
+# step identity + perf-ledger representation axis
+# --------------------------------------------------------------------------
+
+
+def test_step_cfg_key_carries_representation_knobs():
+    base = _cfg(8)
+    assert step_cfg_key(base) != step_cfg_key(
+        base.replace(representation="sparse")
+    )
+    sp = _sparse_cfg(8, 16)
+    assert step_cfg_key(sp) != step_cfg_key(sp.replace(sparse_m=32))
+    assert step_cfg_key(sp) != step_cfg_key(sp.replace(support_every=4))
+    # host-only fields still normalize away
+    assert step_cfg_key(sp) == step_cfg_key(sp.replace(max_iters=99))
+
+
+def test_ledger_refuses_cross_representation_baseline():
+    from bigclam_tpu.obs import ledger as L
+
+    def rec(representation=None, sparse_m=None, run="r"):
+        report = {
+            "run": run, "entry": "fit", "wall_s": 1.0,
+            "fingerprint": {"host": "h", "backend": "cpu",
+                            "device_kind": "cpu"},
+            "compiles": {"count": 1, "by_key": {"X:abc": 1}},
+            "final": {
+                "n": 100, "edges": 300, "k": 16,
+                "representation": representation, "sparse_m": sparse_m,
+            },
+        }
+        return L.build_record(report, [0.01] * 4)
+
+    dense = rec("dense", run="a")
+    sparse = rec("sparse", 8, run="b")
+    old = rec(None, run="c")        # pre-field record (always dense)
+    assert dense["representation"] == "dense"
+    assert sparse["representation"] == "sparse" and sparse["sparse_m"] == 8
+    assert L.match_key(dense) != L.match_key(sparse)
+    assert L.match_key(dense) == L.match_key(old)      # dense continuity
+    led = L.PerfLedger(os.devnull)
+    assert led.baseline_for(sparse, [dense, sparse]) is None
+    assert led.baseline_for(dense, [sparse, dense]) is None
+    assert led.baseline_for(dense, [old, dense]) is old
+
+
+def test_cli_sparse_fit_records_representation(tmp_path):
+    from bigclam_tpu.cli import main as cli_main
+
+    rng = np.random.default_rng(0)
+    edges = set()
+    while len(edges) < 200:
+        u, v = rng.integers(0, 64, 2)
+        if u != v:
+            edges.add((min(int(u), int(v)), max(int(u), int(v))))
+    gpath = tmp_path / "g.txt"
+    gpath.write_text(
+        "".join(f"{u}\t{v}\n" for u, v in sorted(edges))
+    )
+    tdir = str(tmp_path / "telem")
+    import contextlib
+    import io
+
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        rc = cli_main([
+            "fit", "--graph", str(gpath), "--k", "8",
+            "--representation", "sparse", "--sparse-m", "4",
+            "--max-iters", "4", "--init", "random", "--quiet",
+            "--telemetry-dir", tdir,
+        ])
+    assert rc == 0
+    out = json.loads(buf.getvalue().strip().splitlines()[-1])
+    assert out["representation"] == "sparse" and out["sparse_m"] == 4
+    report = json.load(open(os.path.join(tdir, "run_report.json")))
+    assert report["final"]["representation"] == "sparse"
+
+
+def test_cli_sparse_refuses_csr_kernels_on(tmp_path):
+    # --csr-kernels on means REQUIRE the MXU path; the sparse trainers
+    # only have the XLA member-list merge, so the contract is an error,
+    # not a silent fallback
+    from bigclam_tpu.cli import main as cli_main
+
+    gpath = tmp_path / "g.txt"
+    gpath.write_text("0\t1\n1\t2\n2\t0\n")
+    with pytest.raises(SystemExit, match="csr-kernels on"):
+        cli_main([
+            "fit", "--graph", str(gpath), "--k", "4",
+            "--representation", "sparse", "--csr-kernels", "on",
+            "--max-iters", "2", "--init", "random", "--quiet",
+        ])
