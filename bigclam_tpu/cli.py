@@ -122,6 +122,17 @@ def _add_common(p: argparse.ArgumentParser) -> None:
              "JSONL)",
     )
     p.add_argument(
+        "--health-every", type=int, default=10,
+        help="iterations between device-fused model-health samples (grad/"
+             "update norms, effective Armijo step, dead communities, "
+             "membership churn; sparse runs add support churn + comm-cap "
+             "occupancy), emitted as `health` events with anomaly "
+             "detection (divergence/plateau/oscillation/dead-communities/"
+             "cap-pressure) when --telemetry-dir is active; render live "
+             "with `cli watch <dir>`. 0 disables — the step then computes "
+             "nothing and the trajectory is bit-identical either way",
+    )
+    p.add_argument(
         "--perf-ledger", default=None,
         help="append this run's perf record (step-time percentiles, eps, "
              "compile count, per-span totals, config/host digest) to a "
@@ -293,6 +304,7 @@ def _build(args, k: int):
         representation=getattr(args, "representation", "dense"),
         sparse_m=getattr(args, "sparse_m", 64),
         support_every=getattr(args, "support_every", 1),
+        health_every=max(getattr(args, "health_every", 0) or 0, 0),
     )
     g = _load_graph(args)
     return g, cfg
@@ -836,8 +848,16 @@ def cmd_perf(args) -> int:
 def cmd_report(args) -> int:
     """Render a telemetry directory human-readable (obs.report): merged
     per-process run reports, stage seconds, device-memory watermarks,
-    compile counts, stalls, and an events.jsonl schema check. Exit 1 when
-    artifacts are missing/invalid, so CI can gate on a telemetry dir."""
+    compile counts, stalls, model health + anomalies, and an events.jsonl
+    schema check. Exit 1 when artifacts are missing/invalid, so CI can
+    gate on a telemetry dir. --json emits the machine-readable merge
+    (obs.report.render_json) with the SAME exit-code contract."""
+    if getattr(args, "json", False):
+        from bigclam_tpu.obs.report import render_json
+
+        obj, errors = render_json(args.dir)
+        print(json.dumps(obj, sort_keys=True))
+        return 1 if errors else 0
     from bigclam_tpu.obs.report import render
 
     text, errors = render(args.dir)
@@ -845,6 +865,21 @@ def cmd_report(args) -> int:
     if errors:
         print(f"\n{errors} problem(s) found", file=sys.stderr)
     return 1 if errors else 0
+
+
+def cmd_watch(args) -> int:
+    """Live-tail a telemetry directory (obs.watch): LLH / grad-norm /
+    churn sparklines from the health events, anomalies, stalls, last-
+    write age. Reads events.jsonl only — safe to run from any host while
+    the fit is still going; exits when the run finalizes."""
+    from bigclam_tpu.obs.watch import watch
+
+    return watch(
+        args.dir,
+        interval=args.interval,
+        once=args.once,
+        width=args.width,
+    )
 
 
 def cmd_eval(args) -> int:
@@ -1019,7 +1054,31 @@ def main(argv=None) -> int:
              "event schema)",
     )
     p_rep.add_argument("dir", help="telemetry directory of a finished run")
+    p_rep.add_argument(
+        "--json", action="store_true",
+        help="machine-readable output (merged reports + events summary + "
+             "health/anomalies + recovery) for CI; exit codes unchanged",
+    )
     p_rep.set_defaults(fn=cmd_report)
+
+    p_watch = sub.add_parser(
+        "watch",
+        help="live-tail a telemetry dir: LLH/grad-norm/churn sparklines "
+             "from health events, anomalies, stalls (reads events.jsonl "
+             "only; exits when the run finalizes)",
+    )
+    p_watch.add_argument("dir", help="telemetry directory of a running run")
+    p_watch.add_argument(
+        "--interval", type=float, default=2.0,
+        help="seconds between refreshes",
+    )
+    p_watch.add_argument(
+        "--once", action="store_true",
+        help="render one frame and exit (CI / piped use)",
+    )
+    p_watch.add_argument("--width", type=int, default=48,
+                         help="sparkline width in samples")
+    p_watch.set_defaults(fn=cmd_watch)
 
     p_eval = sub.add_parser("eval", help="score predicted vs ground-truth communities")
     p_eval.add_argument("--pred", required=True)

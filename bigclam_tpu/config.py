@@ -301,6 +301,24 @@ class BigClamConfig:
     pallas_interpret: bool = False      # run Pallas kernels in interpret mode
                                         # (CPU testing of the kernel paths)
 
+    # --- model-health diagnostics (ops/diagnostics.py + obs/health.py;
+    # DESIGN.md "Model-health diagnostics") ---
+    health_every: int = 0               # iterations between device-fused
+                                        # health packs (grad/update norms,
+                                        # effective Armijo step, community
+                                        # mass stats, sparse support churn /
+                                        # cap occupancy) computed INSIDE the
+                                        # jitted step and emitted as `health`
+                                        # telemetry events. 0 = off: steps
+                                        # return health=None and the
+                                        # trajectory is bit-identical to the
+                                        # pre-health trainers. STEP-BAKED
+                                        # (not in _HOST_ONLY_FIELDS): two
+                                        # cadences never share a compiled
+                                        # step. The CLI defaults this to 10
+                                        # (--health-every; anomaly detection
+                                        # needs a telemetry dir to land in)
+
     # --- checkpointing / logging ---
     checkpoint_dir: Optional[str] = None
     checkpoint_every: int = 0           # iterations between checkpoints; 0 = off

@@ -230,6 +230,11 @@ class TrainState(NamedTuple):
     # whose Armijo ladder collapses to 1e-15 steps is indistinguishable
     # from a healthy one in the metrics.
     accept_hist: Optional[jax.Array] = None
+    # (ops.diagnostics.HEALTH_LEN,) float32 device health pack of the
+    # update that PRODUCED this state, computed inside the jitted step at
+    # the cfg.health_every cadence (ISSUE 8); None with health off — the
+    # pre-health pytree, bit-identical trajectory.
+    health: Optional[jax.Array] = None
 
 
 @dataclasses.dataclass(frozen=True)
@@ -343,6 +348,8 @@ def run_fit_loop(
     initial_hist: tuple = (),
     ckpt_meta: Optional[dict] = None,
     rebuild_step: Optional[Callable[[float], Callable]] = None,
+    health_sig: Optional[Callable] = None,
+    health_n: Optional[int] = None,
 ):
     """Shared convergence loop (MBSGD semantics, Bigclamv2.scala:203-219),
     used by both the single-chip and the sharded trainer.
@@ -413,6 +420,18 @@ def run_fit_loop(
     from bigclam_tpu.obs import trace as _trace
 
     tel = _obs.current()
+    # MODEL HEALTH (ISSUE 8): with telemetry active and cfg.health_every
+    # > 0, the steps carry a device health pack (ops.diagnostics) and the
+    # monitor turns the cadence samples into `health` events, membership
+    # churn against a rolling signature (health_sig — the trainer's
+    # state->top-community map), LLH-window derivatives, and `anomaly`
+    # events from the obs.health detectors. Off (either switch): one None
+    # check per iteration.
+    monitor = None
+    if tel is not None and int(getattr(cfg, "health_every", 0) or 0) > 0:
+        from bigclam_tpu.obs.health import HealthMonitor
+
+        monitor = HealthMonitor(cfg, tel, sig_fn=health_sig, n_live=health_n)
     # per-iteration phase spans (obs.trace, ISSUE 6): slash-named so they
     # group under "fit_loop/" beneath whatever span encloses the fit (the
     # CLI's "fit" stage). emit=False — exact per-phase totals in the run
@@ -530,6 +549,8 @@ def run_fit_loop(
         since_snap += 1
         if tel is not None:
             tel.step_beat(int(state.it), llh_t)
+        if monitor is not None:
+            monitor.maybe_observe(int(state.it), llh_t, new_state)
         if callback is not None:
             with _span("fit_loop/callback", emit=False):
                 if cb_arity >= 3:
@@ -758,6 +779,19 @@ def make_train_step(
     dispatches to the older Pallas VMEM kernel (ops.pallas_kernels) on TPU
     backends when the edge-chunk/K tiling constraints hold; cfg.use_pallas
     overrides that auto choice."""
+    from bigclam_tpu.ops import diagnostics as dx
+
+    def maybe_health(state, F_new, sumF_new, grad, hist):
+        """The ISSUE 8 health pack for the single-chip steps: computed in
+        the step body (grad rides into the pack's cond, so its reductions
+        run on cadence iterations only), None at trace time with health
+        off — zero added ops on the default path."""
+        if not dx.health_on(cfg):
+            return None
+        return dx.health_pack(
+            cfg, state.it, state.F, F_new, sumF_new, hist, grad=grad,
+        )
+
     if tiles is not None:
         from bigclam_tpu.ops.linesearch import armijo_select
         from bigclam_tpu.ops.objective import node_tail
@@ -792,6 +826,7 @@ def make_train_step(
             return TrainState(
                 F=F_new, sumF=sumF_new, llh=llh_cur, it=state.it + 1,
                 accept_hist=hist,
+                health=maybe_health(state, F_new, sumF_new, grad, hist),
             )
 
         if kblocked:
@@ -820,6 +855,7 @@ def make_train_step(
             return TrainState(
                 F=F_new, sumF=sumF_new, llh=llh_cur, it=state.it + 1,
                 accept_hist=hist,
+                health=maybe_health(state, F_new, sumF_new, grad, hist),
             )
 
         return finalize_step(csr_step), ("csr_grouped" if grouped else "csr")
@@ -839,6 +875,7 @@ def make_train_step(
         return TrainState(
             F=F_new, sumF=sumF_new, llh=llh_cur, it=state.it + 1,
             accept_hist=hist,
+            health=maybe_health(state, F_new, sumF_new, grad, hist),
         )
 
     return finalize_step(step), cand_path
@@ -1129,6 +1166,8 @@ class BigClamModel:
         """TrainState from an already-device-resident PADDED F — init_state
         minus the host upload (the device annealing loop's per-cycle state;
         single source of the state-field construction)."""
+        from bigclam_tpu.ops import diagnostics as dx
+
         return TrainState(
             F=F,
             sumF=F.sum(axis=0),
@@ -1137,12 +1176,21 @@ class BigClamModel:
             accept_hist=jnp.zeros(
                 len(self.cfg.step_candidates) + 1, jnp.int32
             ),
+            health=dx.init_health(self.cfg),
         )
 
     def extract_F(self, state: TrainState) -> np.ndarray:
         """Fetch the live (num_nodes, K) F block to the host."""
         n, k = self.g.num_nodes, self.cfg.num_communities
         return np.asarray(state.F[:n, :k])
+
+    def health_sig(self, state: TrainState) -> jax.Array:
+        """(N_pad,) int32 top-community signature — the rolling membership
+        snapshot obs.health churns against (padding rows are -1 forever,
+        so they never register as churn)."""
+        from bigclam_tpu.ops.diagnostics import dense_top_community
+
+        return dense_top_community(state.F)
 
     def _ckpt_meta(self) -> dict:
         return {
@@ -1167,6 +1215,8 @@ class BigClamModel:
         }
 
     def _state_from_arrays(self, arrays: dict) -> TrainState:
+        from bigclam_tpu.ops import diagnostics as dx
+
         return TrainState(
             F=jnp.asarray(arrays["F"], self.dtype),
             sumF=jnp.asarray(arrays["sumF"], self.dtype),
@@ -1175,6 +1225,7 @@ class BigClamModel:
             accept_hist=jnp.zeros(
                 len(self.cfg.step_candidates) + 1, jnp.int32
             ),
+            health=dx.init_health(self.cfg),
         )
 
     def fit(
@@ -1209,6 +1260,8 @@ class BigClamModel:
                 initial_hist=hist,
                 ckpt_meta=self._ckpt_meta(),
                 rebuild_step=rebuilder,
+                health_sig=self.health_sig,
+                health_n=self.g.num_nodes,
             )
         finally:
             rebuilder.restore()
@@ -1227,6 +1280,8 @@ class BigClamModel:
             return run_fit_loop(
                 self._step, state, self.cfg, callback, None,
                 rebuild_step=rebuilder,
+                health_sig=self.health_sig,
+                health_n=self.g.num_nodes,
             )
         finally:
             rebuilder.restore()

@@ -54,22 +54,27 @@ def effective_m(cfg: BigClamConfig) -> int:
 
 
 def make_sparse_train_step(
-    edges, blocks, cfg: BigClamConfig, k_pad: int, m: int
+    edges, blocks, cfg: BigClamConfig, k_pad: int, m: int,
+    n_live: Optional[int] = None,
 ):
     """One jitted sparse iteration (support update -> grad/LLH ->
     candidates -> Armijo -> sparse sumF); same step_fn contract as
-    make_train_step (finalize_step attaches .jitted / .donating)."""
+    make_train_step (finalize_step attaches .jitted / .donating).
+    `n_live` is the LIVE node count for the support-churn denominator
+    (padding rows have no edges and never admit, so the padded slot
+    count would dilute the fraction); None falls back to padded."""
     sup_every = max(int(cfg.support_every), 1)
+    from bigclam_tpu.ops import diagnostics as dx
 
     def step(state: SparseTrainState) -> SparseTrainState:
-        ids, w, it = state.ids, state.F, state.it
+        ids0, w0, it = state.ids, state.F, state.it
 
         def do_support(args):
             i, ww = args
             return sm.support_update(i, ww, blocks, m, k_pad)
 
         ids, w = jax.lax.cond(
-            it % sup_every == 0, do_support, lambda a: a, (ids, w)
+            it % sup_every == 0, do_support, lambda a: a, (ids0, w0)
         )
         # recompute rather than carry: a support update may DROP members
         # (M < K), and the O(K) scatter is noise next to the edge sweep
@@ -82,15 +87,34 @@ def make_sparse_train_step(
         w_new, hist = sm.sparse_armijo_update(
             ids, w, sumF, grad, node_llh, cand_nbr, cfg, k_pad
         )
+        sumF_new = sm.sparse_sumF(ids, w_new, k_pad)
+        health = None
+        if dx.health_on(cfg):
+            # support churn: fraction of LIVE member-id slots the
+            # admission pass rewrote — one cheap comparison per step,
+            # LATCHED (max-since-last-sample) so an off-cadence
+            # admission burst still shows in the next health sample;
+            # single-chip has no collectives, the cap slots stay NA.
+            # The expensive grad stats ride the pack's cadence cond.
+            slots = float(max(n_live or ids.shape[0], 1) * m)
+            churn = jnp.sum((ids != ids0).astype(jnp.float32)) / slots
+            extras, carry = dx.latch_extras(
+                state.health, {"support_churn": churn}
+            )
+            health = dx.health_pack(
+                cfg, it, w, w_new, sumF_new, hist, grad=grad,
+                extras=extras, skip_carry=carry,
+            )
         return SparseTrainState(
             F=w_new,
             ids=ids,
-            sumF=sm.sparse_sumF(ids, w_new, k_pad),
+            sumF=sumF_new,
             llh=llh_cur.astype(w.dtype),
             it=it + 1,
             accept_hist=hist,
             comm_ids=state.comm_ids,
             comm_dense=state.comm_dense,
+            health=health,
         )
 
     return finalize_step(step), "sparse_xla"
@@ -160,7 +184,8 @@ class SparseBigClamModel:
 
     def _make_step(self):
         return make_sparse_train_step(
-            self._edges, self._blocks, self.cfg, self.k_pad, self.m
+            self._edges, self._blocks, self.cfg, self.k_pad, self.m,
+            n_live=self.g.num_nodes,
         )
 
     def _step_key(self):
@@ -215,6 +240,8 @@ class SparseBigClamModel:
         from the initial per-shard touched counts here."""
 
     def reset_state(self, ids: jax.Array, w: jax.Array) -> SparseTrainState:
+        from bigclam_tpu.ops import diagnostics as dx
+
         return SparseTrainState(
             F=w,
             ids=ids,
@@ -226,6 +253,7 @@ class SparseBigClamModel:
             ),
             comm_ids=jnp.zeros((), jnp.int32),
             comm_dense=jnp.zeros((), jnp.int32),
+            health=dx.init_health(self.cfg),
         )
 
     def extract_F(self, state: SparseTrainState) -> np.ndarray:
@@ -235,6 +263,13 @@ class SparseBigClamModel:
             np.asarray(state.ids), np.asarray(state.F),
             self.g.num_nodes, self.cfg.num_communities,
         )
+
+    def health_sig(self, state: SparseTrainState) -> jax.Array:
+        """(N_pad,) int32 top-community signature from the member lists
+        (obs.health churn snapshot; -1 on empty rows)."""
+        from bigclam_tpu.ops.diagnostics import sparse_top_community
+
+        return sparse_top_community(state.ids, state.F)
 
     # ------------------------------------------------------ checkpoints
     def _ckpt_meta(self) -> dict:
@@ -268,6 +303,8 @@ class SparseBigClamModel:
             )
         ids = jnp.asarray(arrays["ids"], jnp.int32)
         w = jnp.asarray(arrays["F"], self.dtype)
+        from bigclam_tpu.ops import diagnostics as dx
+
         return SparseTrainState(
             F=w,
             ids=ids,
@@ -279,6 +316,7 @@ class SparseBigClamModel:
             ),
             comm_ids=jnp.zeros((), jnp.int32),
             comm_dense=jnp.zeros((), jnp.int32),
+            health=dx.init_health(self.cfg),
         )
 
     def _restore(self, checkpoints):
@@ -337,6 +375,8 @@ class SparseBigClamModel:
                 initial_hist=hist,
                 ckpt_meta=self._ckpt_meta(),
                 rebuild_step=rebuilder,
+                health_sig=self.health_sig,
+                health_n=self.g.num_nodes,
             )
         finally:
             rebuilder.restore()
@@ -353,6 +393,8 @@ class SparseBigClamModel:
             return run_fit_loop(
                 self._step, state, self.cfg, callback, None,
                 rebuild_step=rebuilder,
+                health_sig=self.health_sig,
+                health_n=self.g.num_nodes,
             )
         finally:
             rebuilder.restore()

@@ -25,6 +25,8 @@ regression gate with noise bands). Spans share the events.jsonl schema
 record per run when BIGCLAM_PERF_LEDGER is set.
 """
 
+from bigclam_tpu.obs.health import DEFAULTS as HEALTH_DEFAULTS
+from bigclam_tpu.obs.health import HealthMonitor, run_detectors
 from bigclam_tpu.obs.heartbeat import Heartbeat
 from bigclam_tpu.obs.ledger import LEDGER_ENV, PerfLedger
 from bigclam_tpu.obs.schema import (
@@ -44,6 +46,8 @@ from bigclam_tpu.obs.trace import add_span, open_spans, span, step_annotation
 
 __all__ = [
     "EVENT_KINDS",
+    "HEALTH_DEFAULTS",
+    "HealthMonitor",
     "Heartbeat",
     "LEDGER_ENV",
     "PerfLedger",
@@ -54,6 +58,7 @@ __all__ = [
     "install",
     "note_step_build",
     "open_spans",
+    "run_detectors",
     "span",
     "step_annotation",
     "uninstall",

@@ -116,6 +116,11 @@ class Heartbeat:
         # e.g. ["fit", "fit/fit_loop", "fit/fit_loop/sync"], innermost
         # last, instead of only "no progress for Ns"
         spans = _trace.open_spans()
+        # the last model-health snapshot (ISSUE 8 satellite), next to the
+        # open span stack: a stall report then distinguishes "stuck
+        # compiling / wedged collective" (healthy last snapshot) from
+        # "diverging" (grad norm exploding) — None when health is off
+        health = getattr(self.telemetry, "last_health", None)
         self.telemetry.event(
             "stall",
             silent_s=round(silent_s, 3),
@@ -123,6 +128,7 @@ class Heartbeat:
             progress=progress,
             devices=devices,
             spans=spans,
+            health=health,
         )
         if self.echo:
             where = f"; open span: {spans[-1]}" if spans else ""
@@ -142,6 +148,7 @@ class Heartbeat:
                 silent_s=round(silent_s, 3),
                 progress=progress,
                 spans=spans,
+                health=health,
             )
             if self.echo:
                 print(

@@ -50,6 +50,7 @@ from __future__ import annotations
 
 import hashlib
 import json
+import math
 import os
 import time
 from typing import Any, Dict, List, Optional, Sequence, Tuple
@@ -163,6 +164,33 @@ def build_record(
         },
         "final_llh": final.get("llh"),
     }
+    # convergence figures (ISSUE 8): a fit that still lands the same LLH
+    # but needs 3x the iterations — or stops with a grad norm an order of
+    # magnitude hotter — is a regression `cli perf diff` must catch even
+    # when per-step time is flat. iters_to_tol is the entry's recorded
+    # iteration count (fit converged at conv_tol; max_iters runs record
+    # the cap — same cfg, still comparable); final_grad_norm comes from
+    # the run's last health sample (None with health off).
+    iters = final.get("iters")
+    rec["iters_to_tol"] = int(iters) if isinstance(iters, _NUM) and not (
+        isinstance(iters, bool)
+    ) else None
+    health = report.get("health", {}) or {}
+    last_health = health.get("last") or {}
+    # non-finite -> None: the pack legitimately goes inf/nan mid-blow-up
+    # (schema.py), but the ledger line must stay strict JSON, and the
+    # `cli perf record` path (reading the finite-safed on-disk report,
+    # where non-finite is the string "inf") already records None — the
+    # finalize auto-append must agree
+    gn = last_health.get("grad_norm")
+    rec["final_grad_norm"] = (
+        _round6(float(gn))
+        if isinstance(gn, _NUM) and math.isfinite(float(gn))
+        else None
+    )
+    rec["anomalies"] = sum(
+        int(v) for v in (health.get("anomalies", {}) or {}).values()
+    )
     if note:
         rec["note"] = note
     return rec
@@ -376,6 +404,20 @@ def diff_records(
     ):
         check("hbm_frac", base["hbm_frac"], new["hbm_frac"],
               worse_if_higher=False)
+    # convergence verdicts (ISSUE 8): iteration count to tolerance is
+    # VERDICTED (same cfg + workload + seed ⇒ deterministic up to float
+    # summation order — growth past the band is a real optimizer
+    # regression, not timing noise); the final grad norm is reported as a
+    # finding (its scale is workload-dependent)
+    if isinstance(base.get("iters_to_tol"), _NUM) and isinstance(
+        new.get("iters_to_tol"), _NUM
+    ):
+        check("iters_to_tol", base["iters_to_tol"], new["iters_to_tol"])
+    if isinstance(base.get("final_grad_norm"), _NUM) and isinstance(
+        new.get("final_grad_norm"), _NUM
+    ):
+        check("final_grad_norm", base["final_grad_norm"],
+              new["final_grad_norm"], verdicted=False)
 
     # findings (reported, never verdicted): compile growth + span deltas
     compile_growth = int(new.get("compiles", 0)) - int(
@@ -399,6 +441,11 @@ def diff_records(
         "regression": state["regression"],
         "compile_growth": compile_growth,
         "span_deltas": deltas[:8],
+        # finding, not a verdict: anomaly events in the new run (the
+        # detectors already said WHAT; the diff just surfaces that the
+        # baseline was clean and the new run was not)
+        "anomalies_new": int(new.get("anomalies", 0) or 0),
+        "anomalies_base": int(base.get("anomalies", 0) or 0),
     }
 
 
@@ -426,6 +473,11 @@ def render_diff(d: Dict[str, Any]) -> str:
     if d.get("compile_growth"):
         lines.append(
             f"  note: compile count changed by {d['compile_growth']:+d}"
+        )
+    if d.get("anomalies_new") and not d.get("anomalies_base"):
+        lines.append(
+            f"  note: {d['anomalies_new']} health anomaly event(s) in the "
+            "new run (baseline was clean) — see `cli report`"
         )
     hot = [s for s in d.get("span_deltas", []) if s["ratio"] > 1.0]
     if hot:

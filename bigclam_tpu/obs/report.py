@@ -154,6 +154,27 @@ def merge_reports(reports: List[dict]) -> dict:
         ),
         "final": reports[0].get("final", {}),
     }
+    # model health (ISSUE 8): sample counts and anomaly tallies SUM
+    # across processes (each process's monitor watches the same global
+    # optimizer state, but only its own report records what it saw); the
+    # last-snapshot payload is the primary's (its events.jsonl carries
+    # the authoritative event stream)
+    health = {
+        "samples": sum(
+            int((r.get("health", {}) or {}).get("samples", 0))
+            for r in reports
+        ),
+        "last": (reports[0].get("health", {}) or {}).get("last"),
+        "anomalies": {},
+    }
+    for r in reports:
+        for check, n in ((r.get("health", {}) or {}).get(
+            "anomalies", {}
+        ) or {}).items():
+            health["anomalies"][check] = (
+                health["anomalies"].get(check, 0) + int(n)
+            )
+    merged["health"] = health
     device_peak: Dict[str, dict] = {}
     compiles = {"count": 0, "backend_compiles": 0, "step_builds": 0,
                 "backend_compile_s": 0.0, "by_key": {}}
@@ -208,6 +229,66 @@ def _fmt_bytes(v: Optional[int]) -> str:
     if v >= 1 << 20:
         return f"{v / (1 << 20):.1f} MiB"
     return f"{v} B"
+
+
+def render_json(directory: str) -> Tuple[dict, int]:
+    """(machine-readable report object, error count) — `cli report
+    --json` for CI consumption (ISSUE 8 satellite). Same inputs and the
+    same error accounting as render(), so the exit-code contract is
+    unchanged: errors > 0 ⇔ nonzero exit, anomalies/stalls are findings.
+    The object is strict JSON (no NaN/Infinity: events already went
+    through telemetry's _finite_safe at write time, and the merged
+    reports were serialized the same way)."""
+    reports = load_reports(directory)
+    events = load_events(directory)
+    if not reports and events is None:
+        return {"directory": directory, "error": "no telemetry artifacts",
+                "errors": 1}, 1
+    errors = 0
+    merged = merge_reports(reports)
+    if merged and merged["processes_reported"] < merged["processes_expected"]:
+        errors += 1
+    if merged and merged["events"].get("gave_up", 0):
+        errors += 1
+    if merged and merged.get("span_orphans"):
+        errors += 1
+    schema_errors: List[str] = []
+    n_events = 0
+    if events is not None:
+        n_events, schema_errors = validate_events_file(
+            os.path.join(directory, EVENTS_NAME)
+        )
+        errors += len(schema_errors)
+    anomalies = [
+        {k: v for k, v in e.items()
+         if k not in ("v", "run", "pid", "t", "ts")}
+        for e in (events or [])
+        if e.get("kind") == "anomaly"
+    ]
+    recovery_kinds = (
+        "retry", "recovered", "gave_up", "rollback", "quarantine",
+        "resume", "fault_injected", "stall_escalated",
+    )
+    out = {
+        "directory": directory,
+        "merged": merged,
+        "events": {
+            "count": n_events,
+            "kinds": summarize_kinds(events or []),
+            "duration_s": run_duration_s(events or []),
+        },
+        "health": (merged or {}).get("health", {}),
+        "anomalies": anomalies,
+        "recovery": {
+            k: (merged or {}).get("events", {}).get(k, 0)
+            for k in recovery_kinds
+            if (merged or {}).get("events", {}).get(k, 0)
+        },
+        "resume_lineage": _load_lineage(directory),
+        "schema_errors": schema_errors[:50],
+        "errors": errors,
+    }
+    return out, errors
 
 
 def render(directory: str) -> Tuple[str, int]:
@@ -383,6 +464,78 @@ def render(directory: str) -> Tuple[str, int]:
                 lines.append(
                     "  ERROR: run ended in gave_up (retry budget exhausted)"
                 )
+
+        # --- model health (ISSUE 8): the optimizer's last vital signs +
+        # fired anomaly detectors. Anomalies are FINDINGS, not schema
+        # errors — they never touch the exit code (gave_up stays the only
+        # outcome-level error).
+        health = merged.get("health", {}) or {}
+        if health.get("samples") or health.get("anomalies"):
+            lines.append("")
+            lines.append(f"model health: {health.get('samples', 0)} sample(s)")
+            last = health.get("last") or {}
+            if last:
+                parts = []
+                for key in (
+                    "llh", "grad_norm", "update_norm", "step_eff",
+                    "accept_frac", "top_share", "churn", "support_churn",
+                    "cap_occupancy",
+                ):
+                    v = last.get(key)
+                    if isinstance(v, (int, float)):
+                        parts.append(f"{key} {v:.4g}")
+                    elif isinstance(v, str):      # strict-JSON "inf"/"nan"
+                        parts.append(f"{key} {v}")
+                dead = last.get("dead_comms")
+                active = last.get("active_comms")
+                if dead is not None and active is not None:
+                    parts.append(f"dead {dead}/{int(dead) + int(active)}")
+                lines.append(
+                    f"  last (iter {last.get('iter', '?')}): "
+                    + "  ".join(parts)
+                )
+            anomalies = health.get("anomalies") or {}
+            if anomalies:
+                lines.append(
+                    "  ANOMALIES: "
+                    + ", ".join(
+                        f"{check} x{n}" for check, n in sorted(
+                            anomalies.items()
+                        )
+                    )
+                )
+                for e in (events or []):
+                    if e.get("kind") != "anomaly":
+                        continue
+                    detail = {
+                        k: v for k, v in e.items()
+                        if k not in ("v", "run", "pid", "t", "ts",
+                                     "elapsed_s", "kind", "check", "iter")
+                    }
+                    lines.append(
+                        f"    {e.get('check')} at iter {e.get('iter')}: "
+                        + json.dumps(detail)
+                    )
+            else:
+                lines.append("  anomalies: none")
+            comm = [
+                e for e in (events or []) if e.get("kind") == "sparse_comm"
+            ]
+            if comm:
+                c = comm[-1]
+                lines.append(
+                    f"  sparse collectives: cap {c.get('comm_cap')} "
+                    f"mode {c.get('comm_mode')} "
+                    f"(sized from {c.get('touched_per_shard')} touched/"
+                    f"shard, K={c.get('k')}, M={c.get('m')}, "
+                    f"dp={c.get('dp')})"
+                )
+                if isinstance(last.get("exchanged_max"), (int, float)):
+                    lines.append(
+                        f"    exchanged-ids high-water "
+                        f"{int(last['exchanged_max'])} of cap "
+                        f"{c.get('comm_cap')}"
+                    )
         if merged["final"]:
             lines.append("")
             lines.append("final: " + json.dumps(merged["final"]))

@@ -73,6 +73,22 @@ EVENT_KINDS = {
     # one closed span: `path` is the slash-joined nesting
     # ("fit/fit_loop/dispatch"), `name` its last segment; per-iteration
     # spans aggregate into the run report instead of emitting (emit=False)
+    # --- model-health diagnostics (ops.diagnostics / obs.health, ISSUE
+    # 8). Only `iter` is REQUIRED on `health`: every other payload field
+    # (llh, grad_norm, ...) is a float that can legitimately go non-
+    # finite mid-blow-up, and strict-JSON serialization then stringifies
+    # it ("inf"/"nan" — telemetry._finite_safe), which a numeric
+    # requirement would reject exactly on the events this layer exists
+    # to capture.
+    "health": {"iter": (int,)},            # one device health-pack sample
+    "anomaly": {"check": (str,), "iter": (int,)},  # detector fired
+                                           # (divergence / plateau /
+                                           # oscillation / dead_communities
+                                           # / cap_pressure)
+    "sparse_comm": {"comm_cap": (int,), "comm_mode": (str,)},
+    # sparse-collective layout committed at model build (cap, static
+    # sparse-vs-psum mode, the touched-count it was sized from); the
+    # PER-STEP occupancy/fallback counters ride `health` events
 }
 
 _BASE = {

@@ -323,6 +323,14 @@ class RunTelemetry:
         # MetricsLogger sink) — the ledger's step_p50/p99 source
         self._step_secs: List[float] = []
         self._step_eps: List[float] = []
+        # model-health (ISSUE 8): last `health` event payload + per-check
+        # anomaly counts, folded in by event() — the heartbeat embeds
+        # last_health into stall reports (a stall then says "diverging",
+        # not just "silent"), the run report grows a health section, and
+        # the perf ledger reads the final grad norm from it
+        self.last_health: Optional[Dict[str, Any]] = None
+        self.health_samples = 0
+        self.anomaly_counts: Dict[str, int] = {}
         # tag -> number of watermark samples; dev -> running max stats
         self.watermark_tags: Dict[str, int] = {}
         self.device_peak: Dict[str, Dict[str, Optional[int]]] = {}
@@ -375,6 +383,14 @@ class RunTelemetry:
             )
         with self._lock:
             self.event_counts[kind] = self.event_counts.get(kind, 0) + 1
+            if kind == "health":
+                self.last_health = dict(fields)
+                self.health_samples += 1
+            elif kind == "anomaly":
+                check = str(fields.get("check", "?"))
+                self.anomaly_counts[check] = (
+                    self.anomaly_counts.get(check, 0) + 1
+                )
             if not self._gated:
                 if self.auto_gate:
                     self._commit_gate_locked()
@@ -602,6 +618,15 @@ class RunTelemetry:
                     "orphans": self.span_orphans,
                 },
                 "steps_timed": len(self._step_secs),
+                "health": {
+                    "samples": self.health_samples,
+                    "last": (
+                        dict(self.last_health)
+                        if self.last_health is not None
+                        else None
+                    ),
+                    "anomalies": dict(self.anomaly_counts),
+                },
                 "fingerprint": _fingerprint(),
                 "memory": {
                     "host_rss_bytes": current_rss_bytes(),

@@ -1,0 +1,213 @@
+"""`cli watch <telemetry-dir>`: a live terminal tailer for a running (or
+finished) fit, rendered from events.jsonl alone (ISSUE 8).
+
+`cli report` is a post-mortem; watch answers "is this 30-minute pod fit
+healthy RIGHT NOW" from any host that can read the telemetry directory —
+no jax, no run access. Each refresh re-reads the event log (append-only,
+single writer, line-framed — a torn last line is skipped by the decoder)
+and renders:
+
+* unicode sparklines over the trailing `health` samples: LLH, grad norm,
+  update norm, membership churn (plus support churn / cap occupancy on
+  sparse runs) — the optimizer's vital signs at a glance
+* the step counter / LLH trajectory from `step` events when the run has
+  a metrics sink wired, fit progress from the health samples otherwise
+* fired anomalies, stalls, rollbacks, and the run's last event age (a
+  growing age with no stall event yet is the earliest hang signal)
+
+Dependency-free and read-only by design (the data-prep-host contract of
+obs.report). `once=True` renders a single frame and returns — the mode
+tests and CI use; the live loop redraws every `interval` seconds and
+exits on its own when an `end` event lands (the run finalized) or on
+Ctrl-C.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import List, Optional, Sequence
+
+from bigclam_tpu.obs.report import load_events, run_duration_s
+from bigclam_tpu.obs.telemetry import EVENTS_NAME
+
+BLOCKS = "▁▂▃▄▅▆▇█"
+
+
+def sparkline(vals: Sequence[float], width: int = 48) -> str:
+    """Trailing `width` values as a unicode block sparkline (constant
+    series render mid-scale; non-finite samples render as '!' — the
+    blow-up must be visible, not crash the tailer)."""
+    import math
+
+    vals = list(vals)[-width:]
+    if not vals:
+        return ""
+    finite = [v for v in vals if isinstance(v, (int, float))
+              and math.isfinite(v)]
+    if not finite:
+        return "!" * len(vals)
+    lo, hi = min(finite), max(finite)
+    span = hi - lo
+    out = []
+    for v in vals:
+        if not (isinstance(v, (int, float)) and math.isfinite(v)):
+            out.append("!")
+        elif span <= 0:
+            out.append(BLOCKS[3])
+        else:
+            idx = int((v - lo) / span * (len(BLOCKS) - 1))
+            out.append(BLOCKS[max(0, min(idx, len(BLOCKS) - 1))])
+    return "".join(out)
+
+
+def _series(events: List[dict], kind: str, field: str) -> List[float]:
+    out = []
+    for e in events:
+        if e.get("kind") != kind:
+            continue
+        v = e.get(field)
+        if isinstance(v, (int, float)) and not isinstance(v, bool):
+            out.append(float(v))
+        elif isinstance(v, str) and v in ("nan", "inf", "-inf"):
+            out.append(float(v))    # strict-JSON stringified non-finite
+    return out
+
+
+def _fmt(v: Optional[float]) -> str:
+    if v is None:
+        return "-"
+    return f"{v:.6g}"
+
+
+def render_frame(directory: str, width: int = 48) -> str:
+    """One watch frame (pure render; the loop and the CLI --once mode
+    both call this)."""
+    return _render_events(directory, load_events(directory), width)
+
+
+def _render_events(
+    directory: str, events: Optional[List[dict]], width: int
+) -> str:
+    if events is None:
+        return (
+            f"{directory}: no {EVENTS_NAME} yet (run not started, or a "
+            "non-primary process dir)"
+        )
+    lines: List[str] = []
+    start = next((e for e in events if e.get("kind") == "start"), {})
+    # None while the log holds no decodable timestamped line yet (empty
+    # file / torn first write) — the startup window watch exists to cover
+    dur = run_duration_s(events)
+    ended = any(e.get("kind") == "end" for e in events)
+    lines.append(
+        f"run {start.get('run', '?')}  entry={start.get('entry', '?')}  "
+        f"events {len(events)}  elapsed "
+        + ("-" if dur is None else f"{dur:.1f}s")
+        + ("  [finalized]" if ended else "")
+    )
+
+    steps = [e for e in events if e.get("kind") == "step"]
+    health = [e for e in events if e.get("kind") == "health"]
+    prog = steps[-1] if steps else (health[-1] if health else None)
+    if prog is not None:
+        llh = prog.get("llh")
+        lines.append(
+            f"iter {prog.get('iter', '?')}  llh "
+            f"{llh if isinstance(llh, str) else _fmt(llh)}"
+        )
+
+    def spark_row(label: str, series: List[float]) -> None:
+        if not series:
+            return
+        lines.append(
+            f"  {label:<12} {sparkline(series, width):<{width}} "
+            f"last {_fmt(series[-1])}"
+        )
+
+    src = health if health else steps
+    spark_row("llh", _series(src, src[0]["kind"], "llh") if src else [])
+    if health:
+        spark_row("grad_norm", _series(health, "health", "grad_norm"))
+        spark_row("update_norm", _series(health, "health", "update_norm"))
+        spark_row("churn", _series(health, "health", "churn"))
+        spark_row("support_churn",
+                  _series(health, "health", "support_churn"))
+        spark_row("cap_occ", _series(health, "health", "cap_occupancy"))
+        spark_row("step_eff", _series(health, "health", "step_eff"))
+    else:
+        lines.append(
+            "  (no health samples — run with --health-every N > 0)"
+        )
+    if steps:
+        spark_row("sec/iter", _series(steps, "step", "sec_per_iter"))
+
+    anomalies = [e for e in events if e.get("kind") == "anomaly"]
+    for a in anomalies:
+        lines.append(
+            f"  ANOMALY {a.get('check')} at iter {a.get('iter')}"
+        )
+    stalls = [e for e in events if e.get("kind") == "stall"]
+    if stalls:
+        s = stalls[-1]
+        lines.append(
+            f"  STALLS {len(stalls)} (last: silent {s.get('silent_s')}s"
+            + (f", open span {s['spans'][-1]}" if s.get("spans") else "")
+            + ")"
+        )
+    rollbacks = sum(1 for e in events if e.get("kind") == "rollback")
+    if rollbacks:
+        lines.append(f"  rollbacks {rollbacks}")
+    if not ended and events:
+        # staleness from the file's side, not the event clock: how long
+        # since the writer last appended anything
+        try:
+            age = time.time() - os.path.getmtime(
+                os.path.join(directory, EVENTS_NAME)
+            )
+            lines.append(f"  last write {age:.0f}s ago")
+        except OSError:
+            pass
+    return "\n".join(lines)
+
+
+def watch(
+    directory: str,
+    interval: float = 2.0,
+    once: bool = False,
+    width: int = 48,
+    max_frames: int = 0,
+    out=None,
+) -> int:
+    """The watch loop. Returns 0, or 1 when `once` finds no event log.
+    `max_frames` bounds the loop for tests (0 = until end/Ctrl-C)."""
+    import sys
+
+    out = out or sys.stdout
+    frames = 0
+    while True:
+        # one read+decode per refresh: the same event list feeds the
+        # frame AND the run-ended exit test
+        events = load_events(directory)
+        frame = _render_events(directory, events, width)
+        if once:
+            print(frame, file=out)
+            return 0 if os.path.exists(
+                os.path.join(directory, EVENTS_NAME)
+            ) else 1
+        # ANSI clear + home keeps the frame stable in a terminal; piped
+        # output just sees frame separators
+        if getattr(out, "isatty", lambda: False)():
+            print("\x1b[2J\x1b[H", end="", file=out)
+        print(frame, file=out, flush=True)
+        frames += 1
+        if events is not None and any(
+            e.get("kind") == "end" for e in events
+        ):
+            return 0
+        if max_frames and frames >= max_frames:
+            return 0
+        try:
+            time.sleep(max(interval, 0.1))
+        except KeyboardInterrupt:
+            return 0

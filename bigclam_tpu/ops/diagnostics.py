@@ -1,0 +1,274 @@
+"""Device-fused model-health diagnostics (ISSUE 8 tentpole).
+
+The telemetry (ISSUE 4) and span/ledger layers (ISSUE 6) answer *where
+the time went*; nothing answered *what the optimizer is doing* — a
+diverging or silently-plateaued NMF ascent looks identical to a healthy
+one until the final LLH. This module computes a compact health pack
+INSIDE the already-jitted train step of every trainer (dense, sharded,
+ring, sparse, sparse-sharded), where the gradient is in scope and the
+numbers are free of host round trips:
+
+    grad norm / max, update norm, effective Armijo step + accept
+    fraction, active-community count, top-community mass share, max F
+    entry, and (sparse) support churn + comm-cap occupancy + dense-
+    fallback flag + exchanged-id count
+
+packed into one (HEALTH_LEN,) float32 vector riding the TrainState
+(`state.health`). The pack is gated by `cfg.health_every`:
+
+* `health_every == 0` (the config default): the steps return
+  `health=None` and compute NOTHING — the trajectory and the compiled
+  step's math are bit-identical to the pre-health trainers (pinned by
+  tests/test_health.py), the zero-cost off path of the NULL_SPAN
+  contract.
+* `health_every > 0` (step-baked — NOT in _HOST_ONLY_FIELDS, so two
+  cadences never share a compiled step): a `lax.cond` keyed on
+  `it % health_every` computes the pack on cadence iterations and
+  returns zeros otherwise; the handful of reductions it adds is noise
+  next to the step's 17 edge sweeps (<2% pinned at the default CLI
+  cadence).
+
+The host side (obs.health.HealthMonitor, driven from run_fit_loop)
+fetches the vector only on cadence iterations, adds the LLH-window
+derivatives (delta, slope, relative change) and the membership churn
+against a rolling device-resident snapshot (the `*_top_community`
+signatures below — an (N,) int32 argmax, not an F copy), and emits
+`health` / `anomaly` telemetry events.
+
+Slots that do not apply to a trainer (comm-cap occupancy on a single
+chip) carry the NA sentinel -1.0; the monitor omits them from events.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+# Field order of the device health vector. Consumers index by name via
+# HEALTH_INDEX; the host monitor turns it into a dict (dropping NA
+# slots) before the event is emitted.
+HEALTH_FIELDS = (
+    "iter",            # iteration the pack describes (the update it->it+1)
+    "llh",             # LLH of the step's INPUT F (same scalar the loop syncs)
+    "grad_norm",       # global L2 norm of the gradient
+    "grad_max",        # global max |grad| entry
+    "update_norm",     # global L2 norm of F_new - F_old (the applied update)
+    "step_eff",        # accept-weighted mean Armijo step (0 = all rejected)
+    "accept_frac",     # fraction of rows that accepted any candidate step
+    "active_comms",    # communities with column mass > ACTIVE_EPS
+    "top_share",       # largest column mass / total mass
+    "f_max",           # max F entry (box-ceiling proximity)
+    "support_churn",   # sparse: fraction of member-id slots changed by the
+                       # support update this iteration (NA on dense)
+    "cap_occupancy",   # sparse sharded: touched ids / comm cap (NA else)
+    "dense_fallback",  # sparse sharded: 1 when the sparse allreduce fell
+                       # back to the dense psum this step (NA else)
+    "exchanged_ids",   # sparse sharded: touched ids exchanged (NA else)
+)
+HEALTH_LEN = len(HEALTH_FIELDS)
+HEALTH_INDEX = {name: i for i, name in enumerate(HEALTH_FIELDS)}
+
+# sentinel for slots a trainer does not produce
+NA = -1.0
+# a community column counts as alive above this mass (padding columns
+# are exact zeros, so they never count)
+ACTIVE_EPS = 1e-12
+
+
+def health_on(cfg) -> bool:
+    """The single engagement predicate (trainer step builders branch on
+    it at TRACE time — the off path adds no ops at all)."""
+    return int(getattr(cfg, "health_every", 0) or 0) > 0
+
+
+def init_health(cfg) -> Optional[jax.Array]:
+    """The health leaf for FRESH states (init / checkpoint restore):
+    an NA-filled vector when health is on, None when off. Seeding the
+    initial state with the same (HEALTH_LEN,) leaf the step outputs
+    keeps the TrainState pytree structure CONSTANT across the fit —
+    otherwise the first iteration's None->array transition would
+    retrace and recompile every jitted step (and its donating twin)
+    once per fit."""
+    if not health_on(cfg):
+        return None
+    return jnp.full((HEALTH_LEN,), NA, jnp.float32)
+
+
+def grad_stats(grad, node_axis=None, k_axis=None) -> jax.Array:
+    """(2,) float32 [sum of grad^2, max |grad|] — the only health inputs
+    that exist solely inside the edge-sweep body, so the sharded steps
+    compute them in-shard (psum/pmax over the given mesh axes) and ship
+    the two scalars out of shard_map; everything else in the pack is
+    derived from state arrays in the step wrapper."""
+    gsq = jnp.sum((grad * grad).astype(jnp.float32))
+    gmax = jnp.max(jnp.abs(grad)).astype(jnp.float32)
+    if node_axis is not None:
+        gsq = lax.psum(gsq, node_axis)
+        gmax = lax.pmax(gmax, node_axis)
+    if k_axis is not None:
+        gsq = lax.psum(gsq, k_axis)
+        gmax = lax.pmax(gmax, k_axis)
+    return jnp.stack([gsq, gmax])
+
+
+def zero_grad_stats() -> jax.Array:
+    """Placeholder for steps built with health off (keeps the in-shard
+    return arity uniform; a constant, so XLA folds it away)."""
+    return jnp.zeros(2, jnp.float32)
+
+
+def gated_grad_stats(cfg, it, grad, node_axis=None, k_axis=None):
+    """grad_stats under the cadence cond: the O(N*K) reductions (the
+    only expensive part of the pack) run ONLY on cadence iterations —
+    off-cadence steps pay the cond, nothing else. Collectives inside
+    the branch are fine where the existing support-update cond already
+    runs all_gathers: the predicate is replicated."""
+    every = max(int(cfg.health_every), 1)
+    return lax.cond(
+        (it % every) == 0,
+        lambda g: grad_stats(g, node_axis=node_axis, k_axis=k_axis),
+        lambda g: jnp.zeros(2, jnp.float32),
+        grad,
+    )
+
+
+def latch_extras(prev_health, extras: Dict[str, jax.Array]):
+    """Max-since-last-sample latch for the cheap per-step event slots
+    (sparse dense_fallback / cap_occupancy / exchanged_ids /
+    support_churn): a fallback on an OFF-cadence step must still be
+    visible in the next health sample, so these scalars are computed
+    every step (they are O(1) or one cheap pass — unlike the gated grad
+    stats) and folded into a running max that resets after each emitted
+    sample. NA (-1) is the max-identity, so never-produced slots stay
+    NA.
+
+    Returns (latched extras dict, skip_carry vector): the pack's
+    compute branch emits the latched values; the skip branch returns
+    `skip_carry` so the latch RIDES state.health between samples
+    (iter slot stays NA — the host only reads on cadence iterations).
+    """
+    if prev_health is None:
+        prev_health = jnp.full((HEALTH_LEN,), NA, jnp.float32)
+    sampled_last = prev_health[HEALTH_INDEX["iter"]] >= 0
+    out: Dict[str, jax.Array] = {}
+    carry = jnp.full((HEALTH_LEN,), NA, jnp.float32)
+    for name, cur in extras.items():
+        idx = HEALTH_INDEX[name]
+        base = jnp.where(
+            sampled_last, jnp.float32(NA), prev_health[idx]
+        )
+        val = jnp.maximum(base, jnp.asarray(cur, jnp.float32))
+        out[name] = val
+        carry = carry.at[idx].set(val)
+    return out, carry
+
+
+def health_pack(
+    cfg,
+    it,
+    F_old,
+    F_new,
+    sumF_new,
+    accept_hist,
+    gstats=None,
+    extras: Optional[Dict[str, jax.Array]] = None,
+    grad=None,
+    skip_carry=None,
+) -> jax.Array:
+    """The (HEALTH_LEN,) float32 pack, lax.cond-gated on the cadence —
+    off-cadence iterations pay the cond and nothing else (every
+    reduction, including the grad stats when `grad` is given, lives
+    inside the compute branch).
+
+    Called inside the jitted step (single-chip: in the step body, pass
+    `grad` directly; sharded: in the step wrapper after shard_map, pass
+    `gstats` from the in-shard gated_grad_stats — the full grad never
+    leaves the shard). `it` is the step's INPUT iteration counter,
+    `extras` optional named overrides for the sparse slots (pre-latched
+    via latch_extras where off-cadence events must survive to the next
+    sample), `skip_carry` the off-cadence return (default NA-full; the
+    latch rides it). llh is stamped by the host monitor (the loop
+    already syncs it; keeping it out of the pack spares the sharded
+    steps one more replicated output).
+    """
+    every = max(int(cfg.health_every), 1)
+    ex = extras or {}
+    assert (gstats is None) != (grad is None), "pass gstats XOR grad"
+
+    def compute(g):
+        f32 = jnp.float32
+        gs = grad_stats(g) if g is not None else gstats
+        dF = (F_new - F_old).astype(f32)
+        update_norm = jnp.sqrt(jnp.sum(dF * dF))
+        etas = jnp.asarray(cfg.step_candidates, f32)
+        hist = accept_hist.astype(f32)
+        total = jnp.maximum(hist.sum(), 1.0)
+        accepted = hist[:-1]
+        step_eff = (etas * accepted).sum() / total
+        accept_frac = accepted.sum() / total
+        colmass = sumF_new.astype(f32)
+        active = (colmass > ACTIVE_EPS).sum().astype(f32)
+        mass = colmass.sum()
+        top_share = jnp.max(colmass) / jnp.maximum(mass, ACTIVE_EPS)
+        f_max = jnp.max(F_new).astype(f32)
+        slots = {
+            "iter": it.astype(f32),
+            "llh": jnp.asarray(jnp.nan, f32),   # host-stamped
+            "grad_norm": jnp.sqrt(gs[0]),
+            "grad_max": gs[1],
+            "update_norm": update_norm,
+            "step_eff": step_eff,
+            "accept_frac": accept_frac,
+            "active_comms": active,
+            "top_share": top_share,
+            "f_max": f_max,
+            "support_churn": jnp.asarray(NA, f32),
+            "cap_occupancy": jnp.asarray(NA, f32),
+            "dense_fallback": jnp.asarray(NA, f32),
+            "exchanged_ids": jnp.asarray(NA, f32),
+        }
+        for name, val in ex.items():
+            assert name in slots, name
+            slots[name] = jnp.asarray(val, f32)
+        return jnp.stack([slots[name] for name in HEALTH_FIELDS])
+
+    def skip(g):
+        # never read by the host (it only fetches on cadence iterations);
+        # slot 0 = -1 marks the vector as not-computed for any stray
+        # reader, and the latched extras (when any) ride the carry
+        del g
+        if skip_carry is not None:
+            return skip_carry
+        return jnp.full((HEALTH_LEN,), NA, jnp.float32)
+
+    return lax.cond((it % every) == 0, compute, skip, grad)
+
+
+# ------------------------------------------------------- membership churn
+# (N,) int32 top-community signatures: the rolling snapshot the monitor
+# keeps device-resident between health samples is this argmax, not a full
+# F copy — O(N) bytes, donation-free. -1 marks empty (all-zero) rows, so
+# padding rows compare equal forever and never contribute churn.
+
+@jax.jit
+def dense_top_community(F) -> jax.Array:
+    rowmax = jnp.max(F, axis=1)
+    arg = jnp.argmax(F, axis=1).astype(jnp.int32)
+    return jnp.where(rowmax > 0, arg, jnp.int32(-1))
+
+
+@jax.jit
+def sparse_top_community(ids, w) -> jax.Array:
+    j = jnp.argmax(w, axis=1)
+    top = jnp.take_along_axis(ids, j[:, None], axis=1)[:, 0].astype(jnp.int32)
+    return jnp.where(jnp.max(w, axis=1) > 0, top, jnp.int32(-1))
+
+
+@jax.jit
+def sig_changed(a, b) -> jax.Array:
+    """Count of signature entries that differ (host divides by the live
+    row count for the churn fraction)."""
+    return (a != b).sum().astype(jnp.int32)

@@ -70,6 +70,10 @@ class SparseTrainState(NamedTuple):
     # shards) and whether that step fell back to the dense psum
     comm_ids: Optional[jax.Array] = None
     comm_dense: Optional[jax.Array] = None
+    # (ops.diagnostics.HEALTH_LEN,) float32 device health pack (ISSUE 8;
+    # support churn + cap occupancy ride the sparse slots); None with
+    # health off — see models.bigclam.TrainState.health
+    health: Optional[jax.Array] = None
 
 
 class SupportBlocks(NamedTuple):

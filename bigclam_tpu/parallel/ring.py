@@ -55,6 +55,8 @@ from bigclam_tpu.parallel.sharded import (
     ShardedBigClamModel,
     _mark_varying,
     _rowdot,
+    _shard_grad_stats,
+    _shard_health,
     armijo_tail_select_sharded,
 )
 from bigclam_tpu.utils.compat import shard_map
@@ -310,10 +312,13 @@ def make_ring_train_step(
         )
         sumF_new = lax.psum(sum_loc, NODES_AXIS)
         hist = lax.psum(hist, NODES_AXIS)
-        return F_new, sumF_new, llh_cur.astype(F_loc.dtype), it + 1, hist
+        return (
+            F_new, sumF_new, llh_cur.astype(F_loc.dtype), it + 1, hist,
+            _shard_grad_stats(grad, cfg, it),
+        )
 
     def step(state: TrainState, src, dst, mask) -> TrainState:
-        F_new, sumF, llh, it, hist = shard_map(
+        F_new, sumF, llh, it, hist, gstats = shard_map(
             step_shard,
             mesh=mesh,
             in_specs=(
@@ -323,10 +328,13 @@ def make_ring_train_step(
                 P(NODES_AXIS, None, None, None),
                 P(),
             ),
-            out_specs=(P(NODES_AXIS, K_AXIS), P(K_AXIS), P(), P(), P()),
+            out_specs=(
+                P(NODES_AXIS, K_AXIS), P(K_AXIS), P(), P(), P(), P(),
+            ),
         )(state.F, src, dst, mask, state.it)
         return TrainState(
-            F=F_new, sumF=sumF, llh=llh, it=it, accept_hist=hist
+            F=F_new, sumF=sumF, llh=llh, it=it, accept_hist=hist,
+            health=_shard_health(cfg, state, F_new, sumF, hist, gstats),
         )
 
     # edge arrays as jit ARGUMENTS (multi-controller: no closing over
@@ -491,7 +499,10 @@ def make_ring_csr_train_step(
         )
         sumF_new = lax.psum(sum_loc, NODES_AXIS)
         hist = lax.psum(hist, NODES_AXIS)
-        return F_new, sumF_new, llh_cur.astype(F_loc.dtype), it + 1, hist
+        return (
+            F_new, sumF_new, llh_cur.astype(F_loc.dtype), it + 1, hist,
+            _shard_grad_stats(grad, cfg, it),
+        )
 
     def step_shard_tp(F_loc, srcl, dstl, mask, bid, it):
         srcl, dstl, mask, bid = srcl[0], dstl[0], mask[0], bid[0]
@@ -557,7 +568,10 @@ def make_ring_csr_train_step(
         )
         sumF_new = lax.psum(sum_loc, NODES_AXIS)
         hist = lax.psum(hist, NODES_AXIS)
-        return F_new, sumF_new, llh_cur.astype(F_loc.dtype), it + 1, hist
+        return (
+            F_new, sumF_new, llh_cur.astype(F_loc.dtype), it + 1, hist,
+            _shard_grad_stats(grad, cfg, it),
+        )
 
     def step_shard(F_loc, srcl, dstl, mask, bid, it):
         srcl, dstl, mask, bid = srcl[0], dstl[0], mask[0], bid[0]
@@ -625,10 +639,13 @@ def make_ring_csr_train_step(
         )
         sumF_new = lax.psum(sum_loc, NODES_AXIS)
         hist = lax.psum(hist, NODES_AXIS)
-        return F_new, sumF_new, llh_cur.astype(F_loc.dtype), it + 1, hist
+        return (
+            F_new, sumF_new, llh_cur.astype(F_loc.dtype), it + 1, hist,
+            _shard_grad_stats(grad, cfg, it),
+        )
 
     def step(state: TrainState, srcl, dstl, mask, bid) -> TrainState:
-        F_new, sumF, llh, it, hist = shard_map(
+        F_new, sumF, llh, it, hist, gstats = shard_map(
             step_shard_kb
             if kc
             else (step_shard_tp if tp > 1 else step_shard),
@@ -641,11 +658,14 @@ def make_ring_csr_train_step(
                 P(NODES_AXIS, None, None),
                 P(),
             ),
-            out_specs=(P(NODES_AXIS, K_AXIS), P(K_AXIS), P(), P(), P()),
+            out_specs=(
+                P(NODES_AXIS, K_AXIS), P(K_AXIS), P(), P(), P(), P(),
+            ),
             check_vma=False,       # pallas interpret + prefetch (see sharded)
         )(state.F, srcl, dstl, mask, bid, state.it)
         return TrainState(
-            F=F_new, sumF=sumF, llh=llh, it=it, accept_hist=hist
+            F=F_new, sumF=sumF, llh=llh, it=it, accept_hist=hist,
+            health=_shard_health(cfg, state, F_new, sumF, hist, gstats),
         )
 
     # tile arrays as jit ARGUMENTS (multi-controller: no closing over

@@ -45,6 +45,7 @@ from bigclam_tpu.models.bigclam import (
     restore_checkpoint,
     run_fit_loop,
 )
+from bigclam_tpu.ops import diagnostics as dx
 from bigclam_tpu.ops.objective import EdgeChunks, edge_terms
 from bigclam_tpu.parallel.mesh import K_AXIS, NODES_AXIS
 from bigclam_tpu.parallel.multihost import (
@@ -166,6 +167,29 @@ def _rowdot(a: jax.Array, b: jax.Array) -> jax.Array:
     return lax.psum(jnp.einsum("nk,nk->n", a, b), K_AXIS)
 
 
+def _shard_grad_stats(grad: jax.Array, cfg: BigClamConfig, it) -> jax.Array:
+    """In-shard ISSUE 8 grad stats, replicated over both mesh axes (psum
+    over a size-1 axis is identity, so one call covers every tp),
+    cadence-gated on `it` so off-cadence iterations skip the O(N*K)
+    reductions; a constant zeros pair with health off."""
+    if not dx.health_on(cfg):
+        return dx.zero_grad_stats()
+    return dx.gated_grad_stats(
+        cfg, it, grad, node_axis=NODES_AXIS, k_axis=K_AXIS
+    )
+
+
+def _shard_health(cfg, state, F_new, sumF_new, hist, gstats):
+    """Outer-wrapper health pack for the sharded/ring steps: computed on
+    the GLOBAL (sharded) arrays after shard_map — jit partitions the
+    reductions; None at trace time with health off."""
+    if not dx.health_on(cfg):
+        return None
+    return dx.health_pack(
+        cfg, state.it, state.F, F_new, sumF_new, hist, gstats
+    )
+
+
 def _mark_varying(x: jax.Array, axes: tuple) -> jax.Array:
     """Mark x as varying over the given mesh axes for the VMA type system
     (idempotent: axes already varying are left alone; no-op on jax 0.4.x,
@@ -278,7 +302,10 @@ def make_sharded_csr_train_step(
         sumF_new = lax.psum(sum_loc, NODES_AXIS)
         llh_cur = lax.psum(node_llh.sum(), NODES_AXIS)
         hist = lax.psum(hist, NODES_AXIS)
-        return F_new, sumF_new, llh_cur.astype(F_loc.dtype), it + 1, hist
+        return (
+            F_new, sumF_new, llh_cur.astype(F_loc.dtype), it + 1, hist,
+            _shard_grad_stats(grad, cfg, it),
+        )
 
     def step_shard_flat(F_loc, srcl, dst, mask, bid, it):
         srcl, dst, mask, bid = srcl[0], dst[0], mask[0], bid[0]
@@ -301,7 +328,10 @@ def make_sharded_csr_train_step(
         )
         sumF_new = lax.psum(sum_loc, NODES_AXIS)
         hist = lax.psum(hist, NODES_AXIS)
-        return F_new, sumF_new, llh_cur.astype(F_loc.dtype), it + 1, hist
+        return (
+            F_new, sumF_new, llh_cur.astype(F_loc.dtype), it + 1, hist,
+            _shard_grad_stats(grad, cfg, it),
+        )
 
     def step_shard_tp(F_loc, srcl, dst, mask, bid, it):
         srcl, dst, mask, bid = srcl[0], dst[0], mask[0], bid[0]
@@ -347,7 +377,10 @@ def make_sharded_csr_train_step(
         )
         sumF_new = lax.psum(sum_loc, NODES_AXIS)
         hist = lax.psum(hist, NODES_AXIS)
-        return F_new, sumF_new, llh_cur.astype(F_loc.dtype), it + 1, hist
+        return (
+            F_new, sumF_new, llh_cur.astype(F_loc.dtype), it + 1, hist,
+            _shard_grad_stats(grad, cfg, it),
+        )
 
     def step_shard_grouped_tp(F_loc, srcl, dst, mask, bid, it):
         gt = GroupedTilesDev(
@@ -407,7 +440,7 @@ def make_sharded_csr_train_step(
         # dynamic_slice, which the VMA type check cannot express yet; the
         # XLA sharded step keeps the checked path and the equivalence tests
         # (tests/test_pallas_csr.py::TestShardedCSR) pin the semantics
-        F_new, sumF, llh, it, hist = shard_map(
+        F_new, sumF, llh, it, hist, gstats = shard_map(
             step_shard,
             mesh=mesh,
             in_specs=(
@@ -418,11 +451,14 @@ def make_sharded_csr_train_step(
                 spec_for(bid),
                 P(),
             ),
-            out_specs=(P(NODES_AXIS, K_AXIS), P(K_AXIS), P(), P(), P()),
+            out_specs=(
+                P(NODES_AXIS, K_AXIS), P(K_AXIS), P(), P(), P(), P(),
+            ),
             check_vma=False,
         )(state.F, srcl, dst, mask, bid, state.it)
         return TrainState(
-            F=F_new, sumF=sumF, llh=llh, it=it, accept_hist=hist
+            F=F_new, sumF=sumF, llh=llh, it=it, accept_hist=hist,
+            health=_shard_health(cfg, state, F_new, sumF, hist, gstats),
         )
 
     # tile arrays ride as jit ARGUMENTS, not closure constants: under
@@ -531,10 +567,13 @@ def make_sharded_train_step(
         )
         sumF_new = lax.psum(sum_loc, NODES_AXIS)             # (K_loc,)
         hist = lax.psum(hist, NODES_AXIS)
-        return F_new, sumF_new, llh_cur.astype(F_loc.dtype), it + 1, hist
+        return (
+            F_new, sumF_new, llh_cur.astype(F_loc.dtype), it + 1, hist,
+            _shard_grad_stats(grad, cfg, it),
+        )
 
     def step(state: TrainState, src, dst, mask) -> TrainState:
-        F_new, sumF, llh, it, hist = shard_map(
+        F_new, sumF, llh, it, hist, gstats = shard_map(
             step_shard,
             mesh=mesh,
             in_specs=(
@@ -544,10 +583,13 @@ def make_sharded_train_step(
                 P(NODES_AXIS, None, None),
                 P(),
             ),
-            out_specs=(P(NODES_AXIS, K_AXIS), P(K_AXIS), P(), P(), P()),
+            out_specs=(
+                P(NODES_AXIS, K_AXIS), P(K_AXIS), P(), P(), P(), P(),
+            ),
         )(state.F, src, dst, mask, state.it)
         return TrainState(
-            F=F_new, sumF=sumF, llh=llh, it=it, accept_hist=hist
+            F=F_new, sumF=sumF, llh=llh, it=it, accept_hist=hist,
+            health=_shard_health(cfg, state, F_new, sumF, hist, gstats),
         )
 
     # edge arrays as jit ARGUMENTS (multi-controller: no closing over
@@ -975,6 +1017,7 @@ class ShardedBigClamModel:
             accept_hist=jnp.zeros(
                 len(self.cfg.step_candidates) + 1, jnp.int32
             ),
+            health=dx.init_health(self.cfg),
         )
 
     def extract_F(self, state: TrainState) -> np.ndarray:
@@ -982,6 +1025,14 @@ class ShardedBigClamModel:
         node ids (inverts the balance relabeling)."""
         n, k = self.g.num_nodes, self.cfg.num_communities
         return self._from_internal_rows(fetch_global(state.F)[:n])[:, :k]
+
+    def health_sig(self, state: TrainState) -> jax.Array:
+        """(N_pad,) int32 top-community signature on the sharded F (the
+        argmax runs under jit on the global array — no gather; see
+        models.bigclam.BigClamModel.health_sig)."""
+        from bigclam_tpu.ops.diagnostics import dense_top_community
+
+        return dense_top_community(state.F)
 
     def internal_row_to_node(self) -> Optional[np.ndarray]:
         """Device row index -> ORIGINAL node index, or None when rows were
@@ -1031,6 +1082,7 @@ class ShardedBigClamModel:
             accept_hist=jnp.zeros(
                 len(self.cfg.step_candidates) + 1, jnp.int32
             ),
+            health=dx.init_health(self.cfg),
         )
 
     def fit(
@@ -1065,6 +1117,8 @@ class ShardedBigClamModel:
                 initial_hist=hist,
                 ckpt_meta=self._ckpt_meta(),
                 rebuild_step=rebuilder,
+                health_sig=self.health_sig,
+                health_n=self.g.num_nodes,
             )
         finally:
             rebuilder.restore()
@@ -1084,6 +1138,8 @@ class ShardedBigClamModel:
             return run_fit_loop(
                 self._step, state, self.cfg, callback, None,
                 rebuild_step=rebuilder,
+                health_sig=self.health_sig,
+                health_n=self.g.num_nodes,
             )
         finally:
             rebuilder.restore()

@@ -98,13 +98,19 @@ def make_sparse_sharded_step(
     cap: int,
     mode: str,
     block_b: int,
+    n_live: Optional[int] = None,
 ):
     """One jitted sharded sparse iteration. `blocks` is the
     (src_local, dst, mask) triple of (dp, blocks_per_shard, eb) support
     arrays (dst GLOBAL — it indexes the gathered rows); `mode` is the
-    static collective choice from sparse_collectives.static_mode."""
+    static collective choice from sparse_collectives.static_mode;
+    `n_live` the LIVE node count for the support-churn denominator
+    (None falls back to the padded row count)."""
     sup_every = max(int(cfg.support_every), 1)
     use_sparse = mode == "sparse"
+    from bigclam_tpu.ops import diagnostics as dx
+
+    dp = mesh.shape[NODES_AXIS]
 
     def allreduce(vals, pres):
         if use_sparse:
@@ -118,6 +124,7 @@ def make_sparse_sharded_step(
     def step_shard(ids_loc, w_loc, it, esrc, edst, emask, bsl, bdd, bmm):
         esrc, edst, emask = esrc[0], edst[0], emask[0]
         bsl, bdd, bmm = bsl[0], bdd[0], bmm[0]
+        ids0 = ids_loc
 
         def do_support(op):
             i0, w0 = op
@@ -167,6 +174,23 @@ def make_sparse_sharded_step(
         sumF_new, cnt2, fb2 = allreduce(
             sm.sparse_sumF(ids_loc, w_new, k_pad), pres
         )
+        if dx.health_on(cfg):
+            gstats = dx.gated_grad_stats(cfg, it, grad, node_axis=NODES_AXIS)
+            # fraction of LIVE member-id slots the support admission
+            # rewrote, over ALL shards' rows (psum of local changed
+            # counts over a static global slot count; padding rows have
+            # no edges and never admit, so the padded count would
+            # dilute it) — computed EVERY step (one cheap comparison +
+            # psum) so the wrapper's latch can carry off-cadence bursts
+            # to the next sample; the O(N*M) grad reductions above are
+            # cadence-gated instead
+            slots = float(max(n_live or ids_loc.shape[0] * dp, 1) * m)
+            churn = lax.psum(
+                jnp.sum((ids_loc != ids0).astype(jnp.float32)), NODES_AXIS
+            ) / slots
+        else:
+            gstats = dx.zero_grad_stats()
+            churn = jnp.zeros((), jnp.float32)
         return (
             w_new,
             ids_loc,
@@ -176,6 +200,8 @@ def make_sparse_sharded_step(
             hist,
             jnp.maximum(cnt, cnt2),
             jnp.maximum(fb, fb2),
+            gstats,
+            churn,
         )
 
     espec = P(NODES_AXIS, None, None)
@@ -186,7 +212,7 @@ def make_sparse_sharded_step(
         # shard-varying values, which the replication checker cannot
         # type; the semantics are pinned by the single-chip-equivalence
         # tests (tests/test_sparse.py)
-        w, ids, sumF, llh, it, hist, cnt, fb = shard_map(
+        w, ids, sumF, llh, it, hist, cnt, fb, gstats, churn = shard_map(
             step_shard,
             mesh=mesh,
             in_specs=(
@@ -198,13 +224,34 @@ def make_sparse_sharded_step(
             ),
             out_specs=(
                 P(NODES_AXIS, None), P(NODES_AXIS, None),
-                P(), P(), P(), P(), P(), P(),
+                P(), P(), P(), P(), P(), P(), P(), P(),
             ),
             check_vma=False,
         )(state.ids, state.F, state.it, esrc, edst, emask, bsl, bdd, bmm)
+        health = None
+        if dx.health_on(cfg):
+            extras = {"support_churn": churn}
+            if use_sparse:
+                # comm-cap pressure (the figure that validates the build-
+                # time cap guess, arXiv:1312.3020): touched ids vs the
+                # static cap, plus the runtime dense-psum fallback flag.
+                # NA in static-psum mode — there is no cap to overflow
+                extras["cap_occupancy"] = cnt.astype(jnp.float32) / float(
+                    max(cap, 1)
+                )
+                extras["dense_fallback"] = fb.astype(jnp.float32)
+                extras["exchanged_ids"] = cnt.astype(jnp.float32)
+            # max-since-last-sample latch riding state.health: a dense
+            # fallback / cap spike / admission burst on an OFF-cadence
+            # step still shows in the next emitted sample
+            extras, carry = dx.latch_extras(state.health, extras)
+            health = dx.health_pack(
+                cfg, state.it, state.F, w, sumF, hist, gstats,
+                extras=extras, skip_carry=carry,
+            )
         return SparseTrainState(
             F=w, ids=ids, sumF=sumF, llh=llh, it=it,
-            accept_hist=hist, comm_ids=cnt, comm_dense=fb,
+            accept_hist=hist, comm_ids=cnt, comm_dense=fb, health=health,
         )
 
     # edge/block arrays as jit ARGUMENTS (multi-controller: no closing
@@ -308,13 +355,37 @@ class SparseShardedBigClamModel(SparseBigClamModel):
         self.comm_mode = static_mode(
             self.comm_cap, self.k_pad, cfg.sparse_dense_fallback
         )
+        self._emit_comm_event(touched_per_shard)
+
+    def _emit_comm_event(self, touched_per_shard: int) -> None:
+        """ISSUE 8 satellite: the sparse-collective layout (cap, static
+        mode, the touched-count it was sized from) as a `sparse_comm`
+        telemetry event — before this it existed only in the fit-output
+        dict and never reached events.jsonl or `cli report`. Emitted at
+        build AND again when _on_init_sparsified refines the auto cap, so
+        the event log records the layout the compiled step actually
+        uses; the PER-STEP fallback/occupancy counters ride the `health`
+        events (cap_occupancy / dense_fallback / exchanged_ids slots)."""
+        from bigclam_tpu.obs import telemetry as _obs
+
+        tel = _obs.current()
+        if tel is not None:
+            tel.event(
+                "sparse_comm",
+                comm_cap=int(self.comm_cap),
+                comm_mode=str(self.comm_mode),
+                touched_per_shard=int(touched_per_shard),
+                k=int(self.k_pad),
+                m=int(self.m),
+                dp=int(self.dp),
+            )
 
     def _make_step(self):
         return (
             make_sparse_sharded_step(
                 self.mesh, self._edges, self._blocks, self.cfg,
                 self.k_pad, self.m, self.comm_cap, self.comm_mode,
-                self.block_b,
+                self.block_b, n_live=self.g.num_nodes,
             ),
             f"sparse_xla_{'spall' if self.comm_mode == 'sparse' else 'psum'}",
         )
@@ -386,6 +457,8 @@ class SparseShardedBigClamModel(SparseBigClamModel):
                 "checkpoints cannot resume a sparse fit"
             )
         ids, w = self._place(arrays["ids"], arrays["F"])
+        from bigclam_tpu.ops import diagnostics as dx
+
         return SparseTrainState(
             F=w,
             ids=ids,
@@ -397,4 +470,5 @@ class SparseShardedBigClamModel(SparseBigClamModel):
             ),
             comm_ids=jnp.zeros((), jnp.int32),
             comm_dense=jnp.zeros((), jnp.int32),
+            health=dx.init_health(self.cfg),
         )

@@ -1,0 +1,250 @@
+"""Model-health diagnostics gate (ISSUE 8 satellite): prove, on CPU, that
+the device-fused health layer detects the failure modes it exists for —
+deterministically — and stays silent on a healthy fit.
+
+Four planted scenarios, each a REAL fit with the full telemetry stack:
+
+  healthy      default-tolerance dense fit           -> zero anomalies
+  divergence   sign-flipped single-candidate Armijo  -> `divergence` fires,
+               ladder walks downhill: LLH worsens       run stays NaN-free
+               geometrically (slope blow-up), finite    (no nonfinite event)
+  plateau      conv_tol=0 fit run far past            -> `plateau` fires
+               convergence (the stop rule never can)
+  cap_pressure sparse sharded (dp=2) with a starved   -> `cap_pressure`
+               comm cap: admission overflows the        fires; sparse_comm
+               sparse allreduce -> dense-psum fallback   events recorded
+
+plus the acceptance cross-checks: every events.jsonl schema-validates,
+health-off reproduces the health-on trajectory bit-for-bit, and `cli
+report` / `cli watch` render the health sections (report --json parses).
+
+    python scripts/health_gate.py [HEALTH_r12.json]
+
+Exit 0 iff every check passes. The committed artifact is the proof the
+detectors and their planted failures agree at the commit that shipped
+them; the same recipes run in tier-1 (tests/test_health.py).
+"""
+
+import json
+import math
+import os
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def main() -> int:
+    out_path = sys.argv[1] if len(sys.argv) > 1 else None
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_enable_x64", True)
+    from bigclam_tpu.utils.dist import request_cpu_devices
+
+    request_cpu_devices(2)
+
+    from bigclam_tpu.config import BigClamConfig
+    from bigclam_tpu.models import BigClamModel, SparseBigClamModel
+    from bigclam_tpu.models.agm import sample_planted_graph
+    from bigclam_tpu.obs import (
+        RunTelemetry,
+        install,
+        uninstall,
+        validate_events_file,
+    )
+    from bigclam_tpu.obs.report import render, render_json
+    from bigclam_tpu.obs.telemetry import EVENTS_NAME
+    from bigclam_tpu.obs.watch import render_frame
+    from bigclam_tpu.parallel import SparseShardedBigClamModel, make_mesh
+    from bigclam_tpu.utils.profiling import step_time
+
+    g, _ = sample_planted_graph(
+        240, 4, p_in=0.3, rng=np.random.default_rng(0)
+    )
+    F0 = np.random.default_rng(1).uniform(0.1, 1.0, size=(g.num_nodes, 4))
+
+    def base_cfg(**kw):
+        d = dict(num_communities=4, dtype="float64", max_iters=8,
+                 conv_tol=0.0, health_every=1)
+        d.update(kw)
+        return BigClamConfig(**d)
+
+    checks = {}
+    scenarios = {}
+
+    def run_scenario(name, build_and_fit, expect):
+        tdir = tempfile.mkdtemp(prefix=f"health_{name}_")
+        tel = install(RunTelemetry(tdir, entry=name, quiet=True))
+        llh_history = ()
+        err = None
+        try:
+            llh_history = build_and_fit()
+        except Exception as e:       # a scenario crashing IS a failure
+            err = f"{type(e).__name__}: {e}"
+        finally:
+            tel.finalize()
+            uninstall(tel)
+        events = []
+        with open(os.path.join(tdir, EVENTS_NAME)) as f:
+            for line in f:
+                if line.strip():
+                    events.append(json.loads(line))
+        n, schema_errors = validate_events_file(
+            os.path.join(tdir, EVENTS_NAME)
+        )
+        fired = sorted(
+            {e["check"] for e in events if e["kind"] == "anomaly"}
+        )
+        health_n = sum(1 for e in events if e["kind"] == "health")
+        nonfinite = sum(1 for e in events if e["kind"] == "nonfinite")
+        finite = all(
+            isinstance(v, (int, float)) and math.isfinite(v)
+            for v in llh_history
+        )
+        scenarios[name] = {
+            "telemetry_dir": tdir,
+            "error": err,
+            "events": n,
+            "health_samples": health_n,
+            "anomalies_fired": fired,
+            "anomalies_expected": sorted(expect),
+            "nonfinite_events": nonfinite,
+            "llh_history_finite": finite,
+            "llh_head": [float(f"{v:.6g}") for v in llh_history[:6]],
+            "schema_errors": schema_errors[:5],
+        }
+        checks[f"{name}_schema_valid"] = not schema_errors
+        checks[f"{name}_health_sampled"] = health_n > 0
+        checks[f"{name}_anomalies_match"] = fired == sorted(expect)
+        checks[f"{name}_no_crash"] = err is None
+        return tdir
+
+    # --- healthy baseline: fires nothing ---
+    def fit_healthy():
+        cfg = base_cfg(conv_tol=1e-4, max_iters=100)
+        return BigClamModel(g, cfg).fit(F0).llh_history
+
+    healthy_dir = run_scenario("healthy", fit_healthy, expect=[])
+
+    # --- planted divergence: NaN-free slope blow-up ---
+    def fit_divergence():
+        cfg = base_cfg(alpha=1e9, max_backtracks=0, step_scale=-0.02,
+                       rollback_budget=0)
+        return BigClamModel(g, cfg).fit(F0).llh_history
+
+    div_dir = run_scenario("divergence", fit_divergence,
+                           expect=["divergence"])
+    checks["divergence_nan_free"] = (
+        scenarios["divergence"]["nonfinite_events"] == 0
+        and scenarios["divergence"]["llh_history_finite"]
+    )
+
+    # --- planted plateau: flat far past the (disabled) stop rule ---
+    def fit_plateau():
+        cfg = base_cfg(max_iters=40)
+        return BigClamModel(g, cfg).fit(F0).llh_history
+
+    run_scenario("plateau", fit_plateau, expect=["plateau"])
+
+    # --- planted sparse cap pressure: starved comm cap overflows ---
+    K = 64
+    F0w = np.zeros((g.num_nodes, K))
+    F0w[:, :48] = np.random.default_rng(1).uniform(
+        0.1, 1.0, size=(g.num_nodes, 48)
+    )
+
+    def fit_cap():
+        cfg = base_cfg(
+            num_communities=K, representation="sparse", sparse_m=8,
+            sparse_comm_cap=8, max_iters=4,
+        )
+        mesh = make_mesh((2, 1), jax.devices()[:2])
+        model = SparseShardedBigClamModel(g, cfg, mesh)
+        assert model.comm_mode == "sparse", model.comm_mode
+        return model.fit(F0w).llh_history
+
+    cap_dir = run_scenario("cap_pressure", fit_cap,
+                           expect=["cap_pressure"])
+    cap_events = []
+    with open(os.path.join(cap_dir, EVENTS_NAME)) as f:
+        cap_events = [json.loads(l) for l in f if l.strip()]
+    comm = [e for e in cap_events if e["kind"] == "sparse_comm"]
+    hp = [e for e in cap_events if e["kind"] == "health"]
+    checks["cap_sparse_comm_events"] = bool(comm) and all(
+        isinstance(e.get("comm_cap"), int) and e.get("comm_mode")
+        for e in comm
+    )
+    checks["cap_counters_in_health"] = bool(hp) and all(
+        "cap_occupancy" in e and "dense_fallback" in e
+        and "exchanged_ids" in e for e in hp
+    )
+
+    # --- bit-identity: health off reproduces the health-on trajectory ---
+    cfg_on = base_cfg(conv_tol=1e-4, max_iters=100)
+    cfg_off = cfg_on.replace(health_every=0)
+    r_on = BigClamModel(g, cfg_on).fit(F0)
+    m_off = BigClamModel(g, cfg_off)
+    r_off = m_off.fit(F0)
+    checks["health_off_bit_identical"] = bool(
+        np.array_equal(r_on.F, r_off.F)
+        and r_on.llh_history == r_off.llh_history
+    )
+    off_state = m_off._step(m_off.init_state(F0))
+    checks["health_off_packless"] = off_state.health is None
+
+    # --- step-time delta, informational (the binding <2% pin is the
+    # host-bookkeeping measurement in tests/test_health.py) ---
+    m_on2 = BigClamModel(g, base_cfg(health_every=10))
+    s_on = step_time(m_on2._step, m_on2.init_state(F0), steps=20, warmup=3)
+    s_off = step_time(m_off._step, m_off.init_state(F0), steps=20, warmup=3)
+
+    # --- renderers ---
+    text, render_errors = render(div_dir)
+    checks["report_renders_anomaly"] = (
+        render_errors == 0 and "ANOMALIES: divergence" in text
+    )
+    obj, json_errors = render_json(div_dir)
+    checks["report_json_parses"] = (
+        json_errors == 0
+        and json.loads(json.dumps(obj))["anomalies"][0]["check"]
+        == "divergence"
+    )
+    frame = render_frame(healthy_dir)
+    checks["watch_renders"] = "llh" in frame and "grad_norm" in frame
+
+    ok = all(checks.values())
+    artifact = {
+        "gate": "health_r12",
+        "created_unix": round(time.time(), 1),
+        "pass": ok,
+        "checks": checks,
+        "scenarios": scenarios,
+        "step_time_health_on_s": round(s_on, 6),
+        "step_time_health_off_s": round(s_off, 6),
+        "note": (
+            "planted divergence/plateau/cap-pressure runs fire exactly "
+            "their matching anomaly kind; healthy baseline fires none; "
+            "all events schema-valid; health-off bit-identical. The "
+            "binding <2% overhead pin at the default cadence lives in "
+            "tests/test_health.py (step-time deltas on a 240-node CPU "
+            "toy are dominated by run-to-run jitter)."
+        ),
+    }
+    line = json.dumps(artifact, sort_keys=True)
+    print(line)
+    if out_path:
+        with open(out_path, "w") as f:
+            f.write(line + "\n")
+    if not ok:
+        bad = sorted(k for k, v in checks.items() if not v)
+        print(f"FAILED checks: {bad}", file=sys.stderr)
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
