@@ -178,12 +178,25 @@ def _add_common(p: argparse.ArgumentParser) -> None:
     )
     p.add_argument(
         "--seed-backend", default="auto",
-        choices=["auto", "numpy", "dense", "sampled", "sampled_device"],
+        choices=["auto", "baked", "numpy", "dense", "sampled",
+                 "sampled_device"],
         help="conductance scorer backend (ops.seeding.conductance): "
              "sampled_device runs the degree-capped estimator on the "
              "accelerator — the C5 path past the 16,384-node dense bound "
              "(scripts/device_seeding_bench.py measures the backends on "
-             "your hardware)",
+             "your hardware). On a graph-cache --graph, auto reads the "
+             "INGEST-BAKED scores when present (no re-streaming); baked "
+             "requires them (error with a re-ingest hint otherwise)",
+    )
+    p.add_argument(
+        "--store-native", action="store_true",
+        help="with --mesh/--distributed and a graph-cache --graph: feed "
+             "the trainer per-host from its own shard files "
+             "(StoreSharded/StoreRing — edge blocks, CSR tiles, and ring "
+             "buckets built from HostShard local rows; no host holds the "
+             "global edge set on the training path). Balance comes from "
+             "the cache (`ingest --balance`), trajectories are bit-"
+             "identical to the in-memory trainers",
     )
 
 
@@ -251,23 +264,43 @@ def _close_telemetry(tel) -> None:
 
 def _load_graph(args):
     """Graph for fit/sweep: text+--cache-dir compiles once then reloads;
-    everything else (text OR cache dir) goes through build_graph, which
-    dispatches cache directories itself. Cache loads self-heal crc-failed
-    shards (quarantine + re-ingest) unless --no-self-heal."""
+    everything else (text OR cache dir) goes through the store/parser
+    directly. Cache loads self-heal crc-failed shards (quarantine +
+    re-ingest) unless --no-self-heal. The opened GraphStore (when the
+    graph came from a cache) is stashed on args._store so seeding can
+    read ingest-baked seed scores and --store-native can feed the
+    trainers per-host."""
     from bigclam_tpu.graph import build_graph
-    from bigclam_tpu.graph.store import compile_graph_cache, is_cache_dir
+    from bigclam_tpu.graph.store import (
+        GraphStore,
+        compile_graph_cache,
+        is_cache_dir,
+    )
 
     heal = not getattr(args, "no_self_heal", False)
     path = args.graph
     cache = getattr(args, "cache_dir", None)
+    args._store = None
     if cache and not is_cache_dir(path):
         if not is_cache_dir(cache):
             print(
                 f"note: compiling graph cache {cache} from {path}",
                 file=sys.stderr,
             )
-            return compile_graph_cache(path, cache).load_graph()
-        return build_graph(cache, self_heal=heal)
+            args._store = compile_graph_cache(
+                path, cache,
+                seed=getattr(args, "seed", 0),
+                # forward the fit's cap so the bake runs the estimator the
+                # run will trust (ShardSeedScores.matches) — and so a
+                # capped run never pays the exact edge-quadratic pass
+                seed_cap=getattr(args, "seeding_degree_cap", None),
+            )
+            return args._store.load_graph()
+        args._store = GraphStore.open(cache, self_heal=heal)
+        return args._store.load_graph()
+    if is_cache_dir(path):
+        args._store = GraphStore.open(path, self_heal=heal)
+        return args._store.load_graph()
     return build_graph(path, self_heal=heal)
 
 
@@ -327,6 +360,19 @@ def _make_model(g, cfg, args):
             "--representation sparse yet (member-list kernels run the "
             "XLA searchsorted path; use --csr-kernels auto)"
         )
+    store_native = getattr(args, "store_native", False)
+    if store_native and not (args.mesh or args.distributed):
+        raise SystemExit(
+            "error: --store-native needs a sharded run (--mesh or "
+            "--distributed) — the store trainers load one shard slice "
+            "per host"
+        )
+    if store_native and cfg.representation == "sparse":
+        raise SystemExit(
+            "error: --store-native is not supported with "
+            "--representation sparse yet (the sparse trainers build "
+            "member-list state from the in-memory graph)"
+        )
     if args.mesh or args.distributed:
         import jax
 
@@ -334,6 +380,8 @@ def _make_model(g, cfg, args):
             RingBigClamModel,
             ShardedBigClamModel,
             SparseShardedBigClamModel,
+            StoreRingBigClamModel,
+            StoreShardedBigClamModel,
             make_mesh,
             make_multihost_mesh,
         )
@@ -366,6 +414,25 @@ def _make_model(g, cfg, args):
             return SparseShardedBigClamModel(
                 g, cfg, mesh, balance=args.balance
             )
+        if store_native:
+            store = getattr(args, "_store", None)
+            if store is None:
+                raise SystemExit(
+                    "error: --store-native needs --graph (or --cache-dir) "
+                    "to be a compiled graph cache (run `cli ingest` first)"
+                )
+            if args.balance:
+                raise SystemExit(
+                    "error: --store-native takes balance from the cache; "
+                    "re-ingest with `cli ingest --balance` instead of "
+                    "--balance"
+                )
+            cls = (
+                StoreRingBigClamModel
+                if args.schedule == "ring"
+                else StoreShardedBigClamModel
+            )
+            return cls(store, cfg, mesh)
         cls = RingBigClamModel if args.schedule == "ring" else ShardedBigClamModel
         return cls(g, cfg, mesh, balance=args.balance)
     if cfg.representation == "sparse":
@@ -381,8 +448,62 @@ def _init_F(g, cfg, args):
     from bigclam_tpu.ops import seeding
 
     if args.init == "conductance":
+        backend = getattr(args, "seed_backend", "auto")
+        store = getattr(args, "_store", None)
+        quiet = getattr(args, "quiet", False)
+        phi = None
+        if backend in ("auto", "baked") and store is not None:
+            # ingest-baked seed scores: the conductance pass (the dominant
+            # seeding cost) already ran at ingest; read it instead of
+            # re-streaming the graph (ISSUE 9)
+            try:
+                scores = store.load_seed_scores()
+                if scores.matches(cfg.seeding_degree_cap, cfg.seed):
+                    phi = scores.phi
+                    if not quiet:
+                        print(
+                            "[bigclam] seeding: using ingest-baked seed "
+                            "scores from the graph cache",
+                            file=sys.stderr,
+                        )
+                else:
+                    # the bake's estimator disagrees with this run's
+                    # seeding config — silently using it would change the
+                    # ranking vs the same fit on the raw text graph
+                    msg = (
+                        f"baked seed scores (cap={scores.cap}, "
+                        f"seed={scores.seed}) do not match this run "
+                        f"(--seeding-degree-cap {cfg.seeding_degree_cap}, "
+                        f"--seed {cfg.seed}); re-ingest with matching "
+                        "--seed-cap/--seed"
+                    )
+                    if backend == "baked":
+                        raise SystemExit(f"error: {msg}")
+                    if not quiet:
+                        print(
+                            f"note: {msg} — falling back to the "
+                            "streaming conductance pass",
+                            file=sys.stderr,
+                        )
+            except ValueError as e:
+                if backend == "baked":
+                    raise SystemExit(f"error: {e}")
+                if not quiet:
+                    print(
+                        f"note: {e}; falling back to the streaming "
+                        "conductance pass",
+                        file=sys.stderr,
+                    )
+        elif backend == "baked":
+            raise SystemExit(
+                "error: --seed-backend baked needs the graph to come "
+                "from a compiled cache with baked seed scores (run "
+                "`cli ingest` and pass the cache dir as --graph)"
+            )
         seeds = seeding.conductance_seeds(
-            g, cfg, backend=getattr(args, "seed_backend", "auto")
+            g, cfg,
+            backend="auto" if backend == "baked" else backend,
+            phi=phi,
         )
         return seeding.init_F(g, seeds, cfg)
     rng = np.random.default_rng(cfg.seed)
@@ -672,6 +793,9 @@ def _cmd_ingest(args, tel=None) -> int:
         balance=args.balance,
         overwrite=args.overwrite,
         profile=prof,
+        seed_bake=not args.no_seed_bake,
+        seed_cap=args.seed_cap,
+        seed=args.seed,
     )
     out = {
         "cache_dir": args.cache_dir,
@@ -679,6 +803,11 @@ def _cmd_ingest(args, tel=None) -> int:
         "edges": store.num_directed_edges // 2,
         "shards": store.num_shards,
         "balanced": store.balanced,
+        # from the manifest, not the flag: the work guard can skip an
+        # uncapped bake on hub-heavy graphs (store.SEED_BAKE_EXACT_MAX_WORK)
+        "seed_baked": store.manifest.get("seed_scores", {}).get(
+            "baked", False
+        ),
         "chunk_bytes": args.chunk_bytes,
         **prof.report(),
     }
@@ -984,6 +1113,24 @@ def main(argv=None) -> int:
         "--balance", action="store_true",
         help="bake the degree-balance permutation (parallel/balance.py) "
              "into the shards, so multi-host loads are pre-balanced",
+    )
+    p_ing.add_argument(
+        "--no-seed-bake", action="store_true",
+        help="skip baking per-node conductance seed scores into the cache "
+             "(default: bake — fit-time seeding on the cache then reads "
+             "scores instead of re-streaming the graph)",
+    )
+    p_ing.add_argument(
+        "--seed-cap", type=int, default=None,
+        help="degree cap for the baked conductance scorer (the exact "
+             "triangle pass is edge-quadratic on hubs; same splitmix64 "
+             "estimator as --seeding-degree-cap, exact when cap >= max "
+             "degree)",
+    )
+    p_ing.add_argument(
+        "--seed", type=int, default=0,
+        help="PRNG seed the capped scorer's sample stream derives from "
+             "(match the fit's --seed for identical rankings)",
     )
     p_ing.add_argument("--overwrite", action="store_true")
     p_ing.add_argument(

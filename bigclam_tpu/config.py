@@ -290,6 +290,20 @@ class BigClamConfig:
                                         # (256/512 tuned fastest on v5e:
                                         # one-hot matmul cost scales with B)
     csr_tile_t: int = 512               # edges per kernel tile
+    csr_store_pad_tiles: int = 0        # store-native tile builds (ISSUE 9):
+                                        # uniform per-shard tile-count pad
+                                        # the hosts agree on. 0 = auto (a
+                                        # tiny cross-host max exchange of
+                                        # the local tile counts — one int);
+                                        # explicit values let pod jobs skip
+                                        # the exchange and keep compiled
+                                        # shapes deterministic across
+                                        # restarts. Must be >= every
+                                        # host's true tile count (loudly
+                                        # checked). Host-only: tile arrays
+                                        # ride as jit arguments, so shape
+                                        # changes retrace without a step-
+                                        # key change
     csr_k_block: int = 0                # K columns per kernel invocation on
                                         # the single-chip K-blocked path
                                         # (train_pass_csr_grouped_kblocked).

@@ -34,6 +34,8 @@ Cache directory layout::
     perm.npy                     (balanced caches) old id -> new id
     shard_00000.indptr.npy       per-shard local CSR row pointers (rebased)
     shard_00000.indices.npy      per-shard neighbor lists (global int32 ids)
+    shard_00000.phi.npy          per-shard ingest-baked seed scores
+                                 (ego-net conductance, float64; format v2)
     ...
 """
 
@@ -51,11 +53,28 @@ import numpy as np
 
 from bigclam_tpu.graph.csr import Graph
 from bigclam_tpu.graph.ingest import dedup_directed
-from bigclam_tpu.graph.stream import DEFAULT_CHUNK_BYTES, stream_edge_list
+from bigclam_tpu.graph.stream import (
+    DEFAULT_CHUNK_BYTES,
+    BoundedBlobCache,
+    stream_edge_list,
+)
 
-MANIFEST_VERSION = 1
+# v2 (ISSUE 9): ingest-baked per-node seed scores (shard_*.phi.npy +
+# per-entry "phi" crc). v1 caches still LOAD (graceful migration — the
+# graph bytes are identical); only load_seed_scores refuses on them, with
+# a re-ingest hint, and fit-time seeding falls back to the streaming
+# conductance pass.
+MANIFEST_VERSION = 2
+SUPPORTED_MANIFEST_VERSIONS = (1, 2)
 MANIFEST_NAME = "manifest.json"
 QUARANTINE_DIR = "quarantine"
+
+# The EXACT seed-bake triangle pass expands sum_v deg(v)^2 two-hop entries
+# — edge-quadratic on hubs, which SURVEY.md §7 flags as infeasible at the
+# graph scale the store targets. Past this many entries an uncapped ingest
+# SKIPS the bake with a --seed-cap hint instead of silently walling for
+# hours (~a few minutes of vectorized sweep at the threshold).
+SEED_BAKE_EXACT_MAX_WORK = 2e10
 
 
 class ShardCorruption(ValueError):
@@ -117,6 +136,29 @@ class HostShard:
         return self.hi - self.lo
 
 
+@dataclasses.dataclass(frozen=True)
+class ShardSeedScores:
+    """A node-contiguous slice of the ingest-baked per-node seed scores
+    (ego-net conductance phi, float64). Same files_read isolation contract
+    as HostShard: a host reads exactly the phi blobs of its own shards.
+    `cap`/`seed` echo the bake's estimator parameters so fit-time callers
+    can check they match the run's seeding config before trusting the
+    scores (cli._init_F falls back to the streaming pass on mismatch)."""
+
+    lo: int
+    hi: int
+    phi: np.ndarray
+    cap: Optional[int]
+    seed: Optional[int]
+    files_read: Tuple[str, ...]
+
+    def matches(self, cap: Optional[int], seed: int) -> bool:
+        """True when the baked estimator agrees with a fit that would
+        stream with `seeding_degree_cap=cap, seed=seed` (the stream seed
+        only matters once a cap engages the sampler)."""
+        return self.cap == cap and (cap is None or self.seed == seed)
+
+
 class GraphStore:
     """Handle on a compiled cache directory (validated manifest).
 
@@ -143,10 +185,10 @@ class GraphStore:
         except (OSError, ValueError) as e:
             raise ValueError(f"{directory}: not a graph cache ({e})") from e
         version = manifest.get("format_version")
-        if version != MANIFEST_VERSION:
+        if version not in SUPPORTED_MANIFEST_VERSIONS:
             raise ValueError(
-                f"{directory}: cache format version {version!r} != "
-                f"{MANIFEST_VERSION} (stale cache; re-run "
+                f"{directory}: cache format version {version!r} not in "
+                f"{SUPPORTED_MANIFEST_VERSIONS} (stale cache; re-run "
                 "`python -m bigclam_tpu.cli ingest`)"
             )
         for key in ("num_nodes", "num_directed_edges", "num_shards",
@@ -326,6 +368,54 @@ class GraphStore:
             host_id * per, (host_id + 1) * per, verify=verify
         )
 
+    def load_seed_scores(
+        self,
+        first_shard: int = 0,
+        last_shard: Optional[int] = None,
+        verify: bool = True,
+    ) -> ShardSeedScores:
+        """The ingest-baked per-node conductance scores of shards
+        [first_shard, last_shard), reading ONLY those shards' phi blobs.
+
+        Raises ValueError with a re-ingest hint on caches compiled before
+        the seed bake existed (format v1) or with the bake disabled —
+        callers (cli seeding) degrade to the streaming conductance pass."""
+        S = self.num_shards
+        last = S if last_shard is None else last_shard
+        if not (0 <= first_shard < last <= S):
+            raise ValueError(
+                f"shard range [{first_shard}, {last}) outside [0, {S})"
+            )
+        entries = self.manifest["shards"][first_shard:last]
+        if any("phi" not in e for e in entries):
+            raise ValueError(
+                f"{self.directory}: cache has no baked seed scores "
+                "(compiled before format v2, or with the seed bake "
+                "disabled) — re-ingest to bake seeds "
+                "(`python -m bigclam_tpu.cli ingest`), or use a "
+                "streaming --seed-backend"
+            )
+        files_read: List[str] = []
+        parts = [
+            np.asarray(
+                self._load_blob(
+                    e["phi"], e["crc32"].get("phi"), verify, False,
+                    files_read, shard=first_shard + i,
+                ),
+                np.float64,
+            )
+            for i, e in enumerate(entries)
+        ]
+        meta = self.manifest.get("seed_scores", {})
+        return ShardSeedScores(
+            lo=int(entries[0]["lo"]),
+            hi=int(entries[-1]["hi"]),
+            phi=np.concatenate(parts) if len(parts) > 1 else parts[0],
+            cap=meta.get("cap"),
+            seed=meta.get("seed"),
+            files_read=tuple(files_read),
+        )
+
     def load_raw_ids(self, verify: bool = True) -> np.ndarray:
         entry = self.manifest["files"]["raw_ids"]
         return np.asarray(
@@ -502,6 +592,9 @@ class GraphStore:
                 os.fsync(f.fileno())
             os.replace(tmp, path)
         new_crc = {
+            # start from the existing stamps: a shard rebuild must not strip
+            # the phi blob's crc (the seed scores are untouched by it)
+            **entry["crc32"],
             "indptr": _crc32_file(
                 os.path.join(self.directory, entry["indptr"])
             ),
@@ -516,6 +609,226 @@ class GraphStore:
                 os.path.join(self.directory, MANIFEST_NAME), self.manifest
             )
         return restamped
+
+
+# --------------------------------------------------------------------------
+# ingest-time seed bake (ISSUE 9): conductance scores next to the shards
+# --------------------------------------------------------------------------
+
+
+def _phi_name(s: int) -> str:
+    return f"shard_{s:05d}.phi.npy"
+
+
+def _gather_rows(indptr_b: np.ndarray, data_b: np.ndarray, rows: np.ndarray):
+    """Concatenate CSR rows `rows` of (indptr_b, data_b) — the two-hop
+    expansion of the shard-pair sweeps. Returns (values, counts)."""
+    starts = indptr_b[rows]
+    cnts = indptr_b[rows + 1] - starts
+    total = int(cnts.sum())
+    if total == 0:
+        return data_b[:0], cnts
+    take = np.repeat(starts, cnts) + (
+        np.arange(total, dtype=np.int64)
+        - np.repeat(np.concatenate([[0], np.cumsum(cnts[:-1])]), cnts)
+    )
+    return data_b[take], cnts
+
+
+def bake_seed_scores(
+    cache_dir: str,
+    shard_table: List[dict],
+    deg_final: np.ndarray,
+    num_directed_edges: int,
+    cap: Optional[int] = None,
+    seed: int = 0,
+    profile=None,
+) -> None:
+    """Compute per-node ego-net conductance OUT OF CORE over the written
+    shard blobs and bake per-shard phi blobs next to them (mutates
+    `shard_table` entries in place with "phi" names + crcs; the caller
+    writes the manifest).
+
+    The fit-time scorer streams the whole graph again (triangle pass +
+    neighbor-degree sums); here both passes run at ingest, where the shard
+    blobs are already hot, as SHARD-PAIR sweeps: tri(u) needs N(v) for
+    v in N(u), so for each ordered shard pair (a, b) the sweep intersects
+    shard a's rows with the neighbor lists v owned by shard b — at most
+    two shard blobs (BoundedBlobCache) plus O(N) flag/degree vectors are
+    resident, never the global CSR. With cap=None the counts are exact
+    integers and the baked phi is BIT-IDENTICAL to
+    ops.seeding.conductance(g, backend="numpy"); with a degree cap the
+    capped lists come from the same splitmix64 sampler
+    (seeding.capped_neighbor_lists keyed by GLOBAL row id), so the
+    estimates match triangle_counts_sampled up to float summation order.
+    """
+    # lazy: ops.seeding is imported only here so the default ingest path
+    # stays jax-free AND cheap to import (seeding's module deps are numpy
+    # + config only, but keep the contract explicit)
+    from bigclam_tpu.ops.seeding import (
+        capped_neighbor_lists,
+        phi_from_counts,
+    )
+
+    n = int(deg_final.size)
+    blobs = BoundedBlobCache(capacity=4)
+
+    def shard_csr(entry):
+        ip = np.asarray(
+            blobs.get(os.path.join(cache_dir, entry["indptr"])), np.int64
+        )
+        dx = blobs.get(os.path.join(cache_dir, entry["indices"]))
+        return ip, dx
+
+    # --- pass 1: S1(u) = sum of neighbor degrees, one shard at a time ---
+    s1 = np.zeros(n, dtype=np.float64)
+    for e in shard_table:
+        lo, hi = int(e["lo"]), int(e["hi"])
+        if hi <= lo:
+            continue
+        ip, dx = shard_csr(e)
+        rows = np.repeat(
+            np.arange(hi - lo, dtype=np.int64), np.diff(ip)
+        )
+        s1[lo:hi] = np.bincount(
+            rows, weights=deg_final[dx].astype(np.float64),
+            minlength=hi - lo,
+        )
+        if profile is not None:
+            profile.sample_rss()
+
+    # --- pass 2: triangle counts via ordered shard-pair sweeps ---
+    # Vectorized (no per-row Python loop — O(N*S) iterations would wall an
+    # ingest at real shard counts): per pair (a, b), membership "w in
+    # N(u)" is a searchsorted against shard a's globally-sorted ego keys
+    # u*n + w (CSR rows ascending, neighbor lists ascending — the same
+    # trick as triangle_counts_sampled), with the two-hop expansion
+    # processed in bounded entry chunks.
+    chunk_entries = 1 << 22
+    scratch = None
+    if cap is None:
+        tri_acc = np.zeros(n, dtype=np.int64)
+    else:
+        tri_acc = np.zeros(n, dtype=np.float64)
+        # same stream-seed derivation as triangle_counts_sampled(rng)
+        stream_seed = int(np.random.default_rng(seed).integers(2**63))
+        cdeg_all = np.minimum(deg_final, cap)
+        inner_w = deg_final / np.maximum(cdeg_all, 1)
+        # capped lists are computed ONCE per shard and spilled to scratch
+        # blobs riding the same BoundedBlobCache as the raw CSR: the pair
+        # sweep reads each shard O(S) times, and the per-hub Fisher-Yates
+        # sampler (a Python loop) must not rerun per pair
+        import tempfile
+
+        # system tmp, not cache_dir: a crashed bake must not leave scratch
+        # blobs inside a directory the manifest will later validate
+        scratch = tempfile.mkdtemp(prefix="bigclam_seed_bake_")
+
+        def capped_csr_of(idx: int) -> tuple:
+            return (
+                np.asarray(
+                    blobs.get(os.path.join(scratch, f"{idx}.indptr.npy")),
+                    np.int64,
+                ),
+                blobs.get(os.path.join(scratch, f"{idx}.indices.npy")),
+            )
+
+    try:
+        if scratch is not None:
+            for s, e in enumerate(shard_table):
+                ip, dx = shard_csr(e)
+                ip_c, dx_c = capped_neighbor_lists(
+                    ip, dx, cap, stream_seed, row_offset=int(e["lo"])
+                )
+                np.save(os.path.join(scratch, f"{s}.indptr.npy"), ip_c)
+                np.save(os.path.join(scratch, f"{s}.indices.npy"), dx_c)
+                if profile is not None:
+                    profile.sample_rss()
+        for a, ea in enumerate(shard_table):
+            lo_a, hi_a = int(ea["lo"]), int(ea["hi"])
+            if hi_a <= lo_a:
+                continue
+            # shard a's arrays and its derived ego keys depend only on the
+            # OUTER shard: hoisted out of the pair loop (local refs keep
+            # them alive past any cache eviction by the inner-b reads)
+            ipa, dxa = shard_csr(ea) if cap is None else capped_csr_of(a)
+            rows_a = hi_a - lo_a
+            ego_src = np.repeat(
+                np.arange(rows_a, dtype=np.int64), np.diff(ipa)
+            )
+            ego_keys = (ego_src + lo_a) * n + dxa       # sorted ascending
+            for b, eb in enumerate(shard_table):
+                lo_b, hi_b = int(eb["lo"]), int(eb["hi"])
+                if hi_b <= lo_b:
+                    continue
+                ipb, dxb = shard_csr(eb) if cap is None else capped_csr_of(b)
+                sel = np.flatnonzero((dxa >= lo_b) & (dxa < hi_b))
+                if sel.size == 0:
+                    continue
+                v_rows = dxa[sel].astype(np.int64) - lo_b
+                cnt_v = (ipb[v_rows + 1] - ipb[v_rows]).astype(np.int64)
+                # chunk the selected edges so the expansion stays bounded
+                cum = np.cumsum(cnt_v)
+                splits = np.searchsorted(
+                    cum,
+                    np.arange(chunk_entries, int(cum[-1]) + chunk_entries,
+                              chunk_entries),
+                )
+                starts = np.concatenate(
+                    [[0], np.minimum(splits + 1, sel.size)]
+                )
+                for c0, c1 in zip(starts[:-1], starts[1:]):
+                    if c0 >= c1:
+                        continue
+                    piece = sel[c0:c1]
+                    z, cnts = _gather_rows(
+                        ipb, dxb, dxa[piece].astype(np.int64) - lo_b
+                    )
+                    if z.size == 0:
+                        continue
+                    z_u = np.repeat(ego_src[piece], cnts)
+                    cand = (z_u + lo_a) * n + z
+                    idx = np.searchsorted(ego_keys, cand)
+                    hit = (idx < ego_keys.size) & (
+                        ego_keys[np.minimum(idx, ego_keys.size - 1)]
+                        == cand
+                    )
+                    if cap is None:
+                        tri_acc[lo_a:hi_a] += np.bincount(
+                            z_u[hit], minlength=rows_a
+                        )
+                    else:
+                        w = np.repeat(inner_w[dxa[piece]], cnts)
+                        tri_acc[lo_a:hi_a] += np.bincount(
+                            z_u[hit], weights=w[hit], minlength=rows_a
+                        )
+                if profile is not None:
+                    profile.sample_rss()
+    finally:
+        if scratch is not None:
+            shutil.rmtree(scratch, ignore_errors=True)
+    if cap is None:
+        tri = tri_acc // 2
+    else:
+        pairs = cdeg_all * (cdeg_all - 1)
+        scale = np.where(
+            pairs > 0,
+            deg_final * (deg_final - 1) / np.maximum(pairs, 1),
+            0.0,
+        )
+        tri = tri_acc / 2.0 * scale
+
+    phi = phi_from_counts(
+        deg_final.astype(np.int64), s1, tri, float(num_directed_edges)
+    )
+
+    # --- write per-shard phi blobs, stamp the table in place ---
+    for s, e in enumerate(shard_table):
+        lo, hi = int(e["lo"]), int(e["hi"])
+        name = _phi_name(s)
+        np.save(os.path.join(cache_dir, name), phi[lo:hi])
+        e["phi"] = name
+        e["crc32"]["phi"] = _crc32_file(os.path.join(cache_dir, name))
 
 
 # --------------------------------------------------------------------------
@@ -602,6 +915,9 @@ def compile_graph_cache(
     balance: bool = False,
     overwrite: bool = False,
     profile=None,
+    seed_bake: bool = True,
+    seed_cap: Optional[int] = None,
+    seed: int = 0,
 ) -> GraphStore:
     """Compile a SNAP edge list into a binary shard cache, out of core.
 
@@ -616,6 +932,13 @@ def compile_graph_cache(
       shards   (balance=True: relabel through the balance permutation and
                re-scatter first) write per-shard packed CSR blobs + the
                versioned manifest with per-blob crc32s
+      seed_bake (seed_bake=True, the default) per-node conductance scores
+               baked next to the shards (bake_seed_scores: shard-pair
+               sweeps over the just-written blobs, O(2 shards + N) RSS),
+               so fit-time seeding on a cache reads scores instead of
+               re-streaming the graph. seed_cap engages the degree-capped
+               splitmix64 estimator (exact when cap >= max degree); `seed`
+               is the cfg-level PRNG seed its stream derives from
 
     Shard s owns node rows [s*rows, (s+1)*rows) with
     rows = ceil(max(N, num_shards) / num_shards) — exactly the contiguous
@@ -657,7 +980,8 @@ def compile_graph_cache(
     try:
         return _compile(
             text_path, cache_dir, spill_dir, manifest_path, num_shards,
-            chunk_bytes, workers, balance, profile,
+            chunk_bytes, workers, balance, profile, seed_bake, seed_cap,
+            seed,
         )
     finally:
         shutil.rmtree(spill_dir, ignore_errors=True)
@@ -665,7 +989,7 @@ def compile_graph_cache(
 
 def _compile(
     text_path, cache_dir, spill_dir, manifest_path, num_shards,
-    chunk_bytes, workers, balance, profile,
+    chunk_bytes, workers, balance, profile, seed_bake, seed_cap, seed,
 ) -> GraphStore:
     # --- scan: parse chunks, spill raw pairs, merge unique raw ids ---
     chunk_paths: List[str] = []
@@ -743,6 +1067,7 @@ def _compile(
 
     shard_table = []
     total_directed = 0
+    deg_final = np.zeros(max(n, 1), dtype=np.int64)  # FINAL node order
     with profile.stage("shards"):
         for s in range(num_shards):
             arr = final.read(s)
@@ -758,6 +1083,7 @@ def _compile(
                     np.bincount(arr[:, 0] - lo, minlength=hi - lo),
                     out=local_indptr[1:],
                 )
+            deg_final[lo:hi] = np.diff(local_indptr)
             indices = arr[:, 1].astype(np.int32)
             iname, dname = _shard_files(s)
             np.save(os.path.join(cache_dir, iname), local_indptr)
@@ -803,6 +1129,30 @@ def _compile(
                 "crc32": _crc32_file(os.path.join(cache_dir, "perm.npy")),
             }
 
+    # --- seed bake: conductance scores next to the shards (ISSUE 9) ---
+    bake_skipped = None
+    if seed_bake and seed_cap is None:
+        exact_work = float(
+            np.square(deg_final[:n].astype(np.float64)).sum()
+        )
+        if exact_work > SEED_BAKE_EXACT_MAX_WORK:
+            seed_bake = False
+            bake_skipped = "exact_work"
+            print(
+                f"warning: skipping the seed bake — the exact triangle "
+                f"pass would expand {exact_work:.2e} two-hop entries "
+                f"(> {SEED_BAKE_EXACT_MAX_WORK:.0e}); re-run ingest with "
+                "--seed-cap to bake the degree-capped estimator instead",
+                file=sys.stderr,
+            )
+    if seed_bake:
+        with profile.stage("seed_bake"):
+            bake_seed_scores(
+                cache_dir, shard_table, deg_final[:n], total_directed,
+                cap=seed_cap, seed=seed, profile=profile,
+            )
+            profile.sample_rss()
+
     manifest = {
         "format_version": MANIFEST_VERSION,
         "num_nodes": n,
@@ -815,6 +1165,13 @@ def _compile(
                    "raw_ids": "int64"},
         "shards": shard_table,
         "files": files,
+        "seed_scores": (
+            {"baked": True, "cap": seed_cap, "seed": seed}
+            if seed_bake
+            else {"baked": False, "skipped": bake_skipped}
+            if bake_skipped
+            else {"baked": False}
+        ),
         "source": {
             "path": os.path.abspath(text_path),
             "bytes": os.path.getsize(text_path),
@@ -832,6 +1189,7 @@ def _compile(
             nodes=n,
             shards=num_shards,
             balanced=perm is not None,
+            seed_baked=bool(seed_bake),
             cache_dir=cache_dir,
         )
     return GraphStore(cache_dir, manifest)

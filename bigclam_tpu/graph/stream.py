@@ -20,6 +20,7 @@ pools) loaded, and forking a threaded process is undefined behavior.
 
 from __future__ import annotations
 
+import collections
 import os
 from typing import Iterator, List, Tuple
 
@@ -128,6 +129,33 @@ def stream_edge_list(
             pending.append(ex.submit(parse_span, path, start, end))
         while pending:
             yield pending.popleft().result()
+
+
+class BoundedBlobCache:
+    """np.load results keyed by path with at most `capacity` blobs resident
+    (LRU). The ingest-time seed bake (graph/store.bake_seed_scores) sweeps
+    shard PAIRS — each shard's blobs are re-read O(num_shards) times — and
+    this keeps the sweep's residency at O(capacity * shard bytes) while the
+    hot outer-loop shard never re-reads. Same O(shard)-not-O(E) contract as
+    the chunked parse above, applied to the binary blobs."""
+
+    def __init__(self, capacity: int = 4):
+        assert capacity >= 1
+        self.capacity = capacity
+        self._cache: "collections.OrderedDict[str, np.ndarray]" = (
+            collections.OrderedDict()
+        )
+
+    def get(self, path: str) -> np.ndarray:
+        hit = self._cache.get(path)
+        if hit is not None:
+            self._cache.move_to_end(path)
+            return hit
+        arr = np.load(path)
+        self._cache[path] = arr
+        while len(self._cache) > self.capacity:
+            self._cache.popitem(last=False)
+        return arr
 
 
 def load_edge_list_streaming(

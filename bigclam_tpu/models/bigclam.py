@@ -66,6 +66,9 @@ _HOST_ONLY_FIELDS = dict(
     # (it rescales the baked Armijo ladder — a rollback's step cut compiles
     # a new step, cached by this key)
     rollback_budget=0, rollback_shrink=0.0, rollback_snapshot_every=0,
+    # store-native tile pad: changes data shapes (jit arguments), not
+    # step-baked constants — retraces ride the shape key, not this one
+    csr_store_pad_tiles=0,
 )
 
 

@@ -124,6 +124,20 @@ def build_block_tiles(g: Graph, block_b: int = 512, tile_t: int = 512) -> BlockT
     return build_block_tiles_arrays(g.src, g.dst, g.num_nodes, block_b, tile_t)
 
 
+def _pad_leading(a: np.ndarray, pad_to: int, fill) -> np.ndarray:
+    """Pad a's LEADING axis to pad_to with `fill` — the one padding
+    convention every stacked tile layout shares (padding tiles attach
+    after the real ones; block_id fills carry the layout's last valid
+    block id so the kernels stay in range with mask 0). Every pad site in
+    this module goes through here: the store-built and host-global
+    layouts must stay byte-identical, so the convention lives in exactly
+    one place."""
+    pad = pad_to - a.shape[0]
+    if pad <= 0:
+        return a
+    return np.concatenate([a, np.full((pad,) + a.shape[1:], fill, a.dtype)])
+
+
 def layout_economical(
     slots: int, num_directed_edges: int, n_blocks_total: int, tile_t: int
 ) -> bool:
@@ -301,6 +315,179 @@ def shard_grouped_tiles(
     )
 
 
+def _local_shard_edge_slices(shard, dp: int, n_pad: int):
+    """Yield (global_shard_id, src_shard_local, dst_global) per store shard
+    this host holds — the shared edge-slicing of every store-native builder.
+
+    `shard` is a graph/store.HostShard (duck-typed: lo/indptr/indices/
+    num_nodes/shard_ids): its indptr is rebased at `lo` and its indices
+    keep GLOBAL dst ids, so slicing shard s's rows out needs only the
+    manifest node ranges — no global CSR anywhere.
+    """
+    shard_rows = n_pad // dp
+    n = shard.num_nodes
+    deg = np.diff(shard.indptr)
+    for s in shard.shard_ids:
+        glo = min(s * shard_rows, n)
+        ghi = min((s + 1) * shard_rows, n)
+        e0 = int(shard.indptr[glo - shard.lo])
+        e1 = int(shard.indptr[ghi - shard.lo])
+        src_local = (
+            np.repeat(
+                np.arange(glo, ghi, dtype=np.int64),
+                deg[glo - shard.lo : ghi - shard.lo],
+            )
+            - s * shard_rows
+        ).astype(np.int32)
+        yield s, src_local, np.asarray(shard.indices[e0:e1], np.int32)
+
+
+def local_block_tile_parts(
+    shard, dp: int, n_pad: int, block_b: int, tile_t: int
+) -> list:
+    """Per-local-shard BlockTiles built from a HostShard — the store-native
+    first stage of shard_block_tiles (src rebased shard-local, dst GLOBAL).
+    The caller pads tile counts to the cross-host maximum
+    (stack_block_tile_parts) so shard_map stays SPMD."""
+    assert n_pad % dp == 0 and (n_pad // dp) % block_b == 0, (
+        n_pad, dp, block_b,
+    )
+    shard_rows = n_pad // dp
+    return [
+        build_block_tiles_arrays(src, dst, shard_rows, block_b, tile_t)
+        for _, src, dst in _local_shard_edge_slices(shard, dp, n_pad)
+    ]
+
+
+def stack_block_tile_parts(parts: list, pad_tiles: int) -> "ShardedBlockTiles":
+    """Pad local BlockTiles to `pad_tiles` (the GLOBAL max tile count — from
+    the manifest-agreed geometry or a tiny cross-host max exchange) and
+    stack on a leading local-shard axis. Identical to the matching rows of
+    shard_block_tiles when pad_tiles is the true global max."""
+    local_max = max(p.n_tiles for p in parts)
+    if pad_tiles < local_max:
+        raise ValueError(
+            f"pad_tiles={pad_tiles} below this host's tile count "
+            f"{local_max} — the cross-host max exchange is broken"
+        )
+    n_blocks = parts[0].n_blocks
+
+    def pad_stack(field: str, fill):
+        return np.stack(
+            [_pad_leading(getattr(p, field), pad_tiles, fill) for p in parts]
+        )
+
+    return ShardedBlockTiles(
+        src_local=pad_stack("src_local", 0),
+        dst=pad_stack("dst", 0),
+        mask=pad_stack("mask", 0.0),
+        block_id=pad_stack("block_id", n_blocks - 1),
+        block_b=parts[0].block_b,
+        tile_t=parts[0].tile_t,
+        n_blocks=n_blocks,
+        shard_rows=n_blocks * parts[0].block_b,
+    )
+
+
+def shard_block_tiles_local(
+    shard, dp: int, n_pad: int, block_b: int, tile_t: int,
+    pad_tiles: int = 0,
+) -> "ShardedBlockTiles":
+    """This host's rows of the sharded block-tile layout, built from a
+    per-host graph-store slice — the out-of-core twin of shard_block_tiles:
+    no global CSR exists anywhere. pad_tiles=0 pads to the LOCAL max
+    (exact on single-host loads, where local == global)."""
+    parts = local_block_tile_parts(shard, dp, n_pad, block_b, tile_t)
+    return stack_block_tile_parts(
+        parts, pad_tiles or max(p.n_tiles for p in parts)
+    )
+
+
+def local_ring_tile_parts(
+    shard, dp: int, n_pad: int, block_b: int, tile_t: int
+) -> list:
+    """Per-(local shard, phase) BlockTiles from a HostShard — the
+    store-native first stage of ring_block_tiles. dst is stored LOCAL to
+    the rotating shard resident in that phase (dst - ((i + r) % dp) *
+    shard_rows): the translation needs only the manifest node ranges.
+    Returns a list of per-local-shard lists of dp phase parts."""
+    assert n_pad % dp == 0 and (n_pad // dp) % block_b == 0, (
+        n_pad, dp, block_b,
+    )
+    shard_rows = n_pad // dp
+    out = []
+    for i, src_local, dst in _local_shard_edge_slices(shard, dp, n_pad):
+        phase = ((dst.astype(np.int64) // shard_rows) - i) % dp
+        # CSR order within each bucket (matches ring_block_tiles' global
+        # lexsort, which is stable within one (shard, phase) run)
+        order = np.lexsort((np.arange(dst.size), phase))
+        s_sorted = src_local[order]
+        d_sorted = dst[order].astype(np.int64)
+        ph = phase[order]
+        bounds = np.searchsorted(ph, np.arange(dp + 1))
+        phase_parts = []
+        for r in range(dp):
+            lo, hi = bounds[r], bounds[r + 1]
+            phase_parts.append(
+                build_block_tiles_arrays(
+                    s_sorted[lo:hi],
+                    d_sorted[lo:hi] - ((i + r) % dp) * shard_rows,
+                    shard_rows,
+                    block_b,
+                    tile_t,
+                )
+            )
+        out.append(phase_parts)
+    return out
+
+
+def stack_ring_tile_parts(parts: list, pad_tiles: int) -> "RingBlockTiles":
+    """Pad per-(local shard, phase) BlockTiles to the global max tile count
+    and stack into (n_local, dp, n_tiles, ...) arrays — this host's rows of
+    ring_block_tiles."""
+    flat = [p for phase_parts in parts for p in phase_parts]
+    local_max = max(p.n_tiles for p in flat)
+    if pad_tiles < local_max:
+        raise ValueError(
+            f"pad_tiles={pad_tiles} below this host's ring tile count "
+            f"{local_max} — the cross-host max exchange is broken"
+        )
+    n_blocks = flat[0].n_blocks
+    dpp = len(parts[0])
+
+    def pad_stack(field: str, fill):
+        stacked = np.stack(
+            [_pad_leading(getattr(p, field), pad_tiles, fill) for p in flat]
+        )
+        return stacked.reshape((len(parts), dpp) + stacked.shape[1:])
+
+    return RingBlockTiles(
+        src_local=pad_stack("src_local", 0),
+        dst_local=pad_stack("dst", 0),
+        mask=pad_stack("mask", 0.0),
+        block_id=pad_stack("block_id", n_blocks - 1),
+        block_b=flat[0].block_b,
+        tile_t=flat[0].tile_t,
+        n_blocks=n_blocks,
+        shard_rows=n_blocks * flat[0].block_b,
+    )
+
+
+def ring_block_tiles_local(
+    shard, dp: int, n_pad: int, block_b: int, tile_t: int,
+    pad_tiles: int = 0,
+) -> "RingBlockTiles":
+    """This host's rows of the ring (shard, phase) tile layout, built from
+    a per-host graph-store slice. pad_tiles=0 pads to the LOCAL max (exact
+    on single-host loads)."""
+    parts = local_ring_tile_parts(shard, dp, n_pad, block_b, tile_t)
+    return stack_ring_tile_parts(
+        parts,
+        pad_tiles
+        or max(p.n_tiles for phase_parts in parts for p in phase_parts),
+    )
+
+
 class RingBlockTiles(NamedTuple):
     """Per-(shard, ring-phase) block-tile layouts for the ring-pass CSR
     schedule (parallel/ring.py): in phase r, shard i runs the kernels over
@@ -380,14 +567,7 @@ def ring_block_tiles(
     n_blocks = parts[0].n_blocks
 
     def pad_stack(field: str, fill):
-        outs = []
-        for p in parts:
-            a = getattr(p, field)
-            pad = n_tiles - a.shape[0]
-            if pad:
-                filler = np.full((pad,) + a.shape[1:], fill, a.dtype)
-                a = np.concatenate([a, filler])
-            outs.append(a)
+        outs = [_pad_leading(getattr(p, field), n_tiles, fill) for p in parts]
         return np.stack(outs).reshape((dp, dp) + outs[0].shape)
 
     return RingBlockTiles(
@@ -456,16 +636,9 @@ def shard_block_tiles(
     n_blocks = parts[0].n_blocks
 
     def pad_stack(field: str, fill):
-        outs = []
-        for p in parts:
-            a = getattr(p, field)
-            pad = n_tiles - a.shape[0]
-            if pad:
-                shape = (pad,) + a.shape[1:]
-                filler = np.full(shape, fill, a.dtype)
-                a = np.concatenate([a, filler])
-            outs.append(a)
-        return np.stack(outs)
+        return np.stack(
+            [_pad_leading(getattr(p, field), n_tiles, fill) for p in parts]
+        )
 
     return ShardedBlockTiles(
         src_local=pad_stack("src_local", 0),

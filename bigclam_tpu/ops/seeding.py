@@ -113,6 +113,54 @@ def _splitmix64(x: int) -> int:
     return x ^ (x >> 31)
 
 
+def capped_neighbor_lists(
+    indptr: np.ndarray,
+    indices: np.ndarray,
+    cap: int,
+    seed: int,
+    row_offset: int = 0,
+):
+    """Array-based capped-list sampler over a CSR row range.
+
+    The splitmix64 stream is keyed by the GLOBAL row id `row_offset + r`,
+    so a shard-local call (the graph store's ingest-time seed bake,
+    graph/store.bake_seed_scores) produces bit-identical lists to the
+    whole-graph call restricted to those rows — rankings never depend on
+    who computed them. Returns (indptr_c, indices_c) with each capped
+    list sorted ascending.
+    """
+    indptr = np.asarray(indptr, np.int64)
+    indices = np.asarray(indices)
+    deg = np.diff(indptr)
+    nr = deg.size
+    cdeg = np.minimum(deg, cap)
+    indptr_c = np.concatenate([[0], np.cumsum(cdeg)])
+    indices_c = np.empty(indptr_c[-1], dtype=indices.dtype)
+    # uncapped rows: straight copy (already ascending in CSR)
+    rows = np.repeat(np.arange(nr, dtype=np.int64), deg)
+    pos = np.arange(indices.size, dtype=np.int64) - np.repeat(
+        indptr[:-1], deg
+    )
+    small_e = deg[rows] <= cap
+    indices_c[indptr_c[rows[small_e]] + pos[small_e]] = indices[small_e]
+    # capped (hub) rows: replicate the native partial Fisher-Yates exactly
+    seed &= _M64
+    for r in np.flatnonzero(deg > cap):
+        lo, hi = int(indptr[r]), int(indptr[r + 1])
+        scratch = np.asarray(indices[lo:hi]).copy()
+        d = scratch.size
+        u = int(row_offset + r)
+        s = _splitmix64(seed ^ ((u * 0x2545F4914F6CDD1D) & _M64))
+        out_lo = int(indptr_c[r])
+        for i in range(cap):
+            s = _splitmix64(s)
+            j = i + s % (d - i)
+            scratch[i], scratch[j] = scratch[j], scratch[i]
+            indices_c[out_lo + i] = scratch[i]
+        indices_c[out_lo : out_lo + cap].sort()
+    return indptr_c, indices_c
+
+
 def capped_csr(g: Graph, cap: int, seed: int):
     """Per-node uniform sample (without replacement) of at most `cap`
     neighbors, bit-identical to the native backend's sampler (partial
@@ -121,33 +169,10 @@ def capped_csr(g: Graph, cap: int, seed: int):
     SAME capped lists and produce backend-independent seed rankings
     (ADVICE rounds 1-2). Returns (indptr_c, indices_c) with each capped
     list sorted ascending (so u*N + w keys are globally sorted for
-    searchsorted; the hit SET is order-independent)."""
-    n = g.num_nodes
-    deg = g.degrees.astype(np.int64)
-    cdeg = np.minimum(deg, cap)
-    indptr_c = np.concatenate([[0], np.cumsum(cdeg)])
-    indices_c = np.empty(indptr_c[-1], dtype=g.indices.dtype)
-    # uncapped nodes: straight copy (already ascending in CSR)
-    pos = np.arange(g.indices.size, dtype=np.int64) - np.repeat(
-        g.indptr[:-1].astype(np.int64), deg
-    )
-    small_e = deg[g.src] <= cap
-    indices_c[indptr_c[g.src[small_e]] + pos[small_e]] = g.indices[small_e]
-    # capped (hub) nodes: replicate the native partial Fisher-Yates exactly
-    seed &= _M64
-    for u in np.flatnonzero(deg > cap):
-        lo, hi = int(g.indptr[u]), int(g.indptr[u + 1])
-        scratch = g.indices[lo:hi].copy()
-        d = scratch.size
-        s = _splitmix64(seed ^ ((int(u) * 0x2545F4914F6CDD1D) & _M64))
-        out_lo = int(indptr_c[u])
-        for i in range(cap):
-            s = _splitmix64(s)
-            j = i + s % (d - i)
-            scratch[i], scratch[j] = scratch[j], scratch[i]
-            indices_c[out_lo + i] = scratch[i]
-        indices_c[out_lo : out_lo + cap].sort()
-    return indptr_c, indices_c
+    searchsorted; the hit SET is order-independent). The row loop lives in
+    capped_neighbor_lists so the graph store's shard-local seed bake shares
+    it verbatim."""
+    return capped_neighbor_lists(g.indptr, g.indices, cap, seed)
 
 
 def triangle_counts_sampled(
@@ -355,6 +380,17 @@ def conductance(
         tri = triangle_counts(g)
     s1 = np.zeros(g.num_nodes)
     np.add.at(s1, g.src, deg[g.dst].astype(np.float64))
+    return phi_from_counts(deg, s1, tri, two_e)
+
+
+def phi_from_counts(
+    deg: np.ndarray, s1: np.ndarray, tri: np.ndarray, two_e: float
+) -> np.ndarray:
+    """Ego-net conductance from the closed-form counts: deg(u),
+    S1(u) = sum_{v in N(u)} deg(v), tri(u), and 2E. The ONE formula shared
+    by the fit-time scorer (conductance) and the ingest-time seed bake
+    (graph/store.bake_seed_scores) — baked and streamed scores are the same
+    arithmetic on the same integers, so the exact path is bit-identical."""
     # clamp tri into its feasible range [0, (s1-deg)/2] (exact counts always
     # satisfy it; the sampled estimator can stray and would otherwise drive
     # cut — and phi — negative, corrupting the seed ranking)
@@ -546,21 +582,34 @@ def init_F(
 
 
 def conductance_seeds(
-    g: Graph, cfg: Optional[BigClamConfig] = None, backend: str = "auto"
+    g: Graph,
+    cfg: Optional[BigClamConfig] = None,
+    backend: str = "auto",
+    phi: Optional[np.ndarray] = None,
 ) -> np.ndarray:
     """conductanceLocalMin (Bigclamv2.scala:42-59): phi + ranking in one call.
 
     With cfg.seed_exclusion (auto-on in quality mode) the ranking is the
     coverage-aware greedy walk (select_seeds_covering) instead of the
-    reference's raw top-K nominee order.
+    reference's raw top-K nominee order. A precomputed `phi` (e.g. the
+    graph store's ingest-baked seed scores, GraphStore.load_seed_scores)
+    skips the conductance pass — the dominant seeding cost — entirely.
     """
     cfg = cfg or BigClamConfig()
-    phi = conductance(
-        g,
-        backend=backend,
-        degree_cap=cfg.seeding_degree_cap,
-        rng=np.random.default_rng(cfg.seed),
-    )
+    if phi is None:
+        phi = conductance(
+            g,
+            backend=backend,
+            degree_cap=cfg.seeding_degree_cap,
+            rng=np.random.default_rng(cfg.seed),
+        )
+    else:
+        phi = np.asarray(phi, np.float64)
+        if phi.shape != (g.num_nodes,):
+            raise ValueError(
+                f"precomputed phi has shape {phi.shape}, want "
+                f"({g.num_nodes},)"
+            )
     exclude = (
         cfg.quality_mode if cfg.seed_exclusion is None else cfg.seed_exclusion
     )

@@ -1,11 +1,15 @@
 from bigclam_tpu.parallel.mesh import make_mesh
 from bigclam_tpu.parallel.multihost import (
     initialize_distributed,
+    load_host_seed_scores,
     load_host_shard,
     make_multihost_mesh,
     put_sharded,
 )
-from bigclam_tpu.parallel.ring import RingBigClamModel
+from bigclam_tpu.parallel.ring import (
+    RingBigClamModel,
+    StoreRingBigClamModel,
+)
 from bigclam_tpu.parallel.sharded import (
     ShardedBigClamModel,
     StoreShardedBigClamModel,
@@ -14,6 +18,7 @@ from bigclam_tpu.parallel.sparse_sharded import SparseShardedBigClamModel
 
 __all__ = [
     "initialize_distributed",
+    "load_host_seed_scores",
     "load_host_shard",
     "make_mesh",
     "make_multihost_mesh",
@@ -21,5 +26,6 @@ __all__ = [
     "RingBigClamModel",
     "ShardedBigClamModel",
     "SparseShardedBigClamModel",
+    "StoreRingBigClamModel",
     "StoreShardedBigClamModel",
 ]

@@ -227,6 +227,47 @@ def load_host_shard(
     )
 
 
+def global_max_int(value: int) -> int:
+    """Cross-process max of one host-side integer (a tiny allgather).
+
+    The store-native tile/bucket builders (ISSUE 9) pad per-shard tile
+    counts to the GLOBAL maximum so shard_map stays SPMD, but each host
+    can count only its own shards' tiles — this exchanges exactly one
+    int64 per process, never graph data (the files_read isolation
+    contract is about bytes on disk, not the process group's metadata
+    agreement). Single-process: identity, no collective."""
+    if jax.process_count() == 1:
+        return int(value)
+    from jax.experimental import multihost_utils
+
+    return int(
+        np.max(
+            multihost_utils.process_allgather(
+                np.asarray([value], dtype=np.int64)
+            )
+        )
+    )
+
+
+def load_host_seed_scores(
+    store,
+    process_index: Optional[int] = None,
+    process_count: Optional[int] = None,
+    verify: bool = True,
+):
+    """This process's slice of the ingest-baked seed scores
+    (graph/store.GraphStore.load_seed_scores): reads ONLY the phi blobs of
+    the shards this host's devices own — the seeding analog of
+    load_host_shard, under the same transient-retry policy."""
+    from bigclam_tpu.resilience.retry import call_with_retry
+
+    ids = host_shard_ids(store.num_shards, process_index, process_count)
+    return call_with_retry(
+        lambda: store.load_seed_scores(ids.start, ids.stop, verify=verify),
+        site="store.load_host_seed_scores",
+    )
+
+
 def put_host_local(
     local_rows: np.ndarray, sharding: NamedSharding, global_shape
 ):

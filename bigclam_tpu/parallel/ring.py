@@ -57,6 +57,7 @@ from bigclam_tpu.parallel.sharded import (
     _rowdot,
     _shard_grad_stats,
     _shard_health,
+    _StoreBackedMixin,
     armijo_tail_select_sharded,
 )
 from bigclam_tpu.utils.compat import shard_map
@@ -86,6 +87,27 @@ def ring_bucket_imbalance(
     )
 
 
+def _warn_imbalance_counts(
+    total_directed: int, dp: int, max_count: int,
+    hint: str = "relabel (balance=True or the default balance=None auto "
+                "rule) or shuffle ids before the ring schedule",
+) -> None:
+    """The count-based half of _warn_bucket_imbalance, shared with the
+    store-backed ring build (which knows the total from the manifest and
+    the max from a cross-host exchange, never a global CSR)."""
+    mean_count = max(float(total_directed) / (dp * dp), 1.0)
+    if max_count > RING_IMBALANCE_FACTOR * mean_count:
+        import warnings
+
+        warnings.warn(
+            f"ring phase buckets are imbalanced: max {max_count} vs mean "
+            f"{mean_count:.0f} edges/bucket — the padded sweep does "
+            f"~{max_count / mean_count:.1f}x the real edge work. Node ids "
+            f"look locality-ordered; {hint}.",
+            stacklevel=4,
+        )
+
+
 def _warn_bucket_imbalance(g: Graph, dp: int, max_count: int) -> None:
     """Every (shard, phase) bucket pads to the max: a locality-ordered id
     space (contiguous communities, BFS orders) concentrates edges in the
@@ -95,19 +117,23 @@ def _warn_bucket_imbalance(g: Graph, dp: int, max_count: int) -> None:
     the CSR tile buckets — the distribution is the same. Only reachable
     with balance=False (the explicit escape hatch): the default ring
     build auto-engages the balance relabeling on the same heuristic."""
-    mean_count = max(float(g.src.size) / (dp * dp), 1.0)
-    if max_count > RING_IMBALANCE_FACTOR * mean_count:
-        import warnings
+    _warn_imbalance_counts(int(g.src.size), dp, max_count)
 
-        warnings.warn(
-            f"ring phase buckets are imbalanced: max {max_count} vs mean "
-            f"{mean_count:.0f} edges/bucket — the padded sweep does "
-            f"~{max_count / mean_count:.1f}x the real edge work. Node ids "
-            "look locality-ordered; relabel (balance=True or the default "
-            "balance=None auto rule) or shuffle ids before the ring "
-            "schedule.",
-            stacklevel=3,
-        )
+
+def ring_bucket_local_max(shard, dp: int, n_pad: int) -> int:
+    """Max directed-edge count over THIS host's (shard, phase) buckets —
+    the local half of ring_bucket_imbalance, computed from HostShard rows
+    only. The global max is a one-int cross-host exchange
+    (multihost.global_max_int)."""
+    from bigclam_tpu.ops.csr_tiles import _local_shard_edge_slices
+
+    shard_rows = max(n_pad // dp, 1)
+    mx = 0
+    for i, _, dst in _local_shard_edge_slices(shard, dp, n_pad):
+        if dst.size:
+            phase = ((dst.astype(np.int64) // shard_rows) - i) % dp
+            mx = max(mx, int(np.bincount(phase, minlength=dp).max()))
+    return max(mx, 1)
 
 
 def rotate_scan(F0, acc0, xs, sweep, perm, overlap: bool):
@@ -180,8 +206,10 @@ def ring_shard_edges(
 
     # span (obs.trace): the host-side bucket build is a real model-build
     # cost at pod shard counts — attribute it next to the ring's other
-    # phases instead of folding it into an opaque model_build stage
-    with _trace.span("ring/bucket_build", dp=dp) as _sp:
+    # phases instead of folding it into an opaque model_build stage;
+    # `source` lets the perf ledger tell the host-global builder from the
+    # store-native one (ISSUE 9)
+    with _trace.span("ring/bucket_build", dp=dp, source="host_global") as _sp:
         shard_rows = n_pad // dp
         src_shard = g.src // shard_rows
         dst_shard = g.dst // shard_rows
@@ -216,6 +244,69 @@ def ring_shard_edges(
             src=src.reshape(dp, dp, c, chunk),
             dst=dst.reshape(dp, dp, c, chunk),
             mask=mask.reshape(dp, dp, c, chunk).astype(dtype),
+        )
+
+
+def ring_shard_edges_local(
+    shard,
+    cfg: BigClamConfig,
+    dp: int,
+    n_pad: int,
+    dtype,
+    chunk_bound: int = 0,
+    max_count: int = 0,
+) -> EdgeChunks:
+    """This host's rows of the ring (shard, phase) edge buckets, built from
+    a per-host graph-store slice (graph/store.HostShard) — the out-of-core
+    twin of ring_shard_edges: no global CSR exists anywhere.
+
+    `max_count` is the GLOBAL max bucket edge count (ring_bucket_local_max
+    + multihost.global_max_int — every host pads identically without
+    seeing another host's edges); 0 uses the local max (exact on
+    single-host loads). dst translation to the rotating shard's local rows
+    needs only the manifest node ranges.
+    """
+    from bigclam_tpu.obs import trace as _trace
+    from bigclam_tpu.ops.csr_tiles import _local_shard_edge_slices
+
+    with _trace.span("ring/bucket_build", dp=dp, source="store") as _sp:
+        shard_rows = n_pad // dp
+        if not max_count:
+            max_count = ring_bucket_local_max(shard, dp, n_pad)
+        chunk = min(chunk_bound or cfg.edge_chunk, max(max_count, 1))
+        c = -(-max_count // chunk)
+        padded = c * chunk
+        n_local = len(shard.shard_ids)
+        _sp.set(max_bucket=int(max_count),
+                padded_slots=int(padded * dp * dp))
+        src = np.full((n_local, dp, padded), shard_rows - 1, dtype=np.int32)
+        dst = np.zeros((n_local, dp, padded), dtype=np.int32)
+        mask = np.zeros((n_local, dp, padded), dtype=np.float32)
+        for row, (i, s_loc, d_glob) in enumerate(
+            _local_shard_edge_slices(shard, dp, n_pad)
+        ):
+            if d_glob.size == 0:
+                continue
+            phase = ((d_glob.astype(np.int64) // shard_rows) - i) % dp
+            # CSR order within each bucket (matches ring_shard_edges'
+            # global lexsort, stable within one (shard, phase) run)
+            order = np.lexsort((np.arange(d_glob.size), phase))
+            ss = s_loc[order]
+            dd = d_glob[order].astype(np.int64)
+            ph = phase[order]
+            bounds = np.searchsorted(ph, np.arange(dp + 1))
+            for r in range(dp):
+                lo, hi = bounds[r], bounds[r + 1]
+                m = hi - lo
+                if m == 0:
+                    continue
+                src[row, r, :m] = ss[lo:hi]
+                dst[row, r, :m] = dd[lo:hi] - ((i + r) % dp) * shard_rows
+                mask[row, r, :m] = 1.0
+        return EdgeChunks(
+            src=src.reshape(n_local, dp, c, chunk),
+            dst=dst.reshape(n_local, dp, c, chunk),
+            mask=mask.reshape(n_local, dp, c, chunk).astype(dtype),
         )
 
 
@@ -808,14 +899,19 @@ class RingBigClamModel(ShardedBigClamModel):
         return False
 
     def _build_csr_step(self, dp: int) -> None:
+        from bigclam_tpu.obs import trace as _trace
         from bigclam_tpu.ops.csr_tiles import ring_block_tiles
 
         rbt = getattr(self, "_probe_tiles", None)
         self._probe_tiles = None
         if rbt is None or self._perm is not None:
-            rbt = ring_block_tiles(
-                self.g, dp, self.n_pad, *self._csr_shape
-            )
+            with _trace.span(
+                "ring/tile_build", dp=dp, source="host_global"
+            ) as _sp:
+                rbt = ring_block_tiles(
+                    self.g, dp, self.n_pad, *self._csr_shape
+                )
+                _sp.set(slots=int(rbt.slots))
         dp_, dpp, nt, t = rbt.src_local.shape
         # same distribution as the XLA edge buckets: warn on the TRUE max
         # bucket edge count (tile-slot counts over-fire on balanced graphs
@@ -890,5 +986,181 @@ class RingBigClamModel(ShardedBigClamModel):
             src=put_sharded(edges_host.src, espec),
             dst=put_sharded(edges_host.dst, espec),
             mask=put_sharded(edges_host.mask.astype(self.dtype), espec),
+        )
+        self._step = make_ring_train_step(self.mesh, self.edges, self.cfg)
+
+
+class StoreRingBigClamModel(_StoreBackedMixin, RingBigClamModel):
+    """Ring-pass trainer fed per-host from a compiled graph cache (the
+    store-native twin of RingBigClamModel, ISSUE 9).
+
+    Each process loads ONLY its own shard blobs, builds only its rows of
+    the per-(shard, phase) edge buckets (ring_shard_edges_local) or ring
+    CSR tile buckets (ops.csr_tiles.local_ring_tile_parts), and places
+    them with put_host_local — the ring's O(2 * N/dp * K_loc) peak-HBM
+    profile now comes with O(shard) host RSS too, the combination the
+    Friendster drill needs. Bucket padding is agreed via the manifest's
+    global counts plus a one-int cross-host max exchange.
+
+    Balance is baked at INGEST (`cli ingest --balance`) — the auto-balance
+    relabeling of the in-memory ring cannot run without a global CSR, so
+    an imbalanced unbalanced cache warns with a re-ingest hint instead.
+    Trajectories are byte-identical to RingBigClamModel(balance=False) on
+    the same graph."""
+
+    def __init__(self, store, cfg: BigClamConfig, mesh: Mesh, dtype=None,
+                 verify: bool = True):
+        from bigclam_tpu.parallel.sharded import _StoreGraphView
+
+        self._store_init(store, mesh, verify)
+        # balance=False skips the in-memory auto-probe (it needs g.src);
+        # the store build warns from local stats + the manifest instead
+        super().__init__(
+            _StoreGraphView(store), cfg, mesh, dtype=dtype, balance=False,
+        )
+
+    def _global_max_bucket(self, dp: int) -> int:
+        from bigclam_tpu.parallel.multihost import global_max_int
+
+        return global_max_int(
+            ring_bucket_local_max(self._load_host_shard(), dp, self.n_pad)
+        )
+
+    def _csr_static_ok(self, tp: int) -> bool:
+        # the ring K-blocked phases (kc) run on the SAME flat ring tiles,
+        # so unlike the sharded store trainer kc needs no grouped layout —
+        # only the row/block alignment constraint applies
+        if not ShardedBigClamModel._csr_static_ok(self, tp):
+            return False
+        return self._store_rows_ok()
+
+    def _csr_economy_ok(self, dp: int) -> bool:
+        """Store-native twin of the ring economy probe — identical
+        numbers (manifest edge counts + cross-host maxima), identical
+        engage/fallback decision."""
+        from bigclam_tpu.models.bigclam import GROUP_FD_BUDGET
+        from bigclam_tpu.obs import trace as _trace
+        from bigclam_tpu.ops.csr_tiles import (
+            layout_economical,
+            local_ring_tile_parts,
+        )
+
+        block_b, tile_t = self._csr_shape
+        shard = self._load_host_shard()
+        n_pad = dp * self.store.rows_per_shard
+        with _trace.span("ring/tile_build", dp=dp, source="store") as _sp:
+            parts = local_ring_tile_parts(
+                shard, dp, n_pad, block_b, tile_t
+            )
+            local_max = max(
+                p.n_tiles for phase_parts in parts for p in phase_parts
+            )
+            pad_tiles = self._store_pad_tiles_for(local_max)
+            _sp.set(local_tiles=int(local_max), pad_tiles=int(pad_tiles))
+        e = max(self.store.num_directed_edges, 1)
+        slots = dp * dp * pad_tiles * tile_t
+        k_loc = getattr(self, "_csr_kc", 0) or (
+            self._csr_k_pad // self.mesh.shape[K_AXIS]
+        )
+        n_blocks = (n_pad // dp) // block_b
+        phase_fd = pad_tiles * tile_t * k_loc * 4
+        pad_ok = layout_economical(slots, e, dp * dp * n_blocks, tile_t)
+        if pad_ok and phase_fd <= GROUP_FD_BUDGET:
+            self._probe_parts = parts
+            self._store_ring_pad_tiles = pad_tiles
+            self._csr_nb = None
+            return True
+        if self.cfg.use_pallas_csr is True:
+            raise ValueError(
+                f"use_pallas_csr=True but ring layout uneconomical: "
+                f"{slots - e} padded edge slots on {e}, per-phase fd "
+                f"gather {phase_fd >> 20} MiB (re-ingest with --balance "
+                "or use the all-gather trainer)"
+            )
+        self._csr_reason = (
+            f"store-backed ring layout uneconomical: {slots - e} padded "
+            f"edge slots on {e} edges, per-phase fd gather "
+            f"{phase_fd >> 20} MiB"
+        )
+        return False
+
+    def _build_csr_step(self, dp: int) -> None:
+        from bigclam_tpu.obs import trace as _trace
+        from bigclam_tpu.ops.csr_tiles import stack_ring_tile_parts
+        from bigclam_tpu.parallel.multihost import put_host_local
+
+        parts = self._probe_parts
+        self._probe_parts = None
+        with _trace.span(
+            "ring/tile_build", dp=dp, source="store", stage="stack"
+        ) as _sp:
+            rbt = stack_ring_tile_parts(parts, self._store_ring_pad_tiles)
+            _sp.set(slots=int(dp * dp * rbt.src_local.shape[2] * rbt.tile_t))
+        _warn_imbalance_counts(
+            self.store.num_directed_edges, dp, self._global_max_bucket(dp),
+            hint="re-ingest the cache with --balance",
+        )
+        n_local, dpp, nt, t = rbt.src_local.shape
+
+        def nspec(ndim: int) -> NamedSharding:
+            return NamedSharding(
+                self.mesh, P(NODES_AXIS, *([None] * (ndim - 1)))
+            )
+
+        tiles = {
+            "src_local": put_host_local(
+                rbt.src_local.reshape(n_local, dpp, nt, 1, t).astype(
+                    np.int32
+                ),
+                nspec(5), (dp, dpp, nt, 1, t),
+            ),
+            "dst_local": put_host_local(
+                rbt.dst_local.astype(np.int32), nspec(4), (dp, dpp, nt, t)
+            ),
+            "mask": put_host_local(
+                rbt.mask.reshape(n_local, dpp, nt, 1, t).astype(self.dtype),
+                nspec(5), (dp, dpp, nt, 1, t),
+            ),
+            "block_id": put_host_local(
+                rbt.block_id.astype(np.int32), nspec(3), (dp, dpp, nt)
+            ),
+            "block_b": rbt.block_b,
+            "tile_t": rbt.tile_t,
+            "n_blocks": rbt.n_blocks,
+            "kc": getattr(self, "_csr_kc", 0),
+        }
+        self.edges = None
+        self._tiles_dev = tiles                  # kept for rebuild_step
+        self._step = make_ring_csr_train_step(self.mesh, tiles, self.cfg)
+
+    def _build_edges_and_step(self) -> None:
+        dp = self.mesh.shape[NODES_AXIS]
+        tp = self.mesh.shape[K_AXIS]
+        if self._csr_wanted:
+            self._build_csr_step(dp)
+            return
+        from bigclam_tpu.parallel.multihost import put_host_local
+
+        shard = self._load_host_shard()
+        max_count = self._global_max_bucket(dp)
+        _warn_imbalance_counts(
+            self.store.num_directed_edges, dp, max_count,
+            hint="re-ingest the cache with --balance",
+        )
+        bound = edge_chunk_bound(
+            self.cfg, max(self.k_pad // tp, 1), self.dtype
+        )
+        local = ring_shard_edges_local(
+            shard, self.cfg, dp, self.n_pad, np.float32,
+            chunk_bound=bound, max_count=max_count,
+        )
+        espec = NamedSharding(self.mesh, P(NODES_AXIS, None, None, None))
+        gshape = (dp,) + local.src.shape[1:]
+        self.edges = EdgeChunks(
+            src=put_host_local(local.src, espec, gshape),
+            dst=put_host_local(local.dst, espec, gshape),
+            mask=put_host_local(
+                local.mask.astype(self.dtype), espec, gshape
+            ),
         )
         self._step = make_ring_train_step(self.mesh, self.edges, self.cfg)

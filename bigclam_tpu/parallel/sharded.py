@@ -887,15 +887,29 @@ class ShardedBigClamModel:
     def _build_csr_step(self, dp: int) -> None:
         """Build shard tiles + the CSR train step (engagement already
         decided by _csr_static_ok + _csr_economy_ok)."""
-        from bigclam_tpu.ops.csr_tiles import (
-            shard_block_tiles,
-            shard_grouped_tiles,
-        )
+        from bigclam_tpu.obs import trace as _trace
 
         def nspec(ndim: int) -> NamedSharding:
             return NamedSharding(
                 self.mesh, P(NODES_AXIS, *([None] * (ndim - 1)))
             )
+
+        # span (obs.trace): tile builds are a real model-build cost at pod
+        # shard counts; `source` lets the perf ledger attribute build-time
+        # deltas to the host-global vs store-native builder (ISSUE 9)
+        with _trace.span(
+            "sharded/tile_build", dp=dp, source="host_global"
+        ) as _sp:
+            self.__build_csr_tiles(dp, nspec, _sp)
+        self._step = make_sharded_csr_train_step(
+            self.mesh, self._tiles_dev, self.cfg
+        )
+
+    def __build_csr_tiles(self, dp: int, nspec, _sp) -> None:
+        from bigclam_tpu.ops.csr_tiles import (
+            shard_block_tiles,
+            shard_grouped_tiles,
+        )
 
         sbt = getattr(self, "_probe_tiles", None)
         self._probe_tiles = None
@@ -949,9 +963,9 @@ class ShardedBigClamModel:
                 "tile_t": sbt.tile_t,
                 "n_blocks": sbt.n_blocks,
             }
+        _sp.set(slots=int(sbt.src_local.size), grouped=self._csr_nb is not None)
         self.edges = None                        # not used by the CSR step
         self._tiles_dev = tiles                  # kept for rebuild_step
-        self._step = make_sharded_csr_train_step(self.mesh, tiles, self.cfg)
 
     def _build_edges_and_step(self) -> None:
         dp = self.mesh.shape[NODES_AXIS]
@@ -1164,30 +1178,14 @@ class _StoreGraphView:
         )
 
 
-class StoreShardedBigClamModel(ShardedBigClamModel):
-    """Sharded trainer fed per-host from a compiled graph cache.
+class _StoreBackedMixin:
+    """Shared plumbing of the store-backed trainers (StoreSharded / ring's
+    StoreRing): per-host shard loading, the mesh-vs-process-ownership
+    check, the rows-per-shard <-> block alignment constraint, and the
+    cross-host tile-pad agreement. The global CSR never exists on any
+    host; every builder consumes HostShard local rows."""
 
-    Each process loads ONLY its own shard blobs
-    (multihost.load_host_shard), builds only its rows of the edge blocks
-    (shard_edges_local), and places them with put_host_local — the global
-    CSR is never materialized on any host, which is the whole point of the
-    store at Friendster scale. The math is byte-identical to
-    ShardedBigClamModel on the same graph (same edge blocks, same step).
-
-    Constraints of this path: the XLA edge schedule only (the blocked-CSR
-    tile builders are host-global — ROADMAP open item), and balance is
-    baked at INGEST time (`cli ingest --balance`), not at model build: the
-    cache's node order IS the trainer's row order, so results come back in
-    cache order (map to original ids via the cache's raw_ids).
-    """
-
-    def __init__(self, store, cfg: BigClamConfig, mesh: Mesh, dtype=None,
-                 verify: bool = True):
-        if cfg.use_pallas_csr:
-            raise ValueError(
-                "use_pallas_csr=True is unsupported on the store-backed "
-                "trainer (CSR tile construction needs the global CSR)"
-            )
+    def _store_init(self, store, mesh: Mesh, verify: bool) -> None:
         dp = mesh.shape[NODES_AXIS]
         if store.num_shards != dp:
             raise ValueError(
@@ -1196,34 +1194,223 @@ class StoreShardedBigClamModel(ShardedBigClamModel):
             )
         self.store = store
         self._shard_verify = verify
-        super().__init__(
-            _StoreGraphView(store), cfg.replace(use_pallas_csr=False),
-            mesh, dtype=dtype, balance=False,
+        self.host_shard = None
+
+    def _load_host_shard(self):
+        """Load this process's shard slice ONCE (the CSR economy probe and
+        the step builder both need it), after checking the mesh places
+        this process's rows where process-major shard ownership says."""
+        if self.host_shard is None:
+            dp = self.mesh.shape[NODES_AXIS]
+            espec = NamedSharding(self.mesh, P(NODES_AXIS, None, None))
+            lo_s, hi_s = addressable_row_bounds(espec, (dp, 1, 1))
+            ids = host_shard_ids(dp)
+            if (ids.start, ids.stop) != (lo_s, hi_s):
+                raise ValueError(
+                    f"mesh places this process's node shards at [{lo_s}, "
+                    f"{hi_s}) but process-major shard ownership is "
+                    f"[{ids.start}, {ids.stop}); use a slice-major mesh "
+                    "(make_multihost_mesh)"
+                )
+            self.host_shard = load_host_shard(
+                self.store, verify=self._shard_verify
+            )
+        return self.host_shard
+
+    def _store_rows_ok(self) -> bool:
+        """The store-native CSR layouts keep trainer shard rows == the
+        cache's rows_per_shard (a larger block-rounded shard would pull
+        rows another host's files own — the exact isolation breach the
+        store exists to prevent), so block_b must divide rows_per_shard.
+        Raises when use_pallas_csr=True; records the fallback reason and
+        returns False otherwise."""
+        block_b = self._csr_shape[0]
+        rows = self.store.rows_per_shard
+        if rows % block_b == 0:
+            return True
+        msg = (
+            f"cache rows_per_shard={rows} is not a multiple of "
+            f"csr_block_b={block_b}: store-native tiles cannot cross "
+            "shard-file boundaries (re-ingest with block-aligned shards "
+            "or set csr_block_b to a divisor)"
         )
+        if self.cfg.use_pallas_csr is True:
+            raise ValueError(f"use_pallas_csr=True but {msg}")
+        self._csr_reason = msg
+        return False
+
+    def _store_pad_tiles_for(self, local_max: int) -> int:
+        """The uniform cross-host tile-count pad: cfg.csr_store_pad_tiles
+        when set (deterministic shapes across restarts), else a one-int
+        max exchange over the process group (multihost.global_max_int)."""
+        from bigclam_tpu.parallel.multihost import global_max_int
+
+        explicit = self.cfg.csr_store_pad_tiles
+        if explicit:
+            if explicit < local_max:
+                raise ValueError(
+                    f"csr_store_pad_tiles={explicit} below this host's "
+                    f"tile count {local_max}; raise it (or 0 for the "
+                    "automatic cross-host max)"
+                )
+            return explicit
+        return global_max_int(local_max)
+
+
+class StoreShardedBigClamModel(_StoreBackedMixin, ShardedBigClamModel):
+    """Sharded trainer fed per-host from a compiled graph cache.
+
+    Each process loads ONLY its own shard blobs
+    (multihost.load_host_shard), builds only its rows of the edge blocks
+    (shard_edges_local) or blocked-CSR tiles
+    (ops.csr_tiles.local_block_tile_parts), and places them with
+    put_host_local — the global CSR is never materialized on any host,
+    which is the whole point of the store at Friendster scale. The math is
+    byte-identical to ShardedBigClamModel on the same graph (same edge
+    blocks / tiles, same step).
+
+    Since ISSUE 9 the blocked-CSR MXU kernels engage here exactly like the
+    in-memory trainer (same csr_tiles_supported / auto-shrink policy, same
+    economy probe on manifest-global counts + local tiles) on the FLAT
+    layout; the grouped/K-blocked large-K layouts still fall back to XLA
+    with a recorded reason. Balance is baked at INGEST time (`cli ingest
+    --balance`), not at model build: the cache's node order IS the
+    trainer's row order, so results come back in cache order (map to
+    original ids via the cache's raw_ids).
+    """
+
+    def __init__(self, store, cfg: BigClamConfig, mesh: Mesh, dtype=None,
+                 verify: bool = True):
+        self._store_init(store, mesh, verify)
+        super().__init__(
+            _StoreGraphView(store), cfg, mesh, dtype=dtype, balance=False,
+        )
+
+    def _csr_static_ok(self, tp: int) -> bool:
+        if not super()._csr_static_ok(tp):
+            return False
+        if self._csr_kc:
+            # the sharded K-blocked pass runs on GROUPED tiles, which the
+            # store-native builder does not produce yet
+            msg = (
+                f"K_loc={self._csr_k_pad // tp} needs the K-blocked "
+                "grouped layout, which is not store-native yet (shard the "
+                "K axis, or use the XLA schedule)"
+            )
+            if self.cfg.use_pallas_csr is True:
+                raise ValueError(f"use_pallas_csr=True but {msg}")
+            self._csr_reason = msg
+            return False
+        return self._store_rows_ok()
+
+    def _csr_economy_ok(self, dp: int) -> bool:
+        """Store-native twin of the base economy probe: the slot/padding
+        and fd-gather numbers are identical by construction (manifest
+        edge counts + a cross-host max of the local tile counts), so the
+        engage/fallback decision matches the in-memory trainer on the
+        same graph — only who builds the tiles changes. The grouped
+        large-K fallback is not store-native yet: layouts that need it
+        fall back to XLA (or refuse under use_pallas_csr=True)."""
+        from bigclam_tpu.obs import trace as _trace
+        from bigclam_tpu.ops.csr_tiles import (
+            layout_economical,
+            local_block_tile_parts,
+        )
+
+        cfg = self.cfg
+        tp = self.mesh.shape[K_AXIS]
+        block_b, tile_t = self._csr_shape
+        shard = self._load_host_shard()
+        n_pad = dp * self.store.rows_per_shard
+        with _trace.span(
+            "sharded/tile_build", dp=dp, source="store"
+        ) as _sp:
+            parts = local_block_tile_parts(
+                shard, dp, n_pad, block_b, tile_t
+            )
+            local_max = max(p.n_tiles for p in parts)
+            pad_tiles = self._store_pad_tiles_for(local_max)
+            _sp.set(local_tiles=int(local_max), pad_tiles=int(pad_tiles))
+        e = max(self.store.num_directed_edges, 1)
+        slots = dp * pad_tiles * tile_t
+        k_loc = self._csr_k_pad // tp
+        n_blocks = (n_pad // dp) // block_b
+        fd_bytes = pad_tiles * tile_t * k_loc * 4        # per shard
+        pad_ok = layout_economical(slots, e, dp * n_blocks, tile_t)
+        if pad_ok and fd_bytes <= FLAT_FD_BUDGET:
+            self._probe_parts = parts
+            self._store_pad_tiles = pad_tiles
+            self._csr_nb = None
+            return True
+        if cfg.use_pallas_csr is True:
+            raise ValueError(
+                f"use_pallas_csr=True but sharded layout uneconomical: "
+                f"{slots - e} padded edge slots on {e}, per-shard fd "
+                f"gather {fd_bytes >> 20} MiB (power-law skew? re-ingest "
+                "with --balance, the ring trainer, or a sharded K axis; "
+                "the grouped large-K layout is not store-native yet)"
+            )
+        self._csr_reason = (
+            f"store-backed sharded layout uneconomical: {slots - e} "
+            f"padded edge slots on {e} edges, per-shard fd gather "
+            f"{fd_bytes >> 20} MiB (grouped large-K fallback is not "
+            "store-native yet)"
+        )
+        return False
+
+    def _build_csr_step(self, dp: int) -> None:
+        from bigclam_tpu.obs import trace as _trace
+        from bigclam_tpu.ops.csr_tiles import stack_block_tile_parts
+
+        def nspec(ndim: int) -> NamedSharding:
+            return NamedSharding(
+                self.mesh, P(NODES_AXIS, *([None] * (ndim - 1)))
+            )
+
+        parts = self._probe_parts
+        self._probe_parts = None
+        with _trace.span(
+            "sharded/tile_build", dp=dp, source="store", stage="stack"
+        ) as _sp:
+            sbt = stack_block_tile_parts(parts, self._store_pad_tiles)
+            _sp.set(slots=int(dp * sbt.n_tiles * sbt.tile_t))
+        n_local, nt, t = sbt.src_local.shape
+        tiles = {
+            "src_local": put_host_local(
+                sbt.src_local.reshape(n_local, nt, 1, t).astype(np.int32),
+                nspec(4), (dp, nt, 1, t),
+            ),
+            "dst": put_host_local(
+                sbt.dst.astype(np.int32), nspec(3), (dp, nt, t)
+            ),
+            "mask": put_host_local(
+                sbt.mask.reshape(n_local, nt, 1, t).astype(self.dtype),
+                nspec(4), (dp, nt, 1, t),
+            ),
+            "block_id": put_host_local(
+                sbt.block_id.astype(np.int32), nspec(2), (dp, nt)
+            ),
+            "block_b": sbt.block_b,
+            "tile_t": sbt.tile_t,
+            "n_blocks": sbt.n_blocks,
+        }
+        self.edges = None
+        self._tiles_dev = tiles                  # kept for rebuild_step
+        self._step = make_sharded_csr_train_step(self.mesh, tiles, self.cfg)
 
     def _build_edges_and_step(self) -> None:
         dp = self.mesh.shape[NODES_AXIS]
         tp = self.mesh.shape[K_AXIS]
+        if self._csr_wanted:
+            self._build_csr_step(dp)
+            return
         espec = NamedSharding(self.mesh, P(NODES_AXIS, None, None))
-        # the process-major shard ownership load_host_shard assumes must
-        # agree with where the mesh actually places this process's rows
-        lo_s, hi_s = addressable_row_bounds(espec, (dp, 1, 1))
-        ids = host_shard_ids(dp)
-        if (ids.start, ids.stop) != (lo_s, hi_s):
-            raise ValueError(
-                f"mesh places this process's node shards at [{lo_s}, "
-                f"{hi_s}) but process-major shard ownership is "
-                f"[{ids.start}, {ids.stop}); use a slice-major mesh "
-                "(make_multihost_mesh)"
-            )
-        self.host_shard = load_host_shard(
-            self.store, verify=self._shard_verify
-        )
+        shard = self._load_host_shard()
         bound = edge_chunk_bound(
             self.cfg, max(self.k_pad // tp, 1), self.dtype
         )
         local = shard_edges_local(
-            self.host_shard, self.cfg, dp, self.n_pad, np.float32,
+            shard, self.cfg, dp, self.n_pad, np.float32,
             chunk_bound=bound,
         )
         gshape = (dp,) + local.src.shape[1:]

@@ -1,0 +1,329 @@
+"""Store-native compute gate (ISSUE 9) -> STORE_NATIVE_r13.json.
+
+Proves the four tentpole claims on the CPU fake (8 virtual devices):
+
+1. trajectory_identity — store-backed fits equal the in-memory trainers
+   BIT-FOR-BIT across the schedule matrix {sharded, ring} x {XLA float64,
+   blocked-CSR interpret float32 (use_pallas_csr=True on the store path —
+   the lifted refusal), ring K-blocked}.
+2. files_read_isolation — with two fake hosts (load_shard_range halves),
+   tile builds, ring bucket builds, and baked-seed loads touch ONLY that
+   host's shard files, and the cross-host-padded layouts concatenate to
+   the host-global builders' arrays exactly.
+3. baked_seeds — ingest-baked conductance scores == the streamed scorer
+   (bit-identical exact path; capped estimator within float tolerance and
+   rank-identical).
+4. rss_budget — a jax-free subprocess loading ONE host's half of a
+   4M-edge cache and building its tiles + ring buckets stays inside an
+   EXPLICIT O(shard) budget (budget = 4 x predicted half-structure bytes
+   + 160 MiB runtime slack), with the host-global equivalent recorded for
+   contrast.
+
+Run:  JAX_PLATFORMS=cpu python scripts/store_native_gate.py
+"""
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
+
+from bigclam_tpu.utils.dist import request_cpu_devices  # noqa: E402
+
+request_cpu_devices(8)
+
+import numpy as np  # noqa: E402
+
+ARTIFACT = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "STORE_NATIVE_r13.json",
+)
+
+_RSS_CHILD = r"""
+import json, os, sys
+sys.path.insert(0, sys.argv[1])
+import numpy as np
+from bigclam_tpu.graph.store import GraphStore
+from bigclam_tpu.ops import csr_tiles as ct
+from bigclam_tpu.parallel.ring import ring_bucket_local_max, ring_shard_edges_local
+from bigclam_tpu.utils.profiling import current_rss_bytes
+from bigclam_tpu.config import BigClamConfig
+
+cache, mode = sys.argv[2], sys.argv[3]
+store = GraphStore.open(cache)
+dp = store.num_shards
+n_pad = dp * store.rows_per_shard
+block_b, tile_t = int(sys.argv[4]), int(sys.argv[5])
+cfg = BigClamConfig()
+base = current_rss_bytes()
+if mode == "half":
+    hs = store.load_shard_range(0, dp // 2)
+    parts = ct.local_block_tile_parts(hs, dp, n_pad, block_b, tile_t)
+    sbt = ct.stack_block_tile_parts(parts, max(p.n_tiles for p in parts))
+    mx = ring_bucket_local_max(hs, dp, n_pad)
+    buckets = ring_shard_edges_local(hs, cfg, dp, n_pad, np.float32,
+                                     chunk_bound=1 << 16, max_count=mx)
+    phi = store.load_seed_scores(0, dp // 2)
+    structure = (hs.indices.nbytes + hs.indptr.nbytes
+                 + sbt.src_local.nbytes + sbt.dst.nbytes + sbt.mask.nbytes
+                 + buckets.src.nbytes + buckets.dst.nbytes + buckets.mask.nbytes
+                 + phi.phi.nbytes)
+    files = len(hs.files_read)
+else:
+    g = store.load_graph(mmap=False)
+    sbt = ct.shard_block_tiles(g, dp, n_pad, block_b, tile_t)
+    from bigclam_tpu.parallel.ring import ring_shard_edges
+    buckets = ring_shard_edges(g, cfg, dp, n_pad, np.float32,
+                               chunk_bound=1 << 16)
+    structure = (g.indices.nbytes + g.indptr.nbytes
+                 + sbt.src_local.nbytes + sbt.dst.nbytes + sbt.mask.nbytes
+                 + buckets.src.nbytes + buckets.dst.nbytes + buckets.mask.nbytes)
+    files = -1
+print(json.dumps({
+    "rss_delta_bytes": current_rss_bytes() - base,
+    "structure_bytes": int(structure),
+    "files_read": files,
+}))
+"""
+
+
+def build_cache(tmp, n, m_und, shards, name, seed_cap=None):
+    from bigclam_tpu.graph.store import compile_graph_cache
+
+    rng = np.random.default_rng(42)
+    u = rng.integers(0, n, m_und, dtype=np.int64)
+    v = rng.integers(0, n, m_und, dtype=np.int64)
+    keep = u != v
+    text = os.path.join(tmp, f"{name}.txt")
+    np.savetxt(text, np.stack([u[keep], v[keep]], 1), fmt="%d")
+    cache = os.path.join(tmp, f"{name}.cache")
+    store = compile_graph_cache(
+        text, cache, num_shards=shards, chunk_bytes=4 << 20,
+        seed_cap=seed_cap,
+    )
+    return text, store
+
+
+def trajectory_identity(tmp):
+    from bigclam_tpu.config import BigClamConfig
+    from bigclam_tpu.graph.ingest import build_graph
+    from bigclam_tpu.parallel import (
+        RingBigClamModel,
+        ShardedBigClamModel,
+        StoreRingBigClamModel,
+        StoreShardedBigClamModel,
+        make_mesh,
+    )
+
+    text, store = build_cache(tmp, 480, 4000, 4, "traj")
+    g = build_graph(text)
+    F0 = np.random.default_rng(1).uniform(0.05, 0.9, size=(g.num_nodes, 4))
+    mesh = make_mesh((4, 1), jax.devices()[:4])
+    rows = store.rows_per_shard
+    assert rows % 4 == 0, rows
+    xla = BigClamConfig(num_communities=4, dtype="float64", max_iters=5,
+                        conv_tol=0.0, use_pallas_csr=False)
+    csr = BigClamConfig(num_communities=4, dtype="float32", max_iters=4,
+                        conv_tol=0.0, use_pallas_csr=True,
+                        pallas_interpret=True, csr_block_b=rows // 4,
+                        csr_tile_t=32)
+    cases = []
+    matrix = [
+        ("sharded_xla", ShardedBigClamModel, StoreShardedBigClamModel,
+         xla, {}),
+        ("ring_xla", RingBigClamModel, StoreRingBigClamModel, xla,
+         {"balance": False}),
+        ("sharded_csr_interpret", ShardedBigClamModel,
+         StoreShardedBigClamModel, csr, {}),
+        ("ring_csr_interpret", RingBigClamModel, StoreRingBigClamModel,
+         csr, {"balance": False}),
+        ("ring_csr_kblocked", RingBigClamModel, StoreRingBigClamModel,
+         csr.replace(csr_k_block=2), {"balance": False}),
+    ]
+    for name, mem_cls, store_cls, cfg, kw in matrix:
+        t0 = time.time()
+        mem = mem_cls(g, cfg, mesh, **kw)
+        ref = mem.fit(F0)
+        sm = store_cls(store, cfg, mesh)
+        got = sm.fit(F0)
+        bit_identical = (
+            np.array_equal(got.F, ref.F)
+            and got.llh_history == ref.llh_history
+        )
+        cases.append({
+            "case": name,
+            "engaged_path_in_memory": mem.engaged_path,
+            "engaged_path_store": sm.engaged_path,
+            "paths_agree": mem.engaged_path == sm.engaged_path,
+            "bit_identical_trajectory": bool(bit_identical),
+            "iters": ref.num_iters,
+            "seconds": round(time.time() - t0, 2),
+        })
+    ok = all(
+        c["bit_identical_trajectory"] and c["paths_agree"] for c in cases
+    )
+    return {"ok": ok, "cases": cases}
+
+
+def files_read_isolation(tmp):
+    from bigclam_tpu.config import BigClamConfig
+    from bigclam_tpu.graph.ingest import build_graph
+    from bigclam_tpu.graph.store import GraphStore
+    from bigclam_tpu.ops import csr_tiles as ct
+    from bigclam_tpu.parallel.ring import (
+        ring_shard_edges,
+        ring_shard_edges_local,
+        ring_bucket_imbalance,
+    )
+
+    text = os.path.join(tmp, "traj.txt")
+    store = GraphStore.open(os.path.join(tmp, "traj.cache"))
+    g = build_graph(text)
+    dp = store.num_shards
+    n_pad = dp * store.rows_per_shard
+    block_b, tile_t = store.rows_per_shard // 4, 32
+    cfg = BigClamConfig()
+    ref_tiles = ct.shard_block_tiles(g, dp, n_pad, block_b, tile_t)
+    ref_buckets = ring_shard_edges(g, cfg, dp, n_pad, np.float32,
+                                   chunk_bound=1 << 14)
+    mx = ring_bucket_imbalance(g, dp, n_pad)[0]
+    checks = []
+    for h in range(2):
+        lo_s, hi_s = h * dp // 2, (h + 1) * dp // 2
+        hs = store.load_shard_range(lo_s, hi_s)
+        own = {
+            os.path.basename(p)
+            for s in hs.shard_ids for p in store.shard_files(s)
+        }
+        parts = ct.local_block_tile_parts(hs, dp, n_pad, block_b, tile_t)
+        tiles = ct.stack_block_tile_parts(parts, ref_tiles.n_tiles)
+        buckets = ring_shard_edges_local(hs, cfg, dp, n_pad, np.float32,
+                                         chunk_bound=1 << 14, max_count=mx)
+        phi = store.load_seed_scores(lo_s, hi_s)
+        checks.append({
+            "host": h,
+            "shard_files_read_own_only": set(hs.files_read) == own,
+            "phi_files_read_own_only": set(phi.files_read) == {
+                f"shard_{s:05d}.phi.npy" for s in hs.shard_ids
+            },
+            "tiles_equal_host_global_rows": bool(
+                np.array_equal(tiles.src_local,
+                               ref_tiles.src_local[lo_s:hi_s])
+                and np.array_equal(tiles.dst, ref_tiles.dst[lo_s:hi_s])
+                and np.array_equal(tiles.mask, ref_tiles.mask[lo_s:hi_s])
+            ),
+            "buckets_equal_host_global_rows": bool(
+                np.array_equal(buckets.src, ref_buckets.src[lo_s:hi_s])
+                and np.array_equal(buckets.dst, ref_buckets.dst[lo_s:hi_s])
+            ),
+        })
+    ok = all(all(v for k, v in c.items() if k != "host") for c in checks)
+    return {"ok": ok, "hosts": checks}
+
+
+def baked_seeds(tmp):
+    from bigclam_tpu.graph.ingest import build_graph
+    from bigclam_tpu.graph.store import GraphStore
+    from bigclam_tpu.ops import seeding
+
+    text = os.path.join(tmp, "traj.txt")
+    store = GraphStore.open(os.path.join(tmp, "traj.cache"))
+    g = build_graph(text)
+    baked = store.load_seed_scores().phi
+    streamed = seeding.conductance(g, backend="numpy")
+    exact_identical = bool(np.array_equal(baked, streamed))
+
+    cap = 12
+    _, store_c = build_cache(tmp, 480, 4000, 4, "capped", seed_cap=cap)
+    baked_c = store_c.load_seed_scores().phi
+    streamed_c = seeding.conductance(
+        g, backend="sampled", degree_cap=cap, rng=np.random.default_rng(0)
+    )
+    rel = float(
+        np.max(
+            np.abs(baked_c - streamed_c)
+            / np.maximum(np.abs(streamed_c), 1e-12)
+        )
+    )
+    rank_same = bool(
+        np.array_equal(
+            seeding.rank_seeds(g, baked_c), seeding.rank_seeds(g, streamed_c)
+        )
+    )
+    return {
+        "ok": exact_identical and rel < 1e-8 and rank_same,
+        "exact_bit_identical": exact_identical,
+        "capped_max_rel_diff": rel,
+        "capped_rank_identical": rank_same,
+    }
+
+
+def rss_budget(tmp, repo):
+    _, store = build_cache(tmp, 120_000, 4_000_000, 4, "big")
+    rows = store.rows_per_shard
+    # largest divisor of the shard rows <= 256 (store tiles keep shard
+    # boundaries, so block_b must divide rows_per_shard)
+    block_b = next(d for d in range(256, 0, -1) if rows % d == 0)
+    out = {}
+    for mode in ("half", "full"):
+        r = subprocess.run(
+            [sys.executable, "-c", _RSS_CHILD, repo, store.directory, mode,
+             str(block_b), "128"],
+            capture_output=True, text=True, timeout=900,
+        )
+        assert r.returncode == 0, r.stderr
+        out[mode] = json.loads(r.stdout.strip().splitlines()[-1])
+    slack = 160 << 20
+    budget = 4 * out["half"]["structure_bytes"] + slack
+    ok = out["half"]["rss_delta_bytes"] <= budget
+    return {
+        "ok": bool(ok),
+        "budget_model": "4 * half-structure bytes (local CSR + tiles + "
+                        "ring buckets + phi) + 160 MiB slack",
+        "budget_bytes": int(budget),
+        "half_rss_delta_bytes": out["half"]["rss_delta_bytes"],
+        "half_structure_bytes": out["half"]["structure_bytes"],
+        "half_files_read": out["half"]["files_read"],
+        "host_global_rss_delta_bytes": out["full"]["rss_delta_bytes"],
+        "host_global_structure_bytes": out["full"]["structure_bytes"],
+        "edges_directed": store.num_directed_edges,
+        "nodes": store.num_nodes,
+        "block_b": block_b,
+    }
+
+
+def main():
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    t0 = time.time()
+    with tempfile.TemporaryDirectory() as tmp:
+        report = {
+            "gate": "store_native",
+            "round": 13,
+            "trajectory_identity": trajectory_identity(tmp),
+            "files_read_isolation": files_read_isolation(tmp),
+            "baked_seeds": baked_seeds(tmp),
+            "rss_budget": rss_budget(tmp, repo),
+        }
+    report["pass"] = all(
+        report[k]["ok"]
+        for k in ("trajectory_identity", "files_read_isolation",
+                  "baked_seeds", "rss_budget")
+    )
+    report["wall_s"] = round(time.time() - t0, 1)
+    with open(ARTIFACT, "w") as f:
+        json.dump(report, f, indent=2, sort_keys=True)
+    print(json.dumps(report, indent=2, sort_keys=True))
+    print(f"\n{'PASS' if report['pass'] else 'FAIL'} -> {ARTIFACT}")
+    return 0 if report["pass"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

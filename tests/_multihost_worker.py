@@ -25,6 +25,16 @@ Modes:
                  at ckpt_root (compiled by the parent): asserts this
                  process loaded ONLY its own shard files and its own node
                  ranges, then process 0 writes the trajectory
+  store-csr      ISSUE 9: store-backed fit with use_pallas_csr=True
+                 (interpret mode) — tiles built from THIS host's shard
+                 files only (files_read), baked seed scores loaded per
+                 host (load_host_seed_scores isolation), trajectory
+                 written for the parent to compare against the in-memory
+                 sharded CSR run
+  store-ring     ISSUE 9: StoreRingBigClamModel — ring (shard, phase)
+                 buckets built from this host's shard files only, bucket
+                 pad agreed via the one-int cross-host max exchange;
+                 trajectory must match RingBigClamModel(balance=False)
   telemetry      fit with RunTelemetry pointed at the SHARED dir ckpt_root:
                  asserts the single-writer event-log gate (only process 0
                  may hold the events.jsonl handle) while every process
@@ -65,6 +75,16 @@ def quality_cfg(cfg):
     (the test compares the two runs' annealing trajectories)."""
     return cfg.replace(
         quality_mode=True, restart_cycles=3, restart_tol=0.0, max_iters=6
+    )
+
+
+def store_csr_cfg(cfg):
+    """Interpret-mode blocked-CSR config for the store-backed trainers —
+    single source for worker AND parent (rows_per_shard=6 on the 24-node
+    problem at 4 shards, so block_b=3 divides it)."""
+    return cfg.replace(
+        dtype="float32", max_iters=6, use_pallas_csr=True,
+        pallas_interpret=True, csr_block_b=3, csr_tile_t=8,
     )
 
 
@@ -162,6 +182,46 @@ def main() -> None:
             for path in store.shard_files(s)
         }
         assert set(hs.files_read) == own, (hs.files_read, own)
+
+        res = model.fit(F0)
+        if jax.process_index() == 0:
+            np.savez(
+                out_path, F=res.F, llh_history=np.asarray(res.llh_history)
+            )
+        jax.distributed.shutdown()
+        return
+
+    if mode in ("store-csr", "store-ring"):
+        from bigclam_tpu.graph.store import GraphStore
+        from bigclam_tpu.parallel.multihost import load_host_seed_scores
+        from bigclam_tpu.parallel.ring import StoreRingBigClamModel
+        from bigclam_tpu.parallel.sharded import StoreShardedBigClamModel
+
+        store = GraphStore.open(ckpt_root)
+        p = jax.process_index()
+        if mode == "store-csr":
+            model = StoreShardedBigClamModel(store, store_csr_cfg(cfg), mesh)
+            assert model.engaged_path == "csr", model.path_reason
+        else:
+            model = StoreRingBigClamModel(
+                store, cfg.replace(use_pallas_csr=False), mesh
+            )
+            assert model.engaged_path == "xla", model.path_reason
+        hs = model.host_shard
+        assert hs.shard_ids == (2 * p, 2 * p + 1), hs.shard_ids
+        own = {
+            os.path.basename(path)
+            for s in hs.shard_ids
+            for path in store.shard_files(s)
+        }
+        # tile/bucket builds consumed ONLY this host's shard blobs
+        assert set(hs.files_read) == own, (hs.files_read, own)
+        # baked-seed loading is per-host too: only this host's phi blobs
+        ss = load_host_seed_scores(store)
+        assert (ss.lo, ss.hi) == (hs.lo, hs.hi), (ss.lo, ss.hi)
+        assert set(ss.files_read) == {
+            f"shard_{s:05d}.phi.npy" for s in hs.shard_ids
+        }, ss.files_read
 
         res = model.fit(F0)
         if jax.process_index() == 0:

@@ -274,6 +274,78 @@ def test_true_two_process_store_shard_loading(tmp_path):
     )
 
 
+def _compiled_worker_cache(tmp_path):
+    """The worker problem's text + 4-shard cache (seed scores baked)."""
+    from bigclam_tpu.graph.store import compile_graph_cache
+
+    g, cfg, F0 = _worker_module().problem()
+    text = tmp_path / "g.txt"
+    text.write_text(
+        "\n".join(
+            f"{u} {v}"
+            for u, v in zip(g.src.tolist(), g.dst.tolist())
+            if u < v
+        )
+    )
+    cache = tmp_path / "cache"
+    compile_graph_cache(
+        str(text), str(cache), num_shards=4, chunk_bytes=256
+    )
+    return g, cfg, F0, cache
+
+
+@_needs_multiproc_cpu
+def test_true_two_process_store_csr_tiles(tmp_path):
+    """ISSUE 9: TWO real processes running the store-backed trainer with
+    use_pallas_csr=True (interpret kernels) — blocked-CSR tiles built from
+    each host's OWN shard files (files_read asserted in the worker), baked
+    seed scores loaded per host, trajectory equal to the in-memory sharded
+    CSR run (float32, atol=0)."""
+    g, cfg, F0, cache = _compiled_worker_cache(tmp_path)
+    out = tmp_path / "proc0.npz"
+    _run_two_workers(out, mode="store-csr", ckpt_root=cache)
+    assert out.exists()
+
+    import jax
+
+    from bigclam_tpu.parallel import ShardedBigClamModel, make_mesh
+
+    mod = _worker_module()
+    mesh = make_mesh((4, 1), jax.devices()[:4])
+    ref = ShardedBigClamModel(g, mod.store_csr_cfg(cfg), mesh).fit(F0)
+    got = np.load(out)
+    np.testing.assert_allclose(got["F"], ref.F, rtol=0, atol=0)
+    np.testing.assert_allclose(
+        got["llh_history"], np.asarray(ref.llh_history), rtol=0, atol=0
+    )
+
+
+@_needs_multiproc_cpu
+def test_true_two_process_store_ring_buckets(tmp_path):
+    """ISSUE 9: TWO real processes running StoreRingBigClamModel — ring
+    (shard, phase) buckets built from each host's own shard files with the
+    bucket pad agreed via the one-int cross-host exchange; trajectory
+    equal to RingBigClamModel(balance=False) (float64, atol=0)."""
+    g, cfg, F0, cache = _compiled_worker_cache(tmp_path)
+    out = tmp_path / "proc0.npz"
+    _run_two_workers(out, mode="store-ring", ckpt_root=cache)
+    assert out.exists()
+
+    import jax
+
+    from bigclam_tpu.parallel import RingBigClamModel, make_mesh
+
+    mesh = make_mesh((4, 1), jax.devices()[:4])
+    ref = RingBigClamModel(
+        g, cfg.replace(use_pallas_csr=False), mesh, balance=False
+    ).fit(F0)
+    got = np.load(out)
+    np.testing.assert_allclose(got["F"], ref.F, rtol=0, atol=0)
+    np.testing.assert_allclose(
+        got["llh_history"], np.asarray(ref.llh_history), rtol=0, atol=0
+    )
+
+
 @_needs_multiproc_cpu
 def test_true_two_process_quality_device(tmp_path):
     """Device-resident quality annealing across TWO real processes: the

@@ -381,7 +381,10 @@ def test_store_sharded_model_refuses_mismatch(tmp_path):
     mesh = make_mesh((4, 1), jax.devices()[:4])
     with pytest.raises(ValueError, match="--shards 4"):
         StoreShardedBigClamModel(store, cfg, mesh)
-    with pytest.raises(ValueError, match="unsupported"):
+    # the ISSUE 9 lift: use_pallas_csr=True is no longer refused outright —
+    # it goes through the SAME static policy as the in-memory sharded
+    # trainer (float64 F still refuses, with the shared wording)
+    with pytest.raises(ValueError, match="float32"):
         StoreShardedBigClamModel(
             store, cfg.replace(use_pallas_csr=True),
             make_mesh((2, 1), jax.devices()[:2]),
