@@ -553,14 +553,22 @@ def _ring_overlap_config(configs, jax, BigClamConfig, sample_planted_graph):
         Fr = np.random.default_rng(6).uniform(
             0.1, 1.0, size=(gr.num_nodes, RING_K)
         )
+        state_r = model_r.init_state(Fr)
         rep = overlap_report(
-            model_r, model_r.init_state(Fr), steps=RING_STEPS, warmup=1
+            model_r, state_r, steps=RING_STEPS, warmup=1
         )
         e = gr.num_directed_edges
         eps_chip = {
             k: round(e / v / dp, 1)
             for k, v in rep["sec_per_step"].items()
         }
+        # collective-traffic accounting (obs.comms, ISSUE 10): modeled
+        # bytes/step of the compiled ring step next to hbm_frac, plus
+        # the same model re-priced from the LIVE device buffers — the
+        # pair the comms gate reconciles; drift = a layout change moved
+        # more bytes than the model admits
+        cm = model_r.comms
+        measured = model_r.comms_measured(state_r)
         configs["ring_overlap"] = {
             "config": f"AGM planted N={gr.num_nodes} 2E={e} K={RING_K} "
                       f"dp={dp} (ring, balanced)",
@@ -568,6 +576,15 @@ def _ring_overlap_config(configs, jax, BigClamConfig, sample_planted_graph):
             "eps_per_chip": eps_chip,
             "sec_per_step": rep["sec_per_step"],
             "comm_hidden_fraction": rep["comm_hidden_fraction"],
+            "comms": {
+                "modeled_bytes_per_step": round(cm.bytes_per_step(), 1),
+                "measured_bytes_per_step": round(
+                    measured.bytes_per_step(), 1
+                ),
+                "rotation_bytes_per_step": cm.site_bytes().get(
+                    "ring/ppermute_F_rot"
+                ),
+            },
             "roofline": roofline_position(
                 eps_chip["overlap"], RING_K,
                 jax.devices()[0].device_kind,
@@ -642,6 +659,15 @@ def _emit(jax, spec, g, cfg, F0, backend, model, configs, enron_eps,
                 # the denominator "is it actually fast" gates against
                 "hbm_frac": roof.get("hbm_frac"),
                 "mfu": roof.get("mfu"),
+                # comms-observability fields (ISSUE 10): the ring
+                # config's overlap fraction is VERDICTED by `cli perf
+                # diff` (rotation hops falling out of overlap is a
+                # regression even at flat single-chip step time); the
+                # modeled bytes/step rides the comms events the ring
+                # build already emitted into this telemetry run
+                "overlap_frac": (
+                    configs.get("ring_overlap", {}) or {}
+                ).get("comm_hidden_fraction"),
             }
         )
     print(json.dumps(record))

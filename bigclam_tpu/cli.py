@@ -444,6 +444,13 @@ def _make_model(g, cfg, args):
     return BigClamModel(g, cfg, k_multiple=128 if cfg.dtype == "float32" else 1)
 
 
+def _mesh_label(mesh) -> str:
+    """'dpxtp' identity of a mesh for the perf ledger's match key."""
+    from bigclam_tpu.parallel.mesh import K_AXIS, NODES_AXIS
+
+    return f"{mesh.shape[NODES_AXIS]}x{mesh.shape[K_AXIS]}"
+
+
 def _init_F(g, cfg, args):
     from bigclam_tpu.ops import seeding
 
@@ -639,6 +646,14 @@ def _cmd_fit(args, tel=None) -> int:
         # bench/ledger rows must say which bytes/edge model applies
         "representation": cfg.representation,
     }
+    if mesh is not None:
+        # execution-shape identity (obs.ledger.match_key, ISSUE 10): a
+        # (4,1) run must never baseline against (2,2) — the collective
+        # work differs at equal device count
+        out["mesh"] = _mesh_label(mesh)
+    cm = getattr(model, "comms", None)
+    if cm is not None:
+        out["comms_bytes_per_step"] = round(cm.bytes_per_step(), 1)
     if cfg.representation == "sparse":
         out["sparse_m"] = getattr(model, "m", cfg.sparse_m)
         if hasattr(model, "comm_mode"):
@@ -745,6 +760,10 @@ def _cmd_sweep(args, tel=None) -> int:
         "edges": g.num_directed_edges // 2,
         "representation": cfg.representation,
     }
+    if args.mesh:
+        # the ledger's execution-shape key (ISSUE 10); sweeps build their
+        # models per K inside sweep_k, so the flag is the identity here
+        out["mesh"] = args.mesh.replace(",", "x")
     if tel is not None:
         tel.set_final(out)
     print(json.dumps(out))
@@ -908,6 +927,11 @@ def _cmd_profile(args, tel=None) -> int:
         "k": cfg.num_communities,
         "representation": cfg.representation,
     }
+    if mesh is not None:
+        out["mesh"] = _mesh_label(mesh)
+    cm = getattr(model, "comms", None)
+    if cm is not None:
+        out["comms_bytes_per_step"] = round(cm.bytes_per_step(), 1)
     if tel is not None:
         tel.set_final(out)
     print(json.dumps(out))

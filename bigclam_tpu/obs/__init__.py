@@ -25,6 +25,12 @@ regression gate with noise bands). Spans share the events.jsonl schema
 record per run when BIGCLAM_PERF_LEDGER is set.
 """
 
+from bigclam_tpu.obs.comms import (
+    IMBALANCE_FACTOR,
+    CommsModel,
+    balance_stats,
+    detect_host_skew,
+)
 from bigclam_tpu.obs.health import DEFAULTS as HEALTH_DEFAULTS
 from bigclam_tpu.obs.health import HealthMonitor, run_detectors
 from bigclam_tpu.obs.heartbeat import Heartbeat
@@ -45,15 +51,19 @@ from bigclam_tpu.obs.telemetry import (
 from bigclam_tpu.obs.trace import add_span, open_spans, span, step_annotation
 
 __all__ = [
+    "CommsModel",
     "EVENT_KINDS",
     "HEALTH_DEFAULTS",
     "HealthMonitor",
     "Heartbeat",
+    "IMBALANCE_FACTOR",
     "LEDGER_ENV",
     "PerfLedger",
     "RunTelemetry",
     "SCHEMA_VERSION",
     "add_span",
+    "balance_stats",
+    "detect_host_skew",
     "current",
     "install",
     "note_step_build",

@@ -1,0 +1,666 @@
+"""Collective-traffic accounting + host-skew observability (ISSUE 10).
+
+The span layer (obs.trace) says where wall-clock went and the health pack
+(obs.health) says what the optimizer is doing — but nothing said what the
+INTERCONNECT is doing: every `psum`/`ppermute`/`all_gather` site in
+`parallel/` moved unmeasured bytes, and a slow host was invisible until
+the whole fit was slow. For power-law graph clustering the comm volume
+and its per-participant skew, not FLOPs, decide scaling (Sparse
+Allreduce, arXiv:1312.3020; pre-exascale MCL, arXiv:2002.10083) — this
+module makes both first-class, gateable run signals.
+
+Three layers, all jax-free at import (`cli report`/`cli watch` run on
+data-prep hosts):
+
+* **Static bytes-per-step model.** Each sharded trainer family bakes a
+  `CommsModel` at step-build time: one `Site` per collective site of its
+  compiled step (site id -> op kind, payload bytes, occurrences/step,
+  participants, phase, mesh axis), built by the `*_step_model` functions
+  here from the SAME shape arithmetic the trainer committed
+  (n_pad/k_pad/dp/tp, the sparse cap + static mode). Emitted as `comms`
+  schema events (one per site), summed into the run report and the perf
+  ledger (`comms_bytes_per_step`, verdicted by `cli perf diff`).
+
+  Wire-byte conventions (documented here once, shared by model and
+  reconciliation): an `all_gather` of a local s-byte shard over p
+  participants receives (p-1)*s bytes per device; a `psum` of an s-byte
+  array moves 2*s*(p-1)/p per device (ring allreduce: reduce-scatter +
+  all-gather); a `ppermute` hop moves s bytes per device; `pmax` follows
+  the psum formula. Axes of size 1 contribute zero (the collective
+  compiles to identity).
+
+* **Reconciliation.** `CommsModel.remeasure(payloads)` replaces modeled
+  site payloads with MEASURED ones — the actual addressable-shard nbytes
+  of the live TrainState buffers (`measured_payloads`), and the sparse
+  trainers' runtime exchanged-ids/dense-fallback counters — so the gate
+  (scripts/comms_gate.py) can assert the static model agrees with what
+  the step actually places on the wire, per family, across dp. A padding
+  or layout change that silently inflates traffic now fails a gate
+  instead of landing as folklore.
+
+* **Balance + straggler detection.** `balance_stats` turns per-shard
+  edge counts (from the store manifest or the CSR bounds) and tile-pad
+  waste into skew figures emitted as `balance` events;
+  `emit_imbalance_anomaly` turns the old `_warn_imbalance_counts` stderr
+  lines into `anomaly` events (check="imbalance") that `cli report`,
+  `cli watch`, and the heartbeat stall context all render.
+  `detect_host_skew` is a PURE detector (the PR 8 anomaly machinery's
+  report-time analog) over the merged per-process run reports: a host
+  everyone waits on shows up as the MINIMUM per-pid sync-span total
+  while its peers' sync balloons (the waiters rule), and a host burning
+  time OUTSIDE the loop phases (GC, a planted delay, a slow NIC driver)
+  shows up as loop-overhead skew (the overhead rule). Both fire one
+  finding naming the offending pid + host. Single-process fake-host
+  runs (two per-pid reports synthesized into one telemetry dir) exercise
+  the detector end to end without a process group — the tier-1 path on
+  jax 0.4.37, where the 2-proc worker modes skip.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+_NUM = (int, float)
+
+# bucket/shard skew past this multiple of the mean marks the layout as
+# imbalance-anomalous (shared with parallel.ring's warning heuristic —
+# RING_IMBALANCE_FACTOR aliases this so the anomaly fires exactly where
+# the stderr warning used to)
+IMBALANCE_FACTOR = 4.0
+
+# report-time host-skew detector thresholds (detect_host_skew); host-side
+# knobs like obs.health.DEFAULTS — deliberately NOT config fields
+DEFAULTS: Dict[str, float] = {
+    "straggler_factor": 3.0,    # max/min skew of the per-pid signal
+    "straggler_floor_s": 0.05,  # absolute seconds floor (noise guard)
+}
+
+
+def wire_bytes(op: str, payload_bytes: float, participants: int) -> float:
+    """Per-device wire bytes of ONE occurrence of a collective moving a
+    `payload_bytes` local array over `participants` (see module
+    docstring for the conventions). Size-1 axes cost nothing."""
+    p = max(int(participants), 1)
+    if p <= 1:
+        return 0.0
+    if op == "all_gather":
+        return float(payload_bytes) * (p - 1)
+    if op == "ppermute":
+        return float(payload_bytes)
+    if op in ("psum", "pmax", "pmin"):
+        return 2.0 * float(payload_bytes) * (p - 1) / p
+    raise ValueError(f"unknown collective op {op!r}")
+
+
+@dataclasses.dataclass(frozen=True)
+class Site:
+    """One collective site of a compiled train step.
+
+    payload_bytes: the LOCAL array bytes one occurrence moves (per
+    participant, pre-convention); count: occurrences per optimizer step
+    (fractional = cadence-gated, e.g. 1/health_every); phase: which part
+    of the step issues it (gather / reduce / rotate / support /
+    exchange / health)."""
+
+    site: str
+    op: str
+    payload_bytes: float
+    count: float
+    participants: int
+    phase: str
+    axis: str
+
+    @property
+    def bytes_per_step(self) -> float:
+        return wire_bytes(self.op, self.payload_bytes, self.participants) \
+            * self.count
+
+    def to_fields(self) -> Dict[str, Any]:
+        return {
+            "site": self.site,
+            "op": self.op,
+            "bytes_per_step": round(self.bytes_per_step, 1),
+            "payload_bytes": round(float(self.payload_bytes), 1),
+            "count": round(float(self.count), 4),
+            "participants": int(self.participants),
+            "phase": self.phase,
+            "axis": self.axis,
+        }
+
+
+@dataclasses.dataclass(frozen=True)
+class CommsModel:
+    """The static bytes-per-step model one trainer baked at step build."""
+
+    family: str                  # sharded | ring | sparse
+    model: str                   # trainer class name
+    sites: Tuple[Site, ...]
+    params: Dict[str, Any]       # the shape arithmetic inputs, for the
+
+    def bytes_per_step(self) -> float:          # artifact/report record
+        return sum(s.bytes_per_step for s in self.sites)
+
+    def site_bytes(self) -> Dict[str, float]:
+        return {s.site: round(s.bytes_per_step, 1) for s in self.sites}
+
+    def remeasure(self, payloads: Dict[str, float]) -> "CommsModel":
+        """A copy with the named sites' payloads replaced by MEASURED
+        bytes (live buffer nbytes / runtime counters); unnamed sites keep
+        their modeled payloads. The gate compares bytes_per_step() of
+        the pair — drift means the model no longer describes the step."""
+        sites = tuple(
+            dataclasses.replace(
+                s, payload_bytes=float(payloads[s.site])
+            )
+            if s.site in payloads
+            else s
+            for s in self.sites
+        )
+        return dataclasses.replace(self, sites=sites)
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "family": self.family,
+            "model": self.model,
+            "bytes_per_step": round(self.bytes_per_step(), 1),
+            "sites": [s.to_fields() for s in self.sites],
+            "params": dict(self.params),
+        }
+
+
+def _scalar_payload(itemsize: int, num_candidates: int) -> float:
+    """The per-step scalar-reduce bundle every family shares: the psum'd
+    global LLH plus the (num_candidates + 1,) int32 accept histogram."""
+    return itemsize + (num_candidates + 1) * 4
+
+
+def sharded_step_model(
+    n_pad: int,
+    k_pad: int,
+    dp: int,
+    tp: int,
+    itemsize: int,
+    num_candidates: int,
+    edge_slots: int = 0,
+    health_every: int = 0,
+    model: str = "ShardedBigClamModel",
+) -> CommsModel:
+    """Collective sites of the all-gather sharded step (parallel/sharded
+    .py, XLA and CSR schedules — same collectives at tp == 1; tp > 1
+    adds the per-edge partial-dot psums over "k"). `edge_slots` is the
+    PER-SHARD padded edge-slot count (only the tp > 1 sites read it)."""
+    n_loc = n_pad // max(dp, 1)
+    k_loc = k_pad // max(tp, 1)
+    sites = [
+        Site("sharded/all_gather_F", "all_gather",
+             n_loc * k_loc * itemsize, 1, dp, "gather", "nodes"),
+        # sumF at the top of the step + sumF_new after the update
+        Site("sharded/psum_sumF", "psum",
+             k_loc * itemsize, 2, dp, "reduce", "nodes"),
+        Site("sharded/psum_scalars", "psum",
+             _scalar_payload(itemsize, num_candidates), 1, dp,
+             "reduce", "nodes"),
+    ]
+    if tp > 1:
+        # per-edge partial dots completed over "k": one grad sweep + one
+        # per Armijo candidate, each psum'ing every padded edge slot once
+        sites.append(Site(
+            "sharded/psum_edge_dots", "psum",
+            edge_slots * itemsize, 1 + num_candidates, tp, "reduce", "k",
+        ))
+        # rowdot psums of (n_loc,): gg + the two node-tail terms, plus
+        # two per candidate tail (armijo_tail_select_sharded)
+        sites.append(Site(
+            "sharded/psum_rowdots", "psum",
+            n_loc * itemsize, 3 + 2 * num_candidates, tp, "reduce", "k",
+        ))
+    if health_every and health_every > 0:
+        sites.append(Site(
+            "sharded/psum_health", "psum", 3 * 4, 1.0 / health_every,
+            dp, "health", "nodes",
+        ))
+    return CommsModel(
+        family="sharded", model=model, sites=tuple(sites),
+        params={"n_pad": n_pad, "k_pad": k_pad, "dp": dp, "tp": tp,
+                "itemsize": itemsize, "edge_slots": edge_slots},
+    )
+
+
+def ring_step_model(
+    n_pad: int,
+    k_pad: int,
+    dp: int,
+    tp: int,
+    itemsize: int,
+    num_candidates: int,
+    bucket_slots: int = 0,
+    health_every: int = 0,
+    model: str = "RingBigClamModel",
+) -> CommsModel:
+    """Collective sites of the ring-pass step (parallel/ring.py): the
+    F-shard rotation replaces the all-gather — two full rotations per
+    step (gradient pass + candidate pass), dp ppermute hops each
+    (rotate_scan scans dp phases, one hop per phase, so every device
+    also re-receives its own shard on the closing hop), every hop
+    moving one (n_loc, k_loc) shard. Per pass that is dp*shard on the
+    wire vs the all-gather's (dp-1)*shard — a dp/(dp-1) premium, and
+    the candidate pass re-rotates where the all-gather step reuses its
+    one gathered copy, so the ring's modeled bytes/step are HIGHER; its
+    win is the O(2 shards) peak HBM, which is exactly why bytes/step
+    accounting, not memory, is the honest axis for comparing the
+    schedules. `bucket_slots` is the per-(shard, phase) padded
+    edge-slot count (tp > 1 sites only)."""
+    n_loc = n_pad // max(dp, 1)
+    k_loc = k_pad // max(tp, 1)
+    sites = [
+        Site("ring/ppermute_F_rot", "ppermute",
+             n_loc * k_loc * itemsize, 2 * dp if dp > 1 else 0, dp,
+             "rotate", "nodes"),
+        Site("ring/psum_sumF", "psum",
+             k_loc * itemsize, 2, dp, "reduce", "nodes"),
+        Site("ring/psum_scalars", "psum",
+             _scalar_payload(itemsize, num_candidates), 1, dp,
+             "reduce", "nodes"),
+    ]
+    if tp > 1:
+        sites.append(Site(
+            "ring/psum_edge_dots", "psum",
+            bucket_slots * itemsize, (1 + num_candidates) * dp, tp,
+            "reduce", "k",
+        ))
+        sites.append(Site(
+            "ring/psum_rowdots", "psum",
+            n_loc * itemsize, 3 + 2 * num_candidates, tp, "reduce", "k",
+        ))
+    if health_every and health_every > 0:
+        sites.append(Site(
+            "ring/psum_health", "psum", 3 * 4, 1.0 / health_every,
+            dp, "health", "nodes",
+        ))
+    return CommsModel(
+        family="ring", model=model, sites=tuple(sites),
+        params={"n_pad": n_pad, "k_pad": k_pad, "dp": dp, "tp": tp,
+                "itemsize": itemsize, "bucket_slots": bucket_slots},
+    )
+
+
+def sparse_step_model(
+    n_pad: int,
+    m: int,
+    k_pad: int,
+    dp: int,
+    itemsize: int,
+    num_candidates: int,
+    cap: int,
+    mode: str,
+    support_every: int = 1,
+    health_every: int = 0,
+    model: str = "SparseShardedBigClamModel",
+) -> CommsModel:
+    """Collective sites of the sparse-representation sharded step
+    (parallel/sparse_sharded.py + sparse_collectives.py). The member
+    exchange scales with M, not K; the sumF allreduce moves fixed
+    (cap,) id/value buffers in 'sparse' mode (the wire cost is the CAP,
+    not the touched count — occupancy below cap is headroom, not saved
+    bytes) and the dense (k_pad,) psum in 'dense' mode."""
+    from bigclam_tpu.parallel.sparse_collectives import (
+        exchange_payload_bytes,
+    )
+
+    n_loc = n_pad // max(dp, 1)
+    row_bytes = m * (4 + itemsize)          # int32 id + weight per slot
+    sup = max(int(support_every), 1)
+    sites = [
+        # the post-support id/weight gather pair feeds grad + candidates
+        # every step; the support pass gathers a second pair on cadence
+        Site("sparse/all_gather_members", "all_gather",
+             n_loc * row_bytes, 1.0 + 1.0 / sup, dp, "gather", "nodes"),
+        Site("sparse/psum_scalars", "psum",
+             _scalar_payload(itemsize, num_candidates), 1, dp,
+             "reduce", "nodes"),
+    ]
+    if mode == "sparse":
+        sites.append(Site(
+            "sparse/allreduce_touched", "all_gather",
+            exchange_payload_bytes(cap, itemsize), 2, dp,
+            "exchange", "nodes",
+        ))
+        sites.append(Site(
+            "sparse/pmax_touched_count", "pmax", 4, 2, dp,
+            "exchange", "nodes",
+        ))
+    else:
+        sites.append(Site(
+            "sparse/psum_sumF", "psum", k_pad * itemsize, 2, dp,
+            "reduce", "nodes",
+        ))
+        sites.append(Site(
+            "sparse/pmax_touched_count", "pmax", 4, 2, dp,
+            "exchange", "nodes",
+        ))
+    if health_every and health_every > 0:
+        # support-churn psum runs every step when health is on (the
+        # latch needs it); grad stats ride the cadence
+        sites.append(Site(
+            "sparse/psum_health", "psum", 4, 1, dp, "health", "nodes",
+        ))
+        sites.append(Site(
+            "sparse/psum_grad_stats", "psum", 3 * 4,
+            1.0 / max(int(health_every), 1), dp, "health", "nodes",
+        ))
+    return CommsModel(
+        family="sparse", model=model, sites=tuple(sites),
+        params={"n_pad": n_pad, "m": m, "k_pad": k_pad, "dp": dp,
+                "itemsize": itemsize, "cap": cap, "mode": mode,
+                "support_every": sup},
+    )
+
+
+# --------------------------------------------------------- reconciliation
+def _shard_nbytes(arr) -> Optional[float]:
+    """Bytes of this process's first addressable shard of a (possibly
+    globally sharded) jax.Array — the per-participant payload the step
+    actually places. None when the array exposes no shard API (plain
+    numpy in tests)."""
+    shards = getattr(arr, "addressable_shards", None)
+    if not shards:
+        nbytes = getattr(arr, "nbytes", None)
+        return float(nbytes) if nbytes is not None else None
+    return float(shards[0].data.nbytes)
+
+
+def measured_payloads(family: str, state) -> Dict[str, float]:
+    """Measured per-site payload bytes from a live TrainState's device
+    buffers (see CommsModel.remeasure). Dense families only — the sparse
+    trainer's runtime counters go through sparse_measured."""
+    out: Dict[str, float] = {}
+    f = _shard_nbytes(state.F)
+    s = _shard_nbytes(state.sumF)
+    if family == "sharded":
+        if f is not None:
+            out["sharded/all_gather_F"] = f
+        if s is not None:
+            out["sharded/psum_sumF"] = s
+    elif family == "ring":
+        if f is not None:
+            out["ring/ppermute_F_rot"] = f
+        if s is not None:
+            out["ring/psum_sumF"] = s
+    return out
+
+
+def sparse_measured(model: CommsModel, state) -> Dict[str, Any]:
+    """Reconcile the sparse model against the RUNTIME exchange counters
+    riding the state (comm_ids = max touched ids over shards, comm_dense
+    = a dense-psum fallback fired): the wire stays cap-sized while the
+    sparse branch holds, so the checks are occupancy (exchanged <= cap)
+    and the fallback flipping the accounting to the dense psum."""
+    from bigclam_tpu.parallel.sparse_collectives import (
+        exchange_payload_bytes,
+    )
+
+    cap = int(model.params.get("cap", 0))
+    itemsize = int(model.params.get("itemsize", 4))
+    k_pad = int(model.params.get("k_pad", 0))
+    dp = int(model.params.get("dp", 1))
+    exchanged = int(state.comm_ids)
+    fell_back = bool(int(state.comm_dense))
+    ids_row = _shard_nbytes(state.ids)
+    w_row = _shard_nbytes(state.F)
+    payloads: Dict[str, float] = {}
+    if ids_row is not None and w_row is not None:
+        payloads["sparse/all_gather_members"] = ids_row + w_row
+    if fell_back:
+        # that step's exchange was the dense psum — measured wire for the
+        # allreduce site is the dense formula, not the capped buffers
+        measured_exchange = 2 * wire_bytes("psum", k_pad * itemsize, dp)
+    else:
+        measured_exchange = 2 * wire_bytes(
+            "all_gather", exchange_payload_bytes(cap, itemsize), dp
+        )
+    return {
+        "payloads": payloads,
+        "exchanged_ids": exchanged,
+        "dense_fallback": fell_back,
+        "cap": cap,
+        "occupancy": exchanged / max(cap, 1),
+        "exchange_bytes_per_step": round(measured_exchange, 1),
+    }
+
+
+# ------------------------------------------------------ balance / skew
+def balance_stats(counts: Sequence[float]) -> Dict[str, Any]:
+    """Skew statistics over per-shard work counts (directed edges, tile
+    slots): max, mean (floored at 1 like the ring heuristic), skew =
+    max/mean, cv, and the arg-max shard — what the `balance` events and
+    the imbalance anomaly carry."""
+    vals = [float(v) for v in counts]
+    if not vals:
+        return {"max": 0.0, "mean": 1.0, "skew": 0.0, "cv": 0.0,
+                "argmax": -1}
+    mx = max(vals)
+    mean = max(sum(vals) / len(vals), 1.0)
+    var = sum((v - mean) ** 2 for v in vals) / len(vals)
+    return {
+        "max": mx,
+        "mean": round(mean, 2),
+        "skew": round(mx / mean, 3),
+        "cv": round(math.sqrt(var) / mean, 4),
+        "argmax": max(range(len(vals)), key=lambda i: vals[i]),
+    }
+
+
+def owner_pid(shard: int, num_shards: int, process_count: int) -> int:
+    """Owning process of a store/trainer shard under the process-major
+    contiguous layout (multihost.host_shard_ids): host h of H owns
+    shards [h*S/H, (h+1)*S/H)."""
+    pc = max(int(process_count), 1)
+    s = max(int(num_shards), 1)
+    return min(int(shard) * pc // s, pc - 1)
+
+
+# ----------------------------------------------------------- emission
+def emit_model(cm: CommsModel) -> None:
+    """One `comms` event per collective site of a just-built step (plus
+    the run-report/ledger accumulation RunTelemetry.event folds in).
+    The FIRST event of the batch carries reset_model=True: a re-emitted
+    model (the sparse cap refinement can flip the whole collective MODE)
+    must REPLACE its previous site set in every consumer, or a stale
+    site from the abandoned layout inflates bytes/step forever. No-op
+    with telemetry off."""
+    from bigclam_tpu.obs import telemetry as _obs
+
+    tel = _obs.current()
+    if tel is None:
+        return
+    for i, s in enumerate(cm.sites):
+        tel.event("comms", model=cm.model, family=cm.family,
+                  reset_model=1 if i == 0 else 0, **s.to_fields())
+
+
+def emit_balance(what: str, stats: Dict[str, Any], **fields) -> None:
+    """One `balance` event (shard edge-count skew, tile-pad waste). The
+    skew itself is a finding for the report/watch; crossing
+    IMBALANCE_FACTOR is the anomaly (emit_imbalance_anomaly)."""
+    from bigclam_tpu.obs import telemetry as _obs
+
+    tel = _obs.current()
+    if tel is None:
+        return
+    payload = {k: v for k, v in stats.items()}
+    payload.update(fields)
+    tel.event("balance", what=what, **payload)
+
+
+def emit_shard_balance(
+    what: str,
+    counts: Sequence[float],
+    num_shards: int,
+    process_count: int = 1,
+    hint: str = "",
+    **fields,
+) -> Dict[str, Any]:
+    """The one balance-emission path every sharded trainer build goes
+    through: a `balance` event with the skew stats (+ any tile-pad-waste
+    fields), and — past IMBALANCE_FACTOR — the imbalance anomaly naming
+    the worst shard and its owning process. Returns the stats either
+    way (telemetry off included) so callers can reuse them."""
+    stats = balance_stats(counts)
+    emit_balance(what, stats, **fields)
+    if stats["skew"] > IMBALANCE_FACTOR:
+        emit_imbalance_anomaly(
+            what, stats["max"], stats["mean"],
+            worst_shard=stats["argmax"],
+            host=owner_pid(stats["argmax"], num_shards, process_count),
+            hint=hint,
+        )
+    return stats
+
+
+def emit_imbalance_anomaly(
+    what: str,
+    max_count: float,
+    mean: float,
+    worst_shard: Optional[int] = None,
+    host: Optional[int] = None,
+    hint: str = "",
+) -> None:
+    """The `_warn_imbalance_counts` stderr line as a first-class anomaly
+    event (check="imbalance", build-time: iter=-1) naming the worst
+    shard and — when ownership is known — the host that holds it, so the
+    report, `cli watch`, and `cli perf diff`'s anomaly count all see
+    what used to scroll past on stderr."""
+    from bigclam_tpu.obs import telemetry as _obs
+
+    tel = _obs.current()
+    if tel is None:
+        return
+    fields: Dict[str, Any] = {
+        "what": what,
+        "max": float(max_count),
+        "mean": round(float(mean), 2),
+        "factor": round(float(max_count) / max(float(mean), 1e-9), 2),
+    }
+    if worst_shard is not None:
+        fields["worst_shard"] = int(worst_shard)
+    if host is not None:
+        fields["host_pid"] = int(host)
+    if hint:
+        fields["hint"] = hint
+    tel.event("anomaly", check="imbalance", iter=-1, **fields)
+
+
+# --------------------------------------------- report-time skew detector
+def _pid_of(report: Dict[str, Any]) -> str:
+    return str(report.get("pid", "?"))
+
+
+def _host_of(report: Dict[str, Any]) -> str:
+    return str((report.get("fingerprint", {}) or {}).get("host", "?"))
+
+
+def sync_seconds(report: Dict[str, Any]) -> float:
+    """Total fit-loop sync-span seconds of one per-process report (the
+    host block on the step's scalar LLH — device compute + in-step
+    collective waits + D2H are indistinguishable from the host, so this
+    IS the 'waiting on the gang' phase)."""
+    spans = (report.get("spans", {}) or {}).get("seconds", {}) or {}
+    return sum(
+        float(v) for k, v in spans.items() if k.endswith("fit_loop/sync")
+    )
+
+
+def loop_overhead_seconds(report: Dict[str, Any]) -> float:
+    """Seconds the fit stage spent OUTSIDE the per-iteration phase spans
+    (dispatch/sync/callback/checkpoint/extract_F): host-side work the
+    taxonomy does not attribute — GC, a slow filesystem, a planted
+    per-host delay. The overhead rule of detect_host_skew keys on this
+    because a straggler's slowness lives exactly here (its own sync is
+    SHORT — everyone else waits on it)."""
+    spans = (report.get("spans", {}) or {}).get("seconds", {}) or {}
+    parents = {
+        k.split("/fit_loop/")[0]
+        for k in spans
+        if "/fit_loop/" in k
+    }
+    if not parents and any(k.startswith("fit_loop/") for k in spans):
+        parents = {""}
+    total = 0.0
+    for parent in parents:
+        prefix = f"{parent}/fit_loop/" if parent else "fit_loop/"
+        phase_sum = sum(
+            float(v) for k, v in spans.items() if k.startswith(prefix)
+        )
+        parent_total = float(spans.get(parent, phase_sum)) if parent \
+            else phase_sum
+        total += max(parent_total - phase_sum, 0.0)
+    return total
+
+
+def detect_host_skew(
+    reports: List[Dict[str, Any]],
+    thresholds: Optional[Dict[str, float]] = None,
+) -> List[Dict[str, Any]]:
+    """Straggler findings over the merged per-process run reports (pure;
+    deterministic thresholds — DEFAULTS). Two rules, at most one finding:
+
+    * waiters: one pid's sync total is a straggler_factor below its
+      peers' (they sat in the collective waiting on it) — fire naming
+      the MINIMUM-sync pid.
+    * overhead: one pid's unattributed fit-loop time dwarfs its peers'
+      (host-side slowness: the planted `delay` fault, GC, slow I/O) —
+      fire naming the MAXIMUM-overhead pid.
+
+    Both need >= 2 per-process reports; a single-process run can still
+    exercise them through synthesized fake-host reports (the tier-1
+    path on jax versions whose 2-proc worker modes skip)."""
+    th = {**DEFAULTS, **(thresholds or {})}
+    factor = float(th["straggler_factor"])
+    floor = float(th["straggler_floor_s"])
+    per = [
+        (r, sync_seconds(r), loop_overhead_seconds(r)) for r in reports
+    ]
+    per = [(r, s, o) for r, s, o in per if s > 0.0 or o > 0.0]
+    if len(per) < 2:
+        return []
+    out: List[Dict[str, Any]] = []
+    sync = {(_pid_of(r)): s for r, s, _ in per}
+    # --- waiters rule ---
+    syncs = sorted(per, key=lambda t: t[1])
+    lo_r, lo_s, _ = syncs[0]
+    hi_r, hi_s, _ = syncs[-1]
+    if (
+        lo_s > 0.0
+        and hi_s - lo_s > floor
+        and hi_s > factor * max(lo_s, 1e-9)
+    ):
+        out.append({
+            "check": "straggler",
+            "rule": "waiters",
+            "pid": int(lo_r.get("pid", 0)),
+            "host": _host_of(lo_r),
+            "sync_s": round(lo_s, 4),
+            "peers_sync_s": round(hi_s, 4),
+            "skew": round(hi_s / max(lo_s, 1e-9), 2),
+            "sync_by_pid": {k: round(v, 4) for k, v in sync.items()},
+        })
+        return out
+    # --- overhead rule ---
+    ovh = sorted(per, key=lambda t: t[2])
+    top_r, _, top_o = ovh[-1]
+    second_o = ovh[-2][2]
+    if top_o > floor and top_o > factor * max(second_o, floor):
+        out.append({
+            "check": "straggler",
+            "rule": "overhead",
+            "pid": int(top_r.get("pid", 0)),
+            "host": _host_of(top_r),
+            "overhead_s": round(top_o, 4),
+            "peers_overhead_s": round(second_o, 4),
+            "overhead_by_pid": {
+                _pid_of(r): round(o, 4) for r, _, o in per
+            },
+        })
+    return out

@@ -121,6 +121,11 @@ class Heartbeat:
         # compiling / wedged collective" (healthy last snapshot) from
         # "diverging" (grad norm exploding) — None when health is off
         health = getattr(self.telemetry, "last_health", None)
+        # the last fit-loop sync-span duration (obs.comms / ISSUE 10):
+        # a stall whose final sync was already ballooning reads as
+        # "waiting on the gang / a straggler host", not "computing" —
+        # None before the first iteration completes
+        sync_s = getattr(self.telemetry, "last_sync_s", None)
         self.telemetry.event(
             "stall",
             silent_s=round(silent_s, 3),
@@ -129,6 +134,7 @@ class Heartbeat:
             devices=devices,
             spans=spans,
             health=health,
+            sync_s=sync_s,
         )
         if self.echo:
             where = f"; open span: {spans[-1]}" if spans else ""
@@ -149,6 +155,7 @@ class Heartbeat:
                 progress=progress,
                 spans=spans,
                 health=health,
+                sync_s=sync_s,
             )
             if self.echo:
                 print(

@@ -147,6 +147,16 @@ def build_record(
         # construction: the sparse trainers always stamp them)
         "representation": final.get("representation"),
         "sparse_m": final.get("sparse_m"),
+        # execution shape (ISSUE 10 satellite): a 2-proc run must never
+        # baseline against a single-proc run of the same cfg on the same
+        # box (each process times only its shard's work), and a (4,1)
+        # mesh does different collective work than (2,2) at equal device
+        # count — both join the match key. `processes` comes from the
+        # run report (jax.process_count at finalize); `mesh` is the
+        # "dpxtp" string the sharded entry points stamp into their final
+        # outcome (None on single-chip runs — matches on the None)
+        "processes": int(report.get("processes", 1) or 1),
+        "mesh": final.get("mesh"),
         "wall_s": float(report.get("wall_s", 0.0) or 0.0),
         "steps": len(secs),
         "step_p10": _round6(_percentile(secs, 10)),
@@ -156,6 +166,7 @@ def build_record(
         "eps_p50": _round6(_percentile(eps, 50)),
         "compiles": int((report.get("compiles", {}) or {}).get("count", 0)),
         "hbm_frac": final.get("hbm_frac"),
+        "overlap_frac": final.get("overlap_frac"),
         "spans": {
             k: round(float(v), 4)
             for k, v in (report.get("spans", {}) or {})
@@ -163,6 +174,22 @@ def build_record(
             .items()
         },
         "final_llh": final.get("llh"),
+    }
+    # collective-traffic accounting (obs.comms, ISSUE 10): the modeled
+    # bytes/step total + per-site table of the run's compiled steps —
+    # `cli perf diff` VERDICTS on the total (a layout/padding change that
+    # silently inflates wire traffic is a regression even at flat step
+    # time on a small testbed), per-site deltas ride the record for the
+    # human diff. None when the run built no sharded trainer.
+    comms = report.get("comms", {}) or {}
+    comms_sites = comms.get("sites") or {}
+    rec["comms_bytes_per_step"] = (
+        round(float(comms.get("bytes_per_step", 0.0)), 1)
+        if comms_sites
+        else None
+    )
+    rec["comms_sites"] = {
+        k: round(float(v), 1) for k, v in comms_sites.items()
     }
     # convergence figures (ISSUE 8): a fit that still lands the same LLH
     # but needs 3x the iterations — or stops with a grad norm an order of
@@ -222,6 +249,13 @@ def match_key(rec: Dict[str, Any]) -> Tuple:
         rec.get("backend"),
         rec.get("device_kind"),
         rec.get("host"),
+        # execution shape (ISSUE 10 satellite): before these, a 2-proc
+        # run silently baselined against a single-proc run on the same
+        # box, and (4,1) against (2,2). Pre-field records carry None and
+        # stop matching new ones — by design, the same rebaseline rule
+        # as every match-key widening
+        rec.get("processes"),
+        rec.get("mesh"),
     )
 
 
@@ -404,6 +438,21 @@ def diff_records(
     ):
         check("hbm_frac", base["hbm_frac"], new["hbm_frac"],
               worse_if_higher=False)
+    # collective-traffic verdicts (obs.comms, ISSUE 10): modeled
+    # bytes/step growing past the band is a layout regression the
+    # step-time checks cannot see on a small testbed (the wire cost
+    # scales with the pod, the CPU fake's doesn't); a shrinking overlap
+    # fraction means rotation hops stopped hiding behind compute
+    if isinstance(base.get("comms_bytes_per_step"), _NUM) and isinstance(
+        new.get("comms_bytes_per_step"), _NUM
+    ):
+        check("comms_bytes_per_step", base["comms_bytes_per_step"],
+              new["comms_bytes_per_step"])
+    if isinstance(base.get("overlap_frac"), _NUM) and isinstance(
+        new.get("overlap_frac"), _NUM
+    ):
+        check("overlap_frac", base["overlap_frac"], new["overlap_frac"],
+              worse_if_higher=False)
     # convergence verdicts (ISSUE 8): iteration count to tolerance is
     # VERDICTED (same cfg + workload + seed ⇒ deterministic up to float
     # summation order — growth past the band is a real optimizer
@@ -433,6 +482,20 @@ def diff_records(
                  "ratio": round(ns / bs, 4)}
             )
     deltas.sort(key=lambda d: -d["ratio"])
+    # per-site comms deltas (findings — the verdict rides the total):
+    # which collective site grew is the actionable half of a bytes/step
+    # regression
+    comms_deltas = []
+    bc = base.get("comms_sites", {}) or {}
+    nc = new.get("comms_sites", {}) or {}
+    for site in sorted(set(bc) & set(nc)):
+        bs, ns = float(bc[site]), float(nc[site])
+        if bs > 0 and ns != bs:
+            comms_deltas.append(
+                {"site": site, "base_bytes": bs, "new_bytes": ns,
+                 "ratio": round(ns / bs, 4)}
+            )
+    comms_deltas.sort(key=lambda d: -d["ratio"])
     return {
         "base_run": base.get("run"),
         "new_run": new.get("run"),
@@ -441,6 +504,7 @@ def diff_records(
         "regression": state["regression"],
         "compile_growth": compile_growth,
         "span_deltas": deltas[:8],
+        "comms_deltas": comms_deltas[:8],
         # finding, not a verdict: anomaly events in the new run (the
         # detectors already said WHAT; the diff just surfaces that the
         # baseline was clean and the new run was not)
@@ -486,6 +550,14 @@ def render_diff(d: Dict[str, Any]) -> str:
             lines.append(
                 f"    {s['path']:<32} {s['base_s']:.3f}s -> "
                 f"{s['new_s']:.3f}s ({s['ratio']:.2f}x)"
+            )
+    grew = [c for c in d.get("comms_deltas", []) if c["ratio"] > 1.0]
+    if grew:
+        lines.append("  collective sites moving more bytes/step:")
+        for c in grew[:3]:
+            lines.append(
+                f"    {c['site']:<32} {c['base_bytes']:.0f} -> "
+                f"{c['new_bytes']:.0f} B/step ({c['ratio']:.2f}x)"
             )
     lines.append(
         "  verdict: " + ("REGRESSION" if d["regression"] else "PASS")

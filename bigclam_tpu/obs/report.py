@@ -175,6 +175,28 @@ def merge_reports(reports: List[dict]) -> dict:
                 health["anomalies"].get(check, 0) + int(n)
             )
     merged["health"] = health
+    # collective-traffic accounting (obs.comms, ISSUE 10): the primary's
+    # modeled bytes/step table (every process compiles the same SPMD
+    # step, so the models agree; the primary's event log is
+    # authoritative), plus the per-pid fit-loop sync totals — the raw
+    # signal behind the straggler detector (a host everyone waits on has
+    # the SMALLEST sync total; its peers' balloon)
+    merged["comms"] = next(
+        (
+            r.get("comms")
+            for r in reports
+            if (r.get("comms", {}) or {}).get("sites")
+        ),
+        None,
+    )
+    from bigclam_tpu.obs.comms import sync_seconds
+
+    sync_by_pid = {}
+    for r in reports:
+        s = sync_seconds(r)
+        if s > 0:
+            sync_by_pid[str(r.get("pid", "?"))] = round(s, 4)
+    merged["sync_by_pid"] = sync_by_pid
     device_peak: Dict[str, dict] = {}
     compiles = {"count": 0, "backend_compiles": 0, "step_builds": 0,
                 "backend_compile_s": 0.0, "by_key": {}}
@@ -265,6 +287,16 @@ def render_json(directory: str) -> Tuple[dict, int]:
         for e in (events or [])
         if e.get("kind") == "anomaly"
     ]
+    # report-time host-skew findings (obs.comms, ISSUE 10): stragglers
+    # are only visible ACROSS the per-process reports, so they cannot be
+    # events — they join the anomalies list here, tagged with their
+    # source. Findings, never exit-code errors (same contract as the
+    # event-sourced anomalies).
+    from bigclam_tpu.obs.comms import detect_host_skew
+
+    anomalies.extend(
+        {**f, "source": "report"} for f in detect_host_skew(reports)
+    )
     recovery_kinds = (
         "retry", "recovered", "gave_up", "rollback", "quarantine",
         "resume", "fault_injected", "stall_escalated",
@@ -278,6 +310,8 @@ def render_json(directory: str) -> Tuple[dict, int]:
             "duration_s": run_duration_s(events or []),
         },
         "health": (merged or {}).get("health", {}),
+        "comms": (merged or {}).get("comms"),
+        "sync_by_pid": (merged or {}).get("sync_by_pid", {}),
         "anomalies": anomalies,
         "recovery": {
             k: (merged or {}).get("events", {}).get(k, 0)
@@ -409,6 +443,52 @@ def render(directory: str) -> Tuple[str, int]:
             # counted into the exit code
             lines.append("")
             lines.append(f"STALLS: {merged['stalls']} heartbeat deadline(s) hit")
+
+        # --- collective traffic + host skew (obs.comms, ISSUE 10) ---
+        comms = merged.get("comms") or {}
+        if comms.get("sites"):
+            lines.append("")
+            lines.append(
+                "collective traffic (modeled): "
+                f"{_fmt_bytes(int(comms.get('bytes_per_step', 0)))}"
+                f"/step over {len(comms['sites'])} site(s)"
+            )
+            for site, b in sorted(
+                comms["sites"].items(), key=lambda kv: -kv[1]
+            )[:10]:
+                lines.append(
+                    f"  {site:<34} {_fmt_bytes(int(b)):>10}/step"
+                )
+        sync = merged.get("sync_by_pid") or {}
+        if len(sync) >= 2:
+            ordered = sorted(sync.items(), key=lambda kv: kv[1])
+            (lo_pid, lo_s), (hi_pid, hi_s) = ordered[0], ordered[-1]
+            lines.append("")
+            lines.append(
+                "per-iteration sync totals: "
+                + "  ".join(
+                    f"p{pid} {s:.2f}s" for pid, s in sorted(
+                        sync.items(), key=lambda kv: _pid_key(kv[0])
+                    )
+                )
+                + f"  (skew p{hi_pid}/p{lo_pid} "
+                f"{hi_s / max(lo_s, 1e-9):.1f}x)"
+            )
+        from bigclam_tpu.obs.comms import detect_host_skew
+
+        for f in detect_host_skew(reports):
+            # a finding, like the event anomalies — never an exit error
+            lines.append(
+                f"  STRAGGLER: p{f['pid']} (host {f['host']}) — "
+                f"{f['rule']} rule"
+                + (
+                    f", sync {f['sync_s']}s vs peers "
+                    f"{f['peers_sync_s']}s"
+                    if f["rule"] == "waiters"
+                    else f", unattributed loop time {f['overhead_s']}s "
+                    f"vs peers {f['peers_overhead_s']}s"
+                )
+            )
 
         # --- recovery history (ISSUE 5): retries, rollbacks, quarantines,
         # injected faults, escalations, resume lineage. A gave_up means the

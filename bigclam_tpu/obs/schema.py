@@ -89,6 +89,17 @@ EVENT_KINDS = {
     # sparse-collective layout committed at model build (cap, static
     # sparse-vs-psum mode, the touched-count it was sized from); the
     # PER-STEP occupancy/fallback counters ride `health` events
+    # --- collective-traffic accounting (obs.comms, ISSUE 10) ---
+    "comms": {"site": (str,), "op": (str,), "bytes_per_step": _NUM},
+    # one collective site of a just-built train step (static bytes/step
+    # model; payload/count/participants/phase/axis ride as extra fields).
+    # Re-emitted when the layout changes (sparse cap refinement) — the
+    # run report keeps the LAST model per site
+    "balance": {"what": (str,), "max": _NUM, "mean": _NUM},
+    # per-shard work-balance snapshot at model build (shard edge counts,
+    # tile-pad waste): max/mean/skew/cv + the arg-max shard. Crossing
+    # the imbalance threshold additionally fires an `anomaly` event
+    # (check="imbalance", iter=-1 — build-time, not an iteration)
 }
 
 _BASE = {

@@ -331,6 +331,16 @@ class RunTelemetry:
         self.last_health: Optional[Dict[str, Any]] = None
         self.health_samples = 0
         self.anomaly_counts: Dict[str, int] = {}
+        # collective-traffic accounting (obs.comms, ISSUE 10): modeled
+        # bytes/step per collective site, keyed per MODEL so a re-emitted
+        # model (reset_model on its first event) replaces its whole site
+        # set — the sparse cap refinement can flip the collective mode,
+        # and a stale site from the abandoned layout must not keep
+        # inflating the total. Plus the last fit-loop sync-span duration
+        # (span_complete tracks it) so heartbeat stall reports can say
+        # whether the run died WAITING on the gang or computing.
+        self._comms_by_model: Dict[str, Dict[str, float]] = {}
+        self.last_sync_s: Optional[float] = None
         # tag -> number of watermark samples; dev -> running max stats
         self.watermark_tags: Dict[str, int] = {}
         self.device_peak: Dict[str, Dict[str, Optional[int]]] = {}
@@ -391,6 +401,17 @@ class RunTelemetry:
                 self.anomaly_counts[check] = (
                     self.anomaly_counts.get(check, 0) + 1
                 )
+            elif kind == "comms":
+                model = str(fields.get("model", "?"))
+                if fields.get("reset_model"):
+                    self._comms_by_model[model] = {}
+                sites = self._comms_by_model.setdefault(model, {})
+                try:
+                    sites[str(fields.get("site", "?"))] = float(
+                        fields.get("bytes_per_step", 0.0) or 0.0
+                    )
+                except (TypeError, ValueError):
+                    pass
             if not self._gated:
                 if self.auto_gate:
                     self._commit_gate_locked()
@@ -470,6 +491,10 @@ class RunTelemetry:
             self.span_counts[path] = self.span_counts.get(path, 0) + 1
             if orphans:
                 self.span_orphans += orphans
+            if path.endswith("fit_loop/sync"):
+                # last collective-wait duration, for stall context (one
+                # suffix check per span close — rides the <2% pin)
+                self.last_sync_s = round(seconds, 6)
         if emit:
             payload = dict(fields) if fields else {}
             if not ok:
@@ -626,6 +651,21 @@ class RunTelemetry:
                         else None
                     ),
                     "anomalies": dict(self.anomaly_counts),
+                },
+                "comms": {
+                    "bytes_per_step": round(
+                        sum(
+                            v
+                            for sites in self._comms_by_model.values()
+                            for v in sites.values()
+                        ),
+                        1,
+                    ),
+                    "sites": {
+                        k: round(v, 1)
+                        for sites in self._comms_by_model.values()
+                        for k, v in sites.items()
+                    },
                 },
                 "fingerprint": _fingerprint(),
                 "memory": {

@@ -142,11 +142,47 @@ def _render_events(
     if steps:
         spark_row("sec/iter", _series(steps, "step", "sec_per_iter"))
 
+    # collective-traffic + balance snapshot (obs.comms): per-MODEL site
+    # sets, a re-emitted model (reset_model on its first event — the
+    # sparse cap refinement can flip the collective mode) replaces its
+    # previous sites; latest balance skew — the "is the interconnect/
+    # work-split sane" line
+    comms_by_model = {}
+    for e in events:
+        if e.get("kind") != "comms" or not isinstance(
+            e.get("bytes_per_step"), (int, float)
+        ):
+            continue
+        model = str(e.get("model", "?"))
+        if e.get("reset_model"):
+            comms_by_model[model] = {}
+        comms_by_model.setdefault(model, {})[
+            str(e.get("site", "?"))
+        ] = float(e["bytes_per_step"])
+    if comms_by_model:
+        from bigclam_tpu.obs.report import _fmt_bytes
+
+        sites = [
+            v for m in comms_by_model.values() for v in m.values()
+        ]
+        lines.append(
+            f"  comms {_fmt_bytes(int(sum(sites)))}/step modeled over "
+            f"{len(sites)} site(s)"
+        )
+    balances = [e for e in events if e.get("kind") == "balance"]
+    if balances:
+        b = balances[-1]
+        skew = b.get("skew")
+        lines.append(
+            f"  balance {b.get('what')}: skew "
+            f"{skew if isinstance(skew, (int, float)) else '?'}x "
+            f"(max {b.get('max')} vs mean {b.get('mean')})"
+        )
     anomalies = [e for e in events if e.get("kind") == "anomaly"]
     for a in anomalies:
-        lines.append(
-            f"  ANOMALY {a.get('check')} at iter {a.get('iter')}"
-        )
+        it = a.get("iter")
+        where = "build" if isinstance(it, int) and it < 0 else f"iter {it}"
+        lines.append(f"  ANOMALY {a.get('check')} at {where}")
     stalls = [e for e in events if e.get("kind") == "stall"]
     if stalls:
         s = stalls[-1]

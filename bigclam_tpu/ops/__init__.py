@@ -1,17 +1,47 @@
-from bigclam_tpu.ops.objective import grad_llh, loglikelihood
-from bigclam_tpu.ops.linesearch import candidates_pass, armijo_update
-from bigclam_tpu.ops.components import (
-    column_component_stats,
-    components_backend,
-    graph_components_device,
-)
+"""Device ops package.
 
-__all__ = [
-    "grad_llh",
-    "loglikelihood",
-    "candidates_pass",
-    "armijo_update",
-    "column_component_stats",
-    "components_backend",
-    "graph_components_device",
-]
+LAZY attribute re-exports (PEP 562): the eager re-export of
+objective/linesearch/components here meant that importing ANY ops
+submodule — including the numpy-only ones (`ops.seeding`,
+`ops.csr_tiles`) — executed `import jax` as a side effect of the package
+init. That silently broke the jax-free contract of `cli ingest` (the
+default seed bake does `from bigclam_tpu.ops.seeding import ...` — the
+submodule is numpy-only, the package init was not), caught by
+tests/test_cli_jaxfree.py (ISSUE 10 satellite). Submodule imports now
+touch only what they name; `from bigclam_tpu.ops import grad_llh` still
+works through the module __getattr__.
+"""
+
+_LAZY = {
+    "grad_llh": ("bigclam_tpu.ops.objective", "grad_llh"),
+    "loglikelihood": ("bigclam_tpu.ops.objective", "loglikelihood"),
+    "candidates_pass": ("bigclam_tpu.ops.linesearch", "candidates_pass"),
+    "armijo_update": ("bigclam_tpu.ops.linesearch", "armijo_update"),
+    "column_component_stats": (
+        "bigclam_tpu.ops.components", "column_component_stats",
+    ),
+    "components_backend": (
+        "bigclam_tpu.ops.components", "components_backend",
+    ),
+    "graph_components_device": (
+        "bigclam_tpu.ops.components", "graph_components_device",
+    ),
+}
+
+__all__ = sorted(_LAZY)
+
+
+def __getattr__(name):
+    try:
+        mod_name, attr = _LAZY[name]
+    except KeyError:
+        raise AttributeError(
+            f"module {__name__!r} has no attribute {name!r}"
+        ) from None
+    import importlib
+
+    return getattr(importlib.import_module(mod_name), attr)
+
+
+def __dir__():
+    return sorted(set(globals()) | set(_LAZY))

@@ -138,6 +138,23 @@ def _pad_leading(a: np.ndarray, pad_to: int, fill) -> np.ndarray:
     return np.concatenate([a, np.full((pad,) + a.shape[1:], fill, a.dtype)])
 
 
+def tile_pad_stats(mask: np.ndarray) -> dict:
+    """Slot accounting of any tile/edge layout's mask (ISSUE 10): total
+    slots, real edges, and the padding fraction — per-step kernel work
+    scales with SLOTS, so pad_frac is the fraction of the sweep spent on
+    phantom edges. Every trainer build folds this into its `balance`
+    telemetry event; the same numbers feed layout_economical's accept
+    decision, this just makes the waste observable instead of only
+    gateable."""
+    slots = int(mask.size)
+    real = int(round(float(np.asarray(mask, np.float64).sum())))
+    return {
+        "slots": slots,
+        "real_edges": real,
+        "pad_frac": round((slots - real) / max(slots, 1), 4),
+    }
+
+
 def layout_economical(
     slots: int, num_directed_edges: int, n_blocks_total: int, tile_t: int
 ) -> bool:

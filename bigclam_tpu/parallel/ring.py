@@ -66,9 +66,11 @@ from bigclam_tpu.utils.compat import shard_map
 # a bucket holding more than this multiple of the mean marks the id space
 # as locality-ordered: the padded sweep then does up to dp x the real edge
 # work (measured 15.7x at dp=8, RINGMEM_r05.json). One constant shared by
-# the warning AND the auto-balance engagement rule, so the default
-# schedule engages exactly where the warning used to fire.
-RING_IMBALANCE_FACTOR = 4.0
+# the warning, the auto-balance engagement rule, AND the imbalance
+# anomaly (obs.comms.IMBALANCE_FACTOR is the canonical home since ISSUE
+# 10 — the event fires exactly where the warning used to), so the
+# default schedule engages exactly where the warning used to fire.
+from bigclam_tpu.obs.comms import IMBALANCE_FACTOR as RING_IMBALANCE_FACTOR
 
 
 def ring_bucket_imbalance(
@@ -94,11 +96,20 @@ def _warn_imbalance_counts(
 ) -> None:
     """The count-based half of _warn_bucket_imbalance, shared with the
     store-backed ring build (which knows the total from the manifest and
-    the max from a cross-host exchange, never a global CSR)."""
+    the max from a cross-host exchange, never a global CSR). Since ISSUE
+    10 the firing condition ALSO emits an `anomaly` event
+    (check="imbalance") — the stderr line reached only whoever watched
+    the console; the event reaches `cli report`, `cli watch`, and the
+    perf ledger's anomaly count."""
     mean_count = max(float(total_directed) / (dp * dp), 1.0)
     if max_count > RING_IMBALANCE_FACTOR * mean_count:
         import warnings
 
+        from bigclam_tpu.obs import comms as _comms
+
+        _comms.emit_imbalance_anomaly(
+            "ring_buckets", max_count, mean_count, hint=hint
+        )
         warnings.warn(
             f"ring phase buckets are imbalanced: max {max_count} vs mean "
             f"{mean_count:.0f} edges/bucket — the padded sweep does "
@@ -856,6 +867,30 @@ class RingBigClamModel(ShardedBigClamModel):
             return "xla"
         return "csr_ring_kb" if getattr(self, "_csr_kc", 0) else "csr_ring"
 
+    def _bucket_slots_per_phase(self) -> int:
+        """Padded edge-slot count of ONE (shard, phase) bucket of the
+        built layout (the tp > 1 per-phase partial-dot psums price it)."""
+        if self._csr_wanted:
+            src = self._tiles_dev["src_local"]      # (dp, dp, nt, 1, t)
+        else:
+            src = self.edges.src                    # (dp, dp, C, chunk)
+        return int(np.prod(src.shape[2:]))
+
+    def _build_comms_model(self):
+        from bigclam_tpu.obs import comms as _comms
+
+        return _comms.ring_step_model(
+            n_pad=self.n_pad,
+            k_pad=self.k_pad,
+            dp=self.mesh.shape[NODES_AXIS],
+            tp=self.mesh.shape[K_AXIS],
+            itemsize=jnp.dtype(self.dtype).itemsize,
+            num_candidates=len(self.cfg.step_candidates),
+            bucket_slots=self._bucket_slots_per_phase(),
+            health_every=self.cfg.health_every,
+            model=type(self).__name__,
+        )
+
     def _csr_economy_ok(self, dp: int) -> bool:
         """Probe the ring tile layout: dp*dp buckets padded to the max tile
         count (empty buckets cost one tile each), per-phase fd gather
@@ -913,6 +948,9 @@ class RingBigClamModel(ShardedBigClamModel):
                 )
                 _sp.set(slots=int(rbt.slots))
         dp_, dpp, nt, t = rbt.src_local.shape
+        from bigclam_tpu.ops.csr_tiles import tile_pad_stats
+
+        self._pad_stats = tile_pad_stats(rbt.mask)
         # same distribution as the XLA edge buckets: warn on the TRUE max
         # bucket edge count (tile-slot counts over-fire on balanced graphs
         # where per-dst-block rounding, not locality, pads the tiles);
@@ -981,6 +1019,9 @@ class RingBigClamModel(ShardedBigClamModel):
         edges_host = ring_shard_edges(
             self.g, self.cfg, dp, self.n_pad, np.float32, chunk_bound=bound
         )
+        from bigclam_tpu.ops.csr_tiles import tile_pad_stats
+
+        self._pad_stats = tile_pad_stats(edges_host.mask)
         espec = NamedSharding(self.mesh, P(NODES_AXIS, None, None, None))
         self.edges = EdgeChunks(
             src=put_sharded(edges_host.src, espec),
@@ -1096,6 +1137,13 @@ class StoreRingBigClamModel(_StoreBackedMixin, RingBigClamModel):
         ) as _sp:
             rbt = stack_ring_tile_parts(parts, self._store_ring_pad_tiles)
             _sp.set(slots=int(dp * dp * rbt.src_local.shape[2] * rbt.tile_t))
+        from bigclam_tpu.ops.csr_tiles import tile_pad_stats
+
+        self._pad_stats = {
+            **tile_pad_stats(rbt.mask),
+            "scope": "host_local",
+            "pad_tiles": int(self._store_ring_pad_tiles),
+        }
         _warn_imbalance_counts(
             self.store.num_directed_edges, dp, self._global_max_bucket(dp),
             hint="re-ingest the cache with --balance",
@@ -1154,6 +1202,11 @@ class StoreRingBigClamModel(_StoreBackedMixin, RingBigClamModel):
             shard, self.cfg, dp, self.n_pad, np.float32,
             chunk_bound=bound, max_count=max_count,
         )
+        from bigclam_tpu.ops.csr_tiles import tile_pad_stats
+
+        self._pad_stats = {
+            **tile_pad_stats(local.mask), "scope": "host_local",
+        }
         espec = NamedSharding(self.mesh, P(NODES_AXIS, None, None, None))
         gshape = (dp,) + local.src.shape[1:]
         self.edges = EdgeChunks(

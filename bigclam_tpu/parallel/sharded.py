@@ -59,6 +59,23 @@ from bigclam_tpu.parallel.multihost import (
 from bigclam_tpu.utils.compat import shard_map
 
 
+def _shard_bounds(src: np.ndarray, n_pad: int, dp: int) -> np.ndarray:
+    """Edge-array index bounds of the dp row split over src-sorted
+    edges — ONE implementation, shared by shard_edges' layout and the
+    balance telemetry (obs.comms, ISSUE 10): the counts the telemetry
+    reports are by construction the counts the trainer built."""
+    shard_rows = n_pad // dp
+    return np.searchsorted(
+        src, np.arange(0, n_pad + shard_rows, shard_rows)
+    )
+
+
+def shard_edge_counts(src: np.ndarray, n_pad: int, dp: int) -> np.ndarray:
+    """Per-shard directed-edge counts of the dp row split (the balance
+    events' work distribution; the sparse sharded trainer shares it)."""
+    return np.diff(_shard_bounds(src, n_pad, dp))
+
+
 def shard_edges(
     g: Graph,
     cfg: BigClamConfig,
@@ -76,7 +93,7 @@ def shard_edges(
     per-device gathered column count and model dtype).
     """
     shard_rows = n_pad // dp
-    bounds = np.searchsorted(g.src, np.arange(0, n_pad + shard_rows, shard_rows))
+    bounds = _shard_bounds(g.src, n_pad, dp)
     counts = np.diff(bounds)
     max_count = int(counts.max()) if counts.size else 1
     chunk = min(chunk_bound or cfg.edge_chunk, max(max_count, 1))
@@ -653,6 +670,9 @@ class ShardedBigClamModel:
             from bigclam_tpu.parallel.balance import balance_graph
 
             self.g, self._perm = balance_graph(g, dp, self.n_pad)
+        # tile/edge-padding slot accounting (obs.comms balance events):
+        # filled by whichever layout builder runs below
+        self._pad_stats = None
         self._build_edges_and_step()    # hook: subclasses swap the schedule
         from bigclam_tpu.models.bigclam import step_cfg_key
 
@@ -665,6 +685,12 @@ class ShardedBigClamModel:
         log_engaged_path(
             type(self).__name__, self.engaged_path, self.path_reason
         )
+        # collective-traffic model + per-shard balance (obs.comms,
+        # ISSUE 10): baked from the SAME committed layout the step
+        # compiled against, emitted as `comms`/`balance` events and kept
+        # on the model for the reconciliation gate (comms_measured)
+        self.comms = self._build_comms_model()
+        self._emit_comms_and_balance()
 
     @property
     def engaged_path(self) -> str:
@@ -679,6 +705,67 @@ class ShardedBigClamModel:
                 else "csr_grouped"
             )
         return "csr"
+
+    # ------------------------------------------- comms accounting (ISSUE 10)
+    def _edge_slots_per_shard(self) -> int:
+        """Per-shard padded edge-slot count of the BUILT layout (the
+        tp > 1 partial-dot psums move one float per slot per sweep)."""
+        if self._csr_wanted:
+            src = self._tiles_dev["src_local"]
+        else:
+            src = self.edges.src
+        return int(np.prod(src.shape[1:]))
+
+    def _build_comms_model(self):
+        from bigclam_tpu.obs import comms as _comms
+
+        return _comms.sharded_step_model(
+            n_pad=self.n_pad,
+            k_pad=self.k_pad,
+            dp=self.mesh.shape[NODES_AXIS],
+            tp=self.mesh.shape[K_AXIS],
+            itemsize=jnp.dtype(self.dtype).itemsize,
+            num_candidates=len(self.cfg.step_candidates),
+            edge_slots=self._edge_slots_per_shard(),
+            health_every=self.cfg.health_every,
+            model=type(self).__name__,
+        )
+
+    def _shard_edge_counts(self) -> np.ndarray:
+        """Per-shard directed-edge counts of the trainer's row split —
+        the balance event's work distribution (the store trainers read
+        the manifest instead: no global CSR exists there)."""
+        return shard_edge_counts(
+            self.g.src, self.n_pad, self.mesh.shape[NODES_AXIS]
+        )
+
+    def _emit_comms_and_balance(self) -> None:
+        from bigclam_tpu.obs import comms as _comms
+        from bigclam_tpu.obs import telemetry as _obs
+
+        _comms.emit_model(self.comms)
+        if _obs.current() is None:
+            return
+        dp = self.mesh.shape[NODES_AXIS]
+        fields = dict(self._pad_stats or {})
+        fields["model"] = type(self).__name__
+        fields["dp"] = dp
+        _comms.emit_shard_balance(
+            "shard_edges", self._shard_edge_counts(), dp,
+            process_count=jax.process_count(),
+            hint="relabel (balance=True) or re-ingest with --balance",
+            **fields,
+        )
+
+    def comms_measured(self, state: TrainState):
+        """The comms model re-priced from the LIVE TrainState's
+        addressable device buffers (obs.comms.measured_payloads) — what
+        scripts/comms_gate.py reconciles the static model against."""
+        from bigclam_tpu.obs import comms as _comms
+
+        return self.comms.remeasure(
+            _comms.measured_payloads(self.comms.family, state)
+        )
 
     def _to_internal_rows(self, F0: np.ndarray) -> np.ndarray:
         """Original-id F rows -> the trainer's (possibly relabeled) row order."""
@@ -964,6 +1051,9 @@ class ShardedBigClamModel:
                 "n_blocks": sbt.n_blocks,
             }
         _sp.set(slots=int(sbt.src_local.size), grouped=self._csr_nb is not None)
+        from bigclam_tpu.ops.csr_tiles import tile_pad_stats
+
+        self._pad_stats = tile_pad_stats(sbt.mask)
         self.edges = None                        # not used by the CSR step
         self._tiles_dev = tiles                  # kept for rebuild_step
 
@@ -979,6 +1069,9 @@ class ShardedBigClamModel:
         edges_host = shard_edges(
             self.g, self.cfg, dp, self.n_pad, np.float32, chunk_bound=bound
         )
+        from bigclam_tpu.ops.csr_tiles import tile_pad_stats
+
+        self._pad_stats = tile_pad_stats(edges_host.mask)
         espec = NamedSharding(self.mesh, P(NODES_AXIS, None, None))
         self.edges = EdgeChunks(
             src=put_sharded(edges_host.src, espec),
@@ -1239,6 +1332,16 @@ class _StoreBackedMixin:
         self._csr_reason = msg
         return False
 
+    def _shard_edge_counts(self) -> np.ndarray:
+        """Per-shard directed-edge counts from the store MANIFEST — the
+        balance telemetry never needs a global CSR (the whole point of
+        the store path): every host already agreed on these numbers at
+        cache open."""
+        return np.asarray(
+            [int(e["edges"]) for e in self.store.manifest["shards"]],
+            dtype=np.int64,
+        )
+
     def _store_pad_tiles_for(self, local_max: int) -> int:
         """The uniform cross-host tile-count pad: cfg.csr_store_pad_tiles
         when set (deterministic shapes across restarts), else a one-int
@@ -1374,6 +1477,15 @@ class StoreShardedBigClamModel(_StoreBackedMixin, ShardedBigClamModel):
         ) as _sp:
             sbt = stack_block_tile_parts(parts, self._store_pad_tiles)
             _sp.set(slots=int(dp * sbt.n_tiles * sbt.tile_t))
+        from bigclam_tpu.ops.csr_tiles import tile_pad_stats
+
+        # THIS host's rows only (no global mask exists); scope recorded
+        # so the report reads it as a per-host figure
+        self._pad_stats = {
+            **tile_pad_stats(sbt.mask),
+            "scope": "host_local",
+            "pad_tiles": int(self._store_pad_tiles),
+        }
         n_local, nt, t = sbt.src_local.shape
         tiles = {
             "src_local": put_host_local(
@@ -1413,6 +1525,11 @@ class StoreShardedBigClamModel(_StoreBackedMixin, ShardedBigClamModel):
             shard, self.cfg, dp, self.n_pad, np.float32,
             chunk_bound=bound,
         )
+        from bigclam_tpu.ops.csr_tiles import tile_pad_stats
+
+        self._pad_stats = {
+            **tile_pad_stats(local.mask), "scope": "host_local",
+        }
         gshape = (dp,) + local.src.shape[1:]
         self.edges = EdgeChunks(
             src=put_host_local(local.src, espec, gshape),

@@ -40,6 +40,17 @@ def _round_up(x: int, m: int) -> int:
     return ((x + m - 1) // m) * m
 
 
+def exchange_payload_bytes(cap: int, itemsize: int = 4) -> int:
+    """Local bytes ONE shard contributes to the sparse-allreduce
+    exchange: the (cap,) int32 id buffer plus the (cap,) value buffer.
+    The WIRE cost of the sparse branch is this cap-sized pair regardless
+    of how many ids are actually touched (occupancy below cap is
+    headroom, not saved bytes) — the single payload formula the comms
+    accounting (obs.comms) and the trainer's modeled-vs-measured
+    reconciliation both price the exchange with."""
+    return int(cap) * (4 + int(itemsize))
+
+
 def auto_cap(
     touched_per_shard: int, k_pad: int, slack: float, m: int
 ) -> int:

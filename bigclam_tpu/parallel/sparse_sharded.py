@@ -341,6 +341,26 @@ class SparseShardedBigClamModel(SparseBigClamModel):
         # initial touched counts and rebuilds the step when it moves
         self._set_comm(max(self.m, 8))
         self._step, self.engaged_path = self._make_step()
+        # per-shard balance telemetry (obs.comms, ISSUE 10): same skew
+        # accounting as the dense sharded trainers — member-list rows do
+        # not change who owns which edges. Guarded like the dense path:
+        # the O(E) mask sum + searchsorted are only worth paying when a
+        # telemetry run will receive the event
+        from bigclam_tpu.obs import comms as _comms
+        from bigclam_tpu.obs import telemetry as _obs
+
+        if _obs.current() is not None:
+            from bigclam_tpu.ops.csr_tiles import tile_pad_stats
+            from bigclam_tpu.parallel.sharded import shard_edge_counts
+
+            _comms.emit_shard_balance(
+                "shard_edges",
+                shard_edge_counts(g.src, self.n_pad, dp), dp,
+                process_count=jax.process_count(),
+                hint="pre-balance at ingest (cli ingest --balance)",
+                model=type(self).__name__, dp=dp,
+                **tile_pad_stats(eh.mask),
+            )
 
     def _set_comm(self, touched_per_shard: int) -> None:
         cfg = self.cfg
@@ -356,6 +376,14 @@ class SparseShardedBigClamModel(SparseBigClamModel):
             self.comm_cap, self.k_pad, cfg.sparse_dense_fallback
         )
         self._emit_comm_event(touched_per_shard)
+        # bytes-per-step model of the collective layout just committed
+        # (obs.comms, ISSUE 10). Rebuilt — and re-emitted, overwriting
+        # the per-site totals — whenever the cap refinement moves the
+        # layout, so the run report prices the step that actually runs.
+        from bigclam_tpu.obs import comms as _comms
+
+        self.comms = self._build_comms_model()
+        _comms.emit_model(self.comms)
 
     def _emit_comm_event(self, touched_per_shard: int) -> None:
         """ISSUE 8 satellite: the sparse-collective layout (cap, static
@@ -379,6 +407,33 @@ class SparseShardedBigClamModel(SparseBigClamModel):
                 m=int(self.m),
                 dp=int(self.dp),
             )
+
+    def _build_comms_model(self):
+        from bigclam_tpu.obs import comms as _comms
+
+        return _comms.sparse_step_model(
+            n_pad=self.n_pad,
+            m=self.m,
+            k_pad=self.k_pad,
+            dp=self.dp,
+            itemsize=jnp.dtype(self.dtype).itemsize,
+            num_candidates=len(self.cfg.step_candidates),
+            cap=self.comm_cap,
+            mode=self.comm_mode,
+            support_every=self.cfg.support_every,
+            health_every=self.cfg.health_every,
+            model=type(self).__name__,
+        )
+
+    def comms_measured(self, state: SparseTrainState):
+        """Reconcile the static model against the RUNTIME exchange
+        counters riding the state (obs.comms.sparse_measured): the
+        member-gather payload from the live buffers, the allreduce from
+        the exchanged-ids / dense-fallback counters — the dynamic half
+        the dense trainers do not have."""
+        from bigclam_tpu.obs import comms as _comms
+
+        return _comms.sparse_measured(self.comms, state)
 
     def _make_step(self):
         return (

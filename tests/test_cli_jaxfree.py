@@ -1,0 +1,90 @@
+"""The jax-free contract of `cli ingest` / `cli report` / `cli watch`
+(ISSUE 10 satellite): these entries run on data-prep hosts where the jax
+import costs RSS + seconds — until now the contract was a convention in
+docstrings, not a test. Each entry runs in a FRESH subprocess and
+asserts `jax` never entered sys.modules."""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run_jaxfree(argv, cwd):
+    """Run cli.main(argv) in a fresh interpreter; the child asserts jax
+    stayed unimported AFTER the command finished (an import during the
+    run would persist in sys.modules)."""
+    code = textwrap.dedent(
+        f"""
+        import sys
+        from bigclam_tpu.cli import main
+        rc = main({argv!r})
+        assert "jax" not in sys.modules, "cli entry imported jax"
+        sys.exit(rc)
+        """
+    )
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    return subprocess.run(
+        [sys.executable, "-c", code],
+        env=env, cwd=cwd, capture_output=True, text=True, timeout=120,
+    )
+
+
+def test_cli_ingest_stays_jax_free(tmp_path):
+    edges = tmp_path / "g.txt"
+    edges.write_text(
+        "".join(
+            f"{u}\t{v}\n"
+            for u, v in [(0, 1), (1, 2), (2, 0), (2, 3), (3, 0), (1, 3)]
+        )
+    )
+    r = _run_jaxfree(
+        ["ingest", "--graph", str(edges), "--cache-dir",
+         str(tmp_path / "cache"), "--shards", "2", "--quiet"],
+        str(tmp_path),
+    )
+    assert r.returncode == 0, (r.stdout, r.stderr)
+    out = json.loads(r.stdout.strip().splitlines()[-1])
+    assert out["shards"] == 2 and out["n"] == 4
+
+
+def test_cli_report_and_watch_stay_jax_free(tmp_path):
+    # the telemetry dir is produced here (jax loaded in THIS process is
+    # irrelevant — the contract is about the reading entries), rendered
+    # in fresh jax-free subprocesses
+    from bigclam_tpu.obs import RunTelemetry
+
+    tdir = str(tmp_path / "telem")
+    tel = RunTelemetry(tdir, entry="t", quiet=True)
+    tel.event("step", iter=0, llh=-1.0)
+    tel.event("comms", site="sharded/all_gather_F", op="all_gather",
+              bytes_per_step=1024.0)
+    tel.finalize()
+
+    r = _run_jaxfree(["report", tdir], str(tmp_path))
+    assert r.returncode == 0, (r.stdout, r.stderr)
+    assert "collective traffic (modeled)" in r.stdout
+
+    r = _run_jaxfree(["report", tdir, "--json"], str(tmp_path))
+    assert r.returncode == 0, (r.stdout, r.stderr)
+    obj = json.loads(r.stdout.strip().splitlines()[-1])
+    assert obj["comms"]["sites"]["sharded/all_gather_F"] == 1024.0
+
+    r = _run_jaxfree(["watch", tdir, "--once"], str(tmp_path))
+    assert r.returncode == 0, (r.stdout, r.stderr)
+    assert "comms" in r.stdout
+
+
+def test_cli_perf_show_stays_jax_free(tmp_path):
+    # the perf-ledger tooling shares the data-prep-host contract (the
+    # module docstring promises it; now the test does)
+    ledger = tmp_path / "ledger.jsonl"
+    ledger.write_text("")
+    r = _run_jaxfree(
+        ["perf", "show", "--ledger", str(ledger)], str(tmp_path)
+    )
+    assert r.returncode == 0, (r.stdout, r.stderr)

@@ -1,0 +1,508 @@
+"""Collective-traffic accounting + host-skew observability (ISSUE 10):
+the static bytes-per-step model vs the live buffers, balance/imbalance
+events, the report-time straggler detector (single-process fake-host
+path), the ledger's execution-shape match key + comms/overlap verdicts,
+and the span-coverage band as a tier-1 unit check."""
+
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import jax
+
+from bigclam_tpu.config import BigClamConfig
+from bigclam_tpu.models.agm import sample_planted_graph
+from bigclam_tpu.obs import RunTelemetry, install, uninstall
+from bigclam_tpu.obs import comms as comms
+from bigclam_tpu.obs.report import (
+    load_events,
+    render,
+    render_json,
+    span_coverage,
+)
+from bigclam_tpu.obs.schema import validate_events_file
+from bigclam_tpu.obs.telemetry import EVENTS_NAME
+from bigclam_tpu.parallel import (
+    RingBigClamModel,
+    ShardedBigClamModel,
+    SparseShardedBigClamModel,
+    make_mesh,
+)
+
+
+@pytest.fixture()
+def planted():
+    g, _ = sample_planted_graph(
+        240, 4, p_in=0.3, rng=np.random.default_rng(0)
+    )
+    F0 = np.random.default_rng(1).uniform(0.1, 1.0, size=(g.num_nodes, 4))
+    return g, F0
+
+
+def _events(tdir):
+    return load_events(tdir) or []
+
+
+# ------------------------------------------------------------ conventions
+def test_wire_byte_conventions():
+    # all_gather: receive everyone else's shard
+    assert comms.wire_bytes("all_gather", 100.0, 4) == 300.0
+    # psum: ring allreduce reduce-scatter + all-gather
+    assert comms.wire_bytes("psum", 100.0, 4) == pytest.approx(150.0)
+    # ppermute: one hop
+    assert comms.wire_bytes("ppermute", 100.0, 4) == 100.0
+    # size-1 axis compiles to identity
+    for op in ("all_gather", "psum", "ppermute", "pmax"):
+        assert comms.wire_bytes(op, 100.0, 1) == 0.0
+    with pytest.raises(ValueError):
+        comms.wire_bytes("alltoall", 1.0, 2)
+
+
+def test_sharded_model_arithmetic_by_hand():
+    # n_pad=128, k_pad=8, dp=2, tp=1, f32: shard = 64*8*4 = 2048 B
+    cm = comms.sharded_step_model(
+        n_pad=128, k_pad=8, dp=2, tp=1, itemsize=4, num_candidates=16,
+    )
+    sites = cm.site_bytes()
+    assert sites["sharded/all_gather_F"] == 2048.0      # (p-1)*shard
+    # psum of (8,) f32 x2: 2 * (2*32*1/2) = 64
+    assert sites["sharded/psum_sumF"] == 64.0
+    # tp=1: no "k"-axis sites
+    assert not any("edge_dots" in s for s in sites)
+    assert cm.bytes_per_step() == sum(sites.values())
+
+
+def test_ring_rotation_pays_dp_hops_per_pass():
+    # rotate_scan does dp ppermute hops per pass (each device also
+    # re-receives its own shard on the closing hop) and the candidate
+    # pass re-rotates: 2 * dp * shard bytes/step, a dp/(dp-1) premium
+    # per pass over the all-gather — the model must price what the scan
+    # actually moves, not the idealized (dp-1)-hop exchange
+    kw = dict(n_pad=256, k_pad=16, dp=4, tp=1, itemsize=4,
+              num_candidates=16)
+    ring = comms.ring_step_model(**kw)
+    shard = (256 // 4) * 16 * 4
+    assert ring.site_bytes()["ring/ppermute_F_rot"] == shard * 2 * 4
+    ag = comms.sharded_step_model(**kw)
+    assert ring.site_bytes()["ring/ppermute_F_rot"] == pytest.approx(
+        2 * ag.site_bytes()["sharded/all_gather_F"] * 4 / 3
+    )
+
+
+def test_remeasure_replaces_named_payloads_only():
+    cm = comms.sharded_step_model(
+        n_pad=128, k_pad=8, dp=2, tp=1, itemsize=4, num_candidates=16,
+    )
+    doubled = cm.remeasure({"sharded/all_gather_F": 4096.0})
+    assert doubled.site_bytes()["sharded/all_gather_F"] == 4096.0
+    assert (
+        doubled.site_bytes()["sharded/psum_sumF"]
+        == cm.site_bytes()["sharded/psum_sumF"]
+    )
+
+
+# ------------------------------------------------- model vs live buffers
+@pytest.mark.parametrize("dp", [2, 4])
+def test_sharded_model_agrees_with_measured(planted, dp):
+    g, F0 = planted
+    cfg = BigClamConfig(num_communities=4, dtype="float64", max_iters=2)
+    mesh = make_mesh((dp, 1), jax.devices()[:dp])
+    m = ShardedBigClamModel(g, cfg, mesh)
+    state = m.init_state(F0)
+    modeled = m.comms.bytes_per_step()
+    measured = m.comms_measured(state).bytes_per_step()
+    assert modeled > 0
+    assert measured == pytest.approx(modeled, rel=0.01)
+
+
+@pytest.mark.filterwarnings("ignore:ring phase buckets")
+def test_ring_model_agrees_with_measured(planted):
+    g, F0 = planted
+    cfg = BigClamConfig(num_communities=4, dtype="float64", max_iters=2)
+    mesh = make_mesh((2, 1), jax.devices()[:2])
+    m = RingBigClamModel(g, cfg, mesh, balance=False)
+    state = m.init_state(F0)
+    assert m.comms.family == "ring"
+    assert m.comms_measured(state).bytes_per_step() == pytest.approx(
+        m.comms.bytes_per_step(), rel=0.01
+    )
+
+
+def test_sparse_runtime_counters_reconcile(planted):
+    g, F0 = planted
+    K = 64
+    F0w = np.zeros((g.num_nodes, K))
+    F0w[:, :4] = F0
+    cfg = BigClamConfig(
+        num_communities=K, dtype="float64", max_iters=3,
+        representation="sparse", sparse_m=8, sparse_comm_cap=16,
+    )
+    mesh = make_mesh((2, 1), jax.devices()[:2])
+    m = SparseShardedBigClamModel(g, cfg, mesh)
+    assert m.comm_mode == "sparse"
+    state = m._step(m.init_state(F0w))
+    rec = m.comms_measured(state)
+    assert rec["cap"] == m.comm_cap
+    if not rec["dense_fallback"]:
+        assert rec["exchanged_ids"] <= rec["cap"]
+        assert rec["exchange_bytes_per_step"] == pytest.approx(
+            m.comms.site_bytes()["sparse/allreduce_touched"], rel=0.01
+        )
+    # member-gather payload from the live buffers matches the model
+    assert rec["payloads"]["sparse/all_gather_members"] == pytest.approx(
+        m.comms.sites[0].payload_bytes, rel=0.01
+    )
+
+
+# ------------------------------------------------------- events + report
+def test_comms_and_balance_events_land_in_report(planted, tmp_path):
+    g, F0 = planted
+    cfg = BigClamConfig(
+        num_communities=4, dtype="float64", max_iters=3, conv_tol=0.0
+    )
+    tdir = str(tmp_path / "telem")
+    tel = install(RunTelemetry(tdir, entry="fit", quiet=True))
+    try:
+        mesh = make_mesh((2, 1), jax.devices()[:2])
+        m = ShardedBigClamModel(g, cfg, mesh)
+        m.fit(F0)
+        rep = tel.finalize()
+    finally:
+        uninstall(tel)
+    n, errors = validate_events_file(os.path.join(tdir, EVENTS_NAME))
+    assert not errors, errors[:5]
+    events = _events(tdir)
+    kinds = {e["kind"] for e in events}
+    assert "comms" in kinds and "balance" in kinds
+    # every comms event names a site with modeled bytes
+    for e in events:
+        if e["kind"] == "comms":
+            assert e["site"].startswith("sharded/")
+            assert e["bytes_per_step"] >= 0
+    bal = next(e for e in events if e["kind"] == "balance")
+    assert bal["what"] == "shard_edges"
+    assert bal["skew"] >= 1.0
+    assert "pad_frac" in bal            # csr_tiles.tile_pad_stats rode in
+    # run report + renderers carry the accumulated model
+    assert rep["comms"]["sites"]
+    assert rep["comms"]["bytes_per_step"] == pytest.approx(
+        m.comms.bytes_per_step(), rel=0.01
+    )
+    text, errs = render(tdir)
+    assert errs == 0
+    assert "collective traffic (modeled)" in text
+    obj, jerrs = render_json(tdir)
+    assert jerrs == 0
+    assert obj["comms"]["sites"]
+
+
+def test_imbalance_anomaly_fires_on_locality_ordered_ring(tmp_path):
+    # strongly diagonal planted graph, balance=False: the old stderr
+    # warning now also fires the imbalance anomaly event
+    g, _ = sample_planted_graph(
+        256, 8, p_in=0.9, rng=np.random.default_rng(2)
+    )
+    cfg = BigClamConfig(num_communities=8, dtype="float64", max_iters=2)
+    mesh = make_mesh((4, 1), jax.devices()[:4])
+    tdir = str(tmp_path / "imb")
+    tel = install(RunTelemetry(tdir, entry="fit", quiet=True))
+    try:
+        with pytest.warns(UserWarning, match="imbalanced"):
+            RingBigClamModel(g, cfg, mesh, balance=False)
+        tel.finalize()
+    finally:
+        uninstall(tel)
+    events = _events(tdir)
+    fired = [e for e in events if e.get("kind") == "anomaly"]
+    assert fired and all(e["check"] == "imbalance" for e in fired)
+    ring_anoms = [e for e in fired if e.get("what") == "ring_buckets"]
+    assert ring_anoms and ring_anoms[0]["iter"] == -1
+    assert ring_anoms[0]["factor"] > comms.IMBALANCE_FACTOR
+    # balanced build: no anomaly
+    tdir2 = str(tmp_path / "bal")
+    tel = install(RunTelemetry(tdir2, entry="fit", quiet=True))
+    try:
+        RingBigClamModel(g, cfg, mesh, balance=True)
+        tel.finalize()
+    finally:
+        uninstall(tel)
+    assert not [
+        e for e in _events(tdir2) if e.get("kind") == "anomaly"
+    ]
+
+
+def test_accounting_on_trajectory_bit_identical(planted):
+    g, F0 = planted
+    cfg = BigClamConfig(
+        num_communities=4, dtype="float64", max_iters=5, conv_tol=0.0
+    )
+    mesh = make_mesh((2, 1), jax.devices()[:2])
+    r_off = ShardedBigClamModel(g, cfg, mesh).fit(F0)
+    import tempfile
+
+    tel = install(
+        RunTelemetry(tempfile.mkdtemp(), entry="fit", quiet=True)
+    )
+    try:
+        r_on = ShardedBigClamModel(g, cfg, mesh).fit(F0)
+    finally:
+        tel.finalize()
+        uninstall(tel)
+    assert np.array_equal(r_on.F, r_off.F)
+    assert r_on.llh_history == r_off.llh_history
+
+
+def test_reemitted_model_replaces_its_site_set(tmp_path):
+    # the sparse cap refinement can flip the collective MODE: the
+    # re-emitted model must REPLACE its previous sites everywhere, or a
+    # stale allreduce site keeps inflating bytes/step (report, ledger,
+    # watch) for a layout the compiled step abandoned
+    from bigclam_tpu.obs.watch import render_frame
+
+    tdir = str(tmp_path / "re")
+    tel = install(RunTelemetry(tdir, entry="t", quiet=True))
+    try:
+        kw = dict(n_pad=128, m=8, k_pad=64, dp=2, itemsize=4,
+                  num_candidates=16)
+        comms.emit_model(
+            comms.sparse_step_model(cap=16, mode="sparse", **kw)
+        )
+        dense = comms.sparse_step_model(cap=64, mode="dense", **kw)
+        comms.emit_model(dense)
+        rep = tel.finalize()
+    finally:
+        uninstall(tel)
+    sites = rep["comms"]["sites"]
+    assert "sparse/allreduce_touched" not in sites
+    assert "sparse/psum_sumF" in sites
+    assert rep["comms"]["bytes_per_step"] == pytest.approx(
+        dense.bytes_per_step(), rel=0.01
+    )
+    # the watch fold applies the same replacement
+    frame = render_frame(tdir)
+    assert f"over {len(dense.sites)} site(s)" in frame
+
+
+# ------------------------------------------------- host-skew detector
+def _fake_report(pid, sync_s, fit_s, host="hostA", dispatch_s=0.2):
+    spans = {
+        "fit": fit_s,
+        "fit/fit_loop/dispatch": dispatch_s,
+        "fit/fit_loop/sync": sync_s,
+        "fit/fit_loop/callback": 0.05,
+    }
+    return {
+        "v": 2, "run": "r", "pid": pid, "processes": 2, "entry": "fit",
+        "started_unix": 0.0, "wall_s": fit_s + 0.5,
+        "stages": {"seconds": {"fit": fit_s}, "counts": {"fit": 1}},
+        "spans": {
+            "seconds": spans,
+            "counts": {k: 1 for k in spans},
+            "orphans": 0,
+        },
+        "steps_timed": 0,
+        "health": {"samples": 0, "last": None, "anomalies": {}},
+        "comms": {"bytes_per_step": 0.0, "sites": {}},
+        "fingerprint": {"host": host, "platform": "linux",
+                        "backend": None, "device_kind": None,
+                        "devices": 0},
+        "memory": {"host_rss_bytes": 0, "host_rss_peak_bytes": 0,
+                   "device_peak": {}, "watermark_tags": {}},
+        "compiles": {"backend_compiles": 0, "backend_compile_s": 0.0,
+                     "retraces": 0, "by_key": {}, "step_builds": 0,
+                     "monitor": False, "count": 0},
+        "heartbeat": {"deadline_s": None, "stalls": 0, "escalations": 0},
+        "events": {"start": 1}, "final": {},
+    }
+
+
+def test_detector_waiters_rule_names_min_sync_pid():
+    # p1 is the straggler: everyone ELSE sits in sync waiting on it
+    reports = [
+        _fake_report(0, sync_s=6.0, fit_s=6.5),
+        _fake_report(1, sync_s=0.4, fit_s=6.5, host="hostB"),
+    ]
+    found = comms.detect_host_skew(reports)
+    assert len(found) == 1
+    f = found[0]
+    assert f["check"] == "straggler" and f["rule"] == "waiters"
+    assert f["pid"] == 1 and f["host"] == "hostB"
+
+
+def test_detector_overhead_rule_names_delayed_pid():
+    # syncs agree; p1 burned 4s OUTSIDE the loop phases (planted delay)
+    reports = [
+        _fake_report(0, sync_s=0.5, fit_s=1.0),
+        _fake_report(1, sync_s=0.5, fit_s=5.0, host="hostB"),
+    ]
+    found = comms.detect_host_skew(reports)
+    assert len(found) == 1
+    f = found[0]
+    assert f["rule"] == "overhead" and f["pid"] == 1
+    assert f["overhead_s"] > f["peers_overhead_s"]
+
+
+def test_detector_clean_and_single_process_fire_nothing():
+    balanced = [
+        _fake_report(0, sync_s=1.0, fit_s=1.5),
+        _fake_report(1, sync_s=1.1, fit_s=1.6),
+    ]
+    assert comms.detect_host_skew(balanced) == []
+    assert comms.detect_host_skew(
+        [_fake_report(0, sync_s=1.0, fit_s=1.5)]
+    ) == []
+
+
+def test_fake_host_merged_dir_surfaces_straggler(tmp_path):
+    # the single-process fake-host path (ISSUE 10 satellite): two
+    # per-pid reports synthesized into one telemetry dir — the tier-1
+    # detector coverage on jax versions whose 2-proc worker modes skip
+    tdir = tmp_path / "merged"
+    tdir.mkdir()
+    (tdir / "run_report.json").write_text(
+        json.dumps(_fake_report(0, sync_s=6.0, fit_s=6.5))
+    )
+    (tdir / "run_report.p1.json").write_text(
+        json.dumps(_fake_report(1, sync_s=0.4, fit_s=6.5, host="hostB"))
+    )
+    text, errors = render(str(tdir))
+    assert errors == 0
+    assert "STRAGGLER: p1 (host hostB)" in text
+    assert "per-iteration sync totals" in text
+    obj, jerrs = render_json(str(tdir))
+    assert jerrs == 0
+    stragglers = [
+        a for a in obj["anomalies"] if a.get("check") == "straggler"
+    ]
+    assert len(stragglers) == 1
+    assert stragglers[0]["pid"] == 1
+    assert stragglers[0]["source"] == "report"
+    assert obj["sync_by_pid"] == {"0": 6.0, "1": 0.4}
+
+
+# ---------------------------------------------------------------- ledger
+def test_ledger_match_key_gains_processes_and_mesh():
+    from bigclam_tpu.obs.ledger import build_record, match_key
+
+    def rep(processes=1, mesh=None):
+        r = _fake_report(0, sync_s=0.1, fit_s=0.2)
+        r["processes"] = processes
+        r["final"] = {"n": 10, "edges": 20, "k": 4, "mesh": mesh}
+        r["compiles"]["by_key"] = {"K:4": {"builds": 1, "compiles": 1}}
+        return r
+
+    one = build_record(rep(processes=1))
+    one2 = build_record(rep(processes=1))
+    two = build_record(rep(processes=2))
+    mesh41 = build_record(rep(processes=1, mesh="4x1"))
+    mesh22 = build_record(rep(processes=1, mesh="2x2"))
+    assert match_key(one) == match_key(one2)
+    # a 2-proc run can no longer baseline against a single-proc run
+    assert match_key(one) != match_key(two)
+    assert match_key(mesh41) != match_key(mesh22)
+    assert one["processes"] == 1 and two["processes"] == 2
+
+
+def test_perf_diff_verdicts_comms_bytes_and_overlap():
+    from bigclam_tpu.obs.ledger import build_record, diff_records
+
+    r = _fake_report(0, sync_s=0.1, fit_s=0.2)
+    r["comms"] = {
+        "bytes_per_step": 1000.0,
+        "sites": {"ring/ppermute_F_rot": 900.0, "ring/psum_sumF": 100.0},
+    }
+    r["final"] = {"overlap_frac": 0.6}
+    base = build_record(r, [0.01] * 20, [100.0] * 20)
+    assert base["comms_bytes_per_step"] == 1000.0
+    assert base["overlap_frac"] == 0.6
+    # injected bytes/step regression: same run, 3x the modeled traffic
+    worse = dict(base, run="injected", ts=base["ts"] + 1,
+                 comms_bytes_per_step=3000.0,
+                 comms_sites={"ring/ppermute_F_rot": 2900.0,
+                              "ring/psum_sumF": 100.0})
+    d = diff_records(base, worse)
+    assert d["regression"]
+    flagged = [c for c in d["checks"]
+               if c["metric"] == "comms_bytes_per_step"]
+    assert flagged and flagged[0]["regression"]
+    assert d["comms_deltas"][0]["site"] == "ring/ppermute_F_rot"
+    # overlap collapse is a regression too
+    stale = dict(base, run="stale", ts=base["ts"] + 2, overlap_frac=0.05)
+    d2 = diff_records(base, stale)
+    flagged = [c for c in d2["checks"] if c["metric"] == "overlap_frac"]
+    assert flagged and flagged[0]["regression"] and d2["regression"]
+    # identical re-run passes
+    same = dict(base, run="same", ts=base["ts"] + 3)
+    assert not diff_records(base, same)["regression"]
+
+
+# ------------------------------------------------- span coverage (tier-1)
+def test_span_coverage_band_over_synthetic_reports():
+    # the 0.95 <= cov <= 1.05 acceptance previously asserted only in
+    # scripts/telemetry_smoke.py (ISSUE 10 satellite): in-band, a gap
+    # (unattributed time), and a double-count all classify correctly
+    ok = {"wall_s": 10.0, "spans": {"seconds": {
+        "load": 2.0, "fit": 7.8, "fit/fit_loop/sync": 5.0}}}
+    cov = span_coverage(ok)
+    assert 0.95 <= cov <= 1.05            # children never double-count
+    gap = {"wall_s": 10.0, "spans": {"seconds": {"fit": 5.0}}}
+    assert span_coverage(gap) < 0.95
+    dbl = {"wall_s": 10.0, "spans": {"seconds": {"a": 6.0, "b": 6.0}}}
+    assert span_coverage(dbl) > 1.05
+    assert span_coverage({"wall_s": 0, "spans": {"seconds": {}}}) is None
+
+
+def test_span_coverage_band_over_live_event_stream(tmp_path):
+    from bigclam_tpu.obs import trace as obs_trace
+
+    tel = install(
+        RunTelemetry(str(tmp_path / "cov"), entry="cov", quiet=True)
+    )
+    try:
+        with obs_trace.span("main"):
+            time.sleep(0.6)
+        rep = tel.finalize()
+    finally:
+        uninstall(tel)
+    cov = span_coverage(rep)
+    assert cov is not None and 0.95 <= cov <= 1.05, cov
+
+
+# ------------------------------------------------- heartbeat sync context
+def test_stall_event_embeds_last_sync_duration(tmp_path):
+    from bigclam_tpu.obs.heartbeat import Heartbeat
+
+    tel = RunTelemetry(str(tmp_path / "hb"), entry="t", quiet=True)
+    tel.span_complete("fit/fit_loop/sync", 0.123, emit=False)
+    hb = Heartbeat(tel, deadline_s=0.05, echo=False, poll_s=0.01).start()
+    deadline = time.monotonic() + 3.0
+    while tel.event_counts.get("stall", 0) == 0:
+        assert time.monotonic() < deadline, "no stall fired"
+        time.sleep(0.01)
+    hb.stop()
+    tel.finalize()
+    events = _events(str(tmp_path / "hb"))
+    stall = next(e for e in events if e["kind"] == "stall")
+    assert stall["sync_s"] == pytest.approx(0.123)
+
+
+def test_sync_tracking_is_thread_safe_and_cheap():
+    import tempfile
+
+    tel = RunTelemetry(tempfile.mkdtemp(), entry="t", quiet=True)
+
+    def spam():
+        for _ in range(200):
+            tel.span_complete("fit/fit_loop/sync", 0.001, emit=False)
+
+    threads = [threading.Thread(target=spam) for _ in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert tel.last_sync_s == pytest.approx(0.001)
+    tel.finalize()
