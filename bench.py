@@ -636,13 +636,34 @@ def _emit(jax, spec, g, cfg, F0, backend, model, configs, enron_eps,
         # is the last *evaluated* LLH (one update behind state.F)
         "llh_at_last_eval": llh_last,
     }
+    # memory accounting (obs.memory, ISSUE 12): the headline model's
+    # modeled per-device HBM next to the allocator's measured peak
+    # (None on CPU backends — memory_stats is TPU-only), stamped into
+    # the artifact AND the telemetry final record the roofline's
+    # hbm_frac rides, so the bandwidth model and the capacity model can
+    # never silently disagree about what was resident
     from bigclam_tpu.obs import telemetry as _obs
 
     tel = _obs.current()
+    mem = getattr(model, "memory", None)
+    record["hbm_modeled_bytes"] = (
+        round(mem.hbm_bytes(), 1) if mem is not None else None
+    )
+    measured_peak = None
+    if tel is not None:
+        for stats in tel.device_peak.values():
+            v = stats.get("peak_bytes_in_use") or stats.get("bytes_in_use")
+            if isinstance(v, (int, float)) and (
+                measured_peak is None or v > measured_peak
+            ):
+                measured_peak = v
+    record["hbm_peak_measured_bytes"] = measured_peak
     if tel is not None:
         roof = record.get("roofline") or {}
         tel.set_final(
             {
+                "hbm_modeled_bytes": record["hbm_modeled_bytes"],
+                "hbm_peak_measured_bytes": measured_peak,
                 "metric": record["metric"],
                 "value": record["value"],
                 "vs_baseline": record["vs_baseline"],

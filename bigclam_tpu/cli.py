@@ -654,6 +654,11 @@ def _cmd_fit(args, tel=None) -> int:
     cm = getattr(model, "comms", None)
     if cm is not None:
         out["comms_bytes_per_step"] = round(cm.bytes_per_step(), 1)
+    mem = getattr(model, "memory", None)
+    if mem is not None:
+        # the static capacity model next to the comms model (obs.memory,
+        # ISSUE 12) — same figure the perf ledger verdicts
+        out["hbm_modeled_bytes"] = round(mem.hbm_bytes(), 1)
     if cfg.representation == "sparse":
         out["sparse_m"] = getattr(model, "m", cfg.sparse_m)
         if hasattr(model, "comm_mode"):
@@ -932,6 +937,9 @@ def _cmd_profile(args, tel=None) -> int:
     cm = getattr(model, "comms", None)
     if cm is not None:
         out["comms_bytes_per_step"] = round(cm.bytes_per_step(), 1)
+    mem = getattr(model, "memory", None)
+    if mem is not None:
+        out["hbm_modeled_bytes"] = round(mem.hbm_bytes(), 1)
     if tel is not None:
         tel.set_final(out)
     print(json.dumps(out))
@@ -1018,6 +1026,127 @@ def cmd_report(args) -> int:
     if errors:
         print(f"\n{errors} problem(s) found", file=sys.stderr)
     return 1 if errors else 0
+
+
+def cmd_preflight(args) -> int:
+    """Capacity preflight (obs.memory, ISSUE 12): predict per-device
+    HBM, per-host RSS, and bytes/step for a config + graph + device
+    target WITHOUT touching jax or any hardware — the go/no-go answer
+    the pod drill runs before a single chip is reserved.
+
+        cli preflight --graph friendster.cache --k 1000 \\
+            --mesh 64,1 --device-kind v5e --store-native
+
+    Graph input: a compiled cache dir (exact manifest numbers, per-
+    shard edge counts included) or a SNAP text path (+ --nodes; edges
+    estimated from the file size unless --edges). Exit 0 = fits,
+    2 = does not fit (the verdict names the binding constraint and the
+    knobs that relax it), 1 = bad input."""
+    import os
+
+    from bigclam_tpu.graph.store import GraphStore, is_cache_dir
+    from bigclam_tpu.obs import memory as M
+
+    shard_counts = None
+    rows_per_shard = 0
+    notes: list = []
+    if is_cache_dir(args.graph):
+        w = GraphStore.open(args.graph).workload()
+        n = args.nodes or w["n"]
+        directed = 2 * args.edges if args.edges else w["directed_edges"]
+        rows_per_shard = w["rows_per_shard"]
+        shard_counts = w["shard_edge_counts"]
+    elif os.path.isfile(args.graph):
+        if not args.nodes:
+            print(
+                "error: a text --graph carries no manifest — pass "
+                "--nodes (and ideally --edges), or `cli ingest` it "
+                "first and preflight the cache",
+                file=sys.stderr,
+            )
+            return 1
+        n = args.nodes
+        if args.edges:
+            directed = 2 * args.edges
+        else:
+            # SNAP text: ~13 bytes per "u\tv\n" line, one undirected
+            # edge per line -> 2 directed per line
+            directed = 2 * max(os.path.getsize(args.graph) // 13, 1)
+            notes.append(
+                "edge count estimated from file size (~13 B/line); "
+                "pass --edges or preflight a compiled cache for exact "
+                "numbers"
+            )
+    else:
+        print(f"error: --graph {args.graph}: no such file or cache dir",
+              file=sys.stderr)
+        return 1
+
+    if args.mesh:
+        dp, tp = (int(x) for x in args.mesh.split(","))
+    else:
+        dp, tp = max(args.devices, 1), 1
+    if shard_counts:
+        # aggregate the cache's per-shard counts into TRAINER shards
+        # (dp groups of contiguous store shards). dp == 1 included: the
+        # single device then holds EVERY shard's edges — skipping the
+        # aggregation would underprice the graph by ~num_shards x
+        s = len(shard_counts)
+        if s % dp == 0:
+            per = s // dp
+            shard_counts = [
+                sum(shard_counts[i * per : (i + 1) * per])
+                for i in range(dp)
+            ]
+        else:
+            notes.append(
+                f"cache has {s} shards, not divisible by dp={dp}: "
+                "per-shard counts estimated (recompile with --shards "
+                f"{dp} for exact geometry)"
+            )
+            shard_counts = None
+
+    hbm = 0.0
+    if args.hbm_bytes:
+        hbm = float(args.hbm_bytes)
+    elif args.hbm_gb:
+        hbm = float(args.hbm_gb) * (1 << 30)
+    elif args.device_kind:
+        hbm = float(M.DEVICE_HBM_BYTES[args.device_kind])
+    host_ram = float(args.host_ram_gb) * (1 << 30) if args.host_ram_gb \
+        else 0.0
+
+    from bigclam_tpu.config import BigClamConfig
+
+    p = M.preflight(
+        n,
+        directed,
+        args.k,
+        dp=dp,
+        tp=tp,
+        itemsize=8 if args.dtype == "float64" else 4,
+        num_candidates=args.max_backtracks + 1,
+        representation=args.representation,
+        sparse_m=args.sparse_m,
+        support_every=args.support_every,
+        schedule=args.schedule,
+        store_native=args.store_native,
+        health_every=max(args.health_every or 0, 0),
+        edge_chunk=args.edge_chunk or BigClamConfig.edge_chunk,
+        shard_edge_counts=shard_counts,
+        device_hbm_bytes=hbm,
+        host_ram_bytes=host_ram,
+        processes=max(args.processes, 1),
+        chunk_bytes=args.chunk_bytes,
+        csr_block_b=args.csr_block_b,
+        rows_per_shard=rows_per_shard,
+    )
+    p["notes"] = notes + p["notes"]
+    if args.json:
+        print(json.dumps(p, sort_keys=True))
+    else:
+        print(M.render_preflight(p))
+    return 0 if p["fits"] else 2
 
 
 def cmd_watch(args) -> int:
@@ -1250,6 +1379,75 @@ def main(argv=None) -> int:
     p_watch.add_argument("--width", type=int, default=48,
                          help="sparkline width in samples")
     p_watch.set_defaults(fn=cmd_watch)
+
+    p_pre = sub.add_parser(
+        "preflight",
+        help="jax-free capacity verdict: predicted per-device HBM, "
+             "per-host RSS, and bytes/step for a config + graph + "
+             "device target, with the binding constraint and the knobs "
+             "that relax it (exit 0 fits / 2 does not fit)",
+    )
+    p_pre.add_argument(
+        "--graph", required=True,
+        help="compiled graph-cache dir (exact manifest numbers) or a "
+             "SNAP text path (+ --nodes; edges estimated from size)",
+    )
+    p_pre.add_argument("--k", type=int, required=True)
+    p_pre.add_argument(
+        "--nodes", type=int, default=None,
+        help="node count (required for text graphs; overrides a cache)",
+    )
+    p_pre.add_argument(
+        "--edges", type=int, default=None,
+        help="undirected edge count (overrides the estimate/manifest)",
+    )
+    p_pre.add_argument("--dtype", default="float32",
+                       choices=["float32", "float64"])
+    p_pre.add_argument(
+        "--mesh", default=None, help="'DP,TP' target mesh (default: "
+        "--devices,1)",
+    )
+    p_pre.add_argument(
+        "--devices", type=int, default=1,
+        help="target device count when --mesh is not given",
+    )
+    from bigclam_tpu.obs.memory import DEVICE_HBM_BYTES as _HBM
+
+    p_pre.add_argument(
+        "--device-kind", default=None, choices=sorted(_HBM),
+        help="per-chip HBM from the builtin table "
+             "(--hbm-gb overrides)",
+    )
+    p_pre.add_argument("--hbm-gb", type=float, default=None,
+                       help="per-device HBM budget in GiB")
+    p_pre.add_argument(
+        "--hbm-bytes", type=float, default=None,
+        help="exact per-device HBM budget in bytes (testing/gates)",
+    )
+    p_pre.add_argument("--host-ram-gb", type=float, default=None,
+                       help="per-host RAM budget in GiB")
+    p_pre.add_argument("--processes", type=int, default=1,
+                       help="host process count (per-host RSS divisor "
+                       "for the store-native stages)")
+    p_pre.add_argument("--representation", default="dense",
+                       choices=["dense", "sparse"])
+    p_pre.add_argument("--sparse-m", type=int, default=64)
+    p_pre.add_argument("--support-every", type=int, default=1)
+    p_pre.add_argument("--schedule", default="allgather",
+                       choices=["allgather", "ring"])
+    p_pre.add_argument("--store-native", action="store_true")
+    p_pre.add_argument("--health-every", type=int, default=10)
+    p_pre.add_argument("--max-backtracks", type=int, default=15)
+    p_pre.add_argument("--edge-chunk", type=int, default=None)
+    p_pre.add_argument(
+        "--chunk-bytes", type=int, default=0,
+        help="include the ingest stage in the host model at this "
+             "chunk budget (0 = fit-only stages)",
+    )
+    p_pre.add_argument("--csr-block-b", type=int, default=256)
+    p_pre.add_argument("--json", action="store_true",
+                       help="machine-readable verdict")
+    p_pre.set_defaults(fn=cmd_preflight)
 
     p_eval = sub.add_parser("eval", help="score predicted vs ground-truth communities")
     p_eval.add_argument("--pred", required=True)

@@ -293,6 +293,23 @@ class GraphStore:
         )
         return ip, dp
 
+    def workload(self) -> Dict:
+        """Manifest-only workload numbers for the jax-free capacity
+        preflight (`cli preflight` / obs.memory.preflight): sizes, the
+        shard geometry, and the per-shard directed-edge counts — read
+        without touching any blob, so the answer costs one JSON parse
+        even for a Friendster-scale cache."""
+        return {
+            "n": self.num_nodes,
+            "directed_edges": self.num_directed_edges,
+            "num_shards": self.num_shards,
+            "rows_per_shard": self.rows_per_shard,
+            "balanced": self.balanced,
+            "shard_edge_counts": [
+                int(e["edges"]) for e in self.manifest["shards"]
+            ],
+        }
+
     def load_shard_range(
         self,
         first_shard: int,

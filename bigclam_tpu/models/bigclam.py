@@ -222,6 +222,111 @@ def log_engaged_path(model_name: str, path: str, reason: str = "") -> None:
     )
 
 
+class MemoryAccountedModel:
+    """Shared memory-accounting surface (obs.memory, ISSUE 12): every
+    trainer family bakes a static per-device HBM model + per-host RSS
+    model at step build (`_bake_memory_model`, mirroring the comms-model
+    pattern) and can reconcile it against the LIVE addressable shard
+    bytes of a state (`memory_reconcile` — exact on the CPU fake, the
+    MEM gate's headline check; drift past the band fires the
+    `memory_drift` anomaly, the leak/retained-buffer detector).
+
+    Subclasses provide `_graph_device_arrays()` (the committed edge/
+    tile/support device arrays the compiled step keeps resident) and
+    `_build_memory_model()`; the host model, measurement, and emission
+    are shared here."""
+
+    memory = None                # the baked obs.memory.MemoryModel
+
+    def _bake_memory_model(self) -> None:
+        from bigclam_tpu.obs import memory as _mem
+
+        self.memory = self._build_memory_model()
+        _mem.emit_model(self.memory, self._host_memory_model())
+
+    def _graph_device_arrays(self) -> dict:
+        raise NotImplementedError
+
+    def _build_memory_model(self):
+        raise NotImplementedError
+
+    def _memory_dp(self) -> int:
+        mesh = getattr(self, "mesh", None)
+        if mesh is None:
+            return 1
+        from bigclam_tpu.parallel.mesh import NODES_AXIS
+
+        return mesh.shape[NODES_AXIS]
+
+    def _graph_buffer_bytes(self) -> dict:
+        """Per-device bytes of the committed graph buffers: the arrays
+        are P(nodes)-sharded (or single-device), so per-device = global
+        / dp — the same division measured_device_bytes recovers from
+        the live shards."""
+        from bigclam_tpu.obs import memory as _mem
+
+        dp = self._memory_dp()
+        return {
+            name: _mem.nbytes_of(a) / dp
+            for name, a in self._graph_device_arrays().items()
+        }
+
+    def _host_memory_model(self):
+        from bigclam_tpu.obs import memory as _mem
+
+        g, cfg = self.g, self.cfg
+        store = getattr(self, "store", None)
+        processes = 1
+        if getattr(self, "mesh", None) is not None:
+            processes = jax.process_count()
+        return _mem.host_rss_model(
+            g.num_nodes,
+            g.num_directed_edges,
+            cfg.num_communities,
+            jnp.dtype(self.dtype).itemsize,
+            n_pad=self.n_pad,
+            k_pad=self.k_pad,
+            store_native=store is not None,
+            processes=processes,
+            num_shards=(
+                store.num_shards if store is not None else self._memory_dp()
+            ),
+            representation=cfg.representation,
+            sparse_m=getattr(self, "m", 0),
+        )
+
+    def _memory_state_arrays(self, state) -> list:
+        return [
+            state.F, state.sumF, state.llh, state.it, state.accept_hist,
+            getattr(state, "health", None),
+        ]
+
+    def memory_measured(self, state, extra=()) -> float:
+        """Exact per-device bytes of the LIVE addressable buffers this
+        model's step keeps resident: state arrays + committed graph
+        arrays (+ `extra` — the gate's planted-leak hook: pass retained
+        buffers the model does not know about)."""
+        from bigclam_tpu.obs import memory as _mem
+
+        arrays = (
+            self._memory_state_arrays(state)
+            + list(self._graph_device_arrays().values())
+            + list(extra)
+        )
+        return _mem.measured_device_bytes(arrays)
+
+    def memory_reconcile(self, state, extra=(), emit=True) -> dict:
+        """Static model vs live bytes (obs.memory.MemoryModel.reconcile);
+        emits the `memory_drift` anomaly when the drift exceeds the band
+        (a retained/leaked buffer — or stale model arithmetic)."""
+        from bigclam_tpu.obs import memory as _mem
+
+        recon = self.memory.reconcile(self.memory_measured(state, extra))
+        if emit and not recon["ok"]:
+            _mem.emit_drift_anomaly(recon)
+        return recon
+
+
 class TrainState(NamedTuple):
     F: jax.Array        # (N_pad, K_pad)
     sumF: jax.Array     # (K_pad,)
@@ -884,7 +989,7 @@ def make_train_step(
     return finalize_step(step), cand_path
 
 
-class BigClamModel:
+class BigClamModel(MemoryAccountedModel):
     """Single-chip (or single-mesh-context) BigCLAM trainer.
 
     Usage:
@@ -942,6 +1047,10 @@ class BigClamModel:
 
         note_step_build(cfg, "BigClamModel")
         log_engaged_path("BigClamModel", self.engaged_path, self.path_reason)
+        # static memory model (obs.memory, ISSUE 12): baked from the
+        # SAME committed layout the step compiled against, emitted as
+        # `memory_model` events + kept for memory_reconcile
+        self._bake_memory_model()
 
     def rebuild_step(self) -> None:
         """Swap in the train step for the CURRENT self.cfg.
@@ -961,6 +1070,62 @@ class BigClamModel:
 
             note_step_build(self.cfg, "BigClamModel")
         self._step, self.engaged_path = self._step_cache[key]
+
+    # --------------------------------------- memory accounting (ISSUE 12)
+    def _graph_device_arrays(self) -> dict:
+        """The device arrays the compiled step keeps resident: the CSR
+        tiles on the kernel path, the EdgeChunks on XLA (self._edges
+        directly, NOT the lazy .edges property — on the CSR path the
+        step never reads EdgeChunks, so baking them into the model
+        would price a buffer that does not exist)."""
+        out = {}
+        if self._tiles is not None:
+            t = self._tiles
+            out.update({
+                "graph/tiles_src": t.src_local,
+                "graph/tiles_dst": t.dst,
+                "graph/tiles_mask": t.mask,
+                "graph/tiles_block_id": t.block_id,
+            })
+        if self._edges is not None:
+            out.update({
+                "graph/edges_src": self._edges.src,
+                "graph/edges_dst": self._edges.dst,
+                "graph/edges_mask": self._edges.mask,
+            })
+        return out
+
+    def _memory_fd_bytes(self) -> float:
+        """Bytes of the step's shared dst-row gather (the dominant
+        transient): (chunk, K_pad) per scan step on XLA, the whole
+        layout's (or one group window's) dst rows on the CSR paths."""
+        isz = jnp.dtype(self.dtype).itemsize
+        if self._tiles is not None:
+            dst = self._tiles.dst
+            kc = getattr(self._tiles, "kc", 0) or self.k_pad
+            if dst.ndim >= 3:           # grouped: one (G, T) window live
+                import numpy as _np
+
+                return float(_np.prod(dst.shape[1:])) * kc * isz
+            return float(dst.size) * kc * isz
+        return float(self._edges.src.shape[-1]) * self.k_pad * isz
+
+    def _build_memory_model(self):
+        from bigclam_tpu.obs import memory as _mem
+
+        cfg = self.cfg
+        return _mem.dense_memory_model(
+            self.n_pad,
+            self.k_pad,
+            jnp.dtype(self.dtype).itemsize,
+            len(cfg.step_candidates),
+            self._graph_buffer_bytes(),
+            health_on=int(getattr(cfg, "health_every", 0) or 0) > 0,
+            donate=bool(cfg.donate_state),
+            rollback=int(getattr(cfg, "rollback_budget", 0) or 0) > 0,
+            fd_bytes=self._memory_fd_bytes(),
+            model=type(self).__name__,
+        )
 
     @property
     def edges(self) -> EdgeChunks:

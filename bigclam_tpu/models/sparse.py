@@ -33,6 +33,7 @@ from bigclam_tpu.config import BigClamConfig
 from bigclam_tpu.graph.csr import Graph
 from bigclam_tpu.models.bigclam import (
     FitResult,
+    MemoryAccountedModel,
     _round_up,
     _ScaleRebuilder,
     finalize_step,
@@ -120,7 +121,7 @@ def make_sparse_train_step(
     return finalize_step(step), "sparse_xla"
 
 
-class SparseBigClamModel:
+class SparseBigClamModel(MemoryAccountedModel):
     """Single-chip sparse-representation trainer.
 
     Usage:
@@ -162,6 +163,11 @@ class SparseBigClamModel:
         log_engaged_path(
             type(self).__name__, self.engaged_path, self.path_reason
         )
+        # static memory model (obs.memory, ISSUE 12): M-not-K state
+        # scaling as a model, not just a gate assertion. The sharded
+        # subclass re-bakes when the cap refinement moves its
+        # collective layout (_set_comm).
+        self._bake_memory_model()
 
     def _setup(self) -> None:
         """Build padding, device edge/block buffers, and the train step
@@ -181,6 +187,44 @@ class SparseBigClamModel:
 
     def _path_reason(self) -> str:
         return f"representation=sparse M={self.m}"
+
+    # --------------------------------------- memory accounting (ISSUE 12)
+    def _graph_device_arrays(self) -> dict:
+        e, b = self._edges, self._blocks
+        return {
+            "graph/edges_src": e.src,
+            "graph/edges_dst": e.dst,
+            "graph/edges_mask": e.mask,
+            "graph/support_src": b.src_local,
+            "graph/support_dst": b.dst,
+            "graph/support_mask": b.mask,
+        }
+
+    def _memory_state_arrays(self, state) -> list:
+        return [
+            state.F, state.ids, state.sumF, state.llh, state.it,
+            state.accept_hist, state.comm_ids, state.comm_dense,
+            getattr(state, "health", None),
+        ]
+
+    def _build_memory_model(self):
+        from bigclam_tpu.obs import memory as _mem
+
+        cfg = self.cfg
+        return _mem.sparse_memory_model(
+            self.n_pad,
+            self.m,
+            self.k_pad,
+            self._memory_dp(),
+            jnp.dtype(self.dtype).itemsize,
+            len(cfg.step_candidates),
+            self._graph_buffer_bytes(),
+            health_on=int(getattr(cfg, "health_every", 0) or 0) > 0,
+            donate=bool(cfg.donate_state),
+            rollback=int(getattr(cfg, "rollback_budget", 0) or 0) > 0,
+            comms=getattr(self, "comms", None),
+            model=type(self).__name__,
+        )
 
     def _make_step(self):
         return make_sparse_train_step(

@@ -32,6 +32,12 @@ from bigclam_tpu.obs.comms import (
     detect_host_skew,
 )
 from bigclam_tpu.obs.health import DEFAULTS as HEALTH_DEFAULTS
+from bigclam_tpu.obs.memory import (
+    HostModel,
+    MemoryModel,
+    measured_device_bytes,
+    preflight,
+)
 from bigclam_tpu.obs.health import HealthMonitor, run_detectors
 from bigclam_tpu.obs.heartbeat import Heartbeat
 from bigclam_tpu.obs.ledger import LEDGER_ENV, PerfLedger
@@ -56,9 +62,13 @@ __all__ = [
     "HEALTH_DEFAULTS",
     "HealthMonitor",
     "Heartbeat",
+    "HostModel",
     "IMBALANCE_FACTOR",
     "LEDGER_ENV",
+    "MemoryModel",
+    "measured_device_bytes",
     "PerfLedger",
+    "preflight",
     "RunTelemetry",
     "SCHEMA_VERSION",
     "add_span",

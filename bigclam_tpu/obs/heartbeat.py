@@ -90,6 +90,15 @@ class Heartbeat:
 
     def _run(self) -> None:
         while not self._stop.wait(self.poll_s):
+            # device-memory watermark on the POLL cadence (ISSUE 12
+            # fix): stage-boundary-only sampling made a peak inside a
+            # long fit stage invisible — fold a sample into the running
+            # per-device max every poll (no event emitted; stalls still
+            # carry full snapshots). Never allowed to kill the watchdog.
+            try:
+                self.telemetry.sample_device_peak("heartbeat")
+            except Exception:
+                pass
             now = time.monotonic()
             with self._lock:
                 silent = now - self._last_beat
@@ -126,6 +135,12 @@ class Heartbeat:
         # "waiting on the gang / a straggler host", not "computing" —
         # None before the first iteration completes
         sync_s = getattr(self.telemetry, "last_sync_s", None)
+        # modeled-vs-measured HBM (obs.memory, ISSUE 12): next to the
+        # live device snapshot, the static model's per-device total —
+        # a stall with measured >> modeled reads as "leaked/retained
+        # buffers", measured ~ modeled as "wedged, memory healthy"
+        hbm_fn = getattr(self.telemetry, "hbm_modeled_bytes", None)
+        hbm_modeled = hbm_fn() if callable(hbm_fn) else None
         self.telemetry.event(
             "stall",
             silent_s=round(silent_s, 3),
@@ -135,6 +150,7 @@ class Heartbeat:
             spans=spans,
             health=health,
             sync_s=sync_s,
+            hbm_modeled_bytes=hbm_modeled,
         )
         if self.echo:
             where = f"; open span: {spans[-1]}" if spans else ""
@@ -156,6 +172,7 @@ class Heartbeat:
                 spans=spans,
                 health=health,
                 sync_s=sync_s,
+                hbm_modeled_bytes=hbm_modeled,
             )
             if self.echo:
                 print(

@@ -218,6 +218,31 @@ def build_record(
     rec["anomalies"] = sum(
         int(v) for v in (health.get("anomalies", {}) or {}).values()
     )
+    # memory accounting (obs.memory, ISSUE 12): the modeled per-device
+    # HBM total and per-host RSS peak of the run's trainer builds —
+    # VERDICTED by `cli perf diff`, so a layout/padding/state change
+    # that silently inflates memory fails CI exactly like a perf or
+    # comms regression (the CPU testbed's step time cannot see it; the
+    # pod's HBM can). An explicit final stamp (bench pins the headline
+    # model's figure next to its measured peak) wins over the report
+    # accumulation, which sums every model the run built.
+    mem = (report.get("memory", {}) or {}).get("modeled") or {}
+    hbm = final.get("hbm_modeled_bytes")
+    if not isinstance(hbm, _NUM) or isinstance(hbm, bool):
+        hbm = mem.get("hbm_bytes_per_device")
+    rec["hbm_modeled_bytes"] = (
+        round(float(hbm), 1)
+        if isinstance(hbm, _NUM) and not isinstance(hbm, bool) and hbm > 0
+        else None
+    )
+    host_rss = mem.get("host_rss_bytes")
+    rec["host_rss_modeled_bytes"] = (
+        round(float(host_rss), 1)
+        if isinstance(host_rss, _NUM)
+        and not isinstance(host_rss, bool)
+        and host_rss > 0
+        else None
+    )
     if note:
         rec["note"] = note
     return rec
@@ -453,6 +478,20 @@ def diff_records(
     ):
         check("overlap_frac", base["overlap_frac"], new["overlap_frac"],
               worse_if_higher=False)
+    # memory verdicts (obs.memory, ISSUE 12): modeled per-device HBM or
+    # modeled host-RSS growing past the band is a capacity regression —
+    # invisible to step time on a small testbed, fatal on the pod whose
+    # HBM the config was sized against
+    if isinstance(base.get("hbm_modeled_bytes"), _NUM) and isinstance(
+        new.get("hbm_modeled_bytes"), _NUM
+    ):
+        check("hbm_modeled_bytes", base["hbm_modeled_bytes"],
+              new["hbm_modeled_bytes"])
+    if isinstance(
+        base.get("host_rss_modeled_bytes"), _NUM
+    ) and isinstance(new.get("host_rss_modeled_bytes"), _NUM):
+        check("host_rss_modeled_bytes", base["host_rss_modeled_bytes"],
+              new["host_rss_modeled_bytes"])
     # convergence verdicts (ISSUE 8): iteration count to tolerance is
     # VERDICTED (same cfg + workload + seed ⇒ deterministic up to float
     # summation order — growth past the band is a real optimizer

@@ -1,0 +1,1105 @@
+"""Memory accounting: static HBM / host-RSS models, live reconciliation,
+and the capacity preflight (ISSUE 12).
+
+The obs stack accounts for time (obs.trace + the perf ledger), model
+health (obs.health), and wire bytes (obs.comms) — but the axis that
+actually kills a pod run, MEMORY, was only sampled (`Device.memory_stats`
+watermarks), never modeled: the only way to learn whether a config fits
+in HBM, or whether the host-global O(N*K) F0 upload OOMs the host, was
+to launch it. Memory-constrained graph clustering at scale lives or dies
+on exactly this per-device capacity model (HipMCL's pre-exascale
+analysis, arXiv:2002.10083), and per-replica state accounting is the
+same discipline that makes sharded-update training plannable
+(arXiv:2004.13336). This module makes both first-class, gateable run
+signals, mirroring the comms-model pattern (obs.comms):
+
+* **Static per-device HBM model.** Each trainer family bakes a
+  `MemoryModel` at step-build time: one `Buffer` per live device buffer
+  of its compiled step, built from the SAME shape arithmetic the trainer
+  committed (n_pad/k_pad/dp/tp/M, the committed edge/tile layout's slot
+  counts). Buffer categories:
+
+    state      the TrainState arrays (F/sumF/scalars; ids+weights on the
+               sparse representation) — per-device shard bytes
+    graph      the committed edge blocks / CSR tiles / support blocks
+               the compiled step keeps resident (jit args or closure
+               constants) — per-device shard bytes
+    scratch    persistent state-sized extras: the donation ping-pong
+               twin (cfg.donate_state) and the in-HBM rollback snapshot
+               (cfg.rollback_budget)
+    transient  peak in-step temporaries: the all-gathered F / member
+               lists, the ring's rotating shard pair, the dst-row
+               gather, the gradient, the Armijo candidate accumulators
+    collective the largest single-occurrence collective receive buffer,
+               PRICED FROM THE COMMS SITES (obs.comms) the trainer
+               already baked — the two models can never disagree about
+               what is on the wire
+
+  Emitted as schema'd `memory_model` events (one per buffer), summed
+  into the run report and the perf ledger (`hbm_modeled_bytes`,
+  `host_rss_modeled_bytes`, both VERDICTED by `cli perf diff`).
+
+* **Reconciliation.** `MemoryModel.addressable_bytes()` — the state +
+  graph categories — is the part of the model that corresponds to
+  long-lived, addressable device buffers, and `measured_device_bytes`
+  sums the LIVE per-device shard nbytes of exactly those arrays. On the
+  CPU fake the two agree EXACTLY (scripts/memory_gate.py asserts drift
+  == 0); `reconcile` flags drift past the band as a `memory_drift`
+  anomaly — the leak/retained-buffer detector (a snapshot that should
+  have been donated, a cached gather that outlived its step). Where
+  `Device.memory_stats` exists (TPU), the watermark layer
+  (RunTelemetry.device_peak — sampled at stage boundaries AND on the
+  heartbeat cadence since this PR) gives the allocator-level second
+  opinion the report renders next to the model.
+
+* **Host-RSS model.** A per-stage model of the host side: the ingest
+  chunk budget (the same explicit formula INGEST_r07 gates), the graph /
+  shard load, seeding, and the host-global O(N*K) F0 init — flagged as
+  the DOMINANT host term (ROADMAP item 1a: the per-host init_state
+  refactor is what removes it; --store-native shrinks every other stage
+  to O(shard) but NOT this one yet).
+
+* **Preflight.** `preflight()` builds the same models from a config + a
+  workload (cache manifest numbers or text-size estimates) + a
+  device-kind/count target, with NO jax and NO arrays — the go/no-go
+  answer `cli preflight` prints before a pod job touches hardware:
+  predicted per-device HBM, per-host RSS, bytes/step, a fits-or-doesn't
+  verdict naming the binding constraint, and the knobs that relax it
+  (sparse_m, csr tile shape, mesh, --schedule ring, --store-native).
+
+jax-free at import, like every obs module: `cli preflight` and `cli
+report` run on data-prep hosts.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from bigclam_tpu.obs.comms import CommsModel, wire_bytes
+
+# (HEALTH_LEN,) float32 health pack riding the TrainState when
+# cfg.health_every > 0 — mirrored from ops.diagnostics.HEALTH_LEN (which
+# imports jax; the tier-1 test pins the two equal)
+HEALTH_LEN = 14
+
+# live-vs-model reconciliation band: exact on the CPU fake (the gate
+# asserts 0 drift); real allocators round to pages/tiles, so the anomaly
+# threshold leaves margin. Host-side knob like obs.comms.DEFAULTS —
+# deliberately NOT a config field.
+DEFAULTS: Dict[str, float] = {
+    "drift_frac": 0.02,
+}
+
+# preflight verdicts keep this fraction of HBM free for allocator
+# rounding, XLA fusion temporaries, and infeed buffers the model cannot
+# see — an "exactly fits" prediction is an OOM in practice
+HBM_HEADROOM_FRAC = 0.08
+
+# per-chip HBM of the device kinds the preflight knows; --hbm-gb
+# overrides (the table is a convenience, not a registry)
+DEVICE_HBM_BYTES: Dict[str, int] = {
+    "v3": 16 << 30,
+    "v4": 32 << 30,
+    "v5e": 16 << 30,
+    "v5litepod": 16 << 30,
+    "v5p": 95 << 30,
+    "v6e": 32 << 30,
+}
+
+CATEGORIES = ("state", "graph", "scratch", "transient", "collective")
+# categories whose buffers are long-lived addressable arrays — the exact
+# reconciliation target (scratch/transient/collective are real HBM but
+# not measurable from the state object)
+ADDRESSABLE = ("state", "graph")
+
+
+@dataclasses.dataclass(frozen=True)
+class Buffer:
+    """One live device buffer of a compiled train step (per-DEVICE
+    bytes; `count` for repeated buffers like the ring's rotation pair)."""
+
+    name: str
+    bytes: float
+    category: str = "state"
+    count: float = 1.0
+    note: str = ""
+
+    @property
+    def total_bytes(self) -> float:
+        return float(self.bytes) * float(self.count)
+
+    def to_fields(self) -> Dict[str, Any]:
+        out = {
+            "buffer": self.name,
+            "bytes": round(self.total_bytes, 1),
+            "category": self.category,
+            "count": round(float(self.count), 2),
+        }
+        if self.note:
+            out["note"] = self.note
+        return out
+
+
+@dataclasses.dataclass(frozen=True)
+class MemoryModel:
+    """The static per-device HBM model one trainer baked at step build."""
+
+    family: str                  # dense | sharded | ring | sparse
+    model: str                   # trainer class name
+    buffers: Tuple[Buffer, ...]
+    params: Dict[str, Any]       # the shape arithmetic inputs
+
+    def hbm_bytes(self) -> float:
+        """Modeled per-device HBM peak: every category, scratch and
+        transients included — the capacity/preflight figure."""
+        return sum(b.total_bytes for b in self.buffers)
+
+    def addressable_bytes(self) -> float:
+        """The state + graph categories only — the long-lived buffers
+        `measured_device_bytes` can sum exactly (the reconciliation
+        target; exact on the CPU fake)."""
+        return sum(
+            b.total_bytes for b in self.buffers
+            if b.category in ADDRESSABLE
+        )
+
+    def category_bytes(self) -> Dict[str, float]:
+        out: Dict[str, float] = {}
+        for b in self.buffers:
+            out[b.category] = out.get(b.category, 0.0) + b.total_bytes
+        return {k: round(v, 1) for k, v in out.items()}
+
+    def buffer_bytes(self) -> Dict[str, float]:
+        return {b.name: round(b.total_bytes, 1) for b in self.buffers}
+
+    def reconcile(
+        self, measured_bytes: float, band: Optional[float] = None
+    ) -> Dict[str, Any]:
+        """Modeled addressable bytes vs the LIVE per-device sum (see
+        measured_device_bytes). drift > band means a buffer the model
+        does not know is resident (leak / retained snapshot); drift <
+        -band means the model prices a buffer that does not exist
+        (stale arithmetic). Pure — emit_drift_anomaly turns a bad
+        verdict into the anomaly event."""
+        band = DEFAULTS["drift_frac"] if band is None else float(band)
+        modeled = self.addressable_bytes()
+        drift = (float(measured_bytes) - modeled) / max(modeled, 1.0)
+        return {
+            "model": self.model,
+            "family": self.family,
+            "modeled_bytes": round(modeled, 1),
+            "measured_bytes": round(float(measured_bytes), 1),
+            "drift_frac": round(drift, 6),
+            "band": band,
+            "ok": abs(drift) <= band,
+            "hbm_modeled_bytes": round(self.hbm_bytes(), 1),
+        }
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "family": self.family,
+            "model": self.model,
+            "hbm_bytes": round(self.hbm_bytes(), 1),
+            "addressable_bytes": round(self.addressable_bytes(), 1),
+            "by_category": self.category_bytes(),
+            "buffers": [b.to_fields() for b in self.buffers],
+            "params": dict(self.params),
+        }
+
+
+@dataclasses.dataclass(frozen=True)
+class HostStage:
+    """One stage of the per-host RSS model (stages are sequential, so
+    the host peak is the max stage, not the sum)."""
+
+    stage: str
+    bytes: float
+    note: str = ""
+
+
+@dataclasses.dataclass(frozen=True)
+class HostModel:
+    stages: Tuple[HostStage, ...]
+
+    def peak_bytes(self) -> float:
+        return max((s.bytes for s in self.stages), default=0.0)
+
+    def dominant(self) -> Optional[HostStage]:
+        if not self.stages:
+            return None
+        return max(self.stages, key=lambda s: s.bytes)
+
+    def stage_bytes(self) -> Dict[str, float]:
+        return {s.stage: round(s.bytes, 1) for s in self.stages}
+
+    def to_dict(self) -> Dict[str, Any]:
+        dom = self.dominant()
+        return {
+            "host_rss_bytes": round(self.peak_bytes(), 1),
+            "dominant_stage": dom.stage if dom else None,
+            "stages": [
+                {"stage": s.stage, "bytes": round(s.bytes, 1),
+                 **({"note": s.note} if s.note else {})}
+                for s in self.stages
+            ],
+        }
+
+
+# ----------------------------------------------------- state arithmetic
+def _scalar_state_bytes(
+    itemsize: int, num_candidates: int, health_on: bool,
+    extra_int32: int = 0,
+) -> float:
+    """The replicated per-device scalar bundle every TrainState carries:
+    llh (dtype) + it (int32) + accept_hist ((S+1,) int32) + the health
+    pack when on + `extra_int32` counters (the sparse comm_ids/
+    comm_dense pair)."""
+    return (
+        itemsize
+        + 4
+        + (num_candidates + 1) * 4
+        + (HEALTH_LEN * 4 if health_on else 0)
+        + extra_int32 * 4
+    )
+
+
+def dense_state_buffers(
+    n_pad: int, k_pad: int, dp: int, tp: int, itemsize: int,
+    num_candidates: int, health_on: bool,
+) -> List[Buffer]:
+    """Per-device bytes of the dense TrainState: F sharded P(nodes, k),
+    sumF sharded P(k) (replicated over nodes), scalars replicated."""
+    n_loc = n_pad // max(dp, 1)
+    k_loc = k_pad // max(tp, 1)
+    return [
+        Buffer("state/F", n_loc * k_loc * itemsize, "state"),
+        Buffer("state/sumF", k_loc * itemsize, "state"),
+        Buffer(
+            "state/scalars",
+            _scalar_state_bytes(itemsize, num_candidates, health_on),
+            "state",
+        ),
+    ]
+
+
+def sparse_state_buffers(
+    n_pad: int, m: int, k_pad: int, dp: int, itemsize: int,
+    num_candidates: int, health_on: bool,
+) -> List[Buffer]:
+    """Per-device bytes of the SparseTrainState: weights + int32 member
+    ids sharded P(nodes), the (K_pad,) sumF accumulator replicated, and
+    the scalar bundle + the two exchange counters."""
+    n_loc = n_pad // max(dp, 1)
+    return [
+        Buffer("state/weights", n_loc * m * itemsize, "state"),
+        Buffer("state/member_ids", n_loc * m * 4, "state"),
+        Buffer("state/sumF", k_pad * itemsize, "state"),
+        Buffer(
+            "state/scalars",
+            _scalar_state_bytes(
+                itemsize, num_candidates, health_on, extra_int32=2
+            ),
+            "state",
+        ),
+    ]
+
+
+def _graph_buffers(graph_bytes: Dict[str, float]) -> List[Buffer]:
+    return [
+        Buffer(name, float(b), "graph")
+        for name, b in sorted(graph_bytes.items())
+    ]
+
+
+def _scratch_buffers(
+    state_bytes: float, donate: bool, rollback: bool
+) -> List[Buffer]:
+    out = []
+    if donate:
+        out.append(Buffer(
+            "scratch/donation_pingpong", state_bytes, "scratch",
+            note="cfg.donate_state ping-pong twin (run_fit_loop)",
+        ))
+    if rollback:
+        out.append(Buffer(
+            "scratch/rollback_snapshot", state_bytes, "scratch",
+            note="cfg.rollback_budget last-verified-finite snapshot",
+        ))
+    return out
+
+
+def collective_buffers(comms: Optional[CommsModel]) -> List[Buffer]:
+    """Collective scratch priced from the comms Sites the trainer
+    already baked: the largest single-occurrence receive buffer of the
+    step (the all-gather result / psum double buffer / ppermute
+    in-flight shard). One buffer, named after the site, so the memory
+    and comms models can never disagree about the wire payloads."""
+    if comms is None or not comms.sites:
+        return []
+    best, best_bytes = None, 0.0
+    for s in comms.sites:
+        b = wire_bytes(s.op, s.payload_bytes, s.participants)
+        if b > best_bytes:
+            best, best_bytes = s, b
+    if best is None or best_bytes <= 0:
+        return []
+    return [Buffer(
+        "collective/in_flight", best_bytes, "collective",
+        note=f"largest single-occurrence receive ({best.site})",
+    )]
+
+
+def _total(buffers: Sequence[Buffer]) -> float:
+    return sum(b.total_bytes for b in buffers)
+
+
+# ------------------------------------------------------- family builders
+def dense_memory_model(
+    n_pad: int,
+    k_pad: int,
+    itemsize: int,
+    num_candidates: int,
+    graph_bytes: Dict[str, float],
+    health_on: bool = False,
+    donate: bool = True,
+    rollback: bool = False,
+    fd_bytes: float = 0.0,
+    model: str = "BigClamModel",
+) -> MemoryModel:
+    """Single-chip dense trainer (models.bigclam.BigClamModel). The
+    transient set is the step's in-flight temporaries: the gradient
+    (state-F-sized), the shared dst-row gather (fd — CSR flat/grouped
+    or the XLA (chunk, K) gather), and the (S, N) Armijo candidate
+    accumulators."""
+    state = dense_state_buffers(
+        n_pad, k_pad, 1, 1, itemsize, num_candidates, health_on
+    )
+    buffers = (
+        state
+        + _graph_buffers(graph_bytes)
+        + _scratch_buffers(_total(state), donate, rollback)
+        + [
+            Buffer("transient/grad", n_pad * k_pad * itemsize, "transient"),
+            Buffer(
+                "transient/candidates",
+                num_candidates * n_pad * itemsize, "transient",
+                note="(S, N) Armijo candidate accumulators",
+            ),
+        ]
+        + ([Buffer("transient/fd_gather", fd_bytes, "transient",
+                   note="shared dst-row gather")] if fd_bytes else [])
+    )
+    return MemoryModel(
+        family="dense", model=model, buffers=tuple(buffers),
+        params={"n_pad": n_pad, "k_pad": k_pad, "itemsize": itemsize,
+                "donate": donate, "rollback": rollback},
+    )
+
+
+def sharded_memory_model(
+    n_pad: int,
+    k_pad: int,
+    dp: int,
+    tp: int,
+    itemsize: int,
+    num_candidates: int,
+    graph_bytes: Dict[str, float],
+    health_on: bool = False,
+    donate: bool = True,
+    rollback: bool = False,
+    fd_bytes: float = 0.0,
+    comms: Optional[CommsModel] = None,
+    model: str = "ShardedBigClamModel",
+) -> MemoryModel:
+    """All-gather sharded trainer (parallel.sharded): the dominant
+    transient is the full gathered F copy every device materializes
+    per step — (n_pad, k_loc) regardless of dp, exactly why the ring
+    schedule exists (ring_memory_model prices the alternative)."""
+    n_loc = n_pad // max(dp, 1)
+    k_loc = k_pad // max(tp, 1)
+    state = dense_state_buffers(
+        n_pad, k_pad, dp, tp, itemsize, num_candidates, health_on
+    )
+    buffers = (
+        state
+        + _graph_buffers(graph_bytes)
+        + _scratch_buffers(_total(state), donate, rollback)
+        + [
+            Buffer(
+                "transient/F_allgather", n_pad * k_loc * itemsize,
+                "transient",
+                note="full gathered F per device — O(N*K_loc), the "
+                     "all-gather schedule's memory ceiling",
+            ),
+            Buffer("transient/grad", n_loc * k_loc * itemsize, "transient"),
+            Buffer(
+                "transient/candidates",
+                num_candidates * n_loc * itemsize, "transient",
+            ),
+        ]
+        + ([Buffer("transient/fd_gather", fd_bytes, "transient",
+                   note="per-shard dst-row gather")] if fd_bytes else [])
+        + collective_buffers(comms)
+    )
+    return MemoryModel(
+        family="sharded", model=model, buffers=tuple(buffers),
+        params={"n_pad": n_pad, "k_pad": k_pad, "dp": dp, "tp": tp,
+                "itemsize": itemsize, "donate": donate,
+                "rollback": rollback},
+    )
+
+
+def ring_memory_model(
+    n_pad: int,
+    k_pad: int,
+    dp: int,
+    tp: int,
+    itemsize: int,
+    num_candidates: int,
+    graph_bytes: Dict[str, float],
+    health_on: bool = False,
+    donate: bool = True,
+    rollback: bool = False,
+    fd_bytes: float = 0.0,
+    overlap: bool = True,
+    comms: Optional[CommsModel] = None,
+    model: str = "RingBigClamModel",
+) -> MemoryModel:
+    """Ring-pass trainer: the full-F gather is replaced by the rotating
+    shard pair — the resident rotating copy plus (with ring_overlap)
+    the in-flight double buffer, O(2 * N/dp * K_loc) peak instead of
+    O(N * K_loc). This model is the schedule's memory claim in numbers;
+    its comms model is its (higher) wire claim — the honest tradeoff."""
+    n_loc = n_pad // max(dp, 1)
+    k_loc = k_pad // max(tp, 1)
+    state = dense_state_buffers(
+        n_pad, k_pad, dp, tp, itemsize, num_candidates, health_on
+    )
+    rot_copies = 2.0 if (overlap and dp > 1) else (1.0 if dp > 1 else 0.0)
+    buffers = (
+        state
+        + _graph_buffers(graph_bytes)
+        + _scratch_buffers(_total(state), donate, rollback)
+        + ([Buffer(
+            "transient/ring_rotation", n_loc * k_loc * itemsize,
+            "transient", count=rot_copies,
+            note="rotating F shard"
+                 + (" + in-flight double buffer (ring_overlap)"
+                    if rot_copies == 2.0 else ""),
+        )] if rot_copies else [])
+        + [
+            Buffer("transient/grad", n_loc * k_loc * itemsize, "transient"),
+            Buffer(
+                "transient/candidates",
+                num_candidates * n_loc * itemsize, "transient",
+            ),
+        ]
+        + ([Buffer("transient/fd_gather", fd_bytes, "transient",
+                   note="per-phase dst-row gather")] if fd_bytes else [])
+        + collective_buffers(comms)
+    )
+    return MemoryModel(
+        family="ring", model=model, buffers=tuple(buffers),
+        params={"n_pad": n_pad, "k_pad": k_pad, "dp": dp, "tp": tp,
+                "itemsize": itemsize, "overlap": overlap,
+                "donate": donate, "rollback": rollback},
+    )
+
+
+def sparse_memory_model(
+    n_pad: int,
+    m: int,
+    k_pad: int,
+    dp: int,
+    itemsize: int,
+    num_candidates: int,
+    graph_bytes: Dict[str, float],
+    health_on: bool = False,
+    donate: bool = True,
+    rollback: bool = False,
+    comms: Optional[CommsModel] = None,
+    model: str = "SparseBigClamModel",
+) -> MemoryModel:
+    """Sparse top-M trainers (models.sparse / parallel.sparse_sharded):
+    state and the gathered member lists scale with M, not K — the whole
+    point of the representation, now visible as a model instead of a
+    gate assertion. The sharded trainer's gathered id/weight pair is
+    the dominant transient (n_pad * M per device)."""
+    n_loc = n_pad // max(dp, 1)
+    state = sparse_state_buffers(
+        n_pad, m, k_pad, dp, itemsize, num_candidates, health_on
+    )
+    buffers = (
+        state
+        + _graph_buffers(graph_bytes)
+        + _scratch_buffers(_total(state), donate, rollback)
+        + ([Buffer(
+            "transient/members_allgather", n_pad * m * (4 + itemsize),
+            "transient",
+            note="gathered member ids+weights per device (O(N*M))",
+        )] if dp > 1 else [])
+        + [
+            Buffer("transient/grad", n_loc * m * itemsize, "transient"),
+            Buffer(
+                "transient/candidates",
+                num_candidates * n_loc * itemsize, "transient",
+            ),
+        ]
+        + collective_buffers(comms)
+    )
+    return MemoryModel(
+        family="sparse", model=model, buffers=tuple(buffers),
+        params={"n_pad": n_pad, "m": m, "k_pad": k_pad, "dp": dp,
+                "itemsize": itemsize, "donate": donate,
+                "rollback": rollback},
+    )
+
+
+# -------------------------------------------------------- host RSS model
+def ingest_rss_bytes(
+    chunk_bytes: int, n: int, directed_edges: int, num_shards: int
+) -> float:
+    """The ingest pipeline's explicit RSS budget — the SAME formula
+    scripts/ingest_bench.py gates INGEST_r07 against (12 B of tokenizer
+    transients per chunk byte + 6x the largest scatter bucket + 4x the
+    int64 raw-id table + a 96 MiB allocator floor), now also a model
+    stage instead of only a gate constant."""
+    bucket_bytes = 16 * directed_edges // max(num_shards, 1)
+    idtable_bytes = 8 * n
+    return float(
+        12 * chunk_bytes + 6 * bucket_bytes + 4 * idtable_bytes
+        + (96 << 20)
+    )
+
+
+def f0_init_rss_bytes(n: int, k: int, n_pad: int, k_pad: int,
+                      itemsize: int) -> float:
+    """The host-global O(N*K) F0 init: the float64 (N, K) init array
+    (seeding / random_init_F), the padded float64 staging copy
+    (init_state), and the dtype cast handed to the device upload. THE
+    dominant host term on every path today — store-native shrinks the
+    graph stages to O(shard) but the F0 upload is still host-global
+    (ROADMAP item 1a names the per-host init_state refactor)."""
+    return float(n * k * 8 + n_pad * k_pad * (8 + itemsize))
+
+
+def host_rss_model(
+    n: int,
+    directed_edges: int,
+    k: int,
+    itemsize: int,
+    n_pad: int = 0,
+    k_pad: int = 0,
+    store_native: bool = False,
+    processes: int = 1,
+    num_shards: int = 1,
+    chunk_bytes: int = 0,
+    representation: str = "dense",
+    sparse_m: int = 0,
+) -> HostModel:
+    """Per-stage host-RSS model of a fit entry (per HOST, not per
+    device). Stages are sequential; the peak is the max stage. The
+    `f0_init` stage is host-global O(N*K) on every trainer today and is
+    flagged as such (ROADMAP 1a)."""
+    n_pad = n_pad or n
+    k_pad = k_pad or k
+    p = max(processes, 1)
+    stages: List[HostStage] = []
+    if chunk_bytes:
+        stages.append(HostStage(
+            "ingest",
+            ingest_rss_bytes(chunk_bytes, n, directed_edges, num_shards),
+            note="chunk + scatter bucket + id table (the INGEST_r07 "
+                 "budget); O(chunk), never O(file)",
+        ))
+    if store_native:
+        stages.append(HostStage(
+            "shard_load",
+            (directed_edges / p) * 12.0 + 8.0 * (n / p + num_shards),
+            note="this host's shard slice + local edge-block build "
+                 "(O(shard) — no global CSR)",
+        ))
+    else:
+        # full Graph on the host: indices (2E int32) + indptr int64 +
+        # the materialized src/dst directed-edge views the edge
+        # builders read (int32 each)
+        stages.append(HostStage(
+            "graph_load",
+            directed_edges * 12.0 + 8.0 * (n + 1),
+            note="global CSR + src/dst edge views (host-global)",
+        ))
+    stages.append(HostStage(
+        "seeding", 24.0 * n,
+        note="conductance phi/degree/order arrays (O(N))",
+    ))
+    if representation == "sparse" and sparse_m:
+        f0 = float(n * k * 8 + n_pad * sparse_m * (8 + itemsize + 4))
+        note = (
+            "dense (N, K) float64 F0 sparsified to top-M host-side — "
+            "the dense staging is still O(N*K) (ROADMAP 1a)"
+        )
+    else:
+        f0 = f0_init_rss_bytes(n, k, n_pad, k_pad, itemsize)
+        note = (
+            "host-global O(N*K) F0 init + padded staging — the "
+            "dominant host term (ROADMAP 1a: per-host init_state is "
+            "the open refactor; --store-native does NOT shrink this)"
+        )
+    stages.append(HostStage("f0_init", f0, note=note))
+    stages.append(HostStage(
+        "extract", n * k * (8.0 + itemsize),
+        note="fetched (N, K) F + float64 staging at extract_F",
+    ))
+    return HostModel(stages=tuple(stages))
+
+
+# --------------------------------------------------------- reconciliation
+def measured_device_bytes(arrays: Sequence[Any]) -> float:
+    """Exact per-device bytes of the given live arrays: every
+    addressable shard's nbytes, grouped by device, MAX over devices
+    (layouts are uniform, so max == each; max is the capacity-relevant
+    figure when they are not). Plain numpy arrays (no shard API) count
+    as resident on every device. None entries are skipped (health off).
+    """
+    per_dev: Dict[str, float] = {}
+    plain = 0.0
+    for a in arrays:
+        if a is None:
+            continue
+        shards = getattr(a, "addressable_shards", None)
+        if shards:
+            for s in shards:
+                key = str(s.device)
+                per_dev[key] = per_dev.get(key, 0.0) + s.data.nbytes
+        else:
+            nbytes = getattr(a, "nbytes", None)
+            if nbytes is None:
+                nbytes = int(a.size) * a.dtype.itemsize
+            plain += float(nbytes)
+    if not per_dev:
+        return plain
+    return max(per_dev.values()) + plain
+
+
+def nbytes_of(arr: Any) -> float:
+    """Shape-based total bytes of a (possibly globally sharded, possibly
+    not fully addressable) array — .nbytes where it exists, else
+    size * itemsize. Used by the trainers' graph-buffer accounting."""
+    nbytes = getattr(arr, "nbytes", None)
+    if nbytes is not None:
+        return float(nbytes)
+    return float(int(arr.size) * arr.dtype.itemsize)
+
+
+# ------------------------------------------------------------- emission
+def emit_model(
+    mm: MemoryModel, host: Optional[HostModel] = None
+) -> None:
+    """One `memory_model` event per device buffer (+ one per host stage
+    when a host model rides along). The FIRST device event of the batch
+    carries reset_model=True — a re-emitted model (quality mode /
+    rollback rebuilds, the sparse cap refinement) REPLACES its previous
+    buffer set in every consumer, exactly the obs.comms contract. No-op
+    with telemetry off."""
+    from bigclam_tpu.obs import telemetry as _obs
+
+    tel = _obs.current()
+    if tel is None:
+        return
+    for i, b in enumerate(mm.buffers):
+        tel.event(
+            "memory_model", model=mm.model, family=mm.family,
+            scope="device", reset_model=1 if i == 0 else 0,
+            **b.to_fields(),
+        )
+    if host is not None:
+        dom = host.dominant()
+        for j, st in enumerate(host.stages):
+            fields: Dict[str, Any] = {
+                "model": mm.model,
+                "family": mm.family,
+                "scope": "host",
+                "reset_model": 1 if j == 0 else 0,
+                "buffer": f"host/{st.stage}",
+                "stage": st.stage,
+                "bytes": round(st.bytes, 1),
+                "category": "host",
+            }
+            if st.note:
+                fields["note"] = st.note
+            if dom is not None and st.stage == dom.stage:
+                fields["dominant"] = 1
+            tel.event("memory_model", **fields)
+
+
+def emit_drift_anomaly(recon: Dict[str, Any]) -> None:
+    """A failed reconciliation as a first-class anomaly event
+    (check="memory_drift", build/probe-time: iter=-1): the live
+    addressable bytes disagree with the model past the band — a leaked
+    or retained buffer (positive drift) or stale model arithmetic
+    (negative). No-op with telemetry off."""
+    from bigclam_tpu.obs import telemetry as _obs
+
+    tel = _obs.current()
+    if tel is None:
+        return
+    tel.event(
+        "anomaly", check="memory_drift", iter=-1,
+        model=recon.get("model"),
+        modeled_bytes=recon.get("modeled_bytes"),
+        measured_bytes=recon.get("measured_bytes"),
+        drift_frac=recon.get("drift_frac"),
+        band=recon.get("band"),
+        hint="retained/leaked device buffer (positive drift) or stale "
+             "model arithmetic (negative)",
+    )
+
+
+# ------------------------------------------------------------- preflight
+def _round_up(x: int, m: int) -> int:
+    m = max(int(m), 1)
+    return ((int(x) + m - 1) // m) * m
+
+
+def _chunk_geometry(
+    max_count: int, edge_chunk: int, gather_cols: int, itemsize: int
+) -> Tuple[int, int]:
+    """(padded per-shard edge-slot count, per-scan chunk) of the XLA
+    edge-block layout — the SAME chunk arithmetic shard_edges /
+    edge_chunk_bound commit (chunk bound from the ~1 GB gather budget,
+    even chunk count, padded to chunk * ceil). The chunk is the live
+    (chunk, gather_cols) dst-row gather per scan step — the fd
+    transient the trainers' baked models price."""
+    bound = min(
+        max(edge_chunk, 1),
+        max((1 << 30) // max(gather_cols * itemsize, 1), 1024),
+    )
+    chunk = min(bound, max(max_count, 1))
+    c = max(1, -(-max(max_count, 1) // chunk))
+    return c * chunk, chunk
+
+
+def preflight(
+    n: int,
+    directed_edges: int,
+    k: int,
+    dp: int = 1,
+    tp: int = 1,
+    itemsize: int = 4,
+    num_candidates: int = 16,
+    representation: str = "dense",
+    sparse_m: int = 64,
+    support_every: int = 1,
+    schedule: str = "allgather",
+    store_native: bool = False,
+    health_every: int = 10,
+    donate: bool = True,
+    rollback: bool = True,
+    edge_chunk: int = 1 << 20,
+    shard_edge_counts: Optional[Sequence[int]] = None,
+    device_hbm_bytes: float = 0.0,
+    host_ram_bytes: float = 0.0,
+    processes: int = 1,
+    chunk_bytes: int = 0,
+    csr_block_b: int = 256,
+    rows_per_shard: int = 0,
+) -> Dict[str, Any]:
+    """The jax-free capacity verdict (`cli preflight`): build the same
+    memory + comms models the trainer would bake, from workload numbers
+    alone (cache manifest or text-size estimates), against a
+    device-kind/count target. Returns the full component breakdown, a
+    fits-or-doesn't verdict naming the BINDING constraint, and the
+    knobs that relax it. Estimates where the trainer has data the
+    preflight does not (ring bucket skew without a manifest); exact
+    shard geometry when per-shard counts are given."""
+    from bigclam_tpu.obs import comms as _comms
+
+    dp, tp = max(int(dp), 1), max(int(tp), 1)
+    sparse = representation == "sparse"
+    if sparse:
+        tp = 1
+    n_pad = _round_up(max(n, dp), dp)
+    k_pad = _round_up(k, tp)
+    k_loc = k_pad // tp
+    m = max(1, min(int(sparse_m), int(k))) if sparse else 0
+    if shard_edge_counts:
+        max_shard = max(int(c) for c in shard_edge_counts)
+        counts_known = True
+    else:
+        # uniform split + 15% power-law padding allowance, noted below
+        max_shard = int(math.ceil(directed_edges / dp * (1.15 if dp > 1
+                                                         else 1.0)))
+        counts_known = False
+
+    gather_cols = m if sparse else k_loc
+    notes: List[str] = []
+    if not counts_known and dp > 1:
+        notes.append(
+            "per-shard edge counts estimated (uniform split +15%); "
+            "compile a cache and pass it for exact shard geometry"
+        )
+
+    # --- graph buffers + comms model per family ---
+    if sparse:
+        slots, _chunk = _chunk_geometry(max_shard, edge_chunk, m,
+                                        itemsize)
+        graph = {"graph/edge_blocks": slots * (8.0 + itemsize)}
+        # support blocks: every directed edge once + block rounding
+        graph["graph/support_blocks"] = (
+            directed_edges / dp * 1.1 * (8.0 + itemsize)
+        )
+        cap = min(_round_up(max(8 * m, 8), 8), k_pad)
+        mode = "sparse" if dp > 1 and cap < 0.5 * k_pad else "dense"
+        comms = _comms.sparse_step_model(
+            n_pad, m, k_pad, dp, itemsize, num_candidates, cap, mode,
+            support_every=support_every, health_every=health_every,
+        ) if dp > 1 else None
+        mm = sparse_memory_model(
+            n_pad, m, k_pad, dp, itemsize, num_candidates, graph,
+            health_on=health_every > 0, donate=donate, rollback=rollback,
+            comms=comms,
+            model="SparseShardedBigClamModel" if dp > 1
+            else "SparseBigClamModel",
+        )
+    elif schedule == "ring" and dp > 1:
+        # per-(shard, phase) buckets padded to the max bucket; without
+        # bucket data assume the balanced distribution (what a
+        # --balance ingest delivers — an unbalanced cache can be up to
+        # dp x worse, which the trainer warns about at build)
+        max_bucket = int(math.ceil(max_shard / dp))
+        padded, _chunk = _chunk_geometry(max_bucket, edge_chunk,
+                                         gather_cols, itemsize)
+        slots = dp * padded
+        graph = {"graph/ring_buckets": slots * (8.0 + itemsize)}
+        fd = _chunk * gather_cols * itemsize
+        comms = _comms.ring_step_model(
+            n_pad, k_pad, dp, tp, itemsize, num_candidates,
+            bucket_slots=padded, health_every=health_every,
+        )
+        mm = ring_memory_model(
+            n_pad, k_pad, dp, tp, itemsize, num_candidates, graph,
+            health_on=health_every > 0, donate=donate,
+            rollback=rollback, fd_bytes=fd, comms=comms,
+        )
+        notes.append(
+            "ring buckets priced at the balanced distribution — an "
+            "unbalanced cache pads up to dp x worse (ingest --balance)"
+        )
+    else:
+        slots, _chunk = _chunk_geometry(max_shard, edge_chunk,
+                                        gather_cols, itemsize)
+        graph = {"graph/edge_blocks": slots * (8.0 + itemsize)}
+        # the live per-scan (chunk, K_loc) dst gather — the same fd
+        # transient the trainers' baked models price on every family
+        fd = _chunk * gather_cols * itemsize
+        comms = _comms.sharded_step_model(
+            n_pad, k_pad, dp, tp, itemsize, num_candidates,
+            edge_slots=slots, health_every=health_every,
+        ) if dp * tp > 1 else None
+        if dp * tp > 1:
+            mm = sharded_memory_model(
+                n_pad, k_pad, dp, tp, itemsize, num_candidates, graph,
+                health_on=health_every > 0, donate=donate,
+                rollback=rollback, fd_bytes=fd, comms=comms,
+            )
+        else:
+            mm = dense_memory_model(
+                n_pad, k_pad, itemsize, num_candidates, graph,
+                health_on=health_every > 0, donate=donate,
+                rollback=rollback, fd_bytes=fd,
+            )
+
+    host = host_rss_model(
+        n, directed_edges, k, itemsize, n_pad=n_pad, k_pad=k_pad,
+        store_native=store_native, processes=processes,
+        num_shards=dp if store_native else max(dp, 1),
+        chunk_bytes=chunk_bytes, representation=representation,
+        sparse_m=m,
+    )
+
+    # --- verdict: which constraint binds? ---
+    hbm = mm.hbm_bytes()
+    host_peak = host.peak_bytes()
+    hbm_budget = float(device_hbm_bytes) * (1.0 - HBM_HEADROOM_FRAC) \
+        if device_hbm_bytes else 0.0
+    fits_hbm = not hbm_budget or hbm <= hbm_budget
+    fits_host = not host_ram_bytes or host_peak <= float(host_ram_bytes)
+    fits = fits_hbm and fits_host
+    binding = None
+    if not fits_hbm and not fits_host:
+        binding = (
+            "hbm"
+            if hbm / max(hbm_budget, 1.0)
+            >= host_peak / max(float(host_ram_bytes), 1.0)
+            else "host_rss"
+        )
+    elif not fits_hbm:
+        binding = "hbm"
+    elif not fits_host:
+        binding = "host_rss"
+
+    # --- the knobs that relax the binding constraint ---
+    knobs: List[str] = []
+    cat = mm.category_bytes()
+    if not fits_hbm:
+        if not sparse and (k_pad * itemsize) > 256:
+            m_hint = max(min(64, k // 4), 1)
+            knobs.append(
+                f"--representation sparse --sparse-m {m_hint}: state "
+                "and member exchange scale with M, not K "
+                f"(state {_fmt_bytes(cat.get('state', 0))} -> "
+                f"~{_fmt_bytes(n_pad // dp * m_hint * (4 + itemsize))} "
+                "ids+weights)"
+            )
+        if dp * tp < 64:
+            knobs.append(
+                f"--mesh {dp * 2},{tp}: per-device state/graph shrink "
+                "~1/dp"
+            )
+        if schedule != "ring" and dp > 1:
+            knobs.append(
+                "--schedule ring: O(2 * N/dp) rotating shards replace "
+                "the full per-device F gather "
+                f"({_fmt_bytes(mm.buffer_bytes().get('transient/F_allgather', 0))})"
+            )
+    if not fits_host:
+        if not store_native:
+            knobs.append(
+                "--store-native (after `cli ingest`): graph stages drop "
+                "to O(shard) host RSS — the F0 init stays host-global "
+                "(ROADMAP 1a)"
+            )
+        dom = host.dominant()
+        if dom is not None and dom.stage == "f0_init":
+            knobs.append(
+                "the binding stage is the host-global O(N*K) F0 init — "
+                "no CLI knob relaxes it yet (ROADMAP 1a: per-host "
+                "init_state)"
+            )
+    if rows_per_shard and csr_block_b and rows_per_shard % csr_block_b:
+        notes.append(
+            f"cache rows_per_shard={rows_per_shard} is not a multiple "
+            f"of csr_block_b={csr_block_b}: the store-native CSR tile "
+            "kernels will NOT engage (re-ingest block-aligned or set "
+            "csr_block_b to a divisor)"
+        )
+    if not sparse:
+        # the CSR tile layout's graph bytes at the default tile shape —
+        # the tile-shape knob in numbers (ops.csr_tiles owns the
+        # closed-form; built layouts agree by construction)
+        from bigclam_tpu.ops.csr_tiles import tile_layout_nbytes
+
+        tile_t = 512
+        n_blocks = max((n_pad // dp) // max(csr_block_b, 1), 1)
+        est_tiles = -(-max_shard // tile_t) + n_blocks
+        csr_graph = tile_layout_nbytes(est_tiles, tile_t, itemsize)
+        notes.append(
+            f"csr tile layout (block_b={csr_block_b}, tile_t={tile_t}) "
+            f"estimated at {_fmt_bytes(csr_graph)}/device vs "
+            f"{_fmt_bytes(sum(graph.values()))} edge blocks — tile pad "
+            "waste scales with blocks, shrink csr_block_b on skewed "
+            "graphs"
+        )
+
+    return {
+        "workload": {
+            "n": int(n),
+            "directed_edges": int(directed_edges),
+            "k": int(k),
+            "representation": representation,
+            **({"sparse_m": m} if sparse else {}),
+            "mesh": f"{dp}x{tp}",
+            "schedule": schedule,
+            "store_native": bool(store_native),
+            "itemsize": itemsize,
+            "shard_counts_known": counts_known,
+        },
+        "device": mm.to_dict(),
+        "host": host.to_dict(),
+        "comms_bytes_per_step": (
+            round(comms.bytes_per_step(), 1) if comms is not None else 0.0
+        ),
+        "hbm_bytes_per_device": round(hbm, 1),
+        "hbm_budget_bytes": round(hbm_budget, 1),
+        "host_rss_bytes": round(host_peak, 1),
+        "host_ram_bytes": round(float(host_ram_bytes), 1),
+        "fits": fits,
+        "fits_hbm": fits_hbm,
+        "fits_host": fits_host,
+        "binding": binding,
+        "knobs": knobs,
+        "notes": notes,
+    }
+
+
+def _fmt_bytes(v: float) -> str:
+    # the shared obs byte formatter (lazy import: report pulls telemetry
+    # at import, which preflight-only callers should not pay up front)
+    from bigclam_tpu.obs.report import _fmt_bytes as fmt
+
+    return fmt(v)
+
+
+def render_preflight(p: Dict[str, Any]) -> str:
+    """Human rendering of a preflight() verdict (`cli preflight`)."""
+    w = p["workload"]
+    lines = [
+        f"preflight: N={w['n']}  2E={w['directed_edges']}  K={w['k']}"
+        f"  {w['representation']}"
+        + (f" M={w['sparse_m']}" if w.get("sparse_m") else "")
+        + f"  mesh {w['mesh']}  schedule {w['schedule']}"
+        + ("  store-native" if w["store_native"] else ""),
+        "",
+        f"per-device HBM (modeled): {_fmt_bytes(p['hbm_bytes_per_device'])}"
+        + (
+            f"  vs budget {_fmt_bytes(p['hbm_budget_bytes'])}"
+            f" ({'fits' if p['fits_hbm'] else 'DOES NOT FIT'})"
+            if p["hbm_budget_bytes"]
+            else "  (no device budget given: --device-kind or --hbm-gb)"
+        ),
+    ]
+    for cat, b in sorted(
+        p["device"]["by_category"].items(), key=lambda kv: -kv[1]
+    ):
+        lines.append(f"  {cat:<12} {_fmt_bytes(b):>12}")
+    top = sorted(
+        p["device"]["buffers"], key=lambda b: -b["bytes"]
+    )[:6]
+    for b in top:
+        lines.append(
+            f"    {b['buffer']:<28} {_fmt_bytes(b['bytes']):>12}"
+        )
+    lines.append("")
+    lines.append(
+        f"per-host RSS (modeled peak): {_fmt_bytes(p['host_rss_bytes'])}"
+        + (
+            f"  vs {_fmt_bytes(p['host_ram_bytes'])}"
+            f" ({'fits' if p['fits_host'] else 'DOES NOT FIT'})"
+            if p["host_ram_bytes"]
+            else ""
+        )
+    )
+    dom = p["host"].get("dominant_stage")
+    for s in p["host"]["stages"]:
+        mark = "  <- dominant" if s["stage"] == dom else ""
+        lines.append(
+            f"  {s['stage']:<12} {_fmt_bytes(s['bytes']):>12}{mark}"
+        )
+    if p["comms_bytes_per_step"]:
+        lines.append("")
+        lines.append(
+            "collective traffic (modeled): "
+            f"{_fmt_bytes(p['comms_bytes_per_step'])}/step"
+        )
+    lines.append("")
+    verdict = "FITS" if p["fits"] else (
+        f"DOES NOT FIT (binding: {p['binding']})"
+    )
+    lines.append(f"verdict: {verdict}")
+    for knob in p["knobs"]:
+        lines.append(f"  knob: {knob}")
+    for note in p["notes"]:
+        lines.append(f"  note: {note}")
+    return "\n".join(lines)

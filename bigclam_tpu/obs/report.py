@@ -189,6 +189,17 @@ def merge_reports(reports: List[dict]) -> dict:
         ),
         None,
     )
+    # memory model (obs.memory, ISSUE 12): every process bakes the same
+    # SPMD step, so the models agree — the first report carrying one is
+    # authoritative (same rule as comms)
+    merged["memory_model"] = next(
+        (
+            (r.get("memory", {}) or {}).get("modeled")
+            for r in reports
+            if (r.get("memory", {}) or {}).get("modeled")
+        ),
+        None,
+    )
     from bigclam_tpu.obs.comms import sync_seconds
 
     sync_by_pid = {}
@@ -243,14 +254,20 @@ def _load_lineage(directory: str) -> List[dict]:
         return []
 
 
-def _fmt_bytes(v: Optional[int]) -> str:
+def _fmt_bytes(v: Optional[float]) -> str:
+    """The ONE byte formatter of the obs rendering layer (report, watch,
+    and obs.memory's preflight all import it — two formatters for the
+    same quantities would drift)."""
     if v is None:
         return "-"
+    v = float(v)
     if v >= 1 << 30:
         return f"{v / (1 << 30):.2f} GiB"
     if v >= 1 << 20:
         return f"{v / (1 << 20):.1f} MiB"
-    return f"{v} B"
+    if v >= 1 << 10:
+        return f"{v / (1 << 10):.1f} KiB"
+    return f"{v:.0f} B"
 
 
 def render_json(directory: str) -> Tuple[dict, int]:
@@ -311,6 +328,7 @@ def render_json(directory: str) -> Tuple[dict, int]:
         },
         "health": (merged or {}).get("health", {}),
         "comms": (merged or {}).get("comms"),
+        "memory_model": (merged or {}).get("memory_model"),
         "sync_by_pid": (merged or {}).get("sync_by_pid", {}),
         "anomalies": anomalies,
         "recovery": {
@@ -425,6 +443,70 @@ def render(directory: str) -> Tuple[str, int]:
             lines.append(
                 "  (none sampled — CPU backend or device telemetry off)"
             )
+
+        # --- static memory model (obs.memory, ISSUE 12): modeled
+        # per-device HBM by component next to the measured watermarks
+        # above, and the per-stage host-RSS model with its dominant
+        # stage named — the capacity story `cli preflight` predicts,
+        # rendered from what the run actually baked.
+        mm = merged.get("memory_model") or {}
+        if mm.get("buffers"):
+            lines.append("")
+            lines.append(
+                "memory model (per device, modeled): "
+                f"{_fmt_bytes(int(mm.get('hbm_bytes_per_device', 0)))}"
+                f" ({_fmt_bytes(int(mm.get('addressable_bytes', 0)))}"
+                " addressable state+graph)"
+            )
+            for cat, b in sorted(
+                (mm.get("by_category") or {}).items(),
+                key=lambda kv: -kv[1],
+            ):
+                lines.append(f"  {cat:<12} {_fmt_bytes(int(b)):>12}")
+            for name, b in sorted(
+                (mm.get("buffers") or {}).items(), key=lambda kv: -kv[1]
+            )[:8]:
+                lines.append(
+                    f"    {name:<30} {_fmt_bytes(int(b)):>12}"
+                )
+            # modeled vs measured headroom, when the allocator reported
+            # watermarks (TPU; the CPU fake reports none)
+            measured = [
+                v for v in (
+                    (stats.get("peak_bytes_in_use")
+                     or stats.get("bytes_in_use"))
+                    for stats in merged["device_peak"].values()
+                )
+                if isinstance(v, (int, float))
+            ]
+            if measured:
+                peak = max(measured)
+                modeled = float(mm.get("hbm_bytes_per_device", 0) or 0)
+                lines.append(
+                    f"  measured peak {_fmt_bytes(int(peak))} vs "
+                    f"modeled {_fmt_bytes(int(modeled))}"
+                    + (
+                        f" (measured/modeled {peak / modeled:.2f}x)"
+                        if modeled
+                        else ""
+                    )
+                )
+        if mm.get("host_stages"):
+            lines.append("")
+            dom = mm.get("host_dominant_stage")
+            lines.append(
+                "host RSS model (per stage, modeled peak "
+                f"{_fmt_bytes(int(mm.get('host_rss_bytes') or 0))}):"
+            )
+            for stage, b in sorted(
+                mm["host_stages"].items(), key=lambda kv: -kv[1]
+            ):
+                mark = "  <- dominant (host-global O(N*K) F0, " \
+                    "ROADMAP 1a)" if stage == dom and stage == "f0_init" \
+                    else ("  <- dominant" if stage == dom else "")
+                lines.append(
+                    f"  {stage:<12} {_fmt_bytes(int(b)):>12}{mark}"
+                )
         comp = merged["compiles"]
         lines.append("")
         lines.append(

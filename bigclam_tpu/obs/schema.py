@@ -100,6 +100,15 @@ EVENT_KINDS = {
     # tile-pad waste): max/mean/skew/cv + the arg-max shard. Crossing
     # the imbalance threshold additionally fires an `anomaly` event
     # (check="imbalance", iter=-1 — build-time, not an iteration)
+    # --- memory accounting (obs.memory, ISSUE 12) ---
+    "memory_model": {"buffer": (str,), "bytes": _NUM},
+    # one buffer of a trainer's static memory model, baked at step
+    # build: scope="device" rows are per-device HBM (category state /
+    # graph / scratch / transient / collective), scope="host" rows are
+    # the per-stage host-RSS model (stage + dominant flag). Re-emitted
+    # models REPLACE their site set via reset_model on the batch's
+    # first event, exactly like `comms`. Live-vs-model drift past the
+    # band fires an `anomaly` event (check="memory_drift", iter=-1)
 }
 
 _BASE = {

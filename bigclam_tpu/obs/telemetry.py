@@ -341,6 +341,16 @@ class RunTelemetry:
         # whether the run died WAITING on the gang or computing.
         self._comms_by_model: Dict[str, Dict[str, float]] = {}
         self.last_sync_s: Optional[float] = None
+        # memory accounting (obs.memory, ISSUE 12): modeled per-device
+        # HBM buffers and per-host RSS stages, keyed per MODEL with the
+        # same reset_model replace-the-whole-set contract as comms (a
+        # quality/rollback rebuild re-emits; stale buffers must not
+        # inflate the total). Values are (bytes, category) pairs so the
+        # report can split addressable (state+graph) from scratch/
+        # transient/collective.
+        self._mem_by_model: Dict[str, Dict[str, tuple]] = {}
+        self._mem_host_by_model: Dict[str, Dict[str, float]] = {}
+        self._mem_host_dominant: Optional[str] = None
         # tag -> number of watermark samples; dev -> running max stats
         self.watermark_tags: Dict[str, int] = {}
         self.device_peak: Dict[str, Dict[str, Optional[int]]] = {}
@@ -410,6 +420,32 @@ class RunTelemetry:
                     sites[str(fields.get("site", "?"))] = float(
                         fields.get("bytes_per_step", 0.0) or 0.0
                     )
+                except (TypeError, ValueError):
+                    pass
+            elif kind == "memory_model":
+                model = str(fields.get("model", "?"))
+                host_scope = fields.get("scope") == "host"
+                target = (
+                    self._mem_host_by_model
+                    if host_scope
+                    else self._mem_by_model
+                )
+                if fields.get("reset_model"):
+                    target[model] = {}
+                bufs = target.setdefault(model, {})
+                try:
+                    b = float(fields.get("bytes", 0.0) or 0.0)
+                    name = str(fields.get("buffer", "?"))
+                    if host_scope:
+                        bufs[name] = b
+                        if fields.get("dominant"):
+                            self._mem_host_dominant = str(
+                                fields.get("stage", name)
+                            )
+                    else:
+                        bufs[name] = (
+                            b, str(fields.get("category", ""))
+                        )
                 except (TypeError, ValueError):
                     pass
             if not self._gated:
@@ -551,6 +587,19 @@ class RunTelemetry:
         """Sample device memory, fold into the per-device running peaks,
         and emit a `memory` event. Called at stage boundaries (the sink)
         and explicitly after big placements (model build, edge upload)."""
+        devices = self.sample_device_peak(tag)
+        if not devices:
+            return []
+        self.event("memory", tag=tag, devices=devices)
+        return devices
+
+    def sample_device_peak(self, tag: str) -> List[dict]:
+        """Fold one device-memory sample into the running per-device
+        peaks WITHOUT emitting an event. The heartbeat calls this on its
+        poll cadence (ISSUE 12 fix): stage-boundary-only sampling made a
+        peak INSIDE a long fit stage invisible — the running max now
+        sees intra-stage transients too, without flooding the event log
+        at the poll rate (stalls still carry full snapshots)."""
         devices = self.device_memory_snapshot()
         if not devices:
             return []
@@ -568,8 +617,23 @@ class RunTelemetry:
                         peak[key] is None or v > peak[key]
                     ):
                         peak[key] = v
-        self.event("memory", tag=tag, devices=devices)
         return devices
+
+    def hbm_modeled_bytes(self) -> Optional[float]:
+        """Total modeled per-device HBM over the emitted memory models
+        (obs.memory), or None when no trainer baked one — the figure
+        heartbeat stall events embed next to the measured device
+        snapshot, and the watch headroom line reads."""
+        with self._lock:
+            if not self._mem_by_model:
+                return None
+            return round(
+                sum(
+                    b for bufs in self._mem_by_model.values()
+                    for b, _cat in bufs.values()
+                ),
+                1,
+            )
 
     # ------------------------------------------------------------ compile
     def record_step_build(self, key: str) -> None:
@@ -675,6 +739,12 @@ class RunTelemetry:
                         k: dict(v) for k, v in self.device_peak.items()
                     },
                     "watermark_tags": dict(self.watermark_tags),
+                    # static memory model (obs.memory, ISSUE 12): the
+                    # modeled per-device HBM buffers + per-host RSS
+                    # stages the trainer builds emitted — the perf
+                    # ledger's hbm_modeled_bytes / host_rss_modeled_
+                    # bytes source, rendered by `cli report`
+                    "modeled": self._memory_modeled_locked(),
                 },
                 "compiles": {
                     **{k: v for k, v in self.compiles.items()},
@@ -704,6 +774,43 @@ class RunTelemetry:
                 "events": dict(self.event_counts),
                 "final": dict(self.final),
             }
+
+    def _memory_modeled_locked(self) -> Optional[Dict[str, Any]]:
+        """The memory-model summary for the run report (caller holds the
+        lock via report()): per-buffer/per-category device totals summed
+        over emitted models (reset_model replaced stale sets already)
+        and the host-stage table. None when no model was emitted."""
+        if not self._mem_by_model and not self._mem_host_by_model:
+            return None
+        buffers: Dict[str, float] = {}
+        by_cat: Dict[str, float] = {}
+        addressable = 0.0
+        for bufs in self._mem_by_model.values():
+            for name, (b, cat) in bufs.items():
+                buffers[name] = round(buffers.get(name, 0.0) + b, 1)
+                by_cat[cat] = by_cat.get(cat, 0.0) + b
+                if cat in ("state", "graph"):
+                    addressable += b
+        host_stages: Dict[str, float] = {}
+        for stages in self._mem_host_by_model.values():
+            for name, b in stages.items():
+                stage = name.split("/", 1)[-1]
+                host_stages[stage] = round(
+                    max(host_stages.get(stage, 0.0), b), 1
+                )
+        return {
+            "hbm_bytes_per_device": round(sum(by_cat.values()), 1),
+            "addressable_bytes": round(addressable, 1),
+            "by_category": {k: round(v, 1) for k, v in by_cat.items()},
+            "buffers": buffers,
+            "host_stages": host_stages,
+            "host_rss_bytes": (
+                round(max(host_stages.values()), 1)
+                if host_stages
+                else None
+            ),
+            "host_dominant_stage": self._mem_host_dominant,
+        }
 
     def report_path(self) -> str:
         pid = _process_index()

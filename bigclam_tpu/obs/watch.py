@@ -169,6 +169,53 @@ def _render_events(
             f"  comms {_fmt_bytes(int(sum(sites)))}/step modeled over "
             f"{len(sites)} site(s)"
         )
+    # memory model vs measured headroom (obs.memory, ISSUE 12): the
+    # modeled per-device HBM (memory_model events, reset_model replace
+    # semantics like comms) against the latest watermark's measured
+    # in-use + the device limit — "will this fit" rendered live
+    mem_by_model = {}
+    for e in events:
+        if e.get("kind") != "memory_model" or e.get("scope") == "host":
+            continue
+        if not isinstance(e.get("bytes"), (int, float)):
+            continue
+        model = str(e.get("model", "?"))
+        if e.get("reset_model"):
+            mem_by_model[model] = {}
+        mem_by_model.setdefault(model, {})[
+            str(e.get("buffer", "?"))
+        ] = float(e["bytes"])
+    if mem_by_model:
+        from bigclam_tpu.obs.report import _fmt_bytes
+
+        modeled = sum(
+            v for bufs in mem_by_model.values() for v in bufs.values()
+        )
+        measured = limit = None
+        for e in reversed(events):
+            if e.get("kind") == "memory" and e.get("devices"):
+                vals = [
+                    d.get("bytes_in_use") for d in e["devices"]
+                    if isinstance(d.get("bytes_in_use"), (int, float))
+                ]
+                lims = [
+                    d.get("bytes_limit") for d in e["devices"]
+                    if isinstance(d.get("bytes_limit"), (int, float))
+                ]
+                if vals:
+                    measured = max(vals)
+                if lims:
+                    limit = max(lims)
+                break
+        line = f"  hbm modeled {_fmt_bytes(int(modeled))}/device"
+        if measured is not None:
+            line += f"  measured {_fmt_bytes(int(measured))}"
+        if limit:
+            line += (
+                f"  headroom {_fmt_bytes(int(limit - modeled))}"
+                f" of {_fmt_bytes(int(limit))}"
+            )
+        lines.append(line)
     balances = [e for e in events if e.get("kind") == "balance"]
     if balances:
         b = balances[-1]

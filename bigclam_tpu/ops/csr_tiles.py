@@ -155,6 +155,22 @@ def tile_pad_stats(mask: np.ndarray) -> dict:
     }
 
 
+def tile_layout_nbytes(
+    n_tiles: int, tile_t: int, itemsize: int, per_tile_int32: int = 1
+) -> int:
+    """Device bytes of a blocked-CSR tile layout with `n_tiles` tiles of
+    `tile_t` edge slots each: src_local + dst (int32) + mask (model
+    dtype) per slot, plus `per_tile_int32` int32 words per tile (the
+    block-id array). The closed-form twin of summing the built arrays'
+    nbytes — the jax-free capacity preflight (obs.memory) prices
+    un-built CSR layouts with it, and the built layouts agree by
+    construction (same slot arithmetic as tile_pad_stats)."""
+    slots = int(n_tiles) * int(tile_t)
+    return slots * (8 + int(itemsize)) + int(n_tiles) * 4 * int(
+        per_tile_int32
+    )
+
+
 def layout_economical(
     slots: int, num_directed_edges: int, n_blocks_total: int, tile_t: int
 ) -> bool:

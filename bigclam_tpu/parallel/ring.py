@@ -891,6 +891,32 @@ class RingBigClamModel(ShardedBigClamModel):
             model=type(self).__name__,
         )
 
+    def _build_memory_model(self):
+        """Ring memory model (obs.memory, ISSUE 12): the rotating-shard
+        pair replaces the all-gather's full F copy — the O(2 * N/dp *
+        K_loc) peak-HBM claim of this schedule, now a model instead of a
+        docstring (its comms model carries the matching HIGHER wire
+        claim; together they are the tradeoff in numbers)."""
+        from bigclam_tpu.obs import memory as _mem
+
+        cfg = self.cfg
+        return _mem.ring_memory_model(
+            self.n_pad,
+            self.k_pad,
+            self.mesh.shape[NODES_AXIS],
+            self.mesh.shape[K_AXIS],
+            jnp.dtype(self.dtype).itemsize,
+            len(cfg.step_candidates),
+            self._graph_buffer_bytes(),
+            health_on=int(getattr(cfg, "health_every", 0) or 0) > 0,
+            donate=bool(cfg.donate_state),
+            rollback=int(getattr(cfg, "rollback_budget", 0) or 0) > 0,
+            fd_bytes=self._memory_fd_bytes(),
+            overlap=bool(cfg.ring_overlap),
+            comms=self.comms,
+            model=type(self).__name__,
+        )
+
     def _csr_economy_ok(self, dp: int) -> bool:
         """Probe the ring tile layout: dp*dp buckets padded to the max tile
         count (empty buckets cost one tile each), per-phase fd gather

@@ -37,6 +37,7 @@ from bigclam_tpu.models.bigclam import (
     FLAT_FD_BUDGET,
     GROUP_FD_BUDGET,
     FitResult,
+    MemoryAccountedModel,
     TrainState,
     _lcm,
     _round_up,
@@ -621,7 +622,7 @@ def make_sharded_train_step(
     return attach_donating(step_fn, step, fixed_args=step_fn.jit_args)
 
 
-class ShardedBigClamModel:
+class ShardedBigClamModel(MemoryAccountedModel):
     """Multi-chip BigCLAM trainer over a (nodes, k) mesh.
 
     Mirrors models.BigClamModel's API; identical trajectories (the sharding
@@ -691,6 +692,11 @@ class ShardedBigClamModel:
         # on the model for the reconciliation gate (comms_measured)
         self.comms = self._build_comms_model()
         self._emit_comms_and_balance()
+        # static memory model (obs.memory, ISSUE 12): the per-device
+        # HBM + per-host RSS twin of the comms model, baked from the
+        # SAME committed layout (collective scratch priced from the
+        # comms Sites just built)
+        self._bake_memory_model()
 
     @property
     def engaged_path(self) -> str:
@@ -765,6 +771,59 @@ class ShardedBigClamModel:
 
         return self.comms.remeasure(
             _comms.measured_payloads(self.comms.family, state)
+        )
+
+    # ------------------------------------------ memory model (ISSUE 12)
+    def _graph_device_arrays(self) -> dict:
+        if self._csr_wanted:
+            t = self._tiles_dev
+            return {
+                "graph/tiles_src": t["src_local"],
+                "graph/tiles_dst": t.get("dst", t.get("dst_local")),
+                "graph/tiles_mask": t["mask"],
+                "graph/tiles_block_id": t["block_id"],
+            }
+        return {
+            "graph/edges_src": self.edges.src,
+            "graph/edges_dst": self.edges.dst,
+            "graph/edges_mask": self.edges.mask,
+        }
+
+    def _memory_fd_bytes(self) -> float:
+        """Per-shard dst-row gather bytes: one group/phase window on the
+        grouped/ring CSR layouts, the whole per-shard tile set on the
+        flat layout, (chunk, K_loc) per scan step on XLA."""
+        isz = jnp.dtype(self.dtype).itemsize
+        k_loc = self.k_pad // self.mesh.shape[K_AXIS]
+        cols = getattr(self, "_csr_kc", 0) or k_loc
+        if self._csr_wanted:
+            t = self._tiles_dev
+            dst = t.get("dst", t.get("dst_local"))
+            if dst.ndim >= 4:      # grouped (dp, ng, G, T) / ring
+                per = float(np.prod(dst.shape[2:]))   # (dp, dp, nt, T)
+            else:                  # flat (dp, nt, T)
+                per = float(np.prod(dst.shape[1:]))
+            return per * cols * isz
+        return float(self.edges.src.shape[-1]) * cols * isz
+
+    def _build_memory_model(self):
+        from bigclam_tpu.obs import memory as _mem
+
+        cfg = self.cfg
+        return _mem.sharded_memory_model(
+            self.n_pad,
+            self.k_pad,
+            self.mesh.shape[NODES_AXIS],
+            self.mesh.shape[K_AXIS],
+            jnp.dtype(self.dtype).itemsize,
+            len(cfg.step_candidates),
+            self._graph_buffer_bytes(),
+            health_on=int(getattr(cfg, "health_every", 0) or 0) > 0,
+            donate=bool(cfg.donate_state),
+            rollback=int(getattr(cfg, "rollback_budget", 0) or 0) > 0,
+            fd_bytes=self._memory_fd_bytes(),
+            comms=self.comms,
+            model=type(self).__name__,
         )
 
     def _to_internal_rows(self, F0: np.ndarray) -> np.ndarray:

@@ -384,6 +384,13 @@ class SparseShardedBigClamModel(SparseBigClamModel):
 
         self.comms = self._build_comms_model()
         _comms.emit_model(self.comms)
+        # memory model rides the collective layout (obs.memory, ISSUE
+        # 12): re-bake + re-emit (reset_model) when the cap refinement
+        # moves it, so the run report prices the step that actually
+        # runs. Skipped during _setup — the parent bakes the first
+        # model once the step exists.
+        if getattr(self, "memory", None) is not None:
+            self._bake_memory_model()
 
     def _emit_comm_event(self, touched_per_shard: int) -> None:
         """ISSUE 8 satellite: the sparse-collective layout (cap, static
@@ -407,6 +414,18 @@ class SparseShardedBigClamModel(SparseBigClamModel):
                 m=int(self.m),
                 dp=int(self.dp),
             )
+
+    def _graph_device_arrays(self) -> dict:
+        e = self._edges
+        sl, dd, mm = self._blocks
+        return {
+            "graph/edges_src": e.src,
+            "graph/edges_dst": e.dst,
+            "graph/edges_mask": e.mask,
+            "graph/support_src": sl,
+            "graph/support_dst": dd,
+            "graph/support_mask": mm,
+        }
 
     def _build_comms_model(self):
         from bigclam_tpu.obs import comms as _comms

@@ -1,0 +1,332 @@
+"""Memory-accounting gate (ISSUE 12): prove, on CPU fakes, that the
+static HBM/host-RSS models, the live reconciliation, and the preflight
+verdicts do what they claim — deterministically — and cost nothing on
+the trajectory.
+
+Six check groups, the ISSUE 12 acceptance criteria verbatim:
+
+  model_vs_live   the static per-device HBM model baked at step build
+                  EQUALS the live addressable-shard byte sum (drift 0 —
+                  exact, not banded, on the CPU fake) for all four
+                  trainer families: dense single-chip (XLA + CSR
+                  interpret), all-gather sharded (dp 2 and 4, tp 2),
+                  ring, and sparse (single-chip + sharded), across
+                  rollback on/off
+  leak            a planted retained buffer (an F-sized copy the model
+                  does not know) fires EXACTLY the memory_drift
+                  anomaly; the clean reconcile fires none
+  preflight       `cli preflight` (jax-free in-process) returns the
+                  correct fits/doesn't verdict: an over-sized dense
+                  config against a fake device limit exits 2 naming
+                  hbm as binding, the same config relaxed with
+                  --representation sparse exits 0
+  perf diff       `cli perf diff` exits 2 on an injected
+                  hbm_modeled_bytes regression and 0 on the identical
+                  re-run
+  identity        accounting-on trajectories are bit-identical to
+                  accounting-off (the model is host-side arithmetic at
+                  build time — it never touches the math)
+  overhead        the per-iteration observability path stays within
+                  the existing < 2% pin (the memory layer added no
+                  per-iteration work; the heartbeat-cadence watermark
+                  rides the watchdog thread)
+
+    python scripts/memory_gate.py [MEM_r16.json]
+
+Exit 0 iff every check passes.
+"""
+
+import json
+import os
+import sys
+import tempfile
+import time
+import warnings
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def main() -> int:
+    out_path = sys.argv[1] if len(sys.argv) > 1 else None
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_enable_x64", True)
+    from bigclam_tpu.utils.dist import request_cpu_devices
+
+    request_cpu_devices(8)
+
+    import jax.numpy as jnp
+
+    from bigclam_tpu.config import BigClamConfig
+    from bigclam_tpu.models import BigClamModel, SparseBigClamModel
+    from bigclam_tpu.models.agm import sample_planted_graph
+    from bigclam_tpu.obs import (
+        RunTelemetry,
+        install,
+        uninstall,
+        validate_events_file,
+    )
+    from bigclam_tpu.obs import ledger as L
+    from bigclam_tpu.obs.report import load_events
+    from bigclam_tpu.obs.telemetry import EVENTS_NAME
+    from bigclam_tpu.parallel import (
+        RingBigClamModel,
+        ShardedBigClamModel,
+        SparseShardedBigClamModel,
+        make_mesh,
+    )
+
+    checks = {}
+    detail = {}
+
+    g, _ = sample_planted_graph(
+        256, 4, p_in=0.3, rng=np.random.default_rng(0)
+    )
+    F0 = np.random.default_rng(1).uniform(0.1, 1.0, size=(g.num_nodes, 4))
+
+    def base_cfg(**kw):
+        d = dict(num_communities=4, dtype="float64", max_iters=6,
+                 conv_tol=0.0, health_every=1)
+        d.update(kw)
+        return BigClamConfig(**d)
+
+    # --- 1. modeled == live addressable bytes, EXACT, four families --
+    recons = {}
+
+    def exact(name, model, state):
+        r = model.memory_reconcile(state, emit=False)
+        recons[name] = {
+            "modeled_bytes": r["modeled_bytes"],
+            "measured_bytes": r["measured_bytes"],
+            "drift_frac": r["drift_frac"],
+            "hbm_modeled_bytes": r["hbm_modeled_bytes"],
+        }
+        checks[f"exact_{name}"] = (
+            r["modeled_bytes"] == r["measured_bytes"]
+            and r["drift_frac"] == 0.0
+        )
+
+    for rollback in (0, 3):
+        tag = f"_rb{rollback}" if rollback else ""
+        m = BigClamModel(g, base_cfg(rollback_budget=rollback))
+        st = m._step(m.init_state(F0))
+        exact(f"dense{tag}", m, st)
+    mc = BigClamModel(g, base_cfg(
+        dtype="float32", use_pallas_csr=True, pallas_interpret=True,
+        csr_block_b=64, csr_tile_t=64,
+    ))
+    exact("dense_csr", mc, mc._step(mc.init_state(F0)))
+    for dp in (2, 4):
+        mesh = make_mesh((dp, 1), jax.devices()[:dp])
+        ms = ShardedBigClamModel(g, base_cfg(), mesh)
+        exact(f"sharded_dp{dp}", ms, ms._step(ms.init_state(F0)))
+    mesh22 = make_mesh((2, 2), jax.devices()[:4])
+    mtp = ShardedBigClamModel(g, base_cfg(), mesh22)
+    exact("sharded_tp2", mtp, mtp._step(mtp.init_state(F0)))
+    mesh2 = make_mesh((2, 1), jax.devices()[:2])
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        mr = RingBigClamModel(g, base_cfg(), mesh2, balance=False)
+    exact("ring_dp2", mr, mr._step(mr.init_state(F0)))
+    K = 64
+    F0w = np.zeros((g.num_nodes, K))
+    F0w[:, :4] = F0
+    cfg_sp = base_cfg(num_communities=K, representation="sparse",
+                      sparse_m=8, sparse_comm_cap=16, max_iters=4)
+    msp = SparseBigClamModel(g, cfg_sp)
+    exact("sparse", msp, msp._step(msp.init_state(F0w)))
+    mss = SparseShardedBigClamModel(g, cfg_sp, mesh2)
+    exact("sparse_sharded_dp2", mss, mss._step(mss.init_state(F0w)))
+
+    # --- 2. planted retained buffer -> exactly the drift anomaly -----
+    work = tempfile.mkdtemp(prefix="memory_gate_")
+    leak_dir = os.path.join(work, "leak")
+    tel = install(RunTelemetry(leak_dir, entry="fit", quiet=True))
+    try:
+        ml = BigClamModel(g, base_cfg())
+        stl = ml.init_state(F0)
+        clean = ml.memory_reconcile(stl)
+        leak = jnp.array(np.asarray(stl.F))
+        planted = ml.memory_reconcile(stl, extra=[leak])
+        tel.finalize()
+    finally:
+        uninstall(tel)
+    anomalies = [
+        e for e in (load_events(leak_dir) or [])
+        if e.get("kind") == "anomaly"
+    ]
+    detail["leak"] = {
+        "clean_drift": clean["drift_frac"],
+        "planted_drift": planted["drift_frac"],
+        "anomalies": [
+            {k: e.get(k) for k in ("check", "drift_frac")}
+            for e in anomalies
+        ],
+    }
+    checks["clean_reconcile_fires_nothing"] = clean["ok"]
+    checks["leak_fires_exactly_drift_anomaly"] = (
+        not planted["ok"]
+        and len(anomalies) == 1
+        and anomalies[0]["check"] == "memory_drift"
+    )
+    _, schema_errors = validate_events_file(
+        os.path.join(leak_dir, EVENTS_NAME)
+    )
+    checks["events_schema_valid"] = not schema_errors
+
+    # --- 3. preflight verdicts (jax-free CLI, in-process) ------------
+    from bigclam_tpu.cli import main as cli_main
+    from bigclam_tpu.graph.store import compile_graph_cache
+
+    text = os.path.join(work, "g.txt")
+    with open(text, "w") as f:
+        src, dst = g.src, g.dst
+        for u, v in zip(src, dst):
+            if u < v:
+                f.write(f"{u}\t{v}\n")
+    cache = os.path.join(work, "g.cache")
+    compile_graph_cache(text, cache, num_shards=4)
+    common = ["preflight", "--graph", cache, "--k", "2048",
+              "--mesh", "4,1", "--hbm-bytes", str(4 << 20)]
+    rc_over = cli_main(common)
+    rc_relaxed = cli_main(
+        common + ["--representation", "sparse", "--sparse-m", "16"]
+    )
+    detail["preflight"] = {"over_rc": rc_over, "relaxed_rc": rc_relaxed}
+    checks["preflight_flags_oversized"] = rc_over == 2
+    checks["preflight_passes_sparse_relaxed"] = rc_relaxed == 0
+
+    # --- 4. perf diff on injected hbm regression ---------------------
+    from bigclam_tpu.utils.profiling import StageProfile
+
+    def run_fit(tag):
+        tdir = os.path.join(work, tag)
+        t = install(RunTelemetry(tdir, entry="fit", quiet=True))
+        try:
+            mdl = ShardedBigClamModel(g, base_cfg(max_iters=8), mesh2)
+            with StageProfile().stage("fit"):
+                res = mdl.fit(F0)
+            t.set_final({
+                "llh": res.llh, "iters": res.num_iters,
+                "n": g.num_nodes, "edges": g.num_edges, "k": 4,
+                "mesh": "2x1",
+                "hbm_modeled_bytes": round(mdl.memory.hbm_bytes(), 1),
+            })
+            rep = t.finalize()
+        finally:
+            uninstall(t)
+        return tdir, rep, res
+
+    a_dir, a_rep, a_res = run_fit("baseline")
+    a_events = load_events(a_dir) or []
+    secs = [e["sec_per_iter"] for e in a_events
+            if e.get("kind") == "step"
+            and isinstance(e.get("sec_per_iter"), (int, float))]
+    base_rec = L.build_record(a_rep, secs or [0.01] * 10)
+    checks["record_carries_hbm"] = isinstance(
+        base_rec.get("hbm_modeled_bytes"), float
+    ) and base_rec["hbm_modeled_bytes"] > 0
+    checks["record_carries_host_rss"] = isinstance(
+        base_rec.get("host_rss_modeled_bytes"), float
+    ) and base_rec["host_rss_modeled_bytes"] > 0
+    ledger_path = os.path.join(work, "ledger.jsonl")
+    led = L.PerfLedger(ledger_path)
+    led.append(base_rec)
+    led.append(dict(base_rec, run="rerun", ts=base_rec["ts"] + 1))
+    rc_same = cli_main(["perf", "diff", "--ledger", ledger_path])
+    checks["perf_diff_passes_identical"] = rc_same == 0
+    led.append(dict(
+        base_rec, run="injected-hbm", ts=base_rec["ts"] + 2,
+        hbm_modeled_bytes=round(base_rec["hbm_modeled_bytes"] * 2.0, 1),
+    ))
+    rc_inj = cli_main(["perf", "diff", "--ledger", ledger_path])
+    checks["perf_diff_flags_injected_hbm"] = rc_inj == 2
+    detail["perf_diff"] = {"identical_rc": rc_same, "injected_rc": rc_inj}
+
+    # --- 5. accounting-on bit-identity -------------------------------
+    off_res = ShardedBigClamModel(
+        g, base_cfg(max_iters=8), mesh2
+    ).fit(F0)
+    checks["accounting_on_bit_identical"] = bool(
+        np.array_equal(a_res.F, off_res.F)
+        and a_res.llh_history == off_res.llh_history
+    )
+
+    # --- 6. per-iteration observability overhead < 2% ----------------
+    from bigclam_tpu.obs import trace as obs_trace
+    from bigclam_tpu.utils.profiling import step_time
+
+    g_big, _ = sample_planted_graph(
+        4000, 16, p_in=0.2, rng=np.random.default_rng(3)
+    )
+    big = BigClamModel(g_big, base_cfg(num_communities=16, max_iters=2,
+                                       health_every=10))
+    Fb = np.random.default_rng(4).uniform(
+        0.1, 1.0, size=(g_big.num_nodes, 16)
+    )
+    sec_per_step = step_time(big._step, big.init_state(Fb), steps=10,
+                             warmup=2)
+    t = install(RunTelemetry(os.path.join(work, "ovh"), entry="fit",
+                             quiet=True))
+    try:
+        iters = 3000
+        t0 = time.perf_counter()
+        for i in range(iters):
+            with obs_trace.span("fit_loop/dispatch", emit=False):
+                pass
+            with obs_trace.span("fit_loop/sync", emit=False):
+                pass
+            with obs_trace.span("fit_loop/callback", emit=False):
+                pass
+            t.step_beat(i, -1.0)
+        per_iter = (time.perf_counter() - t0) / iters
+        t.finalize()
+    finally:
+        uninstall(t)
+    detail["overhead"] = {
+        "sec_per_step": round(sec_per_step, 6),
+        "obs_path_per_iter": round(per_iter, 9),
+        "fraction": round(per_iter / sec_per_step, 6),
+    }
+    checks["overhead_under_2pct"] = per_iter < 0.02 * sec_per_step
+
+    ok = all(checks.values())
+    artifact = {
+        "gate": "memory_r16",
+        "created_unix": round(time.time(), 1),
+        "pass": ok,
+        "checks": checks,
+        "reconciliations": recons,
+        "detail": detail,
+        "device": str(jax.devices()[0]),
+        "jax": jax.__version__,
+        "note": (
+            "static per-device HBM model == live addressable-shard "
+            "bytes EXACTLY (drift 0) across dense(XLA/CSR/rollback), "
+            "sharded dp2/dp4/tp2, ring, sparse single+sharded; a "
+            "planted retained F copy fires exactly one memory_drift "
+            "anomaly; cli preflight exits 2 on an over-sized dense "
+            "config vs a 4 MiB fake device limit and 0 with "
+            "--representation sparse; cli perf diff exit 2 on 2x "
+            "injected hbm_modeled_bytes, exit 0 on the identical "
+            "re-run; accounting-on trajectories bit-identical; "
+            "per-iteration obs path within the existing <2% pin."
+        ),
+    }
+    line = json.dumps(artifact, sort_keys=True)
+    print(line)
+    if out_path:
+        with open(out_path, "w") as f:
+            f.write(line + "\n")
+    if not ok:
+        bad = sorted(k for k, v in checks.items() if not v)
+        print(f"FAILED checks: {bad}", file=sys.stderr)
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

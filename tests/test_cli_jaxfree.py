@@ -79,6 +79,44 @@ def test_cli_report_and_watch_stay_jax_free(tmp_path):
     assert "comms" in r.stdout
 
 
+def test_cli_preflight_stays_jax_free_on_manifest(tmp_path):
+    # the capacity preflight (ISSUE 12) is the go/no-go tool for hosts
+    # that may not even have an accelerator stack installed: it must
+    # answer from the cache MANIFEST alone, jax-free, with the verdict
+    # in the exit code (0 fits / 2 does not)
+    edges = tmp_path / "g.txt"
+    edges.write_text(
+        "".join(
+            f"{u}\t{v}\n"
+            for u in range(16) for v in range(u + 1, 16)
+        )
+    )
+    r = _run_jaxfree(
+        ["ingest", "--graph", str(edges), "--cache-dir",
+         str(tmp_path / "cache"), "--shards", "2", "--quiet"],
+        str(tmp_path),
+    )
+    assert r.returncode == 0, (r.stdout, r.stderr)
+    r = _run_jaxfree(
+        ["preflight", "--graph", str(tmp_path / "cache"), "--k", "8",
+         "--mesh", "2,1", "--hbm-gb", "16", "--json"],
+        str(tmp_path),
+    )
+    assert r.returncode == 0, (r.stdout, r.stderr)
+    out = json.loads(r.stdout.strip().splitlines()[-1])
+    assert out["fits"] and out["workload"]["shard_counts_known"]
+    assert out["hbm_bytes_per_device"] > 0
+    assert out["host"]["stages"]
+    # an absurd budget flips the verdict to exit 2, still jax-free
+    r = _run_jaxfree(
+        ["preflight", "--graph", str(tmp_path / "cache"), "--k", "8",
+         "--mesh", "2,1", "--hbm-bytes", "1024"],
+        str(tmp_path),
+    )
+    assert r.returncode == 2, (r.stdout, r.stderr)
+    assert "DOES NOT FIT" in r.stdout
+
+
 def test_cli_perf_show_stays_jax_free(tmp_path):
     # the perf-ledger tooling shares the data-prep-host contract (the
     # module docstring promises it; now the test does)
