@@ -137,6 +137,33 @@ def roofline_model(k: int) -> dict:
     }
 
 
+FUSED_PATHS = ("csr_fused", "csr_fused_kb", "csr_ring_fused",
+               "csr_ring_fused_kb")
+
+
+def roofline_model_fused(k: int) -> dict:
+    """Fused-superstep cost model (ISSUE 13 satellite): bytes per
+    directed edge WITHOUT the fd round-trip. The split model charges
+    every sweep both endpoint rows because each sweep re-reads the
+    HBM-resident gathered fd; the fused kernel DMAs each edge's dst row
+    into VMEM exactly twice per iteration (grad phase + candidate phase
+    — all 16 candidates reuse the VMEM-resident tile) and the src-side
+    block plus the grad/F_new writes amortize to one row-read + one
+    row-write equivalent per edge at real average degrees. hbm_frac for
+    a fused run must quote THIS model — quoting the split model would
+    overstate it ~10x (the honesty rule that added the sparse model in
+    r11).
+    """
+    bytes_iter = 2 * (k * 4) + 2 * (k * 4)
+    flops_iter = SWEEPS_PER_ITER * (2 * k) + 16 * (2 * k)
+    return {
+        "bytes_per_edge_iter": bytes_iter,
+        "flops_per_edge_iter": flops_iter,
+        "sweeps_per_iter": SWEEPS_PER_ITER,
+        "variant": "fused",
+    }
+
+
 def roofline_model_sparse(m: int) -> dict:
     """Sparse-representation cost model (ISSUE 7 satellite): bytes and
     FLOPs per directed edge scale with the top-M slot count, NOT K —
@@ -176,14 +203,21 @@ def device_peaks(device_kind: str):
 
 
 def roofline_position(
-    eps: float, k: int, device_kind: str, sparse_m: int = 0
+    eps: float, k: int, device_kind: str, sparse_m: int = 0,
+    fused: bool = False,
 ) -> dict:
     """The artifact's roofline record for one config: the cost model, the
     achieved HBM-bandwidth fraction (`hbm_frac`) and MXU utilization
     (`mfu`), or None fractions off the peaks table. sparse_m > 0 selects
-    the sparse cost model (bytes/FLOPs per edge ∝ M, not K) so hbm_frac
-    stays honest on the sparse path."""
-    model = roofline_model_sparse(sparse_m) if sparse_m else roofline_model(k)
+    the sparse cost model (bytes/FLOPs per edge ∝ M, not K); fused=True
+    the fused-superstep model (no fd round-trip) — each keeps hbm_frac
+    honest for its path."""
+    if sparse_m:
+        model = roofline_model_sparse(sparse_m)
+    elif fused:
+        model = roofline_model_fused(k)
+    else:
+        model = roofline_model(k)
     hbm_gbs, tflops = device_peaks(device_kind)
     achieved_gbs = eps * model["bytes_per_edge_iter"] / 1e9
     achieved_tflops = eps * model["flops_per_edge_iter"] / 1e12
@@ -369,7 +403,9 @@ def _main(backend, cpu_fallback) -> None:
 
     with prof.stage("enron_csr"):
         model = BigClamModel(g, cfg, k_multiple=128)
-        if on_tpu and model.engaged_path not in ("csr", "csr_grouped"):
+        if on_tpu and model.engaged_path not in (
+            "csr", "csr_grouped", "csr_fused", "csr_fused_kb",
+        ):
             raise RuntimeError(
                 "benchmark invalid: blocked-CSR kernels did not engage on "
                 f"the TPU backend (path={model.engaged_path}, "
@@ -397,7 +433,10 @@ def _main(backend, cpu_fallback) -> None:
         "xla": {"eps": enron_xla_eps, "path": xla_model.engaged_path,
                 "windows": enron_xla_windows},
         "csr_over_xla": round(enron_eps / enron_xla_eps, 2),
-        "roofline": roofline_position(enron_eps, K_ENRON, kind),
+        "roofline": roofline_position(
+            enron_eps, K_ENRON, kind,
+            fused=model.engaged_path in FUSED_PATHS,
+        ),
     }
 
     # --- representative grouped-path scale: AGM N=300K K=1000 ---
@@ -419,7 +458,9 @@ def _main(backend, cpu_fallback) -> None:
             0, 2, size=(gl.num_nodes, LARGE_K)
         ).astype(np.float64)
         model_l = BigClamModel(gl, cfg_l, k_multiple=128)
-        if on_tpu and model_l.engaged_path not in ("csr", "csr_grouped"):
+        if on_tpu and model_l.engaged_path not in (
+            "csr", "csr_grouped", "csr_fused", "csr_fused_kb",
+        ):
             raise RuntimeError(
                 "benchmark invalid: large config fell back to "
                 f"{model_l.engaged_path} ({model_l.path_reason})"
@@ -442,7 +483,10 @@ def _main(backend, cpu_fallback) -> None:
         "xla": {"eps": large_xla_eps, "path": xla_l.engaged_path,
                 "windows": large_xla_windows},
         "csr_over_xla": round(large_eps / large_xla_eps, 2),
-        "roofline": roofline_position(large_eps, LARGE_K, kind),
+        "roofline": roofline_position(
+            large_eps, LARGE_K, kind,
+            fused=model_l.engaged_path in FUSED_PATHS,
+        ),
     }
 
     # --- K-blocked regime: AGM N=60K K=3000 (csr_grouped_kb vs XLA) ---
@@ -457,7 +501,9 @@ def _main(backend, cpu_fallback) -> None:
             0, 2, size=(gk.num_nodes, XLK_K)
         ).astype(np.float64)
         model_k = BigClamModel(gk, cfg_k, k_multiple=128)
-        if on_tpu and model_k.engaged_path != "csr_grouped_kb":
+        if on_tpu and model_k.engaged_path not in (
+            "csr_grouped_kb", "csr_fused_kb",
+        ):
             raise RuntimeError(
                 "K-blocked config fell back to "
                 f"{model_k.engaged_path} ({model_k.path_reason})"
@@ -480,7 +526,10 @@ def _main(backend, cpu_fallback) -> None:
             "xla": {"eps": xlk_xla_eps, "path": xla_k.engaged_path,
                     "windows": xlk_xla_windows},
             "csr_over_xla": round(xlk_eps / xlk_xla_eps, 2),
-            "roofline": roofline_position(xlk_eps, XLK_K, kind),
+            "roofline": roofline_position(
+                xlk_eps, XLK_K, kind,
+                fused=model_k.engaged_path in FUSED_PATHS,
+            ),
         }
     except Exception as e:           # noqa: BLE001 — recorded, not silent
         configs["xl_k"] = {"error": f"{type(e).__name__}: {e}"}
@@ -677,9 +726,14 @@ def _emit(jax, spec, g, cfg, F0, backend, model, configs, enron_eps,
                 "edges": g.num_directed_edges // 2,
                 "representation": record["representation"],
                 # the ledger's roofline fields (obs.ledger): hbm_frac is
-                # the denominator "is it actually fast" gates against
+                # the denominator "is it actually fast" gates against —
+                # with the VARIANT of the cost model it was quoted
+                # against (a fused run quoted on the split model would
+                # overstate hbm_frac ~10x, ISSUE 13)
                 "hbm_frac": roof.get("hbm_frac"),
                 "mfu": roof.get("mfu"),
+                "roofline_variant": roof.get("variant", "split"),
+                "bytes_per_edge_iter": roof.get("bytes_per_edge_iter"),
                 # comms-observability fields (ISSUE 10): the ring
                 # config's overlap fraction is VERDICTED by `cli perf
                 # diff` (rotation hops falling out of overlap is a

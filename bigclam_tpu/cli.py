@@ -78,6 +78,15 @@ def _add_common(p: argparse.ArgumentParser) -> None:
              "when the layout fits; on: require, error if unsupported)",
     )
     p.add_argument(
+        "--csr-fused", default="auto", choices=["auto", "off"],
+        help="fused edge superstep (ops/pallas_fused: in-kernel "
+             "double-buffered dst-row DMA, no HBM fd gather, Armijo "
+             "select + projection in-kernel). auto (default): engaged "
+             "wherever the CSR kernels engage; off: the pre-r17 split "
+             "kernel suite — the A/B + perf-baseline path (fused and "
+             "split runs never share a ledger baseline)",
+    )
+    p.add_argument(
         "--representation", default="dense", choices=["dense", "sparse"],
         help="affiliation-state representation: dense (N, K) F (the "
              "reference semantics, default) or sparse per-node top-M "
@@ -333,6 +342,9 @@ def _build(args, k: int):
         use_pallas_csr={"auto": None, "on": True, "off": False}[
             args.csr_kernels
         ],
+        csr_fused={"auto": None, "off": False}[
+            getattr(args, "csr_fused", "auto")
+        ],
         seeding_degree_cap=args.seeding_degree_cap,
         representation=getattr(args, "representation", "dense"),
         sparse_m=getattr(args, "sparse_m", 64),
@@ -357,8 +369,9 @@ def _make_model(g, cfg, args):
         # honoring the contract means refusing, not silently falling back
         raise SystemExit(
             "error: --csr-kernels on is not supported with "
-            "--representation sparse yet (member-list kernels run the "
-            "XLA searchsorted path; use --csr-kernels auto)"
+            "--representation sparse (the CSR tile kernels are a dense-F "
+            "layout; the sparse path has its own Pallas member-merge "
+            "kernel, auto-engaged on TPU — use --csr-kernels auto)"
         )
     store_native = getattr(args, "store_native", False)
     if store_native and not (args.mesh or args.distributed):
@@ -645,6 +658,11 @@ def _cmd_fit(args, tel=None) -> int:
         # sparse run against a dense one (obs.ledger.match_key), and the
         # bench/ledger rows must say which bytes/edge model applies
         "representation": cfg.representation,
+        # resolved edge-kernel path (ISSUE 13): joins the ledger match
+        # key so a silent XLA fallback can never baseline against a
+        # fused run; the reason says WHY when it is a fallback
+        "kernel_path": getattr(model, "engaged_path", ""),
+        "kernel_path_reason": getattr(model, "path_reason", ""),
     }
     if mesh is not None:
         # execution-shape identity (obs.ledger.match_key, ISSUE 10): a
@@ -927,6 +945,7 @@ def _cmd_profile(args, tel=None) -> int:
         "sec_per_step_min": round(min(times), 6),
         "profile_dir": pdir,
         "path": getattr(model, "engaged_path", ""),
+        "kernel_path": getattr(model, "engaged_path", ""),
         "n": g.num_nodes,
         "edges": g.num_edges,
         "k": cfg.num_communities,

@@ -312,6 +312,30 @@ class BigClamConfig:
                                         # divisor of k_pad that does — the
                                         # single-chip large-K mode (K ≳ 2500
                                         # otherwise falls back to XLA)
+    csr_fused: Optional[bool] = None    # fused edge superstep (ISSUE 13,
+                                        # ops.pallas_fused): dst rows DMA'd
+                                        # per-tile into VMEM inside the
+                                        # kernel (double-buffered against
+                                        # compute — no HBM-resident fd
+                                        # gather), grad kept VMEM-resident
+                                        # per block, Armijo ladder + select
+                                        # + non-negative projection fused
+                                        # into the same kernel pass. None =
+                                        # auto: ON whenever the blocked-CSR
+                                        # kernels engage; False = the
+                                        # pre-r17 split-kernel schedule
+                                        # (the A/B + baseline path).
+                                        # Step-baked: fused and split runs
+                                        # never share a compiled step or a
+                                        # perf-ledger baseline
+    sparse_pallas_merge: Optional[bool] = None  # sparse member-list merge
+                                        # as a Pallas compare-block kernel
+                                        # (ops.sparse_members
+                                        # .member_lookup_pallas) instead of
+                                        # the gather-bound XLA searchsorted
+                                        # merge. None = auto (on for TPU
+                                        # backends, or under
+                                        # pallas_interpret); step-baked
     pallas_interpret: bool = False      # run Pallas kernels in interpret mode
                                         # (CPU testing of the kernel paths)
 

@@ -48,6 +48,15 @@ def csr_want_reason(cfg: BigClamConfig) -> tuple[bool, str]:
     return False, reason
 
 
+def csr_fused_want(cfg: BigClamConfig) -> bool:
+    """Fused edge superstep engagement (ISSUE 13): auto = ON whenever the
+    blocked-CSR kernels engage (ops.pallas_fused is the default schedule
+    since r17; csr_fused=False keeps the pre-r17 split kernels — the A/B
+    and perf-baseline path). Shared by every trainer family so the
+    resolved kernel path can never differ between them for one config."""
+    return cfg.csr_fused is not False
+
+
 # Fields that only the HOST-side loops read (never baked into the compiled
 # step): normalized away by step_cfg_key so rebuild_step can cache compiled
 # steps across host-only cfg swaps (quality mode toggles conv_tol + max_p
@@ -901,10 +910,11 @@ def make_train_step(
         )
 
     if tiles is not None:
-        from bigclam_tpu.ops.linesearch import armijo_select
+        from bigclam_tpu.ops.linesearch import accept_stats, armijo_select
         from bigclam_tpu.ops.objective import node_tail
         from bigclam_tpu.ops.pallas_csr import (
             GroupedTilesDev,
+            TilesDev,
             candidates_csr,
             gather_dst_rows,
             grad_llh_csr,
@@ -915,6 +925,66 @@ def make_train_step(
         interp = cfg.pallas_interpret
         grouped = isinstance(tiles, GroupedTilesDev)
         kblocked = grouped and tiles.kc > 0
+        # fused superstep layouts (ISSUE 13, ops.pallas_fused): a FLAT
+        # TilesDev carrying the grid-entry sequence (one-pass superstep)
+        # or a kc column block size (K-blocked fused — flat tiles, no
+        # grouped layout: with the gather in-kernel there is no fd to
+        # budget)
+        fused_flat = (
+            isinstance(tiles, TilesDev) and tiles.seq is not None
+        )
+        fused_kb = (
+            isinstance(tiles, TilesDev) and tiles.kc > 0 and not fused_flat
+        )
+
+        def fused_superstep_step(state: TrainState) -> TrainState:
+            from bigclam_tpu.ops.pallas_fused import fused_superstep_csr
+
+            F, sumF = state.F, state.sumF
+            adt = jnp.dtype(cfg.accum_dtype) if cfg.accum_dtype else F.dtype
+            F_new, grad, node_llh, ok = fused_superstep_csr(
+                F, sumF, tiles, cfg, interpret=interp
+            )
+            llh_cur = node_llh.astype(adt).sum()
+            hist = accept_stats(ok > 0)
+            return TrainState(
+                F=F_new, sumF=F_new.sum(axis=0), llh=llh_cur.astype(F.dtype),
+                it=state.it + 1, accept_hist=hist,
+                health=maybe_health(
+                    state, F_new, F_new.sum(axis=0), grad, hist
+                ),
+            )
+
+        if fused_flat:
+            return finalize_step(fused_superstep_step), "csr_fused"
+
+        def fused_kb_step(state: TrainState) -> TrainState:
+            # single-chip large K, fused: flat tiles, kc columns per
+            # kernel call, gather in-kernel; candidate terms are
+            # neighbor-only so the Armijo tails ride armijo_update
+            from bigclam_tpu.ops.pallas_fused import (
+                train_pass_csr_kblocked_fused,
+            )
+
+            F, sumF = state.F, state.sumF
+            adt = jnp.dtype(cfg.accum_dtype) if cfg.accum_dtype else F.dtype
+            grad, llh_nbr, cand_nbr = train_pass_csr_kblocked_fused(
+                F, sumF, tiles, cfg, interpret=interp
+            )
+            node_llh = llh_nbr.astype(adt) + node_tail(F, sumF).astype(adt)
+            llh_cur = node_llh.sum()
+            F_new, sumF_new, hist = armijo_update(
+                F, sumF, grad, node_llh, cand_nbr.astype(adt), cfg,
+                with_stats=True,
+            )
+            return TrainState(
+                F=F_new, sumF=sumF_new, llh=llh_cur, it=state.it + 1,
+                accept_hist=hist,
+                health=maybe_health(state, F_new, sumF_new, grad, hist),
+            )
+
+        if fused_kb:
+            return finalize_step(fused_kb_step), "csr_fused_kb"
 
         def csr_step_kblocked(state: TrainState) -> TrainState:
             # single-chip large K: grouped layout + K-column-blocked
@@ -1095,12 +1165,28 @@ class BigClamModel(MemoryAccountedModel):
             })
         return out
 
+    def _memory_fused(self) -> bool:
+        """Did this build commit a FUSED tile layout (ISSUE 13)? Flat
+        TilesDev carrying the entry sequence (superstep) or a kc column
+        block (K-blocked fused) — the layouts with NO HBM fd gather."""
+        t = self._tiles
+        return t is not None and (
+            getattr(t, "seq", None) is not None
+            or (getattr(t, "kc", 0) and not hasattr(t, "nb"))
+        )
+
     def _memory_fd_bytes(self) -> float:
-        """Bytes of the step's shared dst-row gather (the dominant
-        transient): (chunk, K_pad) per scan step on XLA, the whole
-        layout's (or one group window's) dst rows on the CSR paths."""
+        """Bytes of the step's dst-row transient: the shared HBM fd
+        gather on the split paths ((chunk, K_pad) per scan step on XLA,
+        the whole layout's / one group window's dst rows on CSR), or —
+        when the fused kernels engage — the (2, T, Kc) double-buffered
+        in-kernel DMA scratch that replaces it (VMEM-resident; priced so
+        the fd elimination is visible in the model, ISSUE 13)."""
         isz = jnp.dtype(self.dtype).itemsize
         if self._tiles is not None:
+            if self._memory_fused():
+                cols = getattr(self._tiles, "kc", 0) or self.k_pad
+                return 2.0 * self._tiles.tile_t * cols * isz
             dst = self._tiles.dst
             kc = getattr(self._tiles, "kc", 0) or self.k_pad
             if dst.ndim >= 3:           # grouped: one (G, T) window live
@@ -1124,6 +1210,7 @@ class BigClamModel(MemoryAccountedModel):
             donate=bool(cfg.donate_state),
             rollback=int(getattr(cfg, "rollback_budget", 0) or 0) > 0,
             fd_bytes=self._memory_fd_bytes(),
+            fused=self._memory_fused(),
             model=type(self).__name__,
         )
 
@@ -1177,31 +1264,36 @@ class BigClamModel(MemoryAccountedModel):
         n = self.g.num_nodes
         from bigclam_tpu.ops.pallas_csr import fit_tile_shape
 
+        fused = csr_fused_want(cfg)
         kc = 0
         if cfg.csr_k_block:
             # explicit K-blocked mode (also the interpret-mode test hook)
             kc = cfg.csr_k_block
             k_pad = _round_up(k_pad, kc)
             shape = (
-                fit_tile_shape(cfg.csr_block_b, cfg.csr_tile_t, kc)
+                fit_tile_shape(cfg.csr_block_b, cfg.csr_tile_t, kc,
+                               fused=fused)
                 if not cfg.pallas_interpret
                 else (cfg.csr_block_b, cfg.csr_tile_t)
             )
         else:
             shape = (
-                fit_tile_shape(cfg.csr_block_b, cfg.csr_tile_t, k_pad)
+                fit_tile_shape(cfg.csr_block_b, cfg.csr_tile_t, k_pad,
+                               fused=fused)
                 if not cfg.pallas_interpret
                 else (cfg.csr_block_b, cfg.csr_tile_t)
             )
             if shape is None:
                 # whole-K rows exceed VMEM: single-chip large-K mode
                 # (kernels then scan K blocks;
-                # train_pass_csr_grouped_kblocked); policy shared with the
+                # train_pass_csr_grouped_kblocked on the split path,
+                # train_pass_csr_kblocked_fused on flat tiles when the
+                # fused schedule engages); policy shared with the
                 # sharded trainer
                 from bigclam_tpu.ops.pallas_csr import largest_fitting_kblock
 
                 found = largest_fitting_kblock(
-                    cfg.csr_block_b, cfg.csr_tile_t, k_pad
+                    cfg.csr_block_b, cfg.csr_tile_t, k_pad, fused=fused
                 )
                 if found is not None:
                     kc, shape = found
@@ -1275,6 +1367,14 @@ class BigClamModel(MemoryAccountedModel):
                 f"slots on {e} edges"
             )
             return None
+        if fused:
+            # fused superstep (ISSUE 13): the dst gather happens inside
+            # the kernel, so there is NO fd buffer to budget — the flat
+            # layout serves every N, and large K takes the K-blocked
+            # fused pass on the SAME flat tiles (no grouped layout)
+            self.k_pad = k_pad
+            self._node_multiple_csr = bt.n_blocks * bt.block_b
+            return device_tiles(bt, self.dtype, with_seq=not kc, kc=kc)
         if fd_bytes <= FLAT_FD_BUDGET and not kc:
             self.k_pad = k_pad
             self._node_multiple_csr = bt.n_blocks * bt.block_b

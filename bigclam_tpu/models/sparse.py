@@ -118,7 +118,10 @@ def make_sparse_train_step(
             health=health,
         )
 
-    return finalize_step(step), "sparse_xla"
+    from bigclam_tpu.ops.sparse_members import merge_pallas_want
+
+    merge = "merge_pallas" if merge_pallas_want(cfg) else "xla"
+    return finalize_step(step), f"sparse_{merge}"
 
 
 class SparseBigClamModel(MemoryAccountedModel):

@@ -147,6 +147,13 @@ def build_record(
         # construction: the sparse trainers always stamp them)
         "representation": final.get("representation"),
         "sparse_m": final.get("sparse_m"),
+        # resolved edge-kernel path (ISSUE 13 satellite): fused / split /
+        # xla-fallback as the entry point stamped it (cli fit/profile
+        # stamp "kernel_path", bench stamps "path"). Part of the match
+        # key: a run whose kernels silently fell back to XLA must never
+        # baseline against a fused run — the 7.66M-vs-27.4M round-1
+        # capture artifact, now structurally impossible
+        "kernel_path": final.get("kernel_path") or final.get("path"),
         # execution shape (ISSUE 10 satellite): a 2-proc run must never
         # baseline against a single-proc run of the same cfg on the same
         # box (each process times only its shard's work), and a (4,1)
@@ -281,6 +288,11 @@ def match_key(rec: Dict[str, Any]) -> Tuple:
         # as every match-key widening
         rec.get("processes"),
         rec.get("mesh"),
+        # the resolved edge-kernel path (ISSUE 13): fused vs split vs
+        # xla runs do different per-edge work — None (pre-r17 records /
+        # entry points that never stamp it) matches only None, the same
+        # rebaseline rule as every match-key widening
+        rec.get("kernel_path"),
     )
 
 

@@ -356,6 +356,24 @@ def _total(buffers: Sequence[Buffer]) -> float:
 
 
 # ------------------------------------------------------- family builders
+def _fd_buffers(fd_bytes: float, fused: bool, note: str) -> list:
+    """The dst-row transient of one edge sweep: the HBM-resident fd
+    gather on the split kernel/XLA paths, or — when the fused superstep
+    engages (ISSUE 13) — the (2, T, Kc) double-buffered in-kernel DMA
+    scratch that replaces it. The rename is deliberate: a fused run's
+    model must show the fd buffer GONE, not merely smaller, and the
+    scratch it bought instead."""
+    if not fd_bytes:
+        return []
+    if fused:
+        return [Buffer(
+            "transient/fd_dma_scratch", fd_bytes, "transient",
+            note="double-buffered in-kernel dst-row DMA (fused superstep; "
+                 "VMEM-resident — no HBM fd gather exists): " + note,
+        )]
+    return [Buffer("transient/fd_gather", fd_bytes, "transient", note=note)]
+
+
 def dense_memory_model(
     n_pad: int,
     k_pad: int,
@@ -366,6 +384,7 @@ def dense_memory_model(
     donate: bool = True,
     rollback: bool = False,
     fd_bytes: float = 0.0,
+    fused: bool = False,
     model: str = "BigClamModel",
 ) -> MemoryModel:
     """Single-chip dense trainer (models.bigclam.BigClamModel). The
@@ -388,8 +407,7 @@ def dense_memory_model(
                 note="(S, N) Armijo candidate accumulators",
             ),
         ]
-        + ([Buffer("transient/fd_gather", fd_bytes, "transient",
-                   note="shared dst-row gather")] if fd_bytes else [])
+        + _fd_buffers(fd_bytes, fused, "shared dst-row gather")
     )
     return MemoryModel(
         family="dense", model=model, buffers=tuple(buffers),
@@ -410,6 +428,7 @@ def sharded_memory_model(
     donate: bool = True,
     rollback: bool = False,
     fd_bytes: float = 0.0,
+    fused: bool = False,
     comms: Optional[CommsModel] = None,
     model: str = "ShardedBigClamModel",
 ) -> MemoryModel:
@@ -439,8 +458,7 @@ def sharded_memory_model(
                 num_candidates * n_loc * itemsize, "transient",
             ),
         ]
-        + ([Buffer("transient/fd_gather", fd_bytes, "transient",
-                   note="per-shard dst-row gather")] if fd_bytes else [])
+        + _fd_buffers(fd_bytes, fused, "per-shard dst-row gather")
         + collective_buffers(comms)
     )
     return MemoryModel(
@@ -463,6 +481,7 @@ def ring_memory_model(
     donate: bool = True,
     rollback: bool = False,
     fd_bytes: float = 0.0,
+    fused: bool = False,
     overlap: bool = True,
     comms: Optional[CommsModel] = None,
     model: str = "RingBigClamModel",
@@ -496,8 +515,7 @@ def ring_memory_model(
                 num_candidates * n_loc * itemsize, "transient",
             ),
         ]
-        + ([Buffer("transient/fd_gather", fd_bytes, "transient",
-                   note="per-phase dst-row gather")] if fd_bytes else [])
+        + _fd_buffers(fd_bytes, fused, "per-phase dst-row gather")
         + collective_buffers(comms)
     )
     return MemoryModel(

@@ -327,6 +327,17 @@ def render_json(directory: str) -> Tuple[dict, int]:
             "duration_s": run_duration_s(events or []),
         },
         "health": (merged or {}).get("health", {}),
+        # resolved edge-kernel paths (ISSUE 13): one entry per trainer
+        # build — CI can refuse a run whose path silently fell back
+        "kernel_paths": [
+            {
+                "model": e.get("model"),
+                "path": e.get("path"),
+                "reason": e.get("reason", ""),
+            }
+            for e in (events or [])
+            if e.get("kind") == "model_build" and e.get("path")
+        ],
         "comms": (merged or {}).get("comms"),
         "memory_model": (merged or {}).get("memory_model"),
         "sync_by_pid": (merged or {}).get("sync_by_pid", {}),
@@ -368,6 +379,23 @@ def render(directory: str) -> Tuple[str, int]:
                 "  WARNING: fewer per-process reports than processes — "
                 "a process died before finalize"
             )
+        # --- resolved edge-kernel paths (ISSUE 13 satellite): every
+        # trainer build states which implementation compiled (fused /
+        # split / xla) and WHY a fallback fell back — a silent XLA
+        # fallback must be visible here, not only on a stderr line
+        # nobody watched
+        builds = [
+            e for e in (events or [])
+            if e.get("kind") == "model_build" and e.get("path")
+        ]
+        if builds:
+            lines.append("")
+            lines.append("kernel paths (model builds):")
+            for e in builds:
+                why = f"  ({e['reason']})" if e.get("reason") else ""
+                lines.append(
+                    f"  {e.get('model', '?'):<28} {e['path']}{why}"
+                )
         lines.append("")
         lines.append("stage seconds (per process):")
         for pid, stages in sorted(

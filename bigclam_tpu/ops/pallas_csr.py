@@ -51,7 +51,13 @@ class TilesDev(NamedTuple):
     The per-tile vectors carry a middle singleton dim — Mosaic requires the
     last TWO dims of a block shape to be (8, 128)-aligned or full-size, so
     (n_tiles, 1, T) blocks as (1, 1, T) satisfy the rule where (n_tiles, T)
-    as (1, T) would not."""
+    as (1, T) would not.
+
+    `seq` (ISSUE 13) is the fused superstep's grid-entry sequence — each
+    block's tiles listed twice ([tile, phase] per entry, phase 0 = grad
+    pass, phase 1 = candidate/update pass; ops.pallas_fused
+    .fused_entry_seq); None on the split-kernel path. `kc` > 0 marks the
+    K-blocked fused layout (flat tiles, kc columns per kernel call)."""
 
     src_local: jax.Array   # (n_tiles, 1, T) int32, block-local
     dst: jax.Array         # (n_tiles, T) int32, global (XLA gather operand)
@@ -60,14 +66,23 @@ class TilesDev(NamedTuple):
     block_b: int
     tile_t: int
     n_blocks: int
+    seq: Optional[jax.Array] = None   # (2*n_tiles, 2) int32 (fused only)
+    kc: int = 0                       # K block columns (fused large-K only)
 
     @property
     def n_pad(self) -> int:
         return self.n_blocks * self.block_b
 
 
-def device_tiles(bt: BlockTiles, dtype=jnp.float32) -> TilesDev:
+def device_tiles(
+    bt: BlockTiles, dtype=jnp.float32, with_seq: bool = False, kc: int = 0
+) -> TilesDev:
     n_tiles, t = bt.src_local.shape
+    seq = None
+    if with_seq:
+        from bigclam_tpu.ops.pallas_fused import fused_entry_seq
+
+        seq = jnp.asarray(fused_entry_seq(bt.block_id))
     return TilesDev(
         src_local=jnp.asarray(bt.src_local, jnp.int32).reshape(n_tiles, 1, t),
         dst=jnp.asarray(bt.dst, jnp.int32),
@@ -76,24 +91,53 @@ def device_tiles(bt: BlockTiles, dtype=jnp.float32) -> TilesDev:
         block_b=bt.block_b,
         tile_t=bt.tile_t,
         n_blocks=bt.n_blocks,
+        seq=seq,
+        kc=kc,
     )
 
 
-# conservative per-kernel VMEM budget: the candidate kernel holds ~6 (T, K)
-# streams (fd double-buffered, fs/gs expansions, nf temp), ~3 (B, K) blocks
-# (F, grad, output) and the (B, T) one-hot live at once; v5e VMEM is 16 MiB
+# conservative per-kernel VMEM budget: v5e VMEM is 16 MiB
 VMEM_BUDGET = 12 << 20
 
 
+def kernel_vmem_bytes(
+    b: int, t: int, k_pad: int, fused: bool = False, num_s: int = 16
+) -> int:
+    """VMEM working-set model of the edge kernels at tile shape (b, t).
+
+    Counts the PIPELINE'S double-buffered stream copies explicitly (round
+    17 fix: Mosaic holds TWO copies of every blocked input/output while
+    the automatic pipeline prefetches the next grid step — the old
+    estimate priced single copies and auto-shrink could pick shapes that
+    only fit with pipelining off):
+
+      split candidate kernel (the working-set max of the split suite):
+        2x (t, k) fd stream + 2x 2 (b, k) F/grad input blocks +
+        2x (S, b) output + live temps fs/gs/nf (3 (t, k)) + (b, t) one-hot
+      fused superstep kernel (ops.pallas_fused): the explicitly
+        double-buffered (2, t, k) fd DMA scratch + 2x (b, k) F input
+        stream + 4 resident (b, k) output blocks (F_new/grad x in+out
+        copy) + (S, b) candidate accumulator + temps/one-hot as above
+    """
+    if fused:
+        streams = 2 * t * k_pad + 2 * b * k_pad + 4 * b * k_pad + num_s * b
+    else:
+        streams = 2 * t * k_pad + 4 * b * k_pad + 2 * num_s * b
+    temps = 3 * t * k_pad + 2 * b * t
+    return (streams + temps) * 4
+
+
 def fit_tile_shape(
-    block_b: int, tile_t: int, k_pad: int
+    block_b: int, tile_t: int, k_pad: int, fused: bool = False
 ) -> Optional[Tuple[int, int]]:
     """Shrink (block_b, tile_t) — halving, floor 128 — until the kernels'
-    VMEM working set fits. None = not fittable at this k_pad (fall back to
-    the XLA path or shard K)."""
+    VMEM working set (kernel_vmem_bytes, double-buffered streams counted)
+    fits. None = not fittable at this k_pad (fall back to the XLA path or
+    shard K). fused=True prices the fused superstep kernel's working set
+    (in-kernel DMA scratch instead of a pipelined fd stream)."""
 
     def est(b: int, t: int) -> int:
-        return (6 * t * k_pad + 3 * b * k_pad + 2 * b * t) * 4
+        return kernel_vmem_bytes(b, t, k_pad, fused=fused)
 
     def shrink(v: int) -> int:
         # halve but keep Mosaic 128-alignment: a 128-multiple input must
@@ -114,7 +158,7 @@ def fit_tile_shape(
 
 
 def largest_fitting_kblock(
-    block_b: int, tile_t: int, k_pad: int
+    block_b: int, tile_t: int, k_pad: int, fused: bool = False
 ) -> Optional[Tuple[int, Tuple[int, int]]]:
     """Large-K fallback policy shared by the single-chip and sharded
     trainers: the largest 128-multiple divisor kc of k_pad whose tile
@@ -122,7 +166,7 @@ def largest_fitting_kblock(
     is then processed kc columns at a time by the kblocked passes."""
     m = k_pad // 128
     for d in sorted((d for d in range(1, m) if m % d == 0), reverse=True):
-        s = fit_tile_shape(block_b, tile_t, 128 * d)
+        s = fit_tile_shape(block_b, tile_t, 128 * d, fused=fused)
         if s is not None:
             return 128 * d, s
     return None

@@ -1,0 +1,875 @@
+"""Fused Pallas edge superstep: in-kernel dst gather, double-buffered DMA.
+
+ISSUE 13 / ROADMAP item 4. The split blocked-CSR schedule (ops.pallas_csr)
+feeds its kernels an XLA-gathered `fd = F[tiles.dst]` buffer: an
+(E_pad, K) HBM array written once and read twice per step. At K=128 that
+buffer alone moves more bytes than the CSR structure, so the r06 roofline
+pinned the schedule at ~35% of v5e HBM bandwidth — bandwidth spent on a
+buffer that never needs to exist. "Speeding Up BigClam" (arXiv:1712.01209)
+got its wins from row caching and fusing the neighbor sum with the update;
+this module is that idea on the MXU:
+
+  * the dst-side row gather moves INSIDE the kernel: each tile's T dst
+    rows are DMA'd from the HBM-resident F (memory_space=ANY) into a
+    (2, T, K) VMEM scratch, one async copy per row, DOUBLE-BUFFERED — the
+    copies for tile j+1 are issued before tile j's compute, so the gather
+    latency hides behind the one-hot matmuls (no `fd` ever exists in HBM)
+  * the whole superstep — gather -> exp/σ edge terms -> weighted
+    scatter-add -> Armijo candidate ladder -> acceptance -> non-negative
+    projection — runs in ONE pallas_call: the grid walks each block's
+    tiles twice ([tile, phase] entries, fused_entry_seq), the block's
+    gradient accumulates in the VMEM-resident grad output across its
+    phase-0 entries and never round-trips to HBM before the candidate
+    pass reads it, and the block's last entry applies the Armijo
+    selection + clip projection and writes F_new directly
+  * the per-tile index stream (dst row ids) is pipelined through SMEM
+    blocks (current + next tile), so DMA addresses for tile j+1 are
+    available while tile j computes — the two-deep software pipeline
+
+  Accumulation ORDER matches the split kernels exactly (zero-init at the
+  block's first tile, per-tile adds in tile order, candidate accumulator
+  seeded with the Armijo tails before the first scatter add), so fused
+  and split trajectories are bit-identical in interpret mode — pinned by
+  tests/test_fused.py; real-chip hbm_frac stays with the ROADMAP 1 pod
+  drill.
+
+The gather-fused split kernels at the bottom (edge_dots_fused /
+grad_nbr_from_x_fused / cand_dots_fused) give the SAME in-kernel DMA to
+the schedules that cannot run the one-pass superstep: the TP suite (the
+per-edge dot must psum over "k" mid-sweep), the K-blocked large-K passes
+(a (B, K) grad block no longer fits VMEM — columns are processed kc at a
+time, with the DMA slicing kc columns per row), and the ring phases
+(neighbor terms accumulate across rotations). Because no fd is ever
+materialized, the K-blocked fused pass runs on the FLAT tile layout —
+which the store-native builders already produce — closing the
+grouped/K-blocked store-layout gap that used to fall back to XLA.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from bigclam_tpu.config import BigClamConfig
+from bigclam_tpu.ops.objective import edge_terms
+from bigclam_tpu.ops.pallas_csr import (
+    _PREC,
+    TilesDev,
+    _expand_onehot,
+    _out_struct,
+)
+
+
+def fused_entry_seq(block_id: np.ndarray) -> np.ndarray:
+    """The fused superstep's grid-entry sequence from a flat tile layout's
+    block_id: each block's (contiguous) tiles listed twice — once for the
+    grad phase, once for the candidate/update phase. Returns
+    (2*n_tiles, 2) int32 [tile_index, phase]; vectorized (no per-block
+    Python — Friendster-scale layouts have hundreds of thousands of
+    blocks)."""
+    block_id = np.asarray(block_id, np.int32)
+    nt = block_id.shape[0]
+    tile2 = np.concatenate([np.arange(nt), np.arange(nt)]).astype(np.int32)
+    phase = np.concatenate(
+        [np.zeros(nt, np.int32), np.ones(nt, np.int32)]
+    )
+    # stable (block, phase, tile) order: all of a block's phase-0 tiles,
+    # then its phase-1 tiles, blocks in layout order
+    order = np.lexsort((tile2, phase, block_id[tile2]))
+    return np.stack([tile2[order], phase[order]], axis=1)
+
+
+# --- the in-kernel dst-row DMA pipeline -----------------------------------
+#
+# One async copy per dst row, HBM -> VMEM scratch slot, all on one DMA
+# semaphore per slot; the wait loop decrements the same descriptors. Row
+# ids come from the SMEM-resident index block the caller pipelines
+# (current/next tile); `col0`/`kc` slice kc columns per row for the
+# K-blocked passes (the DMA is the only place a column window exists).
+
+
+def _rows_start(dref, f_src_ref, fd_scr, slot, sem, t, col0=None, kc=None):
+    def body(r, _):
+        row = dref[0, r]
+        src = (
+            f_src_ref.at[row]
+            if col0 is None
+            else f_src_ref.at[row, pl.ds(col0, kc)]
+        )
+        pltpu.make_async_copy(src, fd_scr.at[slot, r], sem.at[slot]).start()
+        return _
+
+    lax.fori_loop(0, t, body, 0)
+
+
+def _rows_wait(f_src_ref, fd_scr, slot, sem, t, col0=None, kc=None):
+    def body(r, _):
+        src = (
+            f_src_ref.at[0]
+            if col0 is None
+            else f_src_ref.at[0, pl.ds(0, kc)]
+        )
+        pltpu.make_async_copy(src, fd_scr.at[slot, r], sem.at[slot]).wait()
+        return _
+
+    lax.fori_loop(0, t, body, 0)
+
+
+def _fd_pipeline(i, n, dcur_ref, dnxt_ref, f_src_ref, fd_scr, sem, t,
+                 col0=None, kc=None):
+    """The shared double-buffer: at grid step i, issue tile i+1's row
+    copies (addresses from the pipelined NEXT index block), wait tile
+    i's, return the resident (T, Kc) fd slot. Step 0 pays one un-hidden
+    fetch (the prologue); every later tile's gather was issued one step
+    earlier and overlaps that step's compute."""
+
+    @pl.when(i == 0)
+    def _():
+        _rows_start(dcur_ref, f_src_ref, fd_scr, 0, sem, t, col0, kc)
+
+    @pl.when(i + 1 < n)
+    def _():
+        _rows_start(
+            dnxt_ref, f_src_ref, fd_scr, (i + 1) % 2, sem, t, col0, kc
+        )
+
+    _rows_wait(f_src_ref, fd_scr, i % 2, sem, t, col0, kc)
+    return fd_scr[i % 2]
+
+
+def _dst_specs(nj: int, t: int, tile_of):
+    """(current, next) SMEM index-block specs: tile_of(j, *scalars) names
+    the tile whose dst row-id block grid entry j needs."""
+    return (
+        pl.BlockSpec(
+            (1, t), lambda j, *s: (tile_of(j, *s), 0),
+            memory_space=pltpu.SMEM,
+        ),
+        pl.BlockSpec(
+            (1, t),
+            lambda j, *s: (tile_of(jnp.minimum(j + 1, nj - 1), *s), 0),
+            memory_space=pltpu.SMEM,
+        ),
+    )
+
+
+# --- the one-pass fused superstep -----------------------------------------
+
+
+def _superstep_kernel(seq_ref, bid_ref, srcl_ref, mask_ref, dcur_ref,
+                      dnxt_ref, f_blk_ref, sumf_ref, f_src_ref,
+                      fnew_ref, grad_ref, llh_ref, ok_ref, fd_scr, sem,
+                      *, cfg, block_b, tile_t):
+    j = pl.program_id(0)
+    nj = pl.num_programs(0)
+    tile = seq_ref[j, 0]
+    phase = seq_ref[j, 1]
+    blk = bid_ref[tile]
+    jp = jnp.maximum(j - 1, 0)
+    first = jnp.logical_or(
+        j == 0,
+        jnp.logical_or(
+            bid_ref[seq_ref[jp, 0]] != blk, seq_ref[jp, 1] != phase
+        ),
+    )
+    jn = jnp.minimum(j + 1, nj - 1)
+    last = jnp.logical_or(j == nj - 1, bid_ref[seq_ref[jn, 0]] != blk)
+
+    fd = _fd_pipeline(
+        j, nj, dcur_ref, dnxt_ref, f_src_ref, fd_scr, sem, tile_t
+    )                                        # (T, K) dst rows, in VMEM only
+    srcl = srcl_ref[0, 0]                    # (T,)
+    m = mask_ref[0, 0]                       # (T,)
+    fb = f_blk_ref[:]                        # (B, K)
+    sumf = sumf_ref[0]                       # (K,)
+    one = _expand_onehot(srcl, block_b, fd.dtype)        # (B, T)
+    dims = (((0,), (0,)), ((), ()))
+    fs = lax.dot_general(one, fb, dims, precision=_PREC,
+                         preferred_element_type=fd.dtype)
+    etas = cfg.step_candidates
+
+    @pl.when(phase == 0)
+    def _grad_phase():
+        @pl.when(first)
+        def _():
+            grad_ref[0] = jnp.zeros_like(grad_ref)[0]
+            llh_ref[0, 0] = jnp.zeros_like(llh_ref)[0, 0]
+
+        x = jnp.sum(fs * fd, axis=1)         # (T,) edge dots, VPU f32
+        omp, ell_raw = edge_terms(x, cfg)    # same clipping as every path
+        ell = ell_raw * m
+        coeff = m / omp
+        grad_ref[0] += lax.dot_general(      # neighbor-grad scatter
+            one, fd * coeff[:, None], (((1,), (0,)), ((), ())),
+            precision=_PREC, preferred_element_type=fd.dtype,
+        )
+        llh_ref[0, 0] += jnp.sum(one * ell[None, :], axis=1)
+
+    @pl.when(phase == 1)
+    def _cand_phase():
+        @pl.when(first)
+        def _():
+            # the block's grad is complete (its phase-0 entries all ran):
+            # finalize IN VMEM — the -sumF + F fold and the node tail
+            # never round-trip through HBM — and seed the candidate
+            # accumulator with the Armijo tails (split-kernel order:
+            # tails first, then the per-tile neighbor scatters)
+            gfull = grad_ref[0] - sumf[None, :] + fb
+            grad_ref[0] = gfull
+            llh_ref[0, 0] = llh_ref[0, 0] + (
+                -jnp.sum(fb * sumf[None, :], axis=1) + jnp.sum(fb * fb, axis=1)
+            )
+            fms = fb - sumf[None, :]
+            tails = []
+            for eta in etas:
+                nfb = jnp.clip(fb + eta * gfull, cfg.min_f, cfg.max_f)
+                tails.append(jnp.sum(nfb * fms, axis=1))
+            ok_ref[0] = jnp.stack(tails, axis=0)         # (S, B)
+
+        gfull = grad_ref[0]
+        gs = lax.dot_general(one, gfull, dims, precision=_PREC,
+                             preferred_element_type=fd.dtype)
+        ells = []
+        for eta in etas:
+            nf = jnp.clip(fs + eta * gs, cfg.min_f, cfg.max_f)
+            x = jnp.sum(nf * fd, axis=1)
+            _, ell = edge_terms(x, cfg)
+            ells.append(ell * m)
+        ok_ref[0] += lax.dot_general(        # (S, B) neighbor terms
+            jnp.stack(ells, axis=0), one, (((1,), (1,)), ((), ())),
+            precision=_PREC, preferred_element_type=fd.dtype,
+        )
+
+    @pl.when(last)                           # last => phase == 1
+    def _select():
+        gfull = grad_ref[0]
+        cand_llh = ok_ref[0]                 # (S, B), tails included
+        nllh = llh_ref[0, 0]                 # (B,)
+        gg = jnp.sum(gfull * gfull, axis=1)
+        # per-eta scalar loop (etas are compile-time floats — kernels
+        # cannot capture array constants); best_eta is the MAX accepted
+        # step, order-independent like armijo_select
+        oks = []
+        best_eta = jnp.zeros_like(nllh)
+        for s, eta in enumerate(etas):
+            ok_s = cand_llh[s] >= nllh + cfg.alpha * eta * gg
+            oks.append(ok_s)
+            best_eta = jnp.where(
+                ok_s, jnp.maximum(best_eta, eta), best_eta
+            )
+        okm = jnp.stack(oks, axis=0)
+        accepted = jnp.any(okm, axis=0)
+        fnew_ref[0] = jnp.where(
+            accepted[:, None],
+            jnp.clip(fb + best_eta[:, None] * gfull, cfg.min_f, cfg.max_f),
+            fb,
+        )
+        ok_ref[0] = okm.astype(fb.dtype)     # acceptance mask out (0/1)
+
+
+def fused_superstep_csr(
+    F: jax.Array,
+    sumF: jax.Array,
+    tiles: TilesDev,
+    cfg: BigClamConfig,
+    interpret: bool = False,
+    F_gather: jax.Array = None,
+) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
+    """The whole edge superstep in one Pallas pass over the flat tile
+    layout (tiles.seq required): in-kernel double-buffered dst-row DMA,
+    VMEM-resident per-block grad, Armijo ladder + selection + projection
+    fused. Returns (F_new (n_pad, K), grad (n_pad, K), node_llh (n_pad,),
+    ok (S, n_pad) 0/1 acceptance mask — feed accept_stats). `F_gather` is
+    the DMA source the dst ids index (the all-gathered full F on the
+    sharded path; defaults to F)."""
+    n_pad, k = F.shape
+    assert n_pad == tiles.n_pad, (n_pad, tiles.n_pad)
+    assert tiles.seq is not None, "fused superstep needs tiles.seq"
+    b, t = tiles.block_b, tiles.tile_t
+    nj = tiles.seq.shape[0]
+    num_s = len(cfg.step_candidates)
+    F_src = F if F_gather is None else F_gather
+    kernel = functools.partial(
+        _superstep_kernel, cfg=cfg, block_b=b, tile_t=t
+    )
+    dcur, dnxt = _dst_specs(nj, t, lambda j, seq, bid: seq[j, 0])
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(nj,),
+        in_specs=[
+            pl.BlockSpec((1, 1, t), lambda j, seq, bid: (seq[j, 0], 0, 0)),
+            pl.BlockSpec((1, 1, t), lambda j, seq, bid: (seq[j, 0], 0, 0)),
+            dcur,
+            dnxt,
+            pl.BlockSpec((b, k), lambda j, seq, bid: (bid[seq[j, 0]], 0)),
+            pl.BlockSpec((1, k), lambda j, seq, bid: (0, 0)),
+            pl.BlockSpec(memory_space=pltpu.ANY),
+        ],
+        out_specs=[
+            pl.BlockSpec(
+                (1, b, k), lambda j, seq, bid: (bid[seq[j, 0]], 0, 0)
+            ),
+            pl.BlockSpec(
+                (1, b, k), lambda j, seq, bid: (bid[seq[j, 0]], 0, 0)
+            ),
+            pl.BlockSpec(
+                (1, 1, b), lambda j, seq, bid: (bid[seq[j, 0]], 0, 0)
+            ),
+            pl.BlockSpec(
+                (1, num_s, b), lambda j, seq, bid: (bid[seq[j, 0]], 0, 0)
+            ),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((2, t, F_src.shape[1]), F.dtype),
+            pltpu.SemaphoreType.DMA((2,)),
+        ],
+    )
+    nb = tiles.n_blocks
+    operands = (F, F_src, sumF, tiles.mask)
+    F_new, grad, llh, ok = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=[
+            _out_struct((nb, b, k), F.dtype, *operands),
+            _out_struct((nb, b, k), F.dtype, *operands),
+            _out_struct((nb, 1, b), F.dtype, *operands),
+            _out_struct((nb, num_s, b), F.dtype, *operands),
+        ],
+        interpret=interpret,
+    )(
+        tiles.seq, tiles.block_id, tiles.src_local, tiles.mask,
+        tiles.dst, tiles.dst, F, sumF.reshape(1, k), F_src,
+    )
+    return (
+        F_new.reshape(n_pad, k),
+        grad.reshape(n_pad, k),
+        llh.reshape(n_pad),
+        ok.transpose(1, 0, 2).reshape(num_s, n_pad),
+    )
+
+
+# --- gather-fused split kernels (ring phases, TP suite, K-blocked) --------
+#
+# Same compute bodies as the ops.pallas_csr split kernels, with the XLA fd
+# operand replaced by the in-kernel DMA pipeline. These serve the
+# schedules the one-pass superstep cannot: ring phases (grad/cand
+# accumulate across rotations), the K-sharded TP split (per-edge dots
+# psum over "k" between kernels), and the K-blocked large-K passes
+# (kc columns per call — the DMA slices the column window per row, so no
+# (N, kc) column copy is materialized either).
+
+
+def _grad_blocks_kernel(bid_ref, srcl_ref, mask_ref, dcur_ref, dnxt_ref,
+                        f_blk_ref, f_src_ref, grad_out_ref, llh_out_ref,
+                        fd_scr, sem, *, cfg, block_b, tile_t):
+    i = pl.program_id(0)
+    n = pl.num_programs(0)
+    fd = _fd_pipeline(
+        i, n, dcur_ref, dnxt_ref, f_src_ref, fd_scr, sem, tile_t
+    )
+    srcl = srcl_ref[0, 0]
+    m = mask_ref[0, 0]
+    fb = f_blk_ref[:]
+    one = _expand_onehot(srcl, block_b, fd.dtype)
+    fs = lax.dot_general(one, fb, (((0,), (0,)), ((), ())),
+                         precision=_PREC, preferred_element_type=fd.dtype)
+    x = jnp.sum(fs * fd, axis=1)
+    omp, ell_raw = edge_terms(x, cfg)
+    ell = ell_raw * m
+    coeff = m / omp
+    contrib = lax.dot_general(
+        one, fd * coeff[:, None], (((1,), (0,)), ((), ())),
+        precision=_PREC, preferred_element_type=fd.dtype,
+    )
+    llh_c = jnp.sum(one * ell[None, :], axis=1)
+    prev = bid_ref[jnp.maximum(i - 1, 0)]
+
+    @pl.when(jnp.logical_or(i == 0, bid_ref[i] != prev))
+    def _():
+        grad_out_ref[0] = jnp.zeros_like(grad_out_ref)[0]
+        llh_out_ref[0, 0] = jnp.zeros_like(llh_out_ref)[0, 0]
+
+    grad_out_ref[0] += contrib
+    llh_out_ref[0, 0] += llh_c
+
+
+def _grad_blocks_fused(
+    F: jax.Array,
+    tiles: TilesDev,
+    cfg: BigClamConfig,
+    F_gather: jax.Array,
+    interpret: bool = False,
+) -> Tuple[jax.Array, jax.Array]:
+    """ops.pallas_csr._grad_blocks with the dst rows DMA'd in-kernel from
+    `F_gather` (the ring's rotating shard / the all-gathered F) — raw
+    (n_blocks, B, K) neighbor-grad partials + (n_blocks, 1, B) LLH
+    partials, no HBM fd."""
+    n_pad, k = F.shape
+    b, t = tiles.block_b, tiles.tile_t
+    n_tiles = tiles.src_local.shape[0]
+    kernel = functools.partial(
+        _grad_blocks_kernel, cfg=cfg, block_b=b, tile_t=t
+    )
+    dcur, dnxt = _dst_specs(n_tiles, t, lambda i, bid: i)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(n_tiles,),
+        in_specs=[
+            pl.BlockSpec((1, 1, t), lambda i, bid: (i, 0, 0)),
+            pl.BlockSpec((1, 1, t), lambda i, bid: (i, 0, 0)),
+            dcur,
+            dnxt,
+            pl.BlockSpec((b, k), lambda i, bid: (bid[i], 0)),
+            pl.BlockSpec(memory_space=pltpu.ANY),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, b, k), lambda i, bid: (bid[i], 0, 0)),
+            pl.BlockSpec((1, 1, b), lambda i, bid: (bid[i], 0, 0)),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((2, t, F_gather.shape[1]), F.dtype),
+            pltpu.SemaphoreType.DMA((2,)),
+        ],
+    )
+    operands = (F, F_gather, tiles.mask)
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=[
+            _out_struct((tiles.n_blocks, b, k), F.dtype, *operands),
+            _out_struct((tiles.n_blocks, 1, b), F.dtype, *operands),
+        ],
+        interpret=interpret,
+    )(
+        tiles.block_id, tiles.src_local, tiles.mask, tiles.dst, tiles.dst,
+        F, F_gather,
+    )
+
+
+def _cand_blocks_kernel(bid_ref, srcl_ref, mask_ref, dcur_ref, dnxt_ref,
+                        f_blk_ref, g_blk_ref, f_src_ref, out_ref,
+                        fd_scr, sem, *, cfg, block_b, tile_t):
+    i = pl.program_id(0)
+    n = pl.num_programs(0)
+    fd = _fd_pipeline(
+        i, n, dcur_ref, dnxt_ref, f_src_ref, fd_scr, sem, tile_t
+    )
+    srcl = srcl_ref[0, 0]
+    m = mask_ref[0, 0]
+    fb = f_blk_ref[:]
+    gb = g_blk_ref[:]
+    one = _expand_onehot(srcl, block_b, fd.dtype)
+    dims = (((0,), (0,)), ((), ()))
+    fs = lax.dot_general(one, fb, dims, precision=_PREC,
+                         preferred_element_type=fd.dtype)
+    gs = lax.dot_general(one, gb, dims, precision=_PREC,
+                         preferred_element_type=fd.dtype)
+    ells = []
+    for eta in cfg.step_candidates:
+        nf = jnp.clip(fs + eta * gs, cfg.min_f, cfg.max_f)
+        x = jnp.sum(nf * fd, axis=1)
+        _, ell = edge_terms(x, cfg)
+        ells.append(ell * m)
+    scat = lax.dot_general(
+        jnp.stack(ells, axis=0), one, (((1,), (1,)), ((), ())),
+        precision=_PREC, preferred_element_type=fd.dtype,
+    )
+    prev = bid_ref[jnp.maximum(i - 1, 0)]
+
+    @pl.when(jnp.logical_or(i == 0, bid_ref[i] != prev))
+    def _():
+        out_ref[0] = jnp.zeros_like(out_ref)[0]
+
+    out_ref[0] += scat
+
+
+def _cand_blocks_fused(
+    F: jax.Array,
+    grad: jax.Array,
+    tiles: TilesDev,
+    cfg: BigClamConfig,
+    F_gather: jax.Array,
+    interpret: bool = False,
+) -> jax.Array:
+    """ops.pallas_csr._cand_blocks (with_tails=False — ring phases see a
+    partial edge set, the tails are added once outside) with the dst rows
+    DMA'd in-kernel: (n_blocks, S, B) neighbor candidate partials."""
+    n_pad, k = F.shape
+    b, t = tiles.block_b, tiles.tile_t
+    n_tiles = tiles.src_local.shape[0]
+    num_s = len(cfg.step_candidates)
+    kernel = functools.partial(
+        _cand_blocks_kernel, cfg=cfg, block_b=b, tile_t=t
+    )
+    dcur, dnxt = _dst_specs(n_tiles, t, lambda i, bid: i)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(n_tiles,),
+        in_specs=[
+            pl.BlockSpec((1, 1, t), lambda i, bid: (i, 0, 0)),
+            pl.BlockSpec((1, 1, t), lambda i, bid: (i, 0, 0)),
+            dcur,
+            dnxt,
+            pl.BlockSpec((b, k), lambda i, bid: (bid[i], 0)),
+            pl.BlockSpec((b, k), lambda i, bid: (bid[i], 0)),
+            pl.BlockSpec(memory_space=pltpu.ANY),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, num_s, b), lambda i, bid: (bid[i], 0, 0)
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((2, t, F_gather.shape[1]), F.dtype),
+            pltpu.SemaphoreType.DMA((2,)),
+        ],
+    )
+    operands = (F, grad, F_gather, tiles.mask)
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=_out_struct(
+            (tiles.n_blocks, num_s, b), F.dtype, *operands
+        ),
+        interpret=interpret,
+    )(
+        tiles.block_id, tiles.src_local, tiles.mask, tiles.dst, tiles.dst,
+        F, grad, F_gather,
+    )
+
+
+def _edge_dots_kernel(bid_ref, kb_ref, srcl_ref, dcur_ref, dnxt_ref,
+                      f_blk_ref, f_src_ref, x_out_ref, fd_scr, sem,
+                      *, block_b, tile_t, kc):
+    i = pl.program_id(0)
+    n = pl.num_programs(0)
+    fd = _fd_pipeline(
+        i, n, dcur_ref, dnxt_ref, f_src_ref, fd_scr, sem, tile_t,
+        col0=kb_ref[0] * kc, kc=kc,
+    )
+    srcl = srcl_ref[0, 0]
+    fb = f_blk_ref[:]                        # (B, kc) — spec-sliced columns
+    one = _expand_onehot(srcl, block_b, fd.dtype)
+    fs = lax.dot_general(one, fb, (((0,), (0,)), ((), ())),
+                         precision=_PREC, preferred_element_type=fd.dtype)
+    x_out_ref[0, 0] = jnp.sum(fs * fd, axis=1)
+
+
+def edge_dots_fused(
+    F: jax.Array,
+    tiles: TilesDev,
+    F_gather: jax.Array,
+    kb: jax.Array,
+    kc: int,
+    interpret: bool = False,
+) -> jax.Array:
+    """Per-edge PARTIAL dots over columns [kb*kc, (kb+1)*kc) with the dst
+    rows' column window DMA'd in-kernel from `F_gather`: (n_tiles, 1, T).
+    The column window exists only in the DMA descriptors — neither an fd
+    nor an (N, kc) column slice is ever materialized. kc == K with kb=0
+    is the flat TP form (whole K_loc rows)."""
+    n_pad, k = F.shape
+    b, t = tiles.block_b, tiles.tile_t
+    n_tiles = tiles.src_local.shape[0]
+    kernel = functools.partial(
+        _edge_dots_kernel, block_b=b, tile_t=t, kc=kc
+    )
+    dcur, dnxt = _dst_specs(n_tiles, t, lambda i, bid, kbv: i)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(n_tiles,),
+        in_specs=[
+            pl.BlockSpec((1, 1, t), lambda i, bid, kbv: (i, 0, 0)),
+            dcur,
+            dnxt,
+            pl.BlockSpec((b, kc), lambda i, bid, kbv: (bid[i], kbv[0])),
+            pl.BlockSpec(memory_space=pltpu.ANY),
+        ],
+        out_specs=pl.BlockSpec((1, 1, t), lambda i, bid, kbv: (i, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((2, t, kc), F.dtype),
+            pltpu.SemaphoreType.DMA((2,)),
+        ],
+    )
+    operands = (F, F_gather)
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=_out_struct((n_tiles, 1, t), F.dtype, *operands),
+        interpret=interpret,
+    )(
+        tiles.block_id, jnp.asarray(kb, jnp.int32).reshape(1),
+        tiles.src_local, tiles.dst, tiles.dst, F, F_gather,
+    )
+
+
+def _grad_from_x_kernel(bid_ref, kb_ref, srcl_ref, mask_ref, x_ref,
+                        dcur_ref, dnxt_ref, *rest, cfg, block_b, tile_t,
+                        kc, fold):
+    if fold:
+        (f_blk_ref, sumf_ref, f_src_ref, grad_out_ref, llh_out_ref,
+         fd_scr, sem) = rest
+    else:
+        f_src_ref, grad_out_ref, llh_out_ref, fd_scr, sem = rest
+    i = pl.program_id(0)
+    n = pl.num_programs(0)
+    fd = _fd_pipeline(
+        i, n, dcur_ref, dnxt_ref, f_src_ref, fd_scr, sem, tile_t,
+        col0=kb_ref[0] * kc, kc=kc,
+    )
+    srcl = srcl_ref[0, 0]
+    m = mask_ref[0, 0]
+    x = x_ref[0, 0]                          # (T,) FULL edge dots
+    one = _expand_onehot(srcl, block_b, fd.dtype)
+    omp, ell_raw = edge_terms(x, cfg)
+    ell = ell_raw * m
+    coeff = m / omp
+    contrib = lax.dot_general(
+        one, fd * coeff[:, None], (((1,), (0,)), ((), ())),
+        precision=_PREC, preferred_element_type=fd.dtype,
+    )
+    llh_c = jnp.sum(one * ell[None, :], axis=1)
+    prev = bid_ref[jnp.maximum(i - 1, 0)]
+
+    @pl.when(jnp.logical_or(i == 0, bid_ref[i] != prev))
+    def _():
+        grad_out_ref[0] = jnp.zeros_like(grad_out_ref)[0]
+        llh_out_ref[0, 0] = jnp.zeros_like(llh_out_ref)[0, 0]
+
+    grad_out_ref[0] += contrib
+    llh_out_ref[0, 0] += llh_c
+    if fold:
+        # last tile of the block: fold -sumF + F into the completed
+        # neighbor sum so the caller gets the FULL gradient columns
+        nxt = bid_ref[jnp.minimum(i + 1, n - 1)]
+
+        @pl.when(jnp.logical_or(i == n - 1, nxt != bid_ref[i]))
+        def _():
+            grad_out_ref[0] = (
+                grad_out_ref[0] - sumf_ref[0][None, :] + f_blk_ref[:]
+            )
+
+
+def grad_nbr_from_x_fused(
+    x: jax.Array,
+    tiles: TilesDev,
+    F_gather: jax.Array,
+    kb: jax.Array,
+    kc: int,
+    cfg: BigClamConfig,
+    interpret: bool = False,
+    F: jax.Array = None,
+    sumF: jax.Array = None,
+) -> Tuple[jax.Array, jax.Array]:
+    """Gradient columns [kb*kc, (kb+1)*kc) + neighbor LLH from FULL edge
+    dots `x`, dst rows DMA'd in-kernel. With F/sumF given the -sumF + F
+    fold happens in-kernel at each block's last tile (the K-blocked
+    passes — the caller gets full gradient columns); without, neighbor
+    partials only (ring phases accumulate across rotations). Returns
+    (grad (n_pad, kc), llh (n_pad,))."""
+    n_tiles, _, t = x.shape
+    b = tiles.block_b
+    fold = F is not None
+    kernel = functools.partial(
+        _grad_from_x_kernel, cfg=cfg, block_b=b, tile_t=t, kc=kc,
+        fold=fold,
+    )
+    dcur, dnxt = _dst_specs(n_tiles, t, lambda i, bid, kbv: i)
+    in_specs = [
+        pl.BlockSpec((1, 1, t), lambda i, bid, kbv: (i, 0, 0)),
+        pl.BlockSpec((1, 1, t), lambda i, bid, kbv: (i, 0, 0)),
+        pl.BlockSpec((1, 1, t), lambda i, bid, kbv: (i, 0, 0)),
+        dcur,
+        dnxt,
+    ]
+    args = [
+        tiles.src_local, tiles.mask, x, tiles.dst, tiles.dst,
+    ]
+    if fold:
+        in_specs += [
+            pl.BlockSpec((b, kc), lambda i, bid, kbv: (bid[i], kbv[0])),
+            pl.BlockSpec((1, kc), lambda i, bid, kbv: (0, kbv[0])),
+        ]
+        args += [F, sumF.reshape(1, -1)]
+    in_specs.append(pl.BlockSpec(memory_space=pltpu.ANY))
+    args.append(F_gather)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(n_tiles,),
+        in_specs=in_specs,
+        out_specs=[
+            pl.BlockSpec((1, b, kc), lambda i, bid, kbv: (bid[i], 0, 0)),
+            pl.BlockSpec((1, 1, b), lambda i, bid, kbv: (bid[i], 0, 0)),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((2, t, kc), x.dtype),
+            pltpu.SemaphoreType.DMA((2,)),
+        ],
+    )
+    operands = (x, F_gather, tiles.mask)
+    grad_out, llh_out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=[
+            _out_struct((tiles.n_blocks, b, kc), x.dtype, *operands),
+            _out_struct((tiles.n_blocks, 1, b), x.dtype, *operands),
+        ],
+        interpret=interpret,
+    )(tiles.block_id, jnp.asarray(kb, jnp.int32).reshape(1), *args)
+    return grad_out.reshape(tiles.n_pad, kc), llh_out.reshape(tiles.n_pad)
+
+
+def _cand_dots_kernel(bid_ref, kb_ref, srcl_ref, dcur_ref, dnxt_ref,
+                      f_blk_ref, g_blk_ref, f_src_ref, xc_out_ref,
+                      fd_scr, sem, *, cfg, block_b, tile_t, kc):
+    i = pl.program_id(0)
+    n = pl.num_programs(0)
+    fd = _fd_pipeline(
+        i, n, dcur_ref, dnxt_ref, f_src_ref, fd_scr, sem, tile_t,
+        col0=kb_ref[0] * kc, kc=kc,
+    )
+    srcl = srcl_ref[0, 0]
+    fb = f_blk_ref[:]
+    gb = g_blk_ref[:]
+    one = _expand_onehot(srcl, block_b, fd.dtype)
+    dims = (((0,), (0,)), ((), ()))
+    fs = lax.dot_general(one, fb, dims, precision=_PREC,
+                         preferred_element_type=fd.dtype)
+    gs = lax.dot_general(one, gb, dims, precision=_PREC,
+                         preferred_element_type=fd.dtype)
+    for s, eta in enumerate(cfg.step_candidates):
+        nf = jnp.clip(fs + eta * gs, cfg.min_f, cfg.max_f)
+        xc_out_ref[0, s] = jnp.sum(nf * fd, axis=1)
+
+
+def cand_dots_fused(
+    F: jax.Array,
+    grad_kb: jax.Array,
+    tiles: TilesDev,
+    F_gather: jax.Array,
+    kb: jax.Array,
+    kc: int,
+    cfg: BigClamConfig,
+    interpret: bool = False,
+) -> jax.Array:
+    """Per-edge PARTIAL candidate dots over columns [kb*kc, (kb+1)*kc),
+    dst rows DMA'd in-kernel: (n_tiles, S, T). `grad_kb` holds the kc
+    gradient COLUMNS (n_pad, kc) — already a column window, indexed at
+    block 0."""
+    n_pad, k = F.shape
+    b, t = tiles.block_b, tiles.tile_t
+    n_tiles = tiles.src_local.shape[0]
+    num_s = len(cfg.step_candidates)
+    kernel = functools.partial(
+        _cand_dots_kernel, cfg=cfg, block_b=b, tile_t=t, kc=kc
+    )
+    dcur, dnxt = _dst_specs(n_tiles, t, lambda i, bid, kbv: i)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(n_tiles,),
+        in_specs=[
+            pl.BlockSpec((1, 1, t), lambda i, bid, kbv: (i, 0, 0)),
+            dcur,
+            dnxt,
+            pl.BlockSpec((b, kc), lambda i, bid, kbv: (bid[i], kbv[0])),
+            pl.BlockSpec((b, kc), lambda i, bid, kbv: (bid[i], 0)),
+            pl.BlockSpec(memory_space=pltpu.ANY),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, num_s, t), lambda i, bid, kbv: (i, 0, 0)
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((2, t, kc), F.dtype),
+            pltpu.SemaphoreType.DMA((2,)),
+        ],
+    )
+    operands = (F, grad_kb, F_gather)
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=_out_struct((n_tiles, num_s, t), F.dtype, *operands),
+        interpret=interpret,
+    )(
+        tiles.block_id, jnp.asarray(kb, jnp.int32).reshape(1),
+        tiles.src_local, tiles.dst, tiles.dst, F, grad_kb, F_gather,
+    )
+
+
+def train_pass_csr_kblocked_fused(
+    F: jax.Array,
+    sumF: jax.Array,
+    tiles: TilesDev,
+    cfg: BigClamConfig,
+    k_axis: Optional[str] = None,
+    interpret: bool = False,
+    F_gather: jax.Array = None,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """The K-blocked large-K train pass on FLAT tiles with in-kernel
+    gather — the fused twin of ops.pallas_csr
+    .train_pass_csr_grouped_kblocked_tp, minus the grouped layout (no fd
+    is materialized, so there is no per-group gather to bound; the flat
+    layout the store-native builders already produce suffices — this is
+    what closes the grouped/K-blocked store-layout gap).
+
+    Per step: (1) accumulate full per-edge dots over kc-column K blocks
+    (edge_dots_fused per block), one psum over `k_axis` completes them
+    (identity when k_axis is None — single chip / tp == 1); (2) per K
+    block, consume x into that block's FULL gradient columns (the
+    -sumF + F fold happens in-kernel) and accumulate candidate partial
+    dots; (3) psum the candidate partials, one consume kernel.
+
+    F/sumF hold this device's K_loc columns, tiles.kc | K_loc. Returns
+    (grad (n_pad, K_loc), llh_nbr (n_pad,), cand_nbr (S, n_pad)) —
+    candidate terms NEIGHBOR-only; the caller adds the Armijo tails
+    (armijo_update / armijo_tail_select_sharded)."""
+    from bigclam_tpu.ops.pallas_csr import cand_nbr_from_x_csr
+
+    n_pad, k = F.shape
+    assert n_pad == tiles.n_pad, (n_pad, tiles.n_pad)
+    kc = tiles.kc
+    assert kc > 0 and k % kc == 0, (k, kc)
+    n_kb = k // kc
+    n_tiles = tiles.src_local.shape[0]
+    t = tiles.tile_t
+    num_s = len(cfg.step_candidates)
+    F_src = F if F_gather is None else F_gather
+
+    def psum(v):
+        return v if k_axis is None else lax.psum(v, k_axis)
+
+    def dots_kb(x_acc, kb):
+        x_kb = edge_dots_fused(
+            F, tiles, F_src, kb, kc, interpret=interpret
+        )
+        return x_acc + x_kb, None
+
+    x_loc, _ = lax.scan(
+        dots_kb, jnp.zeros((n_tiles, 1, t), F.dtype), jnp.arange(n_kb)
+    )
+    x = psum(x_loc)
+
+    def consume_kb(xc_acc, kb):
+        grad_kb, ln_kb = grad_nbr_from_x_fused(
+            x, tiles, F_src, kb, kc, cfg, interpret=interpret,
+            F=F, sumF=sumF,
+        )
+        xc_kb = cand_dots_fused(
+            F, grad_kb, tiles, F_src, kb, kc, cfg, interpret=interpret
+        )
+        return xc_acc + xc_kb, (grad_kb, ln_kb)
+
+    xc_loc, (grads, lns) = lax.scan(
+        consume_kb, jnp.zeros((n_tiles, num_s, t), F.dtype),
+        jnp.arange(n_kb),
+    )
+    xc = psum(xc_loc)
+    cand_nbr = cand_nbr_from_x_csr(xc, tiles, cfg, interpret=interpret)
+    grad = grads.transpose(1, 0, 2).reshape(n_pad, k)
+    # llh depends only on the (already global) x and the mask — identical
+    # across K blocks
+    return grad, lns[0], cand_nbr

@@ -193,6 +193,102 @@ def member_lookup(
     return jnp.where(hit, jnp.take_along_axis(wv, pos, axis=-1), 0.0)
 
 
+# --- Pallas member-merge kernel (ISSUE 13) --------------------------------
+#
+# The searchsorted merge above is gather-bound XLA: a vmapped binary
+# search per (edge, slot) plus two take_along_axis gathers — per-element
+# random access the TPU pays latency for. The Pallas kernel below merges
+# an edge BLOCK's member lists with M slot-compare sweeps over VMEM-
+# resident tiles (M is small — 64 by default — so the M^2 compares per
+# edge are dense VPU work instead of E*M scattered loads). EXACT against
+# member_lookup whenever member ids are unique per row (they are by
+# construction: from_dense and support_update both dedup): the compare
+# mask hits at most one slot, and summing one weight plus zeros is the
+# weight bit-for-bit. Pinned by tests/test_fused.py incl. sentinel
+# padding and M < K truncation.
+
+_MERGE_BLOCK_E = 256      # edge rows per kernel block
+
+
+def merge_pallas_want(cfg: BigClamConfig) -> bool:
+    """Should the Pallas member-merge engage? (auto: TPU backends, or
+    interpret mode for the CPU-gated tests — mirrors csr_want_reason)."""
+    want = cfg.sparse_pallas_merge
+    if want is None:
+        want = jax.default_backend() == "tpu" or cfg.pallas_interpret
+    return bool(want)
+
+
+def _merge_kernel(iv_ref, wv_ref, iu_ref, out_ref, *, m, k_pad):
+    iv = iv_ref[:]                       # (eb, M) neighbor member ids
+    wv = wv_ref[:]                       # (eb, M) neighbor weights
+    iu = iu_ref[:]                       # (eb, M) own member ids
+    valid = iu < k_pad                   # sentinel own slots never match
+    acc = jnp.zeros_like(wv)
+    for p in range(m):                   # M slot-compare sweeps, unrolled
+        hit = jnp.logical_and(iv[:, p : p + 1] == iu, valid)
+        acc = acc + jnp.where(hit, wv[:, p : p + 1], 0.0)
+    out_ref[:] = acc
+
+
+def member_lookup_pallas(
+    iv: jax.Array,
+    wv: jax.Array,
+    iu: jax.Array,
+    k_pad: int,
+    interpret: bool = False,
+) -> jax.Array:
+    """member_lookup as a Pallas merge kernel over edge blocks (see the
+    section comment). Same (E, M) -> (E, M) contract; rows are padded to
+    the block size with sentinel ids (k_pad — they produce exact 0.0)
+    and sliced back."""
+    from jax.experimental import pallas as pl
+
+    from bigclam_tpu.ops.pallas_csr import _out_struct
+
+    e, m = iu.shape
+    eb = min(_MERGE_BLOCK_E, max(_round_up(e, 8), 8))
+    e_pad = _round_up(max(e, 1), eb)
+    if e_pad != e:
+        pad = e_pad - e
+        iv = jnp.pad(iv, ((0, pad), (0, 0)), constant_values=k_pad)
+        wv = jnp.pad(wv, ((0, pad), (0, 0)))
+        iu = jnp.pad(iu, ((0, pad), (0, 0)), constant_values=k_pad)
+    import functools
+
+    out = pl.pallas_call(
+        functools.partial(_merge_kernel, m=m, k_pad=k_pad),
+        grid=(e_pad // eb,),
+        in_specs=[
+            pl.BlockSpec((eb, m), lambda i: (i, 0)),
+            pl.BlockSpec((eb, m), lambda i: (i, 0)),
+            pl.BlockSpec((eb, m), lambda i: (i, 0)),
+        ],
+        out_specs=pl.BlockSpec((eb, m), lambda i: (i, 0)),
+        out_shape=_out_struct((e_pad, m), wv.dtype, iv, wv, iu),
+        interpret=interpret,
+    )(iv, wv, iu)
+    return out[:e]
+
+
+def member_lookup_impl(
+    iv: jax.Array,
+    wv: jax.Array,
+    iu: jax.Array,
+    k_pad: int,
+    cfg: BigClamConfig,
+) -> jax.Array:
+    """The ONE merge dispatch every sparse edge sweep goes through: the
+    Pallas merge kernel when engaged (merge_pallas_want), else the XLA
+    searchsorted merge — so the single-chip and sharded sparse trainers
+    can never resolve the path differently for one config."""
+    if merge_pallas_want(cfg):
+        return member_lookup_pallas(
+            iv, wv, iu, k_pad, interpret=cfg.pallas_interpret
+        )
+    return member_lookup(iv, wv, iu, k_pad)
+
+
 def sparse_sumF(ids: jax.Array, w: jax.Array, k_pad: int) -> jax.Array:
     """Dense (K_pad,) column sums from the sparse state — a scatter-add
     of N*M values, never an (N, K) array. Sentinel ids (== k_pad) are
@@ -257,7 +353,9 @@ def sparse_grad_llh(
         nbr_llh, nbr_grad = carry
         s, d, m = sdm
         iu, wu = ids[s], w[s]
-        vals = member_lookup(ids_dst[d], w_dst[d], iu, k_pad)  # (chunk, M)
+        vals = member_lookup_impl(
+            ids_dst[d], w_dst[d], iu, k_pad, cfg
+        )                                                      # (chunk, M)
         x = jnp.einsum("em,em->e", wu, vals)
         omp, ell = edge_terms(x, cfg)
         coeff = m / omp
@@ -304,7 +402,7 @@ def sparse_candidates(
     def body(acc, sdm):
         s, d, m = sdm
         iu, wu, gu = ids[s], w[s], grad[s]
-        vals = member_lookup(ids_dst[d], w_dst[d], iu, k_pad)
+        vals = member_lookup_impl(ids_dst[d], w_dst[d], iu, k_pad, cfg)
 
         def one_eta(eta):
             nw = jnp.clip(wu + eta * gu, cfg.min_f, cfg.max_f)

@@ -483,6 +483,13 @@ def make_ring_csr_train_step(
         edge_dots_csr,
         grad_nbr_from_x_csr,
     )
+    from bigclam_tpu.ops.pallas_fused import (
+        _cand_blocks_fused,
+        _grad_blocks_fused,
+        cand_dots_fused,
+        edge_dots_fused,
+        grad_nbr_from_x_fused,
+    )
 
     dp = mesh.shape[NODES_AXIS]
     tp = mesh.shape[K_AXIS]
@@ -492,6 +499,7 @@ def make_ring_csr_train_step(
     tile_t = tiles["tile_t"]
     n_blocks = tiles["n_blocks"]
     kc = tiles.get("kc", 0)
+    fused = bool(tiles.get("fused"))
     num_s = len(cfg.step_candidates)
 
     def step_shard_kb(F_loc, srcl, dstl, mask, bid, it):
@@ -528,10 +536,19 @@ def make_ring_csr_train_step(
             td, d = td_of(xs)
 
             def dots_kb(x_acc, kb):
-                F_kb = lax.dynamic_slice_in_dim(F_loc, kb * kc, kc, axis=1)
-                x_kb = edge_dots_csr(
-                    F_kb, td, fd_of(F_rot, d, kb), interpret=interp
-                )
+                if fused:
+                    # in-kernel gather from the rotating shard: the
+                    # kc-column window exists only in DMA descriptors
+                    x_kb = edge_dots_fused(
+                        F_loc, td, F_rot, kb, kc, interpret=interp
+                    )
+                else:
+                    F_kb = lax.dynamic_slice_in_dim(
+                        F_loc, kb * kc, kc, axis=1
+                    )
+                    x_kb = edge_dots_csr(
+                        F_kb, td, fd_of(F_rot, d, kb), interpret=interp
+                    )
                 return x_acc + x_kb, None
 
             x_loc, _ = lax.scan(
@@ -541,9 +558,16 @@ def make_ring_csr_train_step(
             x = lax.psum(x_loc, K_AXIS)
 
             def consume_kb(_, kb):
-                gn_kb, ln_kb = grad_nbr_from_x_csr(
-                    x, td, fd_of(F_rot, d, kb), cfg, interpret=interp
-                )
+                if fused:
+                    # neighbor-only (no -sumF + F fold: the ring
+                    # accumulates gn across phases first)
+                    gn_kb, ln_kb = grad_nbr_from_x_fused(
+                        x, td, F_rot, kb, kc, cfg, interpret=interp
+                    )
+                else:
+                    gn_kb, ln_kb = grad_nbr_from_x_csr(
+                        x, td, fd_of(F_rot, d, kb), cfg, interpret=interp
+                    )
                 return None, (gn_kb, ln_kb)
 
             _, (gns, lns) = lax.scan(consume_kb, None, jnp.arange(n_kb))
@@ -573,12 +597,20 @@ def make_ring_csr_train_step(
             td, d = td_of(xs)
 
             def cdots_kb(xc_acc, kb):
-                F_kb = lax.dynamic_slice_in_dim(F_loc, kb * kc, kc, axis=1)
                 g_kb = lax.dynamic_slice_in_dim(grad, kb * kc, kc, axis=1)
-                xc_kb = cand_dots_csr(
-                    F_kb, g_kb, td, fd_of(F_rot, d, kb), cfg,
-                    interpret=interp,
-                )
+                if fused:
+                    xc_kb = cand_dots_fused(
+                        F_loc, g_kb, td, F_rot, kb, kc, cfg,
+                        interpret=interp,
+                    )
+                else:
+                    F_kb = lax.dynamic_slice_in_dim(
+                        F_loc, kb * kc, kc, axis=1
+                    )
+                    xc_kb = cand_dots_csr(
+                        F_kb, g_kb, td, fd_of(F_rot, d, kb), cfg,
+                        interpret=interp,
+                    )
                 return xc_acc + xc_kb, None
 
             xc_loc, _ = lax.scan(
@@ -624,11 +656,27 @@ def make_ring_csr_train_step(
         def grad_sweep(acc, xs, F_rot):
             gn_acc, ln_acc = acc
             td, d = td_of(xs)
-            fd = jnp.take(F_rot, d, axis=0)      # K_loc columns of F_rot
-            x = lax.psum(
-                edge_dots_csr(F_loc, td, fd, interpret=interp), K_AXIS
-            )
-            gn, ln = grad_nbr_from_x_csr(x, td, fd, cfg, interpret=interp)
+            k_loc = F_loc.shape[1]
+            if fused:
+                # fused TP phases: whole-K_loc rows DMA'd in-kernel from
+                # the rotating shard (kb=0, kc=K_loc)
+                x = lax.psum(
+                    edge_dots_fused(
+                        F_loc, td, F_rot, 0, k_loc, interpret=interp
+                    ),
+                    K_AXIS,
+                )
+                gn, ln = grad_nbr_from_x_fused(
+                    x, td, F_rot, 0, k_loc, cfg, interpret=interp
+                )
+            else:
+                fd = jnp.take(F_rot, d, axis=0)  # K_loc columns of F_rot
+                x = lax.psum(
+                    edge_dots_csr(F_loc, td, fd, interpret=interp), K_AXIS
+                )
+                gn, ln = grad_nbr_from_x_csr(
+                    x, td, fd, cfg, interpret=interp
+                )
             return gn_acc + gn, ln_acc + ln
 
         init = (
@@ -650,11 +698,22 @@ def make_ring_csr_train_step(
         # --- rotation 2: candidate partial dots -> psum -> consume ---
         def cand_sweep(cn_acc, xs, F_rot):
             td, d = td_of(xs)
-            fd = jnp.take(F_rot, d, axis=0)
-            xc = lax.psum(
-                cand_dots_csr(F_loc, grad, td, fd, cfg, interpret=interp),
-                K_AXIS,
-            )
+            if fused:
+                xc = lax.psum(
+                    cand_dots_fused(
+                        F_loc, grad, td, F_rot, 0, F_loc.shape[1], cfg,
+                        interpret=interp,
+                    ),
+                    K_AXIS,
+                )
+            else:
+                fd = jnp.take(F_rot, d, axis=0)
+                xc = lax.psum(
+                    cand_dots_csr(
+                        F_loc, grad, td, fd, cfg, interpret=interp
+                    ),
+                    K_AXIS,
+                )
             cb = cand_nbr_from_x_csr(xc, td, cfg, interpret=interp)
             return cn_acc + cb
 
@@ -693,8 +752,13 @@ def make_ring_csr_train_step(
         def grad_sweep(acc, xs, F_rot):
             gn_acc, ln_acc = acc
             td, d = td_of(xs)
-            fd = jnp.take(F_rot, d, axis=0)      # local rows of F_rot
-            gn, ln = _grad_blocks(F_loc, td, cfg, fd, interp)
+            if fused:
+                # per-phase fused kernel: dst rows of the ROTATING shard
+                # DMA'd in-kernel, double-buffered — no per-phase fd
+                gn, ln = _grad_blocks_fused(F_loc, td, cfg, F_rot, interp)
+            else:
+                fd = jnp.take(F_rot, d, axis=0)  # local rows of F_rot
+                gn, ln = _grad_blocks(F_loc, td, cfg, fd, interp)
             return gn_acc + gn, ln_acc + ln
 
         init = (
@@ -720,10 +784,14 @@ def make_ring_csr_train_step(
         # --- rotation 2: per-phase candidate kernels (neighbor terms) ---
         def cand_sweep(cn_acc, xs, F_rot):
             td, d = td_of(xs)
-            fd = jnp.take(F_rot, d, axis=0)
-            cb = _cand_blocks(
-                F_loc, grad, sumF, td, cfg, fd, interp, with_tails=False
-            )
+            if fused:
+                cb = _cand_blocks_fused(F_loc, grad, td, cfg, F_rot, interp)
+            else:
+                fd = jnp.take(F_rot, d, axis=0)
+                cb = _cand_blocks(
+                    F_loc, grad, sumF, td, cfg, fd, interp,
+                    with_tails=False,
+                )
             return cn_acc + cb
 
         initc = _mark_varying(
@@ -865,6 +933,12 @@ class RingBigClamModel(ShardedBigClamModel):
         (K_loc beyond the kernels' VMEM bound)."""
         if not self._csr_wanted:
             return "xla"
+        if getattr(self, "_csr_fused", False):
+            return (
+                "csr_ring_fused_kb"
+                if getattr(self, "_csr_kc", 0)
+                else "csr_ring_fused"
+            )
         return "csr_ring_kb" if getattr(self, "_csr_kc", 0) else "csr_ring"
 
     def _bucket_slots_per_phase(self) -> int:
@@ -912,6 +986,7 @@ class RingBigClamModel(ShardedBigClamModel):
             donate=bool(cfg.donate_state),
             rollback=int(getattr(cfg, "rollback_budget", 0) or 0) > 0,
             fd_bytes=self._memory_fd_bytes(),
+            fused=self._csr_wanted and getattr(self, "_csr_fused", False),
             overlap=bool(cfg.ring_overlap),
             comms=self.comms,
             model=type(self).__name__,
@@ -942,7 +1017,11 @@ class RingBigClamModel(ShardedBigClamModel):
         pad_ok = layout_economical(
             rbt.slots, e, dp * dp * rbt.n_blocks, tile_t
         )
-        if pad_ok and phase_fd <= GROUP_FD_BUDGET:
+        # fused phases gather in-kernel — no per-phase fd to budget
+        if pad_ok and (
+            getattr(self, "_csr_fused", False)
+            or phase_fd <= GROUP_FD_BUDGET
+        ):
             self._probe_tiles = rbt
             self._csr_nb = None
             return True
@@ -1007,6 +1086,7 @@ class RingBigClamModel(ShardedBigClamModel):
             "tile_t": rbt.tile_t,
             "n_blocks": rbt.n_blocks,
             "kc": getattr(self, "_csr_kc", 0),
+            "fused": getattr(self, "_csr_fused", False),
         }
         self.edges = None
         self._tiles_dev = tiles                  # kept for rebuild_step
@@ -1132,7 +1212,10 @@ class StoreRingBigClamModel(_StoreBackedMixin, RingBigClamModel):
         n_blocks = (n_pad // dp) // block_b
         phase_fd = pad_tiles * tile_t * k_loc * 4
         pad_ok = layout_economical(slots, e, dp * dp * n_blocks, tile_t)
-        if pad_ok and phase_fd <= GROUP_FD_BUDGET:
+        if pad_ok and (
+            getattr(self, "_csr_fused", False)
+            or phase_fd <= GROUP_FD_BUDGET
+        ):
             self._probe_parts = parts
             self._store_ring_pad_tiles = pad_tiles
             self._csr_nb = None
@@ -1202,6 +1285,7 @@ class StoreRingBigClamModel(_StoreBackedMixin, RingBigClamModel):
             "tile_t": rbt.tile_t,
             "n_blocks": rbt.n_blocks,
             "kc": getattr(self, "_csr_kc", 0),
+            "fused": getattr(self, "_csr_fused", False),
         }
         self.edges = None
         self._tiles_dev = tiles                  # kept for rebuild_step

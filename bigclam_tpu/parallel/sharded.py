@@ -266,6 +266,28 @@ def armijo_tail_select_sharded(
     return F_new, F_new.sum(axis=0)
 
 
+def _fused_tile_extras(tiles: dict, block_id, csr_kc: int, tp: int,
+                       place) -> None:
+    """Augment a flat tiles dict with the fused-superstep fields
+    (ISSUE 13) — ONE implementation for the in-memory and store-native
+    builders, whose bit-identity is the store path's headline guarantee:
+    the `fused`/`kc` flags plus, on the schedules that actually run the
+    one-pass superstep (tp == 1, no K blocking — the TP and K-blocked
+    fused steps never read it), the per-shard grid entry sequence built
+    from `block_id` rows and device-placed via `place((dp_local, 2*nt,
+    2) int32 array)`."""
+    tiles["fused"] = True
+    tiles["kc"] = csr_kc
+    if not csr_kc and tp == 1:
+        from bigclam_tpu.ops.pallas_fused import fused_entry_seq
+
+        tiles["seq"] = place(
+            np.stack([fused_entry_seq(row) for row in block_id]).astype(
+                np.int32
+            )
+        )
+
+
 def make_sharded_csr_train_step(
     mesh: Mesh, tiles, cfg: BigClamConfig
 ) -> Callable[[TrainState], TrainState]:
@@ -290,7 +312,7 @@ def make_sharded_csr_train_step(
     LLH and sumF are psums either way. `tiles` is a dict of device arrays +
     static fields built by ShardedBigClamModel._build_csr_step.
     """
-    from bigclam_tpu.ops.linesearch import armijo_select
+    from bigclam_tpu.ops.linesearch import accept_stats, armijo_select
     from bigclam_tpu.ops.pallas_csr import (
         GroupedTilesDev,
         TilesDev,
@@ -304,6 +326,13 @@ def make_sharded_csr_train_step(
         train_pass_csr_grouped_kblocked_tp,
         train_pass_csr_grouped_tp,
     )
+    from bigclam_tpu.ops.pallas_fused import (
+        cand_dots_fused,
+        edge_dots_fused,
+        fused_superstep_csr,
+        grad_nbr_from_x_fused,
+        train_pass_csr_kblocked_fused,
+    )
 
     interp = cfg.pallas_interpret
     tp = mesh.shape[K_AXIS]
@@ -311,6 +340,8 @@ def make_sharded_csr_train_step(
     tile_t = tiles["tile_t"]
     grouped = tiles.get("nb") is not None
     kc = tiles.get("kc", 0)
+    fused = bool(tiles.get("fused"))
+    has_seq = fused and tiles.get("seq") is not None
 
     def finish(F_loc, grad, node_llh, cand_nbr, sumF, it):
         """Armijo tails + select + update (shared helper) + the psums."""
@@ -438,7 +469,97 @@ def make_sharded_csr_train_step(
         ).astype(adt)
         return finish(F_loc, grad, node_llh, cand_nbr.astype(adt), sumF, it)
 
-    if grouped and kc:
+    def step_shard_fused(F_loc, srcl, dst, mask, bid, seq, it):
+        # the ONE-PASS fused superstep per shard (ISSUE 13, tp == 1):
+        # in-kernel dst DMA from the all-gathered F, grad VMEM-resident,
+        # Armijo select + projection in the same kernel — only the psums
+        # of the already-reduced outputs remain in XLA
+        srcl, dst, mask, bid, seq = (
+            srcl[0], dst[0], mask[0], bid[0], seq[0]
+        )
+        td = TilesDev(
+            src_local=srcl, dst=dst, mask=mask, block_id=bid,
+            block_b=block_b, tile_t=tile_t, n_blocks=tiles["n_blocks"],
+            seq=seq,
+        )
+        F_full = lax.all_gather(F_loc, NODES_AXIS, axis=0, tiled=True)
+        sumF = lax.psum(F_loc.sum(axis=0), NODES_AXIS)
+        F_new, grad, node_llh, ok = fused_superstep_csr(
+            F_loc, sumF, td, cfg, interpret=interp, F_gather=F_full
+        )
+        llh_cur = lax.psum(node_llh.sum(), NODES_AXIS)
+        sumF_new = lax.psum(F_new.sum(axis=0), NODES_AXIS)
+        hist = lax.psum(accept_stats(ok > 0), NODES_AXIS)
+        return (
+            F_new, sumF_new, llh_cur.astype(F_loc.dtype), it + 1, hist,
+            _shard_grad_stats(grad, cfg, it),
+        )
+
+    def step_shard_fused_tp(F_loc, srcl, dst, mask, bid, it):
+        # K-sharded fused (tp > 1): the TP kernel split with the fd
+        # gather moved in-kernel (whole K_loc rows DMA'd from F_full —
+        # kb=0, kc=K_loc); psums between kernels unchanged
+        srcl, dst, mask, bid = srcl[0], dst[0], mask[0], bid[0]
+        td = TilesDev(
+            src_local=srcl, dst=dst, mask=mask, block_id=bid,
+            block_b=block_b, tile_t=tile_t, n_blocks=tiles["n_blocks"],
+        )
+        k_loc = F_loc.shape[1]
+        adt = jnp.dtype(cfg.accum_dtype) if cfg.accum_dtype else F_loc.dtype
+        F_full = lax.all_gather(F_loc, NODES_AXIS, axis=0, tiled=True)
+        sumF = lax.psum(F_loc.sum(axis=0), NODES_AXIS)       # (K_loc,)
+        x = lax.psum(
+            edge_dots_fused(
+                F_loc, td, F_full, 0, k_loc, interpret=interp
+            ),
+            K_AXIS,
+        )
+        grad_nbr, llh_nbr = grad_nbr_from_x_fused(
+            x, td, F_full, 0, k_loc, cfg, interpret=interp
+        )
+        grad = grad_nbr - sumF[None, :] + F_loc
+        node_llh = llh_nbr.astype(adt) + (
+            -lax.psum(F_loc @ sumF, K_AXIS) + _rowdot(F_loc, F_loc)
+        ).astype(adt)
+        xc = lax.psum(
+            cand_dots_fused(
+                F_loc, grad, td, F_full, 0, k_loc, cfg, interpret=interp
+            ),
+            K_AXIS,
+        )
+        cand_nbr = cand_nbr_from_x_csr(xc, td, cfg, interpret=interp)
+        return finish(F_loc, grad, node_llh, cand_nbr.astype(adt), sumF, it)
+
+    def step_shard_fused_kb(F_loc, srcl, dst, mask, bid, it):
+        # K-blocked fused (large K, any tp) on FLAT tiles: no grouped
+        # layout — with the gather in-kernel there is no fd to budget,
+        # which is also what makes this layout store-native (the flat
+        # local tile builders already exist)
+        srcl, dst, mask, bid = srcl[0], dst[0], mask[0], bid[0]
+        td = TilesDev(
+            src_local=srcl, dst=dst, mask=mask, block_id=bid,
+            block_b=block_b, tile_t=tile_t, n_blocks=tiles["n_blocks"],
+            kc=kc,
+        )
+        adt = jnp.dtype(cfg.accum_dtype) if cfg.accum_dtype else F_loc.dtype
+        F_full = lax.all_gather(F_loc, NODES_AXIS, axis=0, tiled=True)
+        sumF = lax.psum(F_loc.sum(axis=0), NODES_AXIS)       # (K_loc,)
+        grad, llh_nbr, cand_nbr = train_pass_csr_kblocked_fused(
+            F_loc, sumF, td, cfg, k_axis=K_AXIS, interpret=interp,
+            F_gather=F_full,
+        )
+        node_llh = llh_nbr.astype(adt) + (
+            -lax.psum(F_loc @ sumF, K_AXIS) + _rowdot(F_loc, F_loc)
+        ).astype(adt)
+        return finish(F_loc, grad, node_llh, cand_nbr.astype(adt), sumF, it)
+
+    if fused and kc:
+        step_shard = step_shard_fused_kb
+    elif fused and tp > 1:
+        step_shard = step_shard_fused_tp
+    elif has_seq:
+        step_shard = step_shard_fused
+    elif grouped and kc:
         step_shard = step_shard_grouped_kb
     elif grouped and tp > 1:
         step_shard = step_shard_grouped_tp
@@ -452,7 +573,13 @@ def make_sharded_csr_train_step(
     def spec_for(arr) -> P:
         return P(NODES_AXIS, *([None] * (arr.ndim - 1)))
 
-    def step(state: TrainState, srcl, dst, mask, bid) -> TrainState:
+    tile_args = [
+        tiles["src_local"], tiles["dst"], tiles["mask"], tiles["block_id"],
+    ]
+    if step_shard is step_shard_fused:
+        tile_args.append(tiles["seq"])
+
+    def step(state: TrainState, *targs) -> TrainState:
         # check_vma=False: pallas_call's interpret-mode lowering mixes
         # varying (scalar-prefetched block ids) and replicated operands in
         # dynamic_slice, which the VMA type check cannot express yet; the
@@ -462,18 +589,15 @@ def make_sharded_csr_train_step(
             step_shard,
             mesh=mesh,
             in_specs=(
-                P(NODES_AXIS, K_AXIS),
-                spec_for(srcl),
-                spec_for(dst),
-                spec_for(mask),
-                spec_for(bid),
-                P(),
+                (P(NODES_AXIS, K_AXIS),)
+                + tuple(spec_for(a) for a in targs)
+                + (P(),)
             ),
             out_specs=(
                 P(NODES_AXIS, K_AXIS), P(K_AXIS), P(), P(), P(), P(),
             ),
             check_vma=False,
-        )(state.F, srcl, dst, mask, bid, state.it)
+        )(state.F, *targs, state.it)
         return TrainState(
             F=F_new, sumF=sumF, llh=llh, it=it, accept_hist=hist,
             health=_shard_health(cfg, state, F_new, sumF, hist, gstats),
@@ -486,16 +610,11 @@ def make_sharded_csr_train_step(
     jitted = jax.jit(step)
 
     def step_fn(state):
-        return jitted(
-            state, tiles["src_local"], tiles["dst"], tiles["mask"],
-            tiles["block_id"],
-        )
+        return jitted(state, *tile_args)
 
     # AOT handles for scripts/ring_memory.py's compiler memory analysis
     step_fn.jitted = jitted
-    step_fn.jit_args = (
-        tiles["src_local"], tiles["dst"], tiles["mask"], tiles["block_id"],
-    )
+    step_fn.jit_args = tuple(tile_args)
     return attach_donating(step_fn, step, fixed_args=step_fn.jit_args)
 
 
@@ -704,6 +823,10 @@ class ShardedBigClamModel(MemoryAccountedModel):
         log_engaged_path); subclasses with more schedules override."""
         if not self._csr_wanted:
             return "xla"
+        if getattr(self, "_csr_fused", False):
+            return (
+                "csr_fused_kb" if getattr(self, "_csr_kc", 0) else "csr_fused"
+            )
         if getattr(self, "_csr_nb", None):
             return (
                 "csr_grouped_kb"
@@ -792,10 +915,14 @@ class ShardedBigClamModel(MemoryAccountedModel):
     def _memory_fd_bytes(self) -> float:
         """Per-shard dst-row gather bytes: one group/phase window on the
         grouped/ring CSR layouts, the whole per-shard tile set on the
-        flat layout, (chunk, K_loc) per scan step on XLA."""
+        flat layout, (chunk, K_loc) per scan step on XLA — or, on the
+        fused paths, the (2, T, Kc) in-kernel DMA double buffer that
+        replaces the gather (ISSUE 13)."""
         isz = jnp.dtype(self.dtype).itemsize
         k_loc = self.k_pad // self.mesh.shape[K_AXIS]
         cols = getattr(self, "_csr_kc", 0) or k_loc
+        if self._csr_wanted and getattr(self, "_csr_fused", False):
+            return 2.0 * self._tiles_dev["tile_t"] * cols * isz
         if self._csr_wanted:
             t = self._tiles_dev
             dst = t.get("dst", t.get("dst_local"))
@@ -822,6 +949,7 @@ class ShardedBigClamModel(MemoryAccountedModel):
             donate=bool(cfg.donate_state),
             rollback=int(getattr(cfg, "rollback_budget", 0) or 0) > 0,
             fd_bytes=self._memory_fd_bytes(),
+            fused=self._csr_wanted and getattr(self, "_csr_fused", False),
             comms=self.comms,
             model=type(self).__name__,
         )
@@ -852,11 +980,14 @@ class ShardedBigClamModel(MemoryAccountedModel):
 
         from bigclam_tpu.models.bigclam import csr_want_reason
 
+        from bigclam_tpu.models.bigclam import csr_fused_want
+
         cfg = self.cfg
         want, reason = csr_want_reason(cfg)
         if not want:
             self._csr_reason = reason
             return False
+        self._csr_fused = csr_fused_want(cfg)
         # per-device column count governs the kernels' VMEM working set
         self._csr_kc = 0
         if cfg.csr_k_block:
@@ -880,17 +1011,20 @@ class ShardedBigClamModel(MemoryAccountedModel):
             self._csr_shape = (cfg.csr_block_b, cfg.csr_tile_t)
         else:
             self._csr_shape = fit_tile_shape(
-                cfg.csr_block_b, cfg.csr_tile_t, self._csr_kc or k_loc
+                cfg.csr_block_b, cfg.csr_tile_t, self._csr_kc or k_loc,
+                fused=self._csr_fused,
             )
             if self._csr_shape is None and not self._csr_kc:
                 # K_loc itself exceeds VMEM (extreme K / small tp):
                 # K-blocked sharded mode, same policy as the single-chip
                 # trainer; the step then runs
-                # train_pass_csr_grouped_kblocked_tp
+                # train_pass_csr_grouped_kblocked_tp (split) or
+                # train_pass_csr_kblocked_fused on flat tiles (fused)
                 from bigclam_tpu.ops.pallas_csr import largest_fitting_kblock
 
                 found = largest_fitting_kblock(
-                    cfg.csr_block_b, cfg.csr_tile_t, k_loc
+                    cfg.csr_block_b, cfg.csr_tile_t, k_loc,
+                    fused=self._csr_fused,
                 )
                 if found is not None:
                     self._csr_kc, self._csr_shape = found
@@ -941,6 +1075,26 @@ class ShardedBigClamModel(MemoryAccountedModel):
         e = max(self.g.num_directed_edges, 1)
         fd_bytes = sbt.n_tiles * tile_t * k_loc * 4              # per shard
         pad_ok = layout_economical(slots, e, dp * sbt.n_blocks, tile_t)
+        if self._csr_fused:
+            # fused superstep (ISSUE 13): the gather is in-kernel, so
+            # there is no fd budget and no grouped layout — the flat
+            # layout's padding economy is the only constraint
+            if pad_ok:
+                self._probe_tiles = sbt
+                self._csr_nb = None
+                return True
+            if cfg.use_pallas_csr is True:
+                raise ValueError(
+                    f"use_pallas_csr=True but sharded layout "
+                    f"uneconomical: {slots - e} padded edge slots on {e} "
+                    "(power-law skew? try balance=True or the ring "
+                    "trainer)"
+                )
+            self._csr_reason = (
+                f"sharded layout uneconomical: {slots - e} padded edge "
+                f"slots on {e} edges"
+            )
+            return False
         if pad_ok and not self._csr_kc and fd_bytes <= FLAT_FD_BUDGET:
             # reuse the probe's layout in _build_csr_step unless balancing
             # relabels the graph in between (the only thing that changes it)
@@ -1109,6 +1263,12 @@ class ShardedBigClamModel(MemoryAccountedModel):
                 "tile_t": sbt.tile_t,
                 "n_blocks": sbt.n_blocks,
             }
+            if getattr(self, "_csr_fused", False):
+                _fused_tile_extras(
+                    tiles, sbt.block_id, self._csr_kc,
+                    self.mesh.shape[K_AXIS],
+                    lambda a: put_sharded(a, nspec(3)),
+                )
         _sp.set(slots=int(sbt.src_local.size), grouped=self._csr_nb is not None)
         from bigclam_tpu.ops.csr_tiles import tile_pad_stats
 
@@ -1451,13 +1611,17 @@ class StoreShardedBigClamModel(_StoreBackedMixin, ShardedBigClamModel):
     def _csr_static_ok(self, tp: int) -> bool:
         if not super()._csr_static_ok(tp):
             return False
-        if self._csr_kc:
-            # the sharded K-blocked pass runs on GROUPED tiles, which the
-            # store-native builder does not produce yet
+        if self._csr_kc and not self._csr_fused:
+            # the SPLIT sharded K-blocked pass runs on GROUPED tiles,
+            # which the store-native builder does not produce; the FUSED
+            # K-blocked pass (ops.pallas_fused) runs on the flat tiles
+            # the store builders already make — large-K store-native
+            # runs engage it instead of falling back (ISSUE 13)
             msg = (
                 f"K_loc={self._csr_k_pad // tp} needs the K-blocked "
-                "grouped layout, which is not store-native yet (shard the "
-                "K axis, or use the XLA schedule)"
+                "grouped layout, which is not store-native on the split "
+                "kernel path (csr_fused=False); drop the override — the "
+                "fused K-blocked pass runs on store tiles"
             )
             if self.cfg.use_pallas_csr is True:
                 raise ValueError(f"use_pallas_csr=True but {msg}")
@@ -1499,7 +1663,10 @@ class StoreShardedBigClamModel(_StoreBackedMixin, ShardedBigClamModel):
         n_blocks = (n_pad // dp) // block_b
         fd_bytes = pad_tiles * tile_t * k_loc * 4        # per shard
         pad_ok = layout_economical(slots, e, dp * n_blocks, tile_t)
-        if pad_ok and fd_bytes <= FLAT_FD_BUDGET:
+        # the fused paths gather in-kernel: no fd budget applies, and the
+        # K-blocked fused pass runs on these same flat tiles — the
+        # grouped large-K store gap is closed on this branch (ISSUE 13)
+        if pad_ok and (self._csr_fused or fd_bytes <= FLAT_FD_BUDGET):
             self._probe_parts = parts
             self._store_pad_tiles = pad_tiles
             self._csr_nb = None
@@ -1565,6 +1732,12 @@ class StoreShardedBigClamModel(_StoreBackedMixin, ShardedBigClamModel):
             "tile_t": sbt.tile_t,
             "n_blocks": sbt.n_blocks,
         }
+        if getattr(self, "_csr_fused", False):
+            _fused_tile_extras(
+                tiles, sbt.block_id, self._csr_kc,
+                self.mesh.shape[K_AXIS],
+                lambda a: put_host_local(a, nspec(3), (dp,) + a.shape[1:]),
+            )
         self.edges = None
         self._tiles_dev = tiles                  # kept for rebuild_step
         self._step = make_sharded_csr_train_step(self.mesh, tiles, self.cfg)

@@ -455,13 +455,19 @@ class SparseShardedBigClamModel(SparseBigClamModel):
         return _comms.sparse_measured(self.comms, state)
 
     def _make_step(self):
+        from bigclam_tpu.ops.sparse_members import merge_pallas_want
+
+        _merge_pallas = merge_pallas_want(self.cfg)
         return (
             make_sparse_sharded_step(
                 self.mesh, self._edges, self._blocks, self.cfg,
                 self.k_pad, self.m, self.comm_cap, self.comm_mode,
                 self.block_b, n_live=self.g.num_nodes,
             ),
-            f"sparse_xla_{'spall' if self.comm_mode == 'sparse' else 'psum'}",
+            "sparse_{}_{}".format(
+                "merge_pallas" if _merge_pallas else "xla",
+                "spall" if self.comm_mode == "sparse" else "psum",
+            ),
         )
 
     def _step_key(self):

@@ -81,7 +81,11 @@ def main() -> int:
     state = model._step(state)          # compile + first step
     jax.block_until_ready(state.F)
     sec["compile_first_step"] = round(time.time() - t0, 1)
-    if on_tpu and model.engaged_path != "csr_grouped_kb":
+    # csr_fused_kb is the default K-blocked path since r17; csr_grouped_kb
+    # is the split suite (csr_fused=False)
+    if on_tpu and model.engaged_path not in (
+        "csr_fused_kb", "csr_grouped_kb",
+    ):
         raise RuntimeError(
             f"K-blocked path did not engage on TPU: {model.engaged_path} "
             f"({model.path_reason})"

@@ -201,7 +201,7 @@ def main() -> None:
         p = jax.process_index()
         if mode == "store-csr":
             model = StoreShardedBigClamModel(store, store_csr_cfg(cfg), mesh)
-            assert model.engaged_path == "csr", model.path_reason
+            assert model.engaged_path in ("csr", "csr_fused"), model.path_reason
         else:
             model = StoreRingBigClamModel(
                 store, cfg.replace(use_pallas_csr=False), mesh

@@ -95,7 +95,7 @@ def test_csr_kernel_step_accepts_donation(graph):
     )
     F0 = _rand_F(graph)
     m_don = BigClamModel(graph, cfg)
-    assert m_don.engaged_path == "csr"
+    assert m_don.engaged_path == "csr_fused"
     calls = _spy_donating(m_don)
     r_don = m_don.fit(F0)
     assert calls["n"] == r_don.num_iters + 1

@@ -1,0 +1,499 @@
+"""Fused Pallas edge superstep (ops.pallas_fused, ISSUE 13), interpret
+mode on CPU: trajectory parity for the fused dense kernels across all four
+trainer families, the sparse member-merge kernel vs the searchsorted
+merge, the fused/split/xla step-identity pin, the double-buffer-aware
+VMEM estimate, the re-priced memory transients, and the perf-ledger
+kernel-path refusal.
+
+Parity bands: the fused superstep reorders the node-tail/acceptance
+accumulations relative to the split two-kernel schedule (VMEM-resident
+finalization instead of XLA array ops), so fused-vs-split is allclose at
+a few f32 ULPs, not bitwise — the documented "LLH-band where fusion
+reorders accumulation" regime; store-built vs in-memory FUSED runs stay
+bit-identical (same kernels, same tiles)."""
+
+import json
+import os
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from bigclam_tpu.config import BigClamConfig
+from bigclam_tpu.graph.ingest import graph_from_edges
+from bigclam_tpu.models.bigclam import BigClamModel, step_cfg_key
+
+
+def _random_graph(seed, n=57, p=0.12):
+    rng = np.random.default_rng(seed)
+    a = rng.random((n, n)) < p
+    edges = [(i, j) for i in range(n) for j in range(i + 1, n) if a[i, j]]
+    edges.append((0, n - 1))
+    return graph_from_edges(edges, num_nodes=n)
+
+
+def _cfg(**kw):
+    base = dict(
+        num_communities=6, dtype="float32", edge_chunk=64,
+        use_pallas_csr=True, pallas_interpret=True,
+        csr_block_b=8, csr_tile_t=8,
+    )
+    base.update(kw)
+    return BigClamConfig(**base)
+
+
+def _run_steps(model, F0, steps=3):
+    s = model.init_state(F0)
+    for _ in range(steps):
+        s = model._step(s)
+    return s
+
+
+# --------------------------------------------------------------------------
+# single-chip: fused superstep vs split kernels vs XLA
+# --------------------------------------------------------------------------
+
+
+class TestFusedSingleChip:
+    def test_fused_matches_split_and_xla(self, rng):
+        g = _random_graph(0)
+        k = 6
+        F0 = rng.uniform(0.0, 1.0, size=(g.num_nodes, k))
+        m_x = BigClamModel(g, _cfg(use_pallas_csr=False))
+        m_s = BigClamModel(g, _cfg(csr_fused=False))
+        m_f = BigClamModel(g, _cfg())
+        assert m_x.engaged_path == "xla"
+        assert m_s.engaged_path == "csr"
+        assert m_f.engaged_path == "csr_fused"
+        s_x = _run_steps(m_x, F0)
+        s_s = _run_steps(m_s, F0)
+        s_f = _run_steps(m_f, F0)
+        n = g.num_nodes
+        Ff = np.asarray(s_f.F)[:n, :k]
+        np.testing.assert_allclose(
+            Ff, np.asarray(s_s.F)[:n, :k], rtol=3e-5, atol=3e-5
+        )
+        np.testing.assert_allclose(
+            Ff, np.asarray(s_x.F)[:n, :k], rtol=3e-5, atol=3e-5
+        )
+        np.testing.assert_allclose(float(s_f.llh), float(s_x.llh), rtol=1e-5)
+        # the accepted-step histogram (acceptance decisions) agrees
+        np.testing.assert_array_equal(
+            np.asarray(s_f.accept_hist), np.asarray(s_s.accept_hist)
+        )
+
+    def test_fused_first_step_bitwise_vs_split(self, rng):
+        """From identical inputs, ONE fused step reproduces the split
+        step's update bit-for-bit on this box (same accumulation order by
+        construction: tails seeded first, per-tile adds in tile order) —
+        later steps may drift a ULP through XLA fusion differences, which
+        the allclose trajectory test above covers."""
+        g = _random_graph(1, n=41)
+        k = 5
+        F0 = np.random.default_rng(2).uniform(0.0, 1.0, (g.num_nodes, k))
+        m_s = BigClamModel(g, _cfg(num_communities=k, csr_fused=False))
+        m_f = BigClamModel(g, _cfg(num_communities=k))
+        s_s = m_s._step(m_s.init_state(F0))
+        s_f = m_f._step(m_f.init_state(F0))
+        np.testing.assert_array_equal(np.asarray(s_f.F), np.asarray(s_s.F))
+
+    def test_fused_kblocked_matches_xla(self, rng):
+        """Single-chip K-blocked fused (flat tiles, kc columns per
+        kernel, in-kernel column-window DMA) vs XLA."""
+        g = _random_graph(3, n=37)
+        k = 6
+        F0 = rng.uniform(0.0, 1.0, size=(g.num_nodes, k))
+        m_x = BigClamModel(g, _cfg(use_pallas_csr=False))
+        m_f = BigClamModel(g, _cfg(csr_k_block=3))
+        assert m_f.engaged_path == "csr_fused_kb"
+        assert m_f.k_pad % 3 == 0
+        s_x, s_f = _run_steps(m_x, F0), _run_steps(m_f, F0)
+        n = g.num_nodes
+        np.testing.assert_allclose(
+            np.asarray(s_f.F)[:n, :k], np.asarray(s_x.F)[:n, :k],
+            rtol=3e-5, atol=3e-5,
+        )
+        np.testing.assert_allclose(float(s_f.llh), float(s_x.llh), rtol=1e-5)
+
+    def test_fused_layout_skips_fd_budget(self, rng, monkeypatch):
+        """A zero fd budget forces the SPLIT path into the grouped layout;
+        the fused path has no fd to budget and stays on flat tiles."""
+        import bigclam_tpu.models.bigclam as mb
+        from bigclam_tpu.ops.pallas_csr import GroupedTilesDev, TilesDev
+
+        monkeypatch.setattr(mb, "FLAT_FD_BUDGET", 0)
+        monkeypatch.setattr(mb, "GROUP_FD_BUDGET", 40960)
+        g = _random_graph(4, n=37)
+        m_s = BigClamModel(g, _cfg(csr_fused=False))
+        m_f = BigClamModel(g, _cfg())
+        assert isinstance(m_s._tiles, GroupedTilesDev)
+        assert isinstance(m_f._tiles, TilesDev)
+        assert m_f._tiles.seq is not None
+        assert m_f.engaged_path == "csr_fused"
+
+
+# --------------------------------------------------------------------------
+# sharded / ring / store-native families
+# --------------------------------------------------------------------------
+
+
+class TestFusedFamilies:
+    @pytest.mark.parametrize(
+        "mesh_shape,kb,want",
+        [
+            ((2, 1), 0, "csr_fused"),
+            ((2, 2), 0, "csr_fused"),       # fused TP kernel split
+            ((2, 1), 3, "csr_fused_kb"),
+            ((2, 2), 3, "csr_fused_kb"),
+        ],
+    )
+    def test_sharded_fused_matches_xla(self, rng, mesh_shape, kb, want):
+        from bigclam_tpu.parallel import ShardedBigClamModel, make_mesh
+
+        dp, tp = mesh_shape
+        g = _random_graph(5, n=71)
+        k = 12 if kb else 6
+        cfg = _cfg(num_communities=k, csr_k_block=kb)
+        mesh = make_mesh(mesh_shape, jax.devices()[: dp * tp])
+        m_f = ShardedBigClamModel(g, cfg, mesh)
+        m_x = ShardedBigClamModel(
+            g, cfg.replace(use_pallas_csr=False), mesh
+        )
+        assert m_f.engaged_path == want, m_f.path_reason
+        F0 = rng.uniform(0.0, 1.0, size=(g.num_nodes, k))
+        s_f, s_x = _run_steps(m_f, F0), _run_steps(m_x, F0)
+        n = g.num_nodes
+        np.testing.assert_allclose(
+            np.asarray(s_f.F)[:n, :k], np.asarray(s_x.F)[:n, :k],
+            rtol=3e-5, atol=3e-5,
+        )
+        np.testing.assert_allclose(float(s_f.llh), float(s_x.llh), rtol=1e-5)
+
+    @pytest.mark.parametrize(
+        "mesh_shape,kb,want",
+        [
+            ((2, 1), 0, "csr_ring_fused"),
+            ((2, 2), 0, "csr_ring_fused"),  # fused TP phases
+            ((2, 1), 3, "csr_ring_fused_kb"),
+        ],
+    )
+    def test_ring_fused_matches_xla(self, mesh_shape, kb, want):
+        from bigclam_tpu.parallel import RingBigClamModel, make_mesh
+
+        dp, tp = mesh_shape
+        g = _random_graph(6, n=64, p=0.15)
+        k = 12 if kb else 6
+        cfg = _cfg(num_communities=k, csr_k_block=kb)
+        mesh = make_mesh(mesh_shape, jax.devices()[: dp * tp])
+        m_f = RingBigClamModel(g, cfg, mesh)
+        m_x = RingBigClamModel(g, cfg.replace(use_pallas_csr=False), mesh)
+        assert m_f.engaged_path == want, m_f.path_reason
+        rng = np.random.default_rng(7)
+        F0 = rng.uniform(0.0, 1.0, size=(g.num_nodes, k))
+        s_f, s_x = _run_steps(m_f, F0), _run_steps(m_x, F0)
+        n = g.num_nodes
+        np.testing.assert_allclose(
+            np.asarray(s_f.F)[:n, :k], np.asarray(s_x.F)[:n, :k],
+            rtol=3e-5, atol=3e-5,
+        )
+        np.testing.assert_allclose(float(s_f.llh), float(s_x.llh), rtol=1e-5)
+
+
+@pytest.fixture(scope="module")
+def store_problem(tmp_path_factory):
+    from bigclam_tpu.graph.store import compile_graph_cache
+
+    tmp = tmp_path_factory.mktemp("fused_store")
+    edges = []
+    for base in (0, 12):
+        for i in range(12):
+            for j in range(i + 1, 12):
+                edges.append((base + i, base + j))
+    edges.append((11, 12))
+    g = graph_from_edges(edges, num_nodes=24)
+    text = tmp / "g.txt"
+    with open(text, "w") as f:
+        for a, b in edges:
+            f.write(f"{a}\t{b}\n")
+    store = compile_graph_cache(
+        str(text), str(tmp / "cache"), num_shards=4, chunk_bytes=64
+    )
+    F0 = np.random.default_rng(5).uniform(0.1, 1.0, size=(24, 2))
+    return g, store, F0
+
+
+@pytest.mark.parametrize("kb", [0, 1])
+def test_store_fused_bitidentical_and_kb_gap_closed(store_problem, kb):
+    """Store-built fused runs == in-memory fused runs, bit for bit — and
+    kb=1 is the previously-refused K-blocked large-K store layout, now
+    engaging the fused kernels on flat store tiles (no XLA fallback)."""
+    from bigclam_tpu.parallel import (
+        ShardedBigClamModel,
+        StoreShardedBigClamModel,
+        make_mesh,
+    )
+
+    g, store, F0 = store_problem
+    cfg = _cfg(
+        num_communities=2, csr_block_b=3, max_iters=6, conv_tol=0.0,
+        csr_k_block=kb,
+    )
+    mesh = make_mesh((4, 1), jax.devices()[:4])
+    want = "csr_fused_kb" if kb else "csr_fused"
+    refm = ShardedBigClamModel(g, cfg, mesh)
+    assert refm.engaged_path == want, refm.path_reason
+    ref = refm.fit(F0)
+    m = StoreShardedBigClamModel(store, cfg, mesh)
+    assert m.engaged_path == want, m.path_reason    # no XLA fallback
+    got = m.fit(F0)
+    np.testing.assert_allclose(got.F, ref.F, rtol=0, atol=0)
+    assert got.llh_history == ref.llh_history
+
+
+# --------------------------------------------------------------------------
+# sparse member-merge kernel
+# --------------------------------------------------------------------------
+
+
+def _member_rows(rng, e, m, k, fill=0.6):
+    """Sorted unique member-id rows with sentinel (k) padding + weights."""
+    ids = np.full((e, m), k, np.int32)
+    w = np.zeros((e, m), np.float32)
+    for r in range(e):
+        cnt = int(rng.integers(0, m + 1) * fill) if fill < 1 else m
+        pick = rng.choice(k, size=min(cnt, k), replace=False)
+        pick = np.sort(pick)
+        ids[r, : pick.size] = pick
+        w[r, : pick.size] = rng.random(pick.size).astype(np.float32)
+    return ids, w
+
+
+class TestSparseMergeKernel:
+    def test_merge_exact_vs_searchsorted(self):
+        from bigclam_tpu.ops.sparse_members import (
+            member_lookup,
+            member_lookup_pallas,
+        )
+
+        rng = np.random.default_rng(11)
+        e, m, k = 53, 8, 20          # e deliberately not a block multiple
+        iv, wv = _member_rows(rng, e, m, k)
+        iu, _ = _member_rows(rng, e, m, k)
+        ref = member_lookup(
+            jnp.asarray(iv), jnp.asarray(wv), jnp.asarray(iu), k
+        )
+        got = member_lookup_pallas(
+            jnp.asarray(iv), jnp.asarray(wv), jnp.asarray(iu), k,
+            interpret=True,
+        )
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(ref))
+
+    def test_merge_all_sentinel_rows(self):
+        """Sentinel-only rows (empty member lists) produce exact zeros on
+        both sides — incl. the sentinel==sentinel id collision the k_pad
+        guard must exclude."""
+        from bigclam_tpu.ops.sparse_members import (
+            member_lookup,
+            member_lookup_pallas,
+        )
+
+        e, m, k = 9, 4, 7
+        iv = np.full((e, m), k, np.int32)
+        wv = np.zeros((e, m), np.float32)
+        iu = np.full((e, m), k, np.int32)
+        got = member_lookup_pallas(
+            jnp.asarray(iv), jnp.asarray(wv), jnp.asarray(iu), k,
+            interpret=True,
+        )
+        assert np.all(np.asarray(got) == 0.0)
+        np.testing.assert_array_equal(
+            np.asarray(got),
+            np.asarray(member_lookup(
+                jnp.asarray(iv), jnp.asarray(wv), jnp.asarray(iu), k
+            )),
+        )
+
+    def test_sparse_trajectory_bitidentical_incl_truncation(self):
+        """Full sparse fits, merge kernel vs searchsorted, M < K (the
+        truncation regime: init drops entries beyond top-M): bit-identical
+        state — the merge is exact, not merely close."""
+        from bigclam_tpu.models.sparse import SparseBigClamModel
+
+        rng = np.random.default_rng(12)
+        g = _random_graph(13, n=40, p=0.2)
+        k = 8
+        cfg = BigClamConfig(
+            num_communities=k, representation="sparse", sparse_m=4,
+            dtype="float32", edge_chunk=64,
+        )
+        F0 = rng.uniform(0.0, 1.0, size=(g.num_nodes, k))
+        m_x = SparseBigClamModel(g, cfg.replace(sparse_pallas_merge=False))
+        m_p = SparseBigClamModel(
+            g, cfg.replace(sparse_pallas_merge=True, pallas_interpret=True)
+        )
+        assert m_x.engaged_path == "sparse_xla"
+        assert m_p.engaged_path == "sparse_merge_pallas"
+        s_x, s_p = _run_steps(m_x, F0, 4), _run_steps(m_p, F0, 4)
+        np.testing.assert_array_equal(np.asarray(s_p.F), np.asarray(s_x.F))
+        np.testing.assert_array_equal(
+            np.asarray(s_p.ids), np.asarray(s_x.ids)
+        )
+        assert float(s_p.llh) == float(s_x.llh)
+
+
+# --------------------------------------------------------------------------
+# step identity, VMEM estimate, memory transients, ledger refusal
+# --------------------------------------------------------------------------
+
+
+def test_fused_split_xla_never_share_a_step_key():
+    """fused / split / xla configs compile distinct steps: their
+    step_cfg_keys are pairwise distinct (the in-model step cache and the
+    obs compile counters key on it), and the sparse merge flag is
+    step-baked the same way."""
+    xla = _cfg(use_pallas_csr=False)
+    split = _cfg(csr_fused=False)
+    fused = _cfg()
+    keys = {step_cfg_key(c) for c in (xla, split, fused)}
+    assert len(keys) == 3
+    s_x = BigClamConfig(representation="sparse", sparse_pallas_merge=False)
+    s_p = BigClamConfig(representation="sparse", sparse_pallas_merge=True)
+    assert step_cfg_key(s_x) != step_cfg_key(s_p)
+
+
+def test_fused_step_cache_never_mixes(rng):
+    """One model's rebuild_step cache: flipping a HOST-ONLY field reuses
+    the compiled step; the fused/split axis is not host-only (sanity on
+    the cache keying the pin above relies on)."""
+    g = _random_graph(20, n=37)
+    m = BigClamModel(g, _cfg())
+    step0 = m._step
+    m.cfg = m.cfg.replace(conv_tol=0.5)          # host-only field
+    m.rebuild_step()
+    assert m._step is step0                       # cache hit
+
+
+def test_vmem_estimate_counts_double_buffered_streams():
+    from bigclam_tpu.ops.pallas_csr import (
+        VMEM_BUDGET,
+        fit_tile_shape,
+        kernel_vmem_bytes,
+        largest_fitting_kblock,
+    )
+
+    b, t, k = 256, 512, 1024
+    # the pipeline holds TWO copies of the (t, k) fd stream and two of
+    # each (b, k) input block — the estimate must charge at least those
+    assert kernel_vmem_bytes(b, t, k) >= 4 * (2 * t * k + 4 * b * k)
+    assert kernel_vmem_bytes(b, t, k, fused=True) >= 4 * (2 * t * k)
+    # auto-shrink respects the budget under both estimates
+    for fused in (False, True):
+        shape = fit_tile_shape(b, t, 2048, fused=fused)
+        if shape is not None:
+            assert kernel_vmem_bytes(
+                *shape, 2048, fused=fused
+            ) <= VMEM_BUDGET
+        found = largest_fitting_kblock(b, t, 25600, fused=fused)
+        assert found is not None
+        kc, shape = found
+        assert kc % 128 == 0 and 25600 % kc == 0
+        assert kernel_vmem_bytes(*shape, kc, fused=fused) <= VMEM_BUDGET
+
+
+def test_memory_transients_repriced_for_fused(rng):
+    """Fused engagement re-prices the dst-row transient: the HBM fd
+    gather disappears from the model, the (2, T, Kc) DMA double buffer
+    appears — and modeled==measured stays EXACT on the CPU fake."""
+    g = _random_graph(21, n=37)
+    m_s = BigClamModel(g, _cfg(csr_fused=False))
+    m_f = BigClamModel(g, _cfg())
+    bs, bf = m_s.memory.buffer_bytes(), m_f.memory.buffer_bytes()
+    assert "transient/fd_gather" in bs
+    assert "transient/fd_gather" not in bf
+    assert "transient/fd_dma_scratch" in bf
+    isz = 4
+    assert bf["transient/fd_dma_scratch"] == 2 * m_f._tiles.tile_t * (
+        m_f.k_pad
+    ) * isz
+    # the fd elimination: the fused transient is smaller than the split
+    # fd gather it replaces
+    assert bf["transient/fd_dma_scratch"] < bs["transient/fd_gather"]
+    # reconciliation stays exact (state+graph addressable target)
+    st = m_f.init_state(
+        rng.uniform(0.0, 1.0, size=(g.num_nodes, 6))
+    )
+    recon = m_f.memory_reconcile(st, emit=False)
+    assert recon["ok"] and recon["drift_frac"] == 0.0
+
+
+def test_roofline_fused_drops_fd_bytes():
+    """bench.roofline_model_fused: no fd round-trip — modeled bytes per
+    edge-iteration ≤ 0.6x the split model at the K=128 bench point (the
+    ISSUE 13 acceptance bound)."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "bench", os.path.join(os.path.dirname(__file__), "..", "bench.py")
+    )
+    bench = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench)
+    split = bench.roofline_model(128)["bytes_per_edge_iter"]
+    fused = bench.roofline_model_fused(128)["bytes_per_edge_iter"]
+    assert fused <= 0.6 * split
+    assert bench.roofline_model_fused(128)["variant"] == "fused"
+
+
+def test_ledger_kernel_path_refuses_cross_baseline():
+    """fused / split / xla records never share a perf-ledger baseline:
+    kernel_path joins the match key."""
+    from bigclam_tpu.obs.ledger import build_record, match_key
+
+    def rep(path):
+        return {
+            "run": f"r-{path}", "entry": "fit", "wall_s": 1.0,
+            "fingerprint": {
+                "host": "h", "platform": "linux", "backend": "cpu",
+                "device_kind": "cpu", "devices": 1,
+            },
+            "compiles": {"count": 1, "by_key": {"BigClamModel:a": {}}},
+            "spans": {"seconds": {"fit": 1.0}},
+            "final": {"llh": -1.0, "kernel_path": path},
+        }
+
+    fused = build_record(rep("csr_fused"), [0.01])
+    split = build_record(rep("csr"), [0.01])
+    xla = build_record(rep("xla"), [0.01])
+    fused2 = build_record(rep("csr_fused"), [0.01])
+    assert fused["kernel_path"] == "csr_fused"
+    assert match_key(fused) == match_key(fused2)
+    assert match_key(fused) != match_key(split)
+    assert match_key(fused) != match_key(xla)
+    assert match_key(split) != match_key(xla)
+
+
+def test_report_renders_kernel_paths(tmp_path, rng):
+    """`cli report` surfaces the resolved kernel path of every model
+    build (satellite: a silent fallback must be visible in the report)."""
+    from bigclam_tpu.obs.report import render, render_json
+    from bigclam_tpu.obs.telemetry import RunTelemetry, install, uninstall
+
+    g = _random_graph(22, n=37)
+    tel = install(RunTelemetry(str(tmp_path / "t"), entry="fit"))
+    try:
+        BigClamModel(g, _cfg())                      # fused build
+        BigClamModel(g, _cfg(use_pallas_csr=False))  # xla fallback build
+    finally:
+        tel.finalize()
+        uninstall(tel)
+    text, errors = render(str(tmp_path / "t"))
+    assert errors == 0
+    assert "kernel paths" in text
+    assert "csr_fused" in text
+    obj, _ = render_json(str(tmp_path / "t"))
+    paths = {e["path"] for e in obj["kernel_paths"]}
+    assert {"csr_fused", "xla"} <= paths
+    reasons = {
+        e["path"]: e["reason"] for e in obj["kernel_paths"]
+    }
+    assert "use_pallas_csr=False" in reasons["xla"]

@@ -139,7 +139,7 @@ def test_dense_csr_interpret_exact(planted):
         use_pallas_csr=True, pallas_interpret=True,
         csr_block_b=64, csr_tile_t=64, dtype="float32",
     ))
-    assert m.engaged_path == "csr"
+    assert m.engaged_path == "csr_fused"
     st = m.init_state(F0)
     _reconcile_exact(m, st)
     st = m._step(st)

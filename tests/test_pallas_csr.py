@@ -288,6 +288,10 @@ class TestShardedCSR:
             g,
             base.replace(
                 use_pallas_csr=True, pallas_interpret=True,
+                # pin the SPLIT kernel suite (the fused superstep
+                # is the default since r17; its parity lives in
+                # tests/test_fused.py)
+                csr_fused=False,
                 csr_block_b=8, csr_tile_t=8,
             ),
             mesh,
@@ -327,6 +331,10 @@ class TestShardedCSR:
                 g,
                 base.replace(
                     use_pallas_csr=True, pallas_interpret=True,
+                # pin the SPLIT kernel suite (the fused superstep
+                # is the default since r17; its parity lives in
+                # tests/test_fused.py)
+                csr_fused=False,
                     csr_block_b=8, csr_tile_t=8,
                 ),
                 mesh,
@@ -372,6 +380,10 @@ class TestShardedCSR:
             g,
             base.replace(
                 use_pallas_csr=True, pallas_interpret=True,
+                # pin the SPLIT kernel suite (the fused superstep
+                # is the default since r17; its parity lives in
+                # tests/test_fused.py)
+                csr_fused=False,
                 csr_block_b=8, csr_tile_t=8,
             ),
             mesh,
@@ -416,6 +428,10 @@ class TestShardedCSR:
             g,
             base.replace(
                 use_pallas_csr=True, pallas_interpret=True,
+                # pin the SPLIT kernel suite (the fused superstep
+                # is the default since r17; its parity lives in
+                # tests/test_fused.py)
+                csr_fused=False,
                 csr_block_b=8, csr_tile_t=8, csr_k_block=3,
             ),
             mesh,
@@ -456,6 +472,10 @@ class TestShardedCSR:
             g,
             base.replace(
                 use_pallas_csr=True, pallas_interpret=True,
+                # pin the SPLIT kernel suite (the fused superstep
+                # is the default since r17; its parity lives in
+                # tests/test_fused.py)
+                csr_fused=False,
                 csr_block_b=8, csr_tile_t=8, csr_k_block=3,
             ),
             mesh,
@@ -593,6 +613,10 @@ class TestGroupedCSR:
             g,
             cfg.replace(
                 use_pallas_csr=True, pallas_interpret=True,
+                # pin the SPLIT kernel suite (the fused superstep
+                # is the default since r17; its parity lives in
+                # tests/test_fused.py)
+                csr_fused=False,
                 csr_block_b=8, csr_tile_t=8, csr_k_block=3,
             ),
         )
@@ -628,6 +652,10 @@ class TestGroupedCSR:
             g,
             cfg.replace(
                 use_pallas_csr=True, pallas_interpret=True,
+                # pin the SPLIT kernel suite (the fused superstep
+                # is the default since r17; its parity lives in
+                # tests/test_fused.py)
+                csr_fused=False,
                 csr_block_b=8, csr_tile_t=8,
             ),
         )
@@ -675,7 +703,10 @@ def test_sharded_auto_kblock_engagement(rng):
         mesh = make_mesh((2, tp), jax.devices()[: 2 * tp])
         m = ShardedBigClamModel(
             g,
-            BigClamConfig(num_communities=3000, use_pallas_csr=True),
+            BigClamConfig(
+                num_communities=3000, use_pallas_csr=True,
+                csr_fused=False,    # the split-path auto policy
+            ),
             mesh,
         )
         k_loc = m.k_pad // tp

@@ -161,7 +161,7 @@ class TestRingCSR:
             ),
             mesh,
         )
-        assert ring.engaged_path == "csr_ring"
+        assert ring.engaged_path == "csr_ring_fused"
         assert ring.edges is None           # CSR step built, no EdgeChunks
         xla = ShardedBigClamModel(
             g, base.replace(use_pallas_csr=False), mesh
@@ -198,7 +198,7 @@ class TestRingCSR:
             ),
             mesh,
         )
-        assert ring_csr.engaged_path == "csr_ring"
+        assert ring_csr.engaged_path == "csr_ring_fused"
         ring_xla = RingBigClamModel(
             g, base.replace(use_pallas_csr=False), mesh
         )
@@ -236,7 +236,9 @@ class TestRingCSR:
         m_se = RingBigClamModel(
             g, base.replace(ring_overlap=False), mesh
         )
-        assert m_ov.engaged_path == ("csr_ring_kb" if kb else "csr_ring")
+        assert m_ov.engaged_path == (
+            "csr_ring_fused_kb" if kb else "csr_ring_fused"
+        )
         rng = np.random.default_rng(1)
         F0 = rng.uniform(0.0, 1.0, size=(g.num_nodes, k))
         s_o, s_s = m_ov.init_state(F0), m_se.init_state(F0)

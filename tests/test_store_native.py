@@ -214,10 +214,10 @@ def test_store_sharded_csr_matches_in_memory(clique_problem):
     cfg = _csr_cfg()
     mesh = make_mesh((4, 1), jax.devices()[:4])
     refm = ShardedBigClamModel(g, cfg, mesh)
-    assert refm.engaged_path == "csr", refm.path_reason
+    assert refm.engaged_path == "csr_fused", refm.path_reason
     ref = refm.fit(F0)
     m = StoreShardedBigClamModel(store, cfg, mesh)
-    assert m.engaged_path == "csr", m.path_reason
+    assert m.engaged_path == "csr_fused", m.path_reason
     got = m.fit(F0)
     np.testing.assert_allclose(got.F, ref.F, rtol=0, atol=0)
     assert got.llh_history == ref.llh_history
@@ -281,7 +281,7 @@ def test_store_ring_csr_matches_in_memory(clique_problem, kb):
     cfg = _csr_cfg(csr_k_block=kb)
     mesh = make_mesh((4, 1), jax.devices()[:4])
     refm = RingBigClamModel(g, cfg, mesh, balance=False)
-    want = "csr_ring_kb" if kb else "csr_ring"
+    want = "csr_ring_fused_kb" if kb else "csr_ring_fused"
     assert refm.engaged_path == want, refm.path_reason
     ref = refm.fit(F0)
     m = StoreRingBigClamModel(store, cfg, mesh)
@@ -307,8 +307,15 @@ def test_store_csr_refusals_consistent(clique_problem):
     )
     assert m.engaged_path == "xla"
     assert "not a multiple of" in m.path_reason
-    with pytest.raises(ValueError, match="not store-native yet"):
-        StoreShardedBigClamModel(store, _csr_cfg(csr_k_block=1), mesh)
+    # the K-blocked layout ENGAGES on the fused default (flat store
+    # tiles, ISSUE 13 — the closed grouped/K-blocked store gap); only the
+    # explicit split override still refuses, with the actionable hint
+    m_kb = StoreShardedBigClamModel(store, _csr_cfg(csr_k_block=1), mesh)
+    assert m_kb.engaged_path == "csr_fused_kb", m_kb.path_reason
+    with pytest.raises(ValueError, match="not store-native on the split"):
+        StoreShardedBigClamModel(
+            store, _csr_cfg(csr_k_block=1, csr_fused=False), mesh
+        )
 
 
 # --------------------------------------------------------------------------
