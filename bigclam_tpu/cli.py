@@ -250,7 +250,10 @@ def _open_telemetry(args, entry: str):
             entry=entry,
             heartbeat_s=getattr(args, "heartbeat_s", 0.0),
             quiet=getattr(args, "quiet", False),
-            device_memory=entry != "ingest",
+            # ingest and serve are jax-free entries (serve only imports
+            # jax lazily for fold-in): device sampling would initialize
+            # a backend they never use
+            device_memory=entry not in ("ingest", "serve"),
             auto_gate=not getattr(args, "distributed", False),
             heartbeat_escalate=getattr(args, "heartbeat_escalate", 0),
             # passed THROUGH rather than via os.environ: an env mutation
@@ -696,6 +699,22 @@ def _cmd_fit(args, tel=None) -> int:
             extraction.save_communities(args.out, com)
             out["communities"] = len(com)
             out["out"] = args.out
+        if getattr(args, "publish_dir", None):
+            # serving snapshot publication (ISSUE 14): the checkpoint
+            # manager's atomic publish/latest API — a running `cli
+            # serve --snapshots <dir>` hot-swaps to this fit's F
+            from bigclam_tpu.serve.snapshot import publish_snapshot
+
+            path = publish_snapshot(
+                args.publish_dir,
+                step=res.num_iters,
+                F=res.F,
+                raw_ids=g.raw_ids,
+                num_edges=g.num_edges,
+                cfg=cfg,
+                meta={"llh": res.llh, "seed": cfg.seed},
+            )
+            out["published"] = path
         if args.save_f:
             np.save(args.save_f, res.F)
             out["save_f"] = args.save_f
@@ -1183,6 +1202,138 @@ def cmd_watch(args) -> int:
     )
 
 
+def _parse_query_spec(spec: str) -> dict:
+    """--query shorthand: 'communities_of:12', 'members_of:3',
+    'suggest_for:12' — or a raw JSON object for anything richer
+    (explicit-neighbor suggests)."""
+    spec = spec.strip()
+    if spec.startswith("{"):
+        try:
+            return json.loads(spec)
+        except ValueError as e:
+            raise SystemExit(f"error: --query {spec!r}: not JSON ({e})")
+    fam, _, arg = spec.partition(":")
+    keys = {"communities_of": "u", "members_of": "c", "suggest_for": "u"}
+    if fam not in keys:
+        raise SystemExit(
+            f"error: --query {spec!r}: family must be one of "
+            "communities_of/members_of/suggest_for (or pass a JSON object)"
+        )
+    try:
+        return {"family": fam, keys[fam]: int(arg)}
+    except ValueError:
+        raise SystemExit(
+            f"error: --query {spec!r}: {keys[fam]!r} must be an integer "
+            f"(got {arg!r})"
+        )
+
+
+def cmd_serve(args) -> int:
+    tel = _open_telemetry(args, "serve")
+    try:
+        return _cmd_serve(args, tel)
+    finally:
+        _close_telemetry(tel)
+
+
+def _cmd_serve(args, tel=None) -> int:
+    """Membership serving (ISSUE 14): answer the three query families
+    from a published snapshot through the request batcher.
+
+        cli serve --snapshots snaps/ --graph g.cache \\
+            --query communities_of:12 --query members_of:3
+        cli serve --snapshots snaps/ --graph g.cache \\
+            --queries load.jsonl --results answers.jsonl \\
+            --telemetry-dir run1/ --perf-ledger perf/ledger.jsonl
+
+    Read families (communities_of / members_of) are answered jax-free
+    from the snapshot + load-time inverted index; suggest_for runs the
+    batched fold-in (jax imported lazily on first use). Prints the
+    serving stats JSON (p50/p99 latency, QPS, cache hit rate) and stamps
+    it into the telemetry final, so `cli perf diff` verdicts serve p99
+    against the run's matched baseline. Exit 1 when any query errored."""
+    from bigclam_tpu.graph.store import GraphStore, is_cache_dir
+    from bigclam_tpu.serve.server import MembershipServer
+    from bigclam_tpu.serve.snapshot import SnapshotError
+    from bigclam_tpu.utils.profiling import StageProfile
+
+    prof = StageProfile()
+    store = graph = None
+    if args.graph:
+        with prof.stage("graph_load"):
+            if is_cache_dir(args.graph):
+                store = GraphStore.open(
+                    args.graph,
+                    self_heal=not getattr(args, "no_self_heal", False),
+                )
+            else:
+                from bigclam_tpu.graph import build_graph
+
+                graph = build_graph(args.graph)
+    queries = [_parse_query_spec(s) for s in (args.query or [])]
+    if args.queries:
+        with open(args.queries) as f:
+            for lineno, line in enumerate(f, 1):
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    queries.append(json.loads(line))
+                except ValueError as e:
+                    print(
+                        f"error: {args.queries}:{lineno}: not JSON ({e})",
+                        file=sys.stderr,
+                    )
+                    return 1
+    if not queries:
+        print(
+            "error: nothing to serve — pass --query and/or --queries",
+            file=sys.stderr,
+        )
+        return 1
+    try:
+        with prof.stage("snapshot_load"):
+            server = MembershipServer(
+                args.snapshots,
+                store=store,
+                graph=graph,
+                max_batch=args.max_batch,
+                budget_s=args.latency_budget_ms / 1e3,
+                cache_slots=args.cache_slots,
+                foldin_max_iters=args.foldin_max_iters,
+                foldin_conv_tol=args.foldin_conv_tol,
+                foldin_max_deg=args.foldin_max_deg,
+                watch_interval_s=args.watch_snapshots,
+            )
+    except SnapshotError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 1
+    if tel is not None:
+        tel.commit_gate()
+    try:
+        with prof.stage("serve"):
+            results = []
+            for _ in range(max(args.repeat, 1)):
+                results = server.run_queries(queries)
+        out = server.stats()
+        out["snapshots"] = args.snapshots
+        if args.results:
+            with open(args.results, "w") as f:
+                for r in results:
+                    f.write(json.dumps(r) + "\n")
+            out["results"] = args.results
+        elif not args.quiet and len(queries) <= 16:
+            # one-shot interactive use: the answers ARE the output
+            for r in results:
+                print(json.dumps(r))
+    finally:
+        server.close()
+    if tel is not None:
+        tel.set_final(out)
+    print(json.dumps(out))
+    return 1 if out.get("serve_errors") else 0
+
+
 def cmd_eval(args) -> int:
     from bigclam_tpu.evaluation import avg_f1, overlapping_nmi
     from bigclam_tpu.ops.extraction import load_communities
@@ -1236,6 +1387,13 @@ def main(argv=None) -> int:
              "host-side on the final fetched F",
     )
     p_fit.add_argument("--out", default=None, help="write SNAP cmty file")
+    p_fit.add_argument(
+        "--publish-dir", default=None,
+        help="publish the final F as a serving snapshot (atomic "
+             "fsync-rename + crc sidecar + latest.json pointer, "
+             "utils.checkpoint.publish): `cli serve --snapshots <dir>` "
+             "loads it, and a running server hot-swaps to it",
+    )
     p_fit.add_argument("--save-f", default=None, help="write F as .npy")
     p_fit.add_argument(
         "--export-gexf", default=None,
@@ -1398,6 +1556,101 @@ def main(argv=None) -> int:
     p_watch.add_argument("--width", type=int, default=48,
                          help="sparkline width in samples")
     p_watch.set_defaults(fn=cmd_watch)
+
+    p_srv = sub.add_parser(
+        "serve",
+        help="answer membership queries from a published F snapshot "
+             "(ISSUE 14): communities_of / members_of / suggest_for "
+             "(fold-in) through a latency-budgeted request batcher, with "
+             "hot-swap to newly published snapshots; read families are "
+             "jax-free",
+    )
+    p_srv.add_argument(
+        "--snapshots", required=True,
+        help="snapshot directory (`cli fit --publish-dir` / "
+             "utils.checkpoint.publish): the latest published snapshot "
+             "is served, falling back past corrupt ones",
+    )
+    p_srv.add_argument(
+        "--graph", default=None,
+        help="graph-cache dir (preferred: manifest-verified against the "
+             "snapshot) or SNAP text path — the adjacency suggest_for "
+             "needs for graph nodes; read-only queries work without it",
+    )
+    p_srv.add_argument(
+        "--query", action="append", default=None, metavar="FAMILY:ARG",
+        help="one query: communities_of:<u>, members_of:<c>, "
+             "suggest_for:<u>, or a JSON object (repeatable)",
+    )
+    p_srv.add_argument(
+        "--queries", default=None,
+        help="JSONL file of query objects (one per line) — the load-"
+             "test path (scripts/serve_gate.py generates Zipf mixes)",
+    )
+    p_srv.add_argument(
+        "--results", default=None,
+        help="write one JSON answer per query line here (default: "
+             "answers echo to stdout only for <= 16 queries)",
+    )
+    p_srv.add_argument(
+        "--repeat", type=int, default=1,
+        help="run the query set this many times (load testing; stats "
+             "accumulate, results keep the last pass)",
+    )
+    p_srv.add_argument(
+        "--latency-budget-ms", type=float, default=5.0,
+        help="request-batcher window: a lone query waits at most this "
+             "long for batch-mates (the p99 knob)",
+    )
+    p_srv.add_argument(
+        "--max-batch", type=int, default=64,
+        help="flush a batch at this many requests even inside the window",
+    )
+    p_srv.add_argument(
+        "--cache-slots", type=int, default=64,
+        help="hot-community cache capacity (members_of): admission is "
+             "keyed by community mass share — the Zipf head stays "
+             "resident (0 disables)",
+    )
+    p_srv.add_argument(
+        "--foldin-max-iters", type=int, default=200,
+        help="fold-in row-ascent iteration cap per suggest query",
+    )
+    p_srv.add_argument(
+        "--foldin-conv-tol", type=float, default=None,
+        help="per-node fold-in convergence tolerance (default: the "
+             "trainer's conv_tol from the snapshot config)",
+    )
+    p_srv.add_argument(
+        "--foldin-max-deg", type=int, default=4096,
+        help="neighbor cap per suggest query (hub truncation; counted "
+             "in the stats when it engages)",
+    )
+    p_srv.add_argument(
+        "--watch-snapshots", type=float, default=0.0,
+        help="poll the snapshot dir every this many seconds and "
+             "hot-swap when a newer snapshot is published (0 = off; "
+             "swaps drain in-flight batches and drop no queries)",
+    )
+    p_srv.add_argument(
+        "--telemetry-dir", default=None,
+        help="run-telemetry directory: per-batch `serve` events + "
+             "snapshot_swap events + the final serving stats (render "
+             "with `cli report`; jax-free on this entry)",
+    )
+    p_srv.add_argument(
+        "--heartbeat-s", type=float, default=300.0,
+        help="stall-heartbeat deadline with --telemetry-dir (0 disables)",
+    )
+    p_srv.add_argument(
+        "--perf-ledger", default=None,
+        help="append this serve run's record (serve p99/QPS/cache hit "
+             "rate) to a perf-ledger JSONL; `cli perf diff` then "
+             "VERDICTS serve p99 against the matched serve baseline",
+    )
+    p_srv.add_argument("--no-self-heal", action="store_true")
+    p_srv.add_argument("--quiet", action="store_true")
+    p_srv.set_defaults(fn=cmd_serve)
 
     p_pre = sub.add_parser(
         "preflight",

@@ -1558,3 +1558,60 @@ class BigClamModel(MemoryAccountedModel):
         """Bernoulli(0.5) {0,1} init, the reference's random-row distribution
         (Bigclamv2.scala:62). Conductance-seeded init lives in ops.seeding."""
         return random_init_F(self.g, self.cfg, seed)
+
+    def foldin_rows(
+        self,
+        state: TrainState,
+        nodes,
+        max_deg: Optional[int] = None,
+        max_iters: Optional[int] = None,
+        conv_tol: Optional[float] = None,
+        init: str = "own",
+    ):
+        """Batched FOLD-IN (ISSUE 14): re-optimize the rows of `nodes`
+        against this state's FROZEN F — the per-node half of the train
+        step extracted as a standalone batch primitive (ops.foldin), and
+        the operator `cli serve`'s suggest family and the live-graph
+        warm-start refit (ROADMAP 3b) are built on. Each node's row runs
+        the same Armijo candidate ascent as the full step, holding every
+        other row fixed.
+
+        init="own" (default) warm-starts each node from its CURRENT row:
+        a trained node's row is a fixed point of its own fold-in
+        objective, so fold-in recovers the trained row within the
+        convergence band (pinned by tests/test_serve.py) and refines it
+        when the frozen F has drifted (the live-graph warm-start).
+        init="mean" cold-starts from the neighbor mean — the brand-new-
+        node path; the per-node objective is non-concave, so a cold
+        start may land on a DIFFERENT local optimum of the row (the
+        serve gate bands its LLH against a full refit instead of
+        asserting row equality).
+
+        Returns (rows (B, K) np.ndarray, llh (B,), iters (B,))."""
+        from bigclam_tpu.ops import foldin as fi
+        from bigclam_tpu.serve.snapshot import pad_neighbor_batch
+
+        nodes = np.asarray(nodes, np.int64)
+        nbr_ids, nbr_mask, _ = pad_neighbor_batch(
+            self.g.indptr, self.g.indices, nodes, max_deg=max_deg
+        )
+        F = state.F
+        nbr_rows = fi.gather_neighbor_rows(F, jnp.asarray(nbr_ids))
+        mask = jnp.asarray(nbr_mask, F.dtype)
+        own = F[jnp.asarray(nodes)]
+        sumF_others = state.sumF[None, :] - own
+        rows0 = (
+            own if init == "own"
+            else fi.neighbor_mean_rows(nbr_rows, mask)
+        )
+        rows0 = jnp.array(rows0)        # donated: never alias frozen F
+        fit = fi.make_foldin_fit(
+            self.cfg, max_iters=max_iters, conv_tol=conv_tol
+        )
+        rows, llh, iters = fit(rows0, nbr_rows, mask, sumF_others)
+        k = self.cfg.num_communities
+        return (
+            np.asarray(rows)[:, :k],
+            np.asarray(llh),
+            np.asarray(iters),
+        )

@@ -449,6 +449,54 @@ class SparseBigClamModel(MemoryAccountedModel):
     def random_init(self, seed: Optional[int] = None) -> np.ndarray:
         return random_init_F(self.g, self.cfg, seed)
 
+    def foldin_rows(
+        self,
+        state: SparseTrainState,
+        nodes,
+        max_deg: Optional[int] = None,
+        max_iters: Optional[int] = None,
+        conv_tol: Optional[float] = None,
+        init: str = "own",
+    ):
+        """Batched fold-in against the frozen sparse state (the sparse
+        twin of BigClamModel.foldin_rows, ISSUE 14 — see its docstring
+        for the init="own"/"mean" warm-start semantics): neighbor member
+        lists are densified per query batch (ops.foldin
+        .densify_member_rows — only the B*D query window pays K columns,
+        the state stays M-sized), then the identical row ascent runs.
+        Returns dense (rows (B, K), llh (B,), iters (B,))."""
+        from bigclam_tpu.ops import foldin as fi
+        from bigclam_tpu.serve.snapshot import pad_neighbor_batch
+
+        nodes = np.asarray(nodes, np.int64)
+        nbr_ids, nbr_mask, _ = pad_neighbor_batch(
+            self.g.indptr, self.g.indices, nodes, max_deg=max_deg
+        )
+        dt = state.F.dtype
+        nbr_rows = fi.densify_member_rows(
+            state.ids, state.F, jnp.asarray(nbr_ids), self.k_pad
+        )
+        mask = jnp.asarray(nbr_mask, dt)
+        own = fi.densify_rows(
+            state.ids, state.F, jnp.asarray(nodes), self.k_pad
+        )
+        sumF_others = state.sumF[None, :] - own
+        rows0 = (
+            own if init == "own"
+            else fi.neighbor_mean_rows(nbr_rows, mask)
+        )
+        rows0 = jnp.array(rows0)        # donated: never alias live state
+        fit = fi.make_foldin_fit(
+            self.cfg, max_iters=max_iters, conv_tol=conv_tol
+        )
+        rows, llh, iters = fit(rows0, nbr_rows, mask, sumF_others)
+        k = self.cfg.num_communities
+        return (
+            np.asarray(rows)[:, :k],
+            np.asarray(llh),
+            np.asarray(iters),
+        )
+
     def state_nbytes(self, state: Optional[SparseTrainState] = None) -> int:
         """Affiliation-state footprint in bytes (ids + weights + sumF):
         the figure the memory-pinned gate asserts scales with M, not K.

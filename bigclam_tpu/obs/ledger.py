@@ -250,6 +250,36 @@ def build_record(
         and host_rss > 0
         else None
     )
+    # membership serving (ISSUE 14 satellite): a serve run's record
+    # carries the latency/throughput scoreboard the server stamped into
+    # its final outcome — `cli perf diff` VERDICTS serve_p99_s and
+    # serve_qps (the serving SLO axes; unlike the trainer's step_p99,
+    # serve p99 is computed over hundreds of per-request samples, so it
+    # is a stable gate figure), cache_hit_rate rides as a finding. The
+    # entry point ("serve") is already the first element of match_key,
+    # so a serve record can never cross-baseline a fit record; serve_mix
+    # (the query-family ratio string) joins the key below because two
+    # runs with different family mixes do different work per query.
+    for field in ("serve_p50_s", "serve_p99_s", "serve_qps"):
+        v = final.get(field)
+        rec[field] = (
+            _round6(float(v))
+            if isinstance(v, _NUM) and not isinstance(v, bool)
+            else None
+        )
+    sq = final.get("serve_queries")
+    rec["serve_queries"] = (
+        int(sq) if isinstance(sq, _NUM) and not isinstance(sq, bool)
+        else None
+    )
+    chr_ = final.get("cache_hit_rate")
+    rec["cache_hit_rate"] = (
+        _round6(float(chr_))
+        if isinstance(chr_, _NUM) and not isinstance(chr_, bool)
+        else None
+    )
+    mix = final.get("serve_mix")
+    rec["serve_mix"] = str(mix) if mix else None
     if note:
         rec["note"] = note
     return rec
@@ -293,6 +323,13 @@ def match_key(rec: Dict[str, Any]) -> Tuple:
         # entry points that never stamp it) matches only None, the same
         # rebaseline rule as every match-key widening
         rec.get("kernel_path"),
+        # serving workload identity (ISSUE 14 satellite): the entry
+        # point (element 0) already splits serve from fit — a serve p99
+        # baseline can never cross-match a fit step-time baseline — and
+        # the query-family mix splits serve runs whose per-query work
+        # differs (a fold-in-heavy load is not comparable to a read-only
+        # load at equal QPS). None (non-serve entries) matches None
+        rec.get("serve_mix"),
     )
 
 
@@ -466,6 +503,23 @@ def diff_records(
               band_mult=2.0, verdicted=False)
         check("eps_p50", base.get("eps_p50"), new.get("eps_p50"),
               worse_if_higher=False)
+    elif isinstance(new.get("serve_p99_s"), _NUM) and isinstance(
+        base.get("serve_p99_s"), _NUM
+    ):
+        # serving runs (ISSUE 14): the SLO axes are tail latency and
+        # throughput. serve_p99 is a percentile over per-request samples
+        # (hundreds per run), not the trainer's single-sample step_p99 —
+        # it is VERDICTED, which is the whole point of the serve gate's
+        # ledger baseline. Cache hit rate is a finding (worse_if_higher
+        # False, not verdicted): a mix change legitimately moves it
+        check("serve_p99_s", base["serve_p99_s"], new["serve_p99_s"])
+        check("serve_p50_s", base.get("serve_p50_s"),
+              new.get("serve_p50_s"))
+        check("serve_qps", base.get("serve_qps"), new.get("serve_qps"),
+              worse_if_higher=False)
+        check("cache_hit_rate", base.get("cache_hit_rate"),
+              new.get("cache_hit_rate"), worse_if_higher=False,
+              verdicted=False)
     else:
         # steploss entries (ingest, report-only runs): wall time is the
         # only comparable figure

@@ -726,6 +726,42 @@ def render(directory: str) -> Tuple[str, int]:
                         f"{int(last['exchanged_max'])} of cap "
                         f"{c.get('comm_cap')}"
                     )
+        # --- membership serving (ISSUE 14): the query scoreboard of a
+        # `cli serve` run — latency percentiles, throughput, cache hit
+        # rate, hot-swaps. Figures come from the final outcome (what the
+        # perf ledger records); batch/swap counts from the events.
+        final = merged.get("final") or {}
+        if final.get("serve_queries"):
+            lines.append("")
+            lines.append(
+                f"serving: {final['serve_queries']} queries "
+                f"({final.get('serve_errors', 0)} error(s)) "
+                f"over {merged['events'].get('serve', 0)} batch(es)"
+            )
+            parts = []
+            for key, label in (
+                ("serve_p50_s", "p50"), ("serve_p99_s", "p99"),
+            ):
+                v = final.get(key)
+                if isinstance(v, (int, float)):
+                    parts.append(f"{label} {v * 1e3:.3g} ms")
+            if isinstance(final.get("serve_qps"), (int, float)):
+                parts.append(f"{final['serve_qps']:.4g} qps")
+            if isinstance(final.get("cache_hit_rate"), (int, float)):
+                parts.append(
+                    f"cache hit rate {final['cache_hit_rate']:.2%}"
+                )
+            if parts:
+                lines.append("  " + "  ".join(parts))
+            if final.get("serve_mix"):
+                lines.append(f"  mix: {final['serve_mix']}")
+            swaps = merged["events"].get("snapshot_swap", 0)
+            if swaps or final.get("snapshot_swaps"):
+                lines.append(
+                    f"  hot-swaps: {swaps or final.get('snapshot_swaps')} "
+                    f"(serving snapshot step "
+                    f"{final.get('snapshot_step', '?')})"
+                )
         if merged["final"]:
             lines.append("")
             lines.append("final: " + json.dumps(merged["final"]))

@@ -100,6 +100,16 @@ EVENT_KINDS = {
     # tile-pad waste): max/mean/skew/cv + the arg-max shard. Crossing
     # the imbalance threshold additionally fires an `anomaly` event
     # (check="imbalance", iter=-1 — build-time, not an iteration)
+    # --- membership serving (bigclam_tpu.serve, ISSUE 14) ---
+    "serve": {"family": (str,), "batch": (int,), "seconds": _NUM},
+    # one flushed request batch (family = sorted "|"-joined families in
+    # the batch, per-family counts ride as n_<family> extras, `step` the
+    # serving snapshot generation); per-request latencies aggregate into
+    # the stats the entry stamps into `final` (serve_p99_s etc.) so the
+    # perf ledger verdicts serving p99 like step time
+    "snapshot_swap": {"step": (int,)},
+    # a running server hot-swapped to a newly published snapshot
+    # (utils.checkpoint publish/latest; `previous` = the old generation)
     # --- memory accounting (obs.memory, ISSUE 12) ---
     "memory_model": {"buffer": (str,), "bytes": _NUM},
     # one buffer of a trainer's static memory model, baked at step

@@ -26,6 +26,12 @@ _LAZY = {
     "graph_components_device": (
         "bigclam_tpu.ops.components", "graph_components_device",
     ),
+    # fold-in inference (ISSUE 14, jax-touching — lazy like the rest)
+    "foldin_pass": ("bigclam_tpu.ops.foldin", "foldin_pass"),
+    "make_foldin_fit": ("bigclam_tpu.ops.foldin", "make_foldin_fit"),
+    "neighbor_mean_rows": (
+        "bigclam_tpu.ops.foldin", "neighbor_mean_rows",
+    ),
 }
 
 __all__ = sorted(_LAZY)

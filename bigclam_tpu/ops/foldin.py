@@ -1,0 +1,235 @@
+"""Fold-in inference: optimize node rows against a FROZEN F (ISSUE 14).
+
+The serving tentpole's observation (ROADMAP item 2, and the same locality
+argument as "Speeding Up BigClam Implementation on SNAP", arXiv:1712.01209):
+the per-node row update the trainer already jits IS the fold-in operator.
+Holding everyone else's rows fixed, the terms of the global LLH that
+involve node u are
+
+    ell(u) = sum_{v in N(u)} [ log(1 - clip(exp(-r.F_v))) + r.F_v ]
+             - r . sumF_others
+
+where r is u's candidate row and sumF_others = sum_w F_w over the FROZEN
+rows (for an existing node that is sumF - F_u; for a brand-new node it is
+the global sumF as-is). This is exactly the trainer's per-node objective
+(ops.objective: nbr terms + node_tail with the node-local sumF adjustment
+folded), so optimizing r with the same Armijo candidate ladder
+(ops.linesearch semantics: accept iff ell_eta >= ell + alpha*eta*||g||^2,
+take the LARGEST accepted eta) converges to the same row the full fit
+would have produced for u against that F — the fold-in correctness test
+pins it.
+
+Everything here is BATCHED over B query nodes with padded neighbor lists
+(B, D): each node's trajectory depends only on its own row and the frozen
+F, so batched fold-in equals sequential fold-in node-for-node (pinned by
+tests/test_serve.py), and a request batcher can coalesce arbitrary
+suggest queries into one device call. The whole optimization runs inside
+ONE jitted lax.while_loop with per-node convergence (|1 - llh/llh_prev| <
+conv_tol, mirroring models.bigclam._rel_change / run_fit_loop): converged
+rows freeze while the rest keep iterating, and there are no host round
+trips. The initial rows buffer is DONATED (the serving hot loop's
+ping-pong, same discipline as run_fit_loop's donate_state).
+
+Padding conventions: neighbor slots beyond a node's degree carry mask 0
+(their gathered rows are ignored by construction: coeff = mask/omp = 0);
+padding QUERY rows (batch rounded up for compile-cache reuse) carry
+all-zero rows + all-zero masks and stay at zero forever (grad =
+-sumF_others <= 0 clips back to the zero row — the ops.objective padding
+argument).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from bigclam_tpu.config import BigClamConfig
+from bigclam_tpu.ops.objective import edge_terms
+
+
+def foldin_pass(
+    rows: jax.Array,
+    nbr_rows: jax.Array,
+    nbr_mask: jax.Array,
+    sumF_others: jax.Array,
+    cfg: BigClamConfig,
+) -> Tuple[jax.Array, jax.Array]:
+    """Fused gradient + per-node LLH of a row batch vs frozen neighbors.
+
+    rows (B, K), nbr_rows (B, D, K), nbr_mask (B, D), sumF_others (B, K)
+    -> (grad (B, K), llh (B,)). Same math as ops.objective.grad_llh
+    restricted to the batch: coeff = mask/omp folds the +F_v term, and
+    the node tail is -r.sumF_others (== -r.sumF + r.r for an existing
+    node, ops.objective.node_tail)."""
+    x = jnp.einsum("bk,bdk->bd", rows, nbr_rows)
+    omp, ell = edge_terms(x, cfg)
+    nbr_llh = (ell * nbr_mask).sum(axis=-1)
+    coeff = nbr_mask / omp
+    grad = jnp.einsum("bd,bdk->bk", coeff, nbr_rows) - sumF_others
+    llh = nbr_llh - jnp.einsum("bk,bk->b", rows, sumF_others)
+    return grad, llh
+
+
+def foldin_candidates(
+    rows: jax.Array,
+    grad: jax.Array,
+    nbr_rows: jax.Array,
+    nbr_mask: jax.Array,
+    sumF_others: jax.Array,
+    cfg: BigClamConfig,
+) -> jax.Array:
+    """(S, B) candidate LLHs: ell_eta per node for every Armijo step
+    (ops.linesearch.candidates_pass semantics, gathered tiles reused)."""
+    etas = jnp.asarray(cfg.step_candidates, rows.dtype)
+
+    def one_eta(eta):
+        nf = jnp.clip(rows + eta * grad, cfg.min_f, cfg.max_f)
+        x = jnp.einsum("bk,bdk->bd", nf, nbr_rows)
+        _, ell = edge_terms(x, cfg)
+        return (ell * nbr_mask).sum(axis=-1) - jnp.einsum(
+            "bk,bk->b", nf, sumF_others
+        )
+
+    return lax.map(one_eta, etas)
+
+
+def _rel_change_elem(new: jax.Array, old: jax.Array) -> jax.Array:
+    """Elementwise |1 - new/old| with the old == 0 corner handled — the
+    jnp twin of models.bigclam._rel_change (run_fit_loop's convergence
+    predicate), applied per node instead of per fit."""
+    safe = jnp.where(old == 0.0, 1.0, old)
+    rc = jnp.abs(1.0 - new / safe)
+    return jnp.where(
+        old == 0.0, jnp.where(new == 0.0, 0.0, jnp.inf), rc
+    )
+
+
+def neighbor_mean_rows(
+    nbr_rows: jax.Array, nbr_mask: jax.Array
+) -> jax.Array:
+    """Warm-start rows: the masked mean of the frozen neighbor rows —
+    a node joins its neighborhood's communities at average strength, the
+    analog of the trainer's ego-net conductance seeding for one row. A
+    zero init would be a fixed point (grad = -sumF_others <= 0 clips
+    straight back), so fold-in always starts here unless the caller
+    passes explicit rows."""
+    deg = jnp.maximum(nbr_mask.sum(axis=-1, keepdims=True), 1.0)
+    return jnp.einsum("bd,bdk->bk", nbr_mask, nbr_rows) / deg
+
+
+def make_foldin_fit(
+    cfg: BigClamConfig,
+    max_iters: Optional[int] = None,
+    conv_tol: Optional[float] = None,
+):
+    """Build the jitted batched fold-in optimizer.
+
+    Returns fit(rows0, nbr_rows, nbr_mask, sumF_others) ->
+    (rows (B, K), llh (B,), iters (B,)): Armijo row ascent to per-node
+    convergence inside one lax.while_loop (no host round trips — the
+    serving hot loop). rows0 is DONATED; jit's shape cache makes one
+    returned callable serve every padded (B, D) the batcher produces.
+    `llh` is each node's ell at its final row (the fold-in quality
+    figure the serve gate bands against a full refit)."""
+    mi = int(cfg.max_iters if max_iters is None else max_iters)
+    tol = float(cfg.conv_tol if conv_tol is None else conv_tol)
+
+    def fit(rows, nbr_rows, nbr_mask, sumF_others):
+        dt = rows.dtype
+        etas = jnp.asarray(cfg.step_candidates, dt)
+
+        def cond(carry):
+            it, rows, llh_prev, active, iters = carry
+            return (it < mi) & jnp.any(active)
+
+        def body(carry):
+            it, rows, llh_prev, active, iters = carry
+            grad, llh = foldin_pass(
+                rows, nbr_rows, nbr_mask, sumF_others, cfg
+            )
+            # per-node convergence BEFORE applying this iteration's
+            # update: a converged node keeps the row whose llh fired the
+            # test (run_fit_loop returns the converged step's INPUT
+            # state for the same reason)
+            conv = (it > 0) & (_rel_change_elem(llh, llh_prev) < tol)
+            act = active & ~conv
+            cand = foldin_candidates(
+                rows, grad, nbr_rows, nbr_mask, sumF_others, cfg
+            )
+            gg = jnp.einsum("bk,bk->b", grad, grad)
+            ok = (
+                cand
+                >= llh[None, :] + cfg.alpha * etas[:, None] * gg[None, :]
+            )
+            best_eta = jnp.max(
+                jnp.where(ok, etas[:, None], 0.0), axis=0
+            )
+            accepted = jnp.any(ok, axis=0)
+            rows = jnp.where(
+                (act & accepted)[:, None],
+                jnp.clip(
+                    rows + best_eta[:, None] * grad, cfg.min_f, cfg.max_f
+                ),
+                rows,
+            )
+            llh_prev = jnp.where(active, llh, llh_prev)
+            return (it + 1, rows, llh_prev, act, iters + act)
+
+        b = rows.shape[0]
+        init = (
+            jnp.zeros((), jnp.int32),
+            rows,
+            jnp.full((b,), -jnp.inf, dt),
+            jnp.ones((b,), bool),
+            jnp.zeros((b,), jnp.int32),
+        )
+        _, rows, llh, _, iters = lax.while_loop(cond, body, init)
+        return rows, llh, iters
+
+    return jax.jit(fit, donate_argnums=(0,))
+
+
+# ------------------------------------------------- frozen-state gathers
+def gather_neighbor_rows(F: jax.Array, nbr_ids: jax.Array) -> jax.Array:
+    """Dense frozen rows for a padded neighbor batch: (B, D, K). Padding
+    slots may point at any valid row — their mask is 0."""
+    return F[nbr_ids]
+
+
+def densify_member_rows(
+    ids: jax.Array, w: jax.Array, nbr_ids: jax.Array, k_pad: int
+) -> jax.Array:
+    """Sparse-representation frozen rows: gather the (B, D, M) member
+    lists of the neighbor batch and scatter them dense to (B, D, k_pad).
+    Sentinel slots (id == k_pad, ops.sparse_members) land in a discarded
+    overflow column. O(B*D*K) is the fold-in working set either way —
+    the sparse trainer's state stays M-sized; only the query batch pays
+    K columns."""
+    mi = ids[nbr_ids]
+    mw = w[nbr_ids]
+
+    def one(row_ids, row_w):
+        return (
+            jnp.zeros((k_pad + 1,), row_w.dtype).at[row_ids].add(row_w)
+        )[:k_pad]
+
+    return jax.vmap(jax.vmap(one))(mi, mw)
+
+
+def densify_rows(
+    ids: jax.Array, w: jax.Array, node_ids: jax.Array, k_pad: int
+) -> jax.Array:
+    """(B, k_pad) dense rows of the given nodes from sparse member lists
+    (the sumF_others subtraction for existing sparse nodes)."""
+    mi = ids[node_ids]
+    mw = w[node_ids]
+
+    def one(row_ids, row_w):
+        return (
+            jnp.zeros((k_pad + 1,), row_w.dtype).at[row_ids].add(row_w)
+        )[:k_pad]
+
+    return jax.vmap(one)(mi, mw)

@@ -1,0 +1,49 @@
+"""Membership serving (ISSUE 14): batched fold-in inference, snapshot
+hot-swap, and the query surface behind `cli serve`.
+
+LAZY attribute re-exports (PEP 562, same rationale as bigclam_tpu.ops):
+the package init must not decide for its submodules what gets imported —
+`cli serve` answering only membership reads stays jax-free end to end
+(serve.snapshot / serve.batcher / serve.server import no jax at module
+scope; the FoldInEngine pulls jax on the first suggest query).
+"""
+
+_LAZY = {
+    "FOLDIN_CFG_FIELDS": (
+        "bigclam_tpu.serve.snapshot", "FOLDIN_CFG_FIELDS",
+    ),
+    "ServingSnapshot": ("bigclam_tpu.serve.snapshot", "ServingSnapshot"),
+    "SnapshotError": ("bigclam_tpu.serve.snapshot", "SnapshotError"),
+    "pad_neighbor_batch": (
+        "bigclam_tpu.serve.snapshot", "pad_neighbor_batch",
+    ),
+    "publish_snapshot": (
+        "bigclam_tpu.serve.snapshot", "publish_snapshot",
+    ),
+    "Future": ("bigclam_tpu.serve.batcher", "Future"),
+    "RequestBatcher": ("bigclam_tpu.serve.batcher", "RequestBatcher"),
+    "FAMILIES": ("bigclam_tpu.serve.server", "FAMILIES"),
+    "FoldInEngine": ("bigclam_tpu.serve.server", "FoldInEngine"),
+    "HotCommunityCache": (
+        "bigclam_tpu.serve.server", "HotCommunityCache",
+    ),
+    "MembershipServer": ("bigclam_tpu.serve.server", "MembershipServer"),
+}
+
+__all__ = sorted(_LAZY)
+
+
+def __getattr__(name):
+    try:
+        mod_name, attr = _LAZY[name]
+    except KeyError:
+        raise AttributeError(
+            f"module {__name__!r} has no attribute {name!r}"
+        ) from None
+    import importlib
+
+    return getattr(importlib.import_module(mod_name), attr)
+
+
+def __dir__():
+    return sorted(set(globals()) | set(_LAZY))

@@ -1,0 +1,198 @@
+"""Request batcher with a latency budget (ISSUE 14 tentpole).
+
+Single-query device dispatch would waste the accelerator: a fold-in step
+over one row costs the same launch overhead as over 64. The batcher
+coalesces requests the way the trainers coalesce edges — the first
+request in an empty queue opens a WINDOW of `budget_s` seconds; the batch
+flushes when either `max_batch` requests have accumulated or the window
+closes, whichever comes first. Under load the batch fills instantly
+(throughput mode: amortized dispatch); when idle a lone query pays at
+most the budget in added latency (the p99 knob `cli serve
+--latency-budget-ms` turns).
+
+Thread model: one flusher thread; submit() is thread-safe and returns a
+Future. Handler exceptions fail that batch's futures, never the thread.
+`drain()` blocks until the queue is empty AND no handler is mid-flight —
+the hot-swap barrier (serve.server swaps snapshots between batches, so a
+swap drains in-flight batches and drops zero queries).
+
+jax-free: pure threading + deque; the handler decides what touches a
+device.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Any, Callable, List, Optional
+
+
+class Future:
+    """Minimal single-assignment result slot (no concurrent.futures
+    executor semantics needed — the batcher owns the lifecycle)."""
+
+    __slots__ = ("_ev", "_value", "_error", "t_submit", "t_done")
+
+    def __init__(self):
+        self._ev = threading.Event()
+        self._value = None
+        self._error: Optional[BaseException] = None
+        self.t_submit = time.perf_counter()
+        self.t_done: Optional[float] = None
+
+    def set_result(self, value: Any) -> None:
+        self._value = value
+        self.t_done = time.perf_counter()
+        self._ev.set()
+
+    def set_error(self, error: BaseException) -> None:
+        self._error = error
+        self.t_done = time.perf_counter()
+        self._ev.set()
+
+    def done(self) -> bool:
+        return self._ev.is_set()
+
+    def result(self, timeout: Optional[float] = None) -> Any:
+        if not self._ev.wait(timeout):
+            raise TimeoutError("request not completed within timeout")
+        if self._error is not None:
+            raise self._error
+        return self._value
+
+    @property
+    def latency_s(self) -> Optional[float]:
+        return (
+            None if self.t_done is None else self.t_done - self.t_submit
+        )
+
+
+class Request:
+    __slots__ = ("payload", "future")
+
+    def __init__(self, payload: Any):
+        self.payload = payload
+        self.future = Future()
+
+
+class RequestBatcher:
+    """See module docstring. handler(batch: List[Request]) must set every
+    request's future (the batcher backstops: an unset future after a
+    clean handler return gets a RuntimeError, and a handler exception
+    fails every still-unset future in the batch)."""
+
+    def __init__(
+        self,
+        handler: Callable[[List[Request]], None],
+        max_batch: int = 64,
+        budget_s: float = 0.005,
+    ):
+        self.handler = handler
+        self.max_batch = max(int(max_batch), 1)
+        self.budget_s = max(float(budget_s), 0.0)
+        self._q: deque = deque()
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._inflight = 0
+        self._stop = False
+        self._thread: Optional[threading.Thread] = None
+        self.batches = 0
+        self.flushed_full = 0       # batches flushed by max_batch
+        self.flushed_deadline = 0   # batches flushed by the budget window
+
+    # ------------------------------------------------------- lifecycle
+    def start(self) -> "RequestBatcher":
+        if self._thread is None:
+            self._stop = False
+            self._thread = threading.Thread(
+                target=self._loop, name="bigclam-serve-batcher", daemon=True
+            )
+            self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        with self._cond:
+            self._stop = True
+            self._cond.notify_all()
+        if self._thread is not None:
+            self._thread.join(timeout=30.0)
+            self._thread = None
+        # fail anything still queued (stop during load is a caller bug,
+        # but futures must never hang)
+        while self._q:
+            req = self._q.popleft()
+            if not req.future.done():
+                req.future.set_error(
+                    RuntimeError("batcher stopped with request queued")
+                )
+
+    # --------------------------------------------------------- clients
+    def submit(self, payload: Any) -> Future:
+        req = Request(payload)
+        with self._cond:
+            if self._stop or self._thread is None:
+                raise RuntimeError("batcher is not running")
+            self._q.append(req)
+            self._cond.notify_all()
+        return req.future
+
+    def drain(self, timeout: float = 60.0) -> None:
+        """Block until the queue is empty and no batch is executing —
+        the hot-swap barrier. Requests submitted DURING a drain simply
+        extend it; nothing is rejected or dropped."""
+        deadline = time.perf_counter() + timeout
+        with self._cond:
+            while self._q or self._inflight:
+                remaining = deadline - time.perf_counter()
+                if remaining <= 0:
+                    raise TimeoutError("batcher did not drain in time")
+                self._cond.wait(remaining)
+
+    # ----------------------------------------------------------- flush
+    def _take_batch_locked(self) -> List[Request]:
+        batch = []
+        while self._q and len(batch) < self.max_batch:
+            batch.append(self._q.popleft())
+        return batch
+
+    def _loop(self) -> None:
+        while True:
+            with self._cond:
+                while not self._q and not self._stop:
+                    self._cond.wait()
+                if self._stop and not self._q:
+                    return
+                # window opens at the first queued request; fill until
+                # max_batch or the deadline
+                deadline = time.perf_counter() + self.budget_s
+                while (
+                    len(self._q) < self.max_batch and not self._stop
+                ):
+                    remaining = deadline - time.perf_counter()
+                    if remaining <= 0:
+                        break
+                    self._cond.wait(remaining)
+                full = len(self._q) >= self.max_batch
+                batch = self._take_batch_locked()
+                self._inflight += 1
+                self.batches += 1
+                if full:
+                    self.flushed_full += 1
+                else:
+                    self.flushed_deadline += 1
+            try:
+                self.handler(batch)
+                for req in batch:
+                    if not req.future.done():
+                        req.future.set_error(
+                            RuntimeError("handler left request unanswered")
+                        )
+            except BaseException as e:   # noqa: BLE001 — thread must live
+                for req in batch:
+                    if not req.future.done():
+                        req.future.set_error(e)
+            finally:
+                with self._cond:
+                    self._inflight -= 1
+                    self._cond.notify_all()

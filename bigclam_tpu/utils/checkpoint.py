@@ -18,6 +18,17 @@ writeback) — both fall back to the next-older checkpoint, with the cause
 named in the warning. Rotation counts only VALID checkpoints toward
 `keep`: when the newest files are corrupt, the newest readable checkpoint
 is never deleted out from under the resume path.
+
+Publication (ISSUE 14 satellite): `publish`/`latest`/`load_published` are
+the snapshot API a running `cli serve` hot-swaps from. A published
+snapshot is the same fsync-rename + per-array-crc32 archive as a
+checkpoint under a `snap_` prefix, plus an atomically-replaced
+`latest.json` pointer — so fit (the publisher) and serve (the consumer)
+agree on ONE publication primitive, and a reader either sees the previous
+complete snapshot or the new complete snapshot, never a torn one.
+A corrupted newest snapshot falls back to the previous published one at
+load, exactly like restore() does for checkpoints. Published snapshots
+are never rotated away by the checkpoint rotation (different prefix).
 """
 
 from __future__ import annotations
@@ -62,15 +73,19 @@ class CheckpointManager:
     def _path(self, step: int) -> str:
         return os.path.join(self.directory, f"ckpt_{step:09d}.npz")
 
-    def save(
+    def _snap_path(self, step: int) -> str:
+        return os.path.join(self.directory, f"snap_{step:09d}.npz")
+
+    def _write_archive(
         self,
+        path: str,
         step: int,
         arrays: Dict[str, np.ndarray],
-        meta: Optional[Dict[str, Any]] = None,
-    ) -> str:
-        """Atomically write arrays + metadata for `step`, then rotate. The
-        sidecar always carries a crc32 per array (restore verifies)."""
-        path = self._path(step)
+        meta: Optional[Dict[str, Any]],
+    ) -> Dict[str, np.ndarray]:
+        """The shared atomic-write primitive (fsync + rename, per-array
+        crc32 sidecar) behind both `save` (checkpoints) and `publish`
+        (serving snapshots)."""
         arrays = {k: np.asarray(v) for k, v in arrays.items()}
         fd, tmp = tempfile.mkstemp(dir=self.directory, suffix=".tmp")
         try:
@@ -87,13 +102,6 @@ class CheckpointManager:
             if os.path.exists(tmp):
                 os.unlink(tmp)
             raise
-        # the file we just wrote and fsynced is valid by construction:
-        # seed the probe cache so rotation never re-reads it (any later
-        # mutation — including the fault site below — changes its stat
-        # key and forces a real probe)
-        key = self._stat_key(step)
-        if key is not None:
-            self._valid_cache[step] = (key, True)
         mp = path + ".json"
         sidecar = {
             "step": step,
@@ -105,6 +113,26 @@ class CheckpointManager:
             f.flush()
             os.fsync(f.fileno())
         os.replace(mp + ".tmp", mp)
+        return arrays
+
+    def save(
+        self,
+        step: int,
+        arrays: Dict[str, np.ndarray],
+        meta: Optional[Dict[str, Any]] = None,
+    ) -> str:
+        """Atomically write arrays + metadata for `step`, then rotate. The
+        sidecar always carries a crc32 per array (restore verifies)."""
+        path = self._path(step)
+        arrays = {k: np.asarray(v) for k, v in arrays.items()}
+        self._write_archive(path, step, arrays, meta)
+        # the file we just wrote and fsynced is valid by construction:
+        # seed the probe cache so rotation never re-reads it (any later
+        # mutation — including the fault site below — changes its stat
+        # key and forces a real probe)
+        key = self._stat_key(step)
+        if key is not None:
+            self._valid_cache[step] = (key, True)
         # fault-injection site (resilience.faults): a truncate/corrupt here
         # models a lost page-cache writeback / silent bit flip AFTER the
         # rename — the failure class restore()'s fallback exists for
@@ -165,10 +193,91 @@ class CheckpointManager:
                 )
         return None
 
+    # ------------------------------------------------ publication (serve)
+    def publish(
+        self,
+        step: int,
+        arrays: Dict[str, np.ndarray],
+        meta: Optional[Dict[str, Any]] = None,
+    ) -> str:
+        """Atomically publish a serving snapshot for `step` (see module
+        docstring): fsync-rename archive + crc32 sidecar under the
+        `snap_` prefix, then an atomic `latest.json` pointer update. The
+        pointer flip is the publication instant — a concurrent reader
+        resolves either the previous snapshot or this one, complete."""
+        path = self._snap_path(step)
+        self._write_archive(path, step, arrays, meta)
+        lp = os.path.join(self.directory, "latest.json")
+        with open(lp + ".tmp", "w") as f:
+            json.dump({"step": step}, f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(lp + ".tmp", lp)
+        return path
+
+    def published_steps(self) -> list[int]:
+        out = []
+        for name in os.listdir(self.directory):
+            if name.startswith("snap_") and name.endswith(".npz"):
+                out.append(int(name[5:-4]))
+        return sorted(out)
+
+    def latest(self) -> Optional[int]:
+        """The currently-published snapshot step: the `latest.json`
+        pointer when it names a readable archive, else the newest
+        published snapshot on disk (pointer lost/corrupt — the archive
+        set is still authoritative). None when nothing is published."""
+        lp = os.path.join(self.directory, "latest.json")
+        try:
+            with open(lp) as f:
+                step = int(json.load(f)["step"])
+            if os.path.exists(self._snap_path(step)):
+                return step
+        except (OSError, ValueError, KeyError, TypeError):
+            pass
+        steps = self.published_steps()
+        return steps[-1] if steps else None
+
+    def load_published(
+        self, step: Optional[int] = None
+    ) -> Optional[Tuple[int, Dict[str, np.ndarray], Dict[str, Any]]]:
+        """Load a published snapshot, crc-verified. With step=None, the
+        `latest()` snapshot — falling back past a truncated/corrupted
+        newest one to the PREVIOUS published snapshot (the serve-side
+        twin of restore()'s fallback). An explicit step propagates its
+        error."""
+        if step is not None:
+            return self._load_archive(self._snap_path(step), step)
+        steps = self.published_steps()
+        head = self.latest()
+        if head in steps:
+            # try the pointed-at snapshot first, then strictly older ones
+            steps = [s for s in steps if s <= head]
+        for s in reversed(steps):
+            try:
+                return self._load_archive(self._snap_path(s), s)
+            except _CORRUPT_ERRORS as e:
+                cause = (
+                    "silently corrupted"
+                    if isinstance(e, CheckpointCorruption)
+                    else "unreadable"
+                )
+                print(
+                    f"warning: published snapshot step {s} {cause} "
+                    f"({type(e).__name__}: {e}); falling back to the "
+                    "previous published snapshot",
+                    file=sys.stderr,
+                )
+        return None
+
     def _load(
         self, step: int
     ) -> Tuple[int, Dict[str, np.ndarray], Dict[str, Any]]:
-        path = self._path(step)
+        return self._load_archive(self._path(step), step)
+
+    def _load_archive(
+        self, path: str, step: int
+    ) -> Tuple[int, Dict[str, np.ndarray], Dict[str, Any]]:
         with np.load(path) as z:
             arrays = {k: z[k] for k in z.files}
         meta: Dict[str, Any] = {}

@@ -117,6 +117,74 @@ def test_cli_preflight_stays_jax_free_on_manifest(tmp_path):
     assert "DOES NOT FIT" in r.stdout
 
 
+def test_cli_serve_help_stays_jax_free(tmp_path):
+    # `serve --help` must answer on boxes with no accelerator stack
+    # (argparse exits via SystemExit, so the jax assertion runs first)
+    code = textwrap.dedent(
+        """
+        import sys
+        from bigclam_tpu.cli import main
+        try:
+            main(["serve", "--help"])
+        except SystemExit as e:
+            assert e.code in (0, None), e.code
+        assert "jax" not in sys.modules, "serve --help imported jax"
+        """
+    )
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    r = subprocess.run(
+        [sys.executable, "-c", code],
+        env=env, cwd=str(tmp_path), capture_output=True, text=True,
+        timeout=120,
+    )
+    assert r.returncode == 0, (r.stdout, r.stderr)
+
+
+def test_cli_serve_read_queries_and_report_stay_jax_free(tmp_path):
+    # the ISSUE 14 satellite: membership READ families (communities_of /
+    # members_of) answer from the snapshot + inverted index with no jax
+    # import — only the fold-in family may pull jax, lazily. The
+    # snapshot is published in-parent (publish_snapshot is numpy-only).
+    import numpy as np
+
+    from bigclam_tpu.config import BigClamConfig
+    from bigclam_tpu.serve.snapshot import publish_snapshot
+
+    rng = np.random.default_rng(0)
+    F = rng.uniform(0.0, 1.0, size=(12, 3))
+    snapdir = str(tmp_path / "snaps")
+    publish_snapshot(
+        snapdir, step=1, F=F, num_edges=20,
+        cfg=BigClamConfig(num_communities=3),
+    )
+    queries = tmp_path / "q.jsonl"
+    queries.write_text(
+        "".join(
+            json.dumps(q) + "\n"
+            for q in (
+                [{"family": "communities_of", "u": u} for u in range(12)]
+                + [{"family": "members_of", "c": c} for c in range(3)]
+            )
+        )
+    )
+    tdir = str(tmp_path / "telem")
+    r = _run_jaxfree(
+        ["serve", "--snapshots", snapdir, "--queries", str(queries),
+         "--results", str(tmp_path / "ans.jsonl"),
+         "--telemetry-dir", tdir, "--latency-budget-ms", "1", "--quiet"],
+        str(tmp_path),
+    )
+    assert r.returncode == 0, (r.stdout, r.stderr)
+    stats = json.loads(r.stdout.strip().splitlines()[-1])
+    assert stats["serve_queries"] == 15 and stats["serve_errors"] == 0
+    assert stats["serve_p99_s"] > 0
+    # the serve report path stays jax-free too, and renders the section
+    r = _run_jaxfree(["report", tdir], str(tmp_path))
+    assert r.returncode == 0, (r.stdout, r.stderr)
+    assert "serving: 15 queries" in r.stdout
+
+
 def test_cli_perf_show_stays_jax_free(tmp_path):
     # the perf-ledger tooling shares the data-prep-host contract (the
     # module docstring promises it; now the test does)

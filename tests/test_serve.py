@@ -1,0 +1,707 @@
+"""Membership serving (ISSUE 14): fold-in correctness, snapshot
+publish/hot-swap, the query families, the request batcher, the
+Zipf-aware cache, and the serving ledger fields."""
+
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from bigclam_tpu.config import BigClamConfig
+from bigclam_tpu.models import BigClamModel
+from bigclam_tpu.models.agm import sample_planted_graph
+from bigclam_tpu.ops import extraction
+from bigclam_tpu.serve.batcher import RequestBatcher
+from bigclam_tpu.serve.server import (
+    FoldInEngine,
+    HotCommunityCache,
+    MembershipServer,
+)
+from bigclam_tpu.serve.snapshot import (
+    ServingSnapshot,
+    SnapshotError,
+    pad_neighbor_batch,
+    publish_snapshot,
+)
+from bigclam_tpu.utils.checkpoint import CheckpointManager
+
+K = 6
+N = 120
+
+
+@pytest.fixture(scope="module")
+def fitted():
+    """One small planted fit shared by the module (trainer correctness
+    is pinned elsewhere; serving tests only need a realistic F)."""
+    rng = np.random.default_rng(3)
+    g, truth = sample_planted_graph(N, K, p_in=0.8, rng=rng)
+    cfg = BigClamConfig(num_communities=K, max_iters=300)
+    model = BigClamModel(g, cfg)
+    res = model.fit(model.random_init())
+    return g, truth, cfg, model, res
+
+
+@pytest.fixture()
+def snapdir(tmp_path, fitted):
+    g, truth, cfg, model, res = fitted
+    d = str(tmp_path / "snaps")
+    publish_snapshot(
+        d, step=res.num_iters, F=res.F, raw_ids=g.raw_ids,
+        num_edges=g.num_edges, cfg=cfg, meta={"llh": res.llh},
+    )
+    return d
+
+
+# ---------------------------------------------------------- fold-in ops
+def test_foldin_pass_matches_trainer_per_node(fitted):
+    """The sharpest correctness pin: the fold-in objective/gradient of a
+    row batch equals the trainer's own per-node grad/LLH slice."""
+    import jax.numpy as jnp
+
+    from bigclam_tpu.ops import foldin as fi
+    from bigclam_tpu.ops.objective import grad_llh
+
+    g, _, cfg, model, res = fitted
+    state = model.init_state(res.F)
+    grad_full, node_llh = grad_llh(state.F, state.sumF, model.edges, cfg)
+    nodes = [0, 7, 33, 77]
+    nbr_ids, nbr_mask, _ = pad_neighbor_batch(g.indptr, g.indices, nodes)
+    rows = state.F[jnp.asarray(nodes)]
+    nbr_rows = fi.gather_neighbor_rows(state.F, jnp.asarray(nbr_ids))
+    mask = jnp.asarray(nbr_mask, state.F.dtype)
+    sumF_others = state.sumF[None, :] - rows
+    grad, llh = fi.foldin_pass(rows, nbr_rows, mask, sumF_others, cfg)
+    np.testing.assert_allclose(
+        np.asarray(grad), np.asarray(grad_full)[nodes], atol=1e-5
+    )
+    np.testing.assert_allclose(
+        np.asarray(llh), np.asarray(node_llh)[nodes], atol=1e-5
+    )
+
+
+def test_foldin_recovers_trained_row_dense(fitted):
+    """A node present during training: its trained row is a fixed point
+    of the fold-in objective (init='own' recovers it within the band)."""
+    g, _, cfg, model, res = fitted
+    state = model.init_state(res.F)
+    nodes = list(range(0, N, 11))
+    rows, llh, iters = model.foldin_rows(
+        state, nodes, conv_tol=1e-8, max_iters=500
+    )
+    np.testing.assert_allclose(rows, res.F[nodes], atol=1e-3)
+    assert np.all(np.isfinite(llh))
+
+
+def test_foldin_recovers_trained_row_sparse(fitted):
+    """Sparse twin at M >= K (no truncation): fold-in against the frozen
+    member lists recovers the trained rows of the sparse fit."""
+    from bigclam_tpu.models.sparse import SparseBigClamModel
+
+    g, _, cfg, model, res = fitted
+    scfg = cfg.replace(representation="sparse", sparse_m=K)
+    smodel = SparseBigClamModel(g, scfg)
+    state, llh, iters, _ = smodel.fit_state(
+        smodel.init_state(smodel.random_init())
+    )
+    F_tr = smodel.extract_F(state)
+    nodes = list(range(0, N, 13))
+    rows, rl, ri = smodel.foldin_rows(
+        state, nodes, conv_tol=1e-8, max_iters=500
+    )
+    # the sparse fit stops at the JOINT conv_tol, so fold-in may refine
+    # a row slightly past it — the band is the recovery tolerance
+    np.testing.assert_allclose(rows, F_tr[nodes], atol=5e-3)
+
+
+@pytest.mark.parametrize("init", ["own", "mean"])
+def test_foldin_batched_equals_sequential_dense(fitted, init):
+    g, _, cfg, model, res = fitted
+    state = model.init_state(res.F)
+    nodes = [2, 19, 45, 101]
+    rows_b, llh_b, it_b = model.foldin_rows(
+        state, nodes, conv_tol=1e-8, max_iters=400, init=init
+    )
+    for i, u in enumerate(nodes):
+        rows_1, llh_1, it_1 = model.foldin_rows(
+            state, [u], conv_tol=1e-8, max_iters=400, init=init
+        )
+        np.testing.assert_allclose(rows_1[0], rows_b[i], rtol=1e-6,
+                                   atol=1e-7)
+        assert int(it_1[0]) == int(it_b[i])
+
+
+def test_foldin_batched_equals_sequential_sparse(fitted):
+    from bigclam_tpu.models.sparse import SparseBigClamModel
+
+    g, _, cfg, model, res = fitted
+    scfg = cfg.replace(representation="sparse", sparse_m=K)
+    smodel = SparseBigClamModel(g, scfg)
+    state, _, _, _ = smodel.fit_state(
+        smodel.init_state(smodel.random_init())
+    )
+    nodes = [5, 28, 61]
+    rows_b, _, it_b = smodel.foldin_rows(
+        state, nodes, conv_tol=1e-8, max_iters=400, init="mean"
+    )
+    for i, u in enumerate(nodes):
+        rows_1, _, it_1 = smodel.foldin_rows(
+            state, [u], conv_tol=1e-8, max_iters=400, init="mean"
+        )
+        np.testing.assert_allclose(rows_1[0], rows_b[i], rtol=1e-6,
+                                   atol=1e-7)
+        assert int(it_1[0]) == int(it_b[i])
+
+
+def test_pad_neighbor_batch_shapes_and_truncation(fitted):
+    g, *_ = fitted
+    nodes = [0, 1, 2]
+    ids, mask, trunc = pad_neighbor_batch(g.indptr, g.indices, nodes)
+    degs = [len(g.neighbors(u)) for u in nodes]
+    assert trunc == 0 and ids.shape == mask.shape
+    assert [int(r.sum()) for r in mask] == degs
+    for i, u in enumerate(nodes):
+        np.testing.assert_array_equal(
+            ids[i, : degs[i]], g.neighbors(u)
+        )
+    ids2, mask2, trunc2 = pad_neighbor_batch(
+        g.indptr, g.indices, nodes, max_deg=2
+    )
+    assert ids2.shape[1] == 2 and trunc2 == sum(d - 2 for d in degs if d > 2)
+
+
+# ------------------------------------------------- snapshots + publish
+def test_publish_latest_and_roundtrip(tmp_path, fitted):
+    g, _, cfg, model, res = fitted
+    d = str(tmp_path / "s")
+    mgr = CheckpointManager(d)
+    assert mgr.latest() is None
+    publish_snapshot(d, step=5, F=res.F, raw_ids=g.raw_ids,
+                     num_edges=g.num_edges, cfg=cfg)
+    assert mgr.latest() == 5
+    publish_snapshot(d, step=9, F=res.F + 0.25, raw_ids=g.raw_ids,
+                     num_edges=g.num_edges, cfg=cfg)
+    assert mgr.latest() == 9
+    assert mgr.published_steps() == [5, 9]
+    step, arrays, meta = mgr.load_published()
+    assert step == 9 and meta["representation"] == "dense"
+    np.testing.assert_array_equal(arrays["F"], res.F + 0.25)
+    # checkpoints and snapshots never collide: rotation ignores snap_
+    mgr.save(1, {"F": res.F})
+    assert mgr.published_steps() == [5, 9]
+    assert mgr.steps() == [1]
+
+
+def test_corrupt_latest_snapshot_falls_back(tmp_path, fitted, capsys):
+    g, _, cfg, model, res = fitted
+    d = str(tmp_path / "s")
+    publish_snapshot(d, step=1, F=res.F, raw_ids=g.raw_ids,
+                     num_edges=g.num_edges, cfg=cfg)
+    publish_snapshot(d, step=2, F=res.F + 1.0, raw_ids=g.raw_ids,
+                     num_edges=g.num_edges, cfg=cfg)
+    # flip bytes inside the newest archive (silent corruption)
+    path = os.path.join(d, "snap_000000002.npz")
+    data = bytearray(open(path, "rb").read())
+    data[len(data) // 2] ^= 0xFF
+    open(path, "wb").write(bytes(data))
+    snap = ServingSnapshot.load(d)
+    assert snap.step == 1
+    np.testing.assert_array_equal(snap.F, res.F)
+
+
+def test_snapshot_refuses_wrong_store(tmp_path, fitted):
+    g, _, cfg, model, res = fitted
+
+    class FakeStore:
+        num_nodes = N + 1
+        num_directed_edges = 2 * g.num_edges
+
+    d = str(tmp_path / "s")
+    publish_snapshot(d, step=1, F=res.F, raw_ids=g.raw_ids,
+                     num_edges=g.num_edges, cfg=cfg)
+    with pytest.raises(SnapshotError, match="does not match the store"):
+        ServingSnapshot.load(d, store=FakeStore())
+
+
+def test_snapshot_membership_index_matches_extraction(snapdir, fitted):
+    g, _, cfg, model, res = fitted
+    snap = ServingSnapshot.load(snapdir)
+    comms = extraction.extract_communities(res.F, g)
+    for c in range(K):
+        assert snap.members_of(c).tolist() == comms.get(c, [])
+    delta = extraction.delta_threshold(g.num_nodes, g.num_edges)
+    assert snap.delta == pytest.approx(delta)
+    mask = extraction.membership_mask(res.F, delta)
+    for u in range(0, N, 17):
+        cids, weights = snap.communities_of(snap.row_of(u))
+        assert sorted(cids.tolist()) == np.nonzero(mask[u])[0].tolist()
+        # ranked by weight descending
+        assert list(weights) == sorted(weights, reverse=True)
+
+
+def test_sparse_snapshot_membership(tmp_path, fitted):
+    from bigclam_tpu.ops import sparse_members as sm
+
+    g, _, cfg, model, res = fitted
+    ids, w, truncated = sm.from_dense(res.F, K, K, N)
+    assert truncated == 0          # M == K: nothing dropped
+    d = str(tmp_path / "s")
+    publish_snapshot(d, step=3, ids=ids, w=w, raw_ids=g.raw_ids,
+                     num_edges=g.num_edges, cfg=cfg)
+    snap = ServingSnapshot.load(d)
+    assert snap.representation == "sparse"
+    comms = extraction.extract_communities(res.F, g)
+    delta = snap.delta
+    mask = extraction.membership_mask(res.F, delta)
+    nonzero_rows = np.asarray(res.F).max(axis=1) > 0
+    for c in range(K):
+        want = [
+            u for u in comms.get(c, []) if nonzero_rows[snap.row_of(u)]
+        ]
+        assert snap.members_of(c).tolist() == want
+    np.testing.assert_allclose(
+        snap.sumF, res.F.sum(axis=0), rtol=1e-6
+    )
+
+
+def test_snapshot_members_sorted_by_raw_id_under_permutation(tmp_path):
+    """Balanced caches permute rows, so raw_ids is not monotone in row
+    index: members_of must still return RAW-id-sorted lists (the
+    ops.extraction._group_pairs contract)."""
+    rng = np.random.default_rng(2)
+    n, k = 30, 3
+    F = rng.uniform(0.0, 1.0, size=(n, k))
+    raw = rng.permutation(np.arange(100, 100 + n))
+    d = str(tmp_path / "s")
+    publish_snapshot(
+        d, step=1, F=F, raw_ids=raw, num_edges=40,
+        cfg=BigClamConfig(num_communities=k),
+    )
+    snap = ServingSnapshot.load(d)
+    delta = snap.delta
+    mask = extraction.membership_mask(F, delta)
+    for c in range(k):
+        want = sorted(int(raw[u]) for u in np.nonzero(mask[:, c])[0])
+        assert snap.members_of(c).tolist() == want
+    # row_of inverts the permutation
+    for u in (0, 7, 29):
+        assert snap.row_of(int(raw[u])) == u
+
+
+def test_snapshot_stamps_conv_tol_for_foldin(tmp_path):
+    """The fold-in engine must stop at the TRAINER's tolerance — the
+    snapshot carries conv_tol (a fit at 1e-6 must not serve suggests
+    converged only to the class default 1e-4)."""
+    cfg = BigClamConfig(num_communities=3, conv_tol=1e-6, alpha=0.07)
+    d = str(tmp_path / "s")
+    F = np.random.default_rng(0).uniform(size=(10, 3))
+    publish_snapshot(d, step=1, F=F, num_edges=12, cfg=cfg)
+    snap = ServingSnapshot.load(d)
+    assert snap.meta["conv_tol"] == 1e-6
+    engine = FoldInEngine(snap)
+    assert engine.cfg.conv_tol == 1e-6
+    assert engine.cfg.alpha == 0.07
+
+
+def test_maybe_reload_survives_corrupt_newest_publication(tmp_path,
+                                                          fitted):
+    g, _, cfg, model, res = fitted
+    d = str(tmp_path / "s")
+    publish_snapshot(d, step=1, F=res.F, raw_ids=g.raw_ids,
+                     num_edges=g.num_edges, cfg=cfg)
+    with MembershipServer(d, budget_s=0.001) as server:
+        publish_snapshot(d, step=2, F=np.roll(res.F, 1, axis=1),
+                         raw_ids=g.raw_ids, num_edges=g.num_edges,
+                         cfg=cfg)
+        # newest publication lost a writeback: the fallback load
+        # resolves to the snapshot already serving -> NO swap, no error
+        open(os.path.join(d, "snap_000000002.npz"), "wb").write(b"torn")
+        assert server.maybe_reload() is None
+        assert server.generation == 1
+        r = server.query({"family": "members_of", "c": 0})
+        assert "members" in r
+        # the publisher retries; now the swap goes through
+        publish_snapshot(d, step=3, F=np.roll(res.F, 1, axis=1),
+                         raw_ids=g.raw_ids, num_edges=g.num_edges,
+                         cfg=cfg)
+        assert server.maybe_reload() == 3
+        assert server.generation == 3
+
+
+def test_malformed_query_does_not_lose_batch_telemetry(tmp_path,
+                                                       snapdir):
+    from bigclam_tpu.obs import RunTelemetry, install, uninstall
+
+    tdir = str(tmp_path / "telem")
+    tel = install(RunTelemetry(tdir, entry="serve", quiet=True,
+                               device_memory=False))
+    try:
+        with MembershipServer(snapdir, budget_s=0.01,
+                              max_batch=8) as server:
+            results = server.run_queries(
+                [{"family": "members_of", "c": 0},
+                 {"u": 1},                      # family missing
+                 {"family": 12, "c": 0},        # family not a string
+                 "not even a dict"]
+            )
+    finally:
+        tel.finalize()
+        uninstall(tel)
+    assert "members" in results[0]
+    assert all("error" in r for r in results[1:])
+    with open(os.path.join(tdir, "events.jsonl")) as f:
+        serve_events = [
+            json.loads(ln) for ln in f
+            if ln.strip() and json.loads(ln)["kind"] == "serve"
+        ]
+    # the batch's serve event survived the malformed entries
+    assert sum(e["batch"] for e in serve_events) == 4
+
+
+def test_cli_query_spec_errors_are_clean(snapdir):
+    from bigclam_tpu.cli import _parse_query_spec
+
+    assert _parse_query_spec("members_of:3") == {"family": "members_of",
+                                                 "c": 3}
+    for bad in ("members_of:abc", "members_of", "nope:1", "{not json"):
+        with pytest.raises(SystemExit, match="error: --query"):
+            _parse_query_spec(bad)
+
+
+# ------------------------------------------------------------ batcher
+def test_batcher_full_and_deadline_flush():
+    seen = []
+
+    def handler(batch):
+        seen.append(len(batch))
+        for req in batch:
+            req.future.set_result(req.payload)
+
+    b = RequestBatcher(handler, max_batch=4, budget_s=0.05).start()
+    try:
+        futs = [b.submit(i) for i in range(8)]
+        assert [f.result(5.0) for f in futs] == list(range(8))
+        assert sum(seen) == 8
+        t0 = time.perf_counter()
+        lone = b.submit(99)
+        assert lone.result(5.0) == 99
+        # the lone request waits ~the budget, not forever
+        assert time.perf_counter() - t0 < 2.0
+        b.drain()
+        assert b.flushed_deadline >= 1
+    finally:
+        b.stop()
+
+
+def test_batcher_handler_exception_fails_futures_not_thread():
+    calls = {"n": 0}
+
+    def handler(batch):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise RuntimeError("boom")
+        for req in batch:
+            req.future.set_result("ok")
+
+    b = RequestBatcher(handler, max_batch=1, budget_s=0.0).start()
+    try:
+        with pytest.raises(RuntimeError, match="boom"):
+            b.submit(1).result(5.0)
+        assert b.submit(2).result(5.0) == "ok"   # thread survived
+    finally:
+        b.stop()
+
+
+# ---------------------------------------------------- cache (Zipf-aware)
+def test_hot_cache_prewarm_and_mass_share_admission(snapdir):
+    snap = ServingSnapshot.load(snapdir)
+    cache = HotCommunityCache(slots=2)
+    cache.reset(snap)
+    top = snap.top_mass_communities(2)
+    for c in top:
+        assert cache.get(int(c)) is not None        # pre-warmed: hits
+    order = np.argsort(-snap.mass_share, kind="stable")
+    coldest = int(order[-1])
+    assert cache.get(coldest) is None               # miss
+    cache.put(coldest, snap.members_of(coldest))
+    # the long tail never evicts the hot head
+    assert coldest not in cache.data
+    assert cache.hits == 2 and cache.misses == 1
+    assert cache.hit_rate == pytest.approx(2 / 3)
+
+
+# ------------------------------------------------------------- server
+def test_server_three_families(snapdir, fitted):
+    g, _, cfg, model, res = fitted
+    with MembershipServer(
+        snapdir, graph=g, budget_s=0.001, max_batch=16
+    ) as server:
+        snap = ServingSnapshot.load(snapdir)
+        u = 7
+        r = server.query({"family": "communities_of", "u": int(g.raw_ids[u])})
+        cids, weights = snap.communities_of(u)
+        assert [c for c, _ in r["communities"]] == cids.tolist()
+        r = server.query({"family": "members_of", "c": 0})
+        assert r["members"] == snap.members_of(0).tolist()
+        r = server.query({"family": "suggest_for", "u": int(g.raw_ids[u])})
+        assert r["suggested"], "fold-in suggested nothing"
+        # an existing node's suggestion leads with its trained community
+        assert r["suggested"][0][0] == cids[0]
+        stats = server.stats()
+        assert stats["serve_queries"] == 3 and stats["serve_errors"] == 0
+        assert stats["serve_p99_s"] > 0 and stats["serve_qps"] > 0
+
+
+def test_server_new_node_suggest_via_neighbors(snapdir, fitted):
+    """A brand-new node described only by its neighbor list lands in the
+    community its neighbors share (the live-graph fold-in path)."""
+    g, truth, cfg, model, res = fitted
+    snap = ServingSnapshot.load(snapdir)
+    # pick the community with the most members; its trained members are
+    # the new node's neighbors
+    c = int(np.argmax(np.diff(snap.comm_indptr)))
+    members = snap.members_of(c).tolist()[:10]
+    with MembershipServer(snapdir, budget_s=0.001) as server:
+        r = server.query(
+            {"family": "suggest_for", "neighbors": members}
+        )
+        assert r["suggested"][0][0] == c
+        assert r["iters"] >= 1
+
+
+def test_server_suggest_for_frozen_zero_row_uses_neighbor_mean(tmp_path):
+    """A node whose trained row froze all-zero (the faithful dynamics'
+    known failure mode) must still get a real suggestion: the engine
+    falls back to the neighbor-mean cold start for empty own rows."""
+    from bigclam_tpu.graph.csr import Graph
+
+    # star: node 0 (zero row) linked to 4 nodes all in community 1
+    n = 6
+    indptr = np.array([0, 4, 5, 6, 7, 8, 8], np.int64)
+    indices = np.array([1, 2, 3, 4, 0, 0, 0, 0], np.int32)
+    g = Graph(indptr=indptr, indices=indices,
+              raw_ids=np.arange(n, dtype=np.int64))
+    F = np.zeros((n, 3))
+    F[1:5, 1] = 0.9
+    d = str(tmp_path / "s")
+    publish_snapshot(d, step=1, F=F, raw_ids=g.raw_ids, num_edges=4,
+                     cfg=BigClamConfig(num_communities=3))
+    with MembershipServer(d, graph=g, budget_s=0.001) as server:
+        r = server.query({"family": "suggest_for", "u": 0})
+        assert r["suggested"][0][0] == 1
+        assert r["suggested"][0][1] > 0
+
+
+def test_server_per_query_errors_do_not_kill_batch(snapdir):
+    with MembershipServer(snapdir, budget_s=0.001) as server:
+        results = server.run_queries(
+            [
+                {"family": "members_of", "c": 0},
+                {"family": "members_of", "c": 999},       # out of range
+                {"family": "communities_of", "u": 10 ** 9},  # unknown id
+                {"family": "nope"},                        # unknown family
+                {"family": "suggest_for", "u": 0},  # no adjacency wired
+            ]
+        )
+        assert "members" in results[0]
+        assert all("error" in r for r in results[1:])
+        assert server.stats()["serve_errors"] == 4
+
+
+def test_hot_swap_changes_members_and_drops_nothing(tmp_path, fitted):
+    g, _, cfg, model, res = fitted
+    d = str(tmp_path / "s")
+    publish_snapshot(d, step=1, F=res.F, raw_ids=g.raw_ids,
+                     num_edges=g.num_edges, cfg=cfg)
+    with MembershipServer(d, budget_s=0.0005, max_batch=8) as server:
+        before = server.query({"family": "members_of", "c": 0})
+        assert server.generation == 1
+        # a column-rolled F: every community's member list changes
+        publish_snapshot(d, step=2, F=np.roll(res.F, 1, axis=1),
+                         raw_ids=g.raw_ids, num_edges=g.num_edges,
+                         cfg=cfg)
+        # fire queries from a background thread WHILE swapping
+        n_load = 60
+        results = []
+
+        def load():
+            results.extend(
+                server.run_queries(
+                    [{"family": "members_of", "c": i % K}
+                     for i in range(n_load)]
+                )
+            )
+
+        t = threading.Thread(target=load)
+        t.start()
+        new_step = server.hot_swap()
+        t.join(timeout=30.0)
+        assert not t.is_alive()
+        assert new_step == 2 and server.generation == 2
+        # zero drops: every query answered, none errored
+        assert len(results) == n_load
+        assert all("members" in r for r in results)
+        after = server.query({"family": "members_of", "c": 0})
+        snap2 = ServingSnapshot.load(d)
+        assert after["members"] == snap2.members_of(0).tolist()
+        assert snap2.step == 2
+        assert server.stats()["snapshot_swaps"] == 1
+        # maybe_reload is a no-op when already at latest
+        assert server.maybe_reload() is None
+        assert before["members"] != after["members"]
+
+
+def test_serve_telemetry_events_and_report(tmp_path, snapdir, fitted):
+    from bigclam_tpu.obs import (
+        RunTelemetry,
+        install,
+        uninstall,
+        validate_events_file,
+    )
+    from bigclam_tpu.obs.report import render
+
+    g, *_ = fitted
+    tdir = str(tmp_path / "telem")
+    tel = install(RunTelemetry(tdir, entry="serve", quiet=True,
+                               device_memory=False))
+    try:
+        with MembershipServer(snapdir, graph=g, budget_s=0.001) as server:
+            server.run_queries(
+                [{"family": "members_of", "c": i % K} for i in range(10)]
+                + [{"family": "communities_of",
+                    "u": int(g.raw_ids[i])} for i in range(5)]
+            )
+            publish_snapshot(
+                snapdir, step=999, F=np.asarray(fitted[4].F),
+                raw_ids=g.raw_ids, num_edges=g.num_edges, cfg=fitted[2],
+            )
+            server.hot_swap()
+            tel.set_final(server.stats())
+    finally:
+        tel.finalize()
+        uninstall(tel)
+    n, errors = validate_events_file(os.path.join(tdir, "events.jsonl"))
+    assert not errors, errors
+    with open(os.path.join(tdir, "events.jsonl")) as f:
+        kinds = [json.loads(ln)["kind"] for ln in f if ln.strip()]
+    assert "serve" in kinds and "snapshot_swap" in kinds
+    text, report_errors = render(tdir)
+    assert report_errors == 0
+    assert "serving: 15 queries" in text
+    assert "hot-swaps: 1" in text
+
+
+# ------------------------------------------------------------- ledger
+def _serve_report(p99=0.002, qps=500.0, mix="members_of:1.00"):
+    return {
+        "run": "r1", "entry": "serve", "pid": 0, "processes": 1,
+        "wall_s": 1.0,
+        "fingerprint": {"host": "h", "backend": "cpu",
+                        "device_kind": "cpu", "platform": "cpu"},
+        "compiles": {"count": 0, "by_key": {}},
+        "spans": {"seconds": {}},
+        "final": {
+            "serve_queries": 100, "serve_p50_s": p99 / 2,
+            "serve_p99_s": p99, "serve_qps": qps,
+            "cache_hit_rate": 0.9, "serve_mix": mix,
+        },
+    }
+
+
+def test_ledger_serve_fields_and_p99_verdict():
+    from bigclam_tpu.obs import ledger as L
+
+    base = L.build_record(_serve_report())
+    assert base["serve_p99_s"] == pytest.approx(0.002)
+    assert base["serve_qps"] == pytest.approx(500.0)
+    assert base["serve_queries"] == 100
+    assert base["cache_hit_rate"] == pytest.approx(0.9)
+    assert base["serve_mix"] == "members_of:1.00"
+    assert not L.validate_record(base)
+    # identical run: PASS
+    same = L.build_record(_serve_report())
+    d = L.diff_records(base, same)
+    assert not d["regression"]
+    # 2x p99: REGRESSION (serve p99 IS verdicted, unlike step_p99)
+    slow = L.build_record(_serve_report(p99=0.004))
+    d = L.diff_records(base, slow)
+    assert d["regression"]
+    assert any(
+        c["metric"] == "serve_p99_s" and c["regression"] and c["verdicted"]
+        for c in d["checks"]
+    )
+    # halved throughput: REGRESSION
+    d = L.diff_records(base, L.build_record(_serve_report(qps=200.0)))
+    assert d["regression"]
+
+
+def test_ledger_serve_never_baselines_fit():
+    from bigclam_tpu.obs import ledger as L
+
+    serve_rec = L.build_record(_serve_report())
+    fit_report = dict(_serve_report())
+    fit_report["entry"] = "fit"
+    fit_report["final"] = {"llh": -1.0, "n": 10, "edges": 20, "k": 4}
+    fit_rec = L.build_record(fit_report)
+    assert L.match_key(serve_rec) != L.match_key(fit_rec)
+    # different query mixes never cross-baseline either
+    other_mix = L.build_record(
+        _serve_report(mix="members_of:0.50|suggest_for:0.50")
+    )
+    assert L.match_key(serve_rec) != L.match_key(other_mix)
+
+
+# ---------------------------------------------------------------- cli
+def test_cli_serve_one_shot(tmp_path, snapdir, fitted, capsys):
+    from bigclam_tpu.cli import main
+
+    g, *_ = fitted
+    edges = tmp_path / "g.txt"
+    with open(edges, "w") as f:
+        for u, v in zip(g.src, g.dst):
+            if u < v:
+                f.write(f"{g.raw_ids[u]}\t{g.raw_ids[v]}\n")
+    rc = main(
+        [
+            "serve", "--snapshots", snapdir, "--graph", str(edges),
+            "--query", f"communities_of:{int(g.raw_ids[3])}",
+            "--query", "members_of:0",
+            "--query", f"suggest_for:{int(g.raw_ids[3])}",
+            "--latency-budget-ms", "1",
+        ]
+    )
+    out = capsys.readouterr().out.strip().splitlines()
+    assert rc == 0
+    stats = json.loads(out[-1])
+    assert stats["serve_queries"] == 3 and stats["serve_errors"] == 0
+    answers = [json.loads(ln) for ln in out[:-1]]
+    assert any("communities" in a for a in answers)
+    assert any("members" in a for a in answers)
+    assert any("suggested" in a for a in answers)
+
+
+def test_cli_fit_publishes_snapshot(tmp_path, fitted, capsys):
+    from bigclam_tpu.cli import main
+
+    g, *_ = fitted
+    edges = tmp_path / "g.txt"
+    with open(edges, "w") as f:
+        for u, v in zip(g.src, g.dst):
+            if u < v:
+                f.write(f"{g.raw_ids[u]}\t{g.raw_ids[v]}\n")
+    pub = str(tmp_path / "pub")
+    rc = main(
+        [
+            "fit", "--graph", str(edges), "--k", "4", "--max-iters", "30",
+            "--init", "random", "--publish-dir", pub, "--quiet",
+            "--health-every", "0",
+        ]
+    )
+    assert rc == 0
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["published"].endswith(".npz")
+    snap = ServingSnapshot.load(pub)
+    assert snap.n == g.num_nodes and snap.k == 4
+    assert CheckpointManager(pub).latest() == out["iters"]
