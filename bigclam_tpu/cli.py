@@ -46,8 +46,13 @@ def _add_common(p: argparse.ArgumentParser) -> None:
     )
     p.add_argument("--seed", type=int, default=0)
     p.add_argument(
-        "--init", default="conductance", choices=["conductance", "random"],
-        help="F initialization (conductance seeding is the reference default)",
+        "--init", default="conductance",
+        choices=["conductance", "random", "rowkeyed"],
+        help="F initialization (conductance seeding is the reference "
+             "default). rowkeyed: the {0,1} row-keyed counter init "
+             "(models.bigclam.rowkeyed_init_rows) — on --store-native "
+             "runs each host seeds ONLY its own row range, no host ever "
+             "materializes the O(N*K) F0 array (ROADMAP 1a)",
     )
     p.add_argument(
         "--mesh", default=None,
@@ -470,6 +475,13 @@ def _mesh_label(mesh) -> str:
 def _init_F(g, cfg, args):
     from bigclam_tpu.ops import seeding
 
+    if args.init == "rowkeyed":
+        # host-global materialization of the row-keyed counter init —
+        # entries that skip the fit's F0=None fast path (profile)
+        # still get the SAME bits as `fit --init rowkeyed`
+        from bigclam_tpu.models.bigclam import rowkeyed_init_F
+
+        return rowkeyed_init_F(g, cfg)
     if args.init == "conductance":
         backend = getattr(args, "seed_backend", "auto")
         store = getattr(args, "_store", None)
@@ -587,6 +599,34 @@ def _cmd_fit(args, tel=None) -> int:
             "defaulting to every 50 iterations",
             file=sys.stderr,
         )
+    if args.init == "rowkeyed" and cfg.quality_mode:
+        raise SystemExit(
+            "error: --init rowkeyed is not supported with --quality "
+            "(the annealing schedule owns its noise-floor init)"
+        )
+    if getattr(args, "follow", None):
+        # validate the follow preconditions BEFORE the (possibly hours-
+        # long) fit: a misconfigured loop must refuse up front, not
+        # after the fit it would have discarded
+        if getattr(args, "_store", None) is None:
+            raise SystemExit(
+                "error: --follow needs a compiled graph cache (--graph "
+                "<cache-dir> or --cache-dir): deltas re-ingest shard "
+                "ranges, not text files"
+            )
+        if not getattr(args, "publish_dir", None):
+            raise SystemExit(
+                "error: --follow needs --publish-dir (each refit "
+                "publishes a snapshot generation the server swaps to)"
+            )
+        if args.mesh or args.distributed or cfg.quality_mode or (
+            cfg.representation == "sparse"
+        ):
+            raise SystemExit(
+                "error: --follow supports single-chip dense fits for "
+                "now (the sharded/sparse refit loop rides the ROADMAP "
+                "item 1 pod drill)"
+            )
     with prof.stage("model_build"):
         model = _make_model(g, cfg, args)
     if tel is not None:
@@ -595,7 +635,10 @@ def _cmd_fit(args, tel=None) -> int:
         # initialize_distributed never ran (single-process fallback)
         tel.commit_gate()
     with prof.stage("seeding"):
-        F0 = _init_F(g, cfg, args)
+        # rowkeyed: F0 = None defers to the model's init_state — on the
+        # store-backed trainers each host generates only its own row
+        # range (ISSUE 15 satellite); no host-global array exists here
+        F0 = None if args.init == "rowkeyed" else _init_F(g, cfg, args)
     ckpt = (
         CheckpointManager(args.checkpoint_dir) if args.checkpoint_dir else None
     )
@@ -645,12 +688,16 @@ def _cmd_fit(args, tel=None) -> int:
                 F0, callback=cb, checkpoints=ckpt, resume=resume
             )
 
+        import time as _time
+
+        t_fit = _time.perf_counter()
         with prof.stage("fit"), trace(args.profile_dir):
             # the supervisor retries transient-classified failures (and
             # stall escalations, when wired to abort): each retried
             # attempt re-enters the fit WITH the CheckpointManager, so a
             # retry resumes instead of restarting
             qres, res = sup.run_fit(_run_fit)
+        fit_wall_s = round(_time.perf_counter() - t_fit, 4)
     out = {
         "llh": res.llh,
         "iters": res.num_iters,
@@ -705,16 +752,28 @@ def _cmd_fit(args, tel=None) -> int:
             # serve --snapshots <dir>` hot-swaps to this fit's F
             from bigclam_tpu.serve.snapshot import publish_snapshot
 
+            from bigclam_tpu.utils.checkpoint import published_step_of
+
             path = publish_snapshot(
                 args.publish_dir,
-                step=res.num_iters,
+                # step=None: the NEXT generation under the publish lock
+                # (ISSUE 15). Iteration counts made terrible steps — a
+                # re-fit converging in fewer iterations would publish a
+                # "lower" generation the never-backward pointer rule
+                # then rightly refused to serve
+                step=None,
                 F=res.F,
                 raw_ids=g.raw_ids,
                 num_edges=g.num_edges,
                 cfg=cfg,
-                meta={"llh": res.llh, "seed": cfg.seed},
+                # fit_wall_s/iters: the full-fit cost baseline `cli
+                # refit` prices its refit_cost_ratio against (ISSUE 15)
+                meta={"llh": res.llh, "seed": cfg.seed,
+                      "fit_wall_s": fit_wall_s,
+                      "fit_iters": res.num_iters},
             )
             out["published"] = path
+            out["generation"] = published_step_of(path)
         if args.save_f:
             np.save(args.save_f, res.F)
             out["save_f"] = args.save_f
@@ -723,6 +782,25 @@ def _cmd_fit(args, tel=None) -> int:
 
             export_gexf(args.export_gexf, g, communities=com, F=res.F)
             out["export_gexf"] = args.export_gexf
+    if getattr(args, "follow", None):
+        # the continuous fit->publish->serve loop (ISSUE 15 tentpole):
+        # watch a delta directory, and per new edge file run delta
+        # re-ingest -> warm-start refit -> publish the next generation
+        # (a running `cli serve --watch-snapshots` hot-swaps each one);
+        # preconditions were validated up front, before the fit
+        store = args._store
+        from bigclam_tpu.models.refit import follow_deltas
+
+        with prof.stage("follow"):
+            out["follow"] = follow_deltas(
+                store, cfg, res.F, args.publish_dir, args.follow,
+                halo=getattr(args, "refit_halo", 1),
+                max_rounds=getattr(args, "refit_rounds", 12),
+                interval_s=getattr(args, "follow_interval", 0.5),
+                max_deltas=getattr(args, "follow_max", 0),
+                timeout_s=getattr(args, "follow_timeout", None),
+                quiet=args.quiet,
+            )
     if tel is not None:
         tel.set_final(out)
     print(json.dumps(out))
@@ -828,13 +906,64 @@ def _cmd_ingest(args, tel=None) -> int:
     ingest pipeline's own footprint (O(chunk + bucket + N), not O(file)).
     Telemetry (when on) follows suit: device-memory sampling is disabled
     (_open_telemetry), so the stage events/watermarks never import jax."""
-    from bigclam_tpu.graph.store import compile_graph_cache, is_cache_dir
+    from bigclam_tpu.graph.store import (
+        GraphStore,
+        compile_graph_cache,
+        is_cache_dir,
+    )
     from bigclam_tpu.utils.profiling import IngestProfile
 
+    if getattr(args, "delta", None):
+        # delta re-ingest (ISSUE 15): append an edge file to an EXISTING
+        # cache, rebuilding only the touched node ranges (jax-free like
+        # the rest of this entry; untouched shard blobs byte-identical)
+        if not is_cache_dir(args.cache_dir):
+            print(
+                f"error: --delta needs an existing compiled cache at "
+                f"{args.cache_dir} (run a full ingest first)",
+                file=sys.stderr,
+            )
+            return 1
+        store = GraphStore.open(args.cache_dir)
+        prof = IngestProfile()
+        try:
+            info = store.apply_delta(
+                args.delta, seed_rebake=not args.no_seed_bake,
+                profile=prof,
+            )
+        except ValueError as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 1
+        out = {
+            "cache_dir": args.cache_dir,
+            "delta": info["delta_path"],
+            "delta_seq": info["delta_seq"],
+            "edges_added": info["edges_added"],
+            "edges": info["num_directed_edges"] // 2,
+            "touched_shards": info["touched_shards"],
+            "touched_rows": int(info["touched_rows"].size),
+            "touched_frac": info["touched_frac"],
+            "phi_rebaked_shards": info["phi_rebaked_shards"],
+            "files_read": list(info["files_read"]),
+            "seconds": info["seconds"],
+            **prof.report(),
+        }
+        if tel is not None:
+            tel.set_final(out)
+        print(json.dumps(out))
+        return 0
+
+    if not args.graph:
+        print(
+            "error: a full ingest needs --graph (pass --delta to "
+            "append to an existing cache instead)",
+            file=sys.stderr,
+        )
+        return 1
     if is_cache_dir(args.cache_dir) and not args.overwrite:
         print(
             f"{args.cache_dir}: already compiled (use --overwrite to "
-            "rebuild)",
+            "rebuild, or --delta to append an edge file)",
             file=sys.stderr,
         )
         return 1
@@ -1262,10 +1391,14 @@ def _cmd_serve(args, tel=None) -> int:
     if args.graph:
         with prof.stage("graph_load"):
             if is_cache_dir(args.graph):
-                store = GraphStore.open(
-                    args.graph,
-                    self_heal=not getattr(args, "no_self_heal", False),
-                )
+                # ALWAYS read-only (ISSUE 15): a serving replica must
+                # never self-heal the cache — with the delta pipeline
+                # mutating it live, a crc mismatch here is usually a
+                # half-applied delta seen through a stale manifest, and
+                # a "heal" would rebuild the PRE-delta blobs over the
+                # writer's work. Healing belongs to the writer entries
+                # (ingest/fit); the server just retries after the swap.
+                store = GraphStore.open(args.graph, self_heal=False)
             else:
                 from bigclam_tpu.graph import build_graph
 
@@ -1334,6 +1467,170 @@ def _cmd_serve(args, tel=None) -> int:
     return 1 if out.get("serve_errors") else 0
 
 
+def cmd_refit(args) -> int:
+    tel = _open_telemetry(args, "refit")
+    try:
+        return _cmd_refit(args, tel)
+    finally:
+        _close_telemetry(tel)
+
+
+def _cmd_refit(args, tel=None) -> int:
+    """Warm-start incremental refit (ISSUE 15 tentpole part b): start
+    from the previous PUBLISHED F, re-optimize only the rows a delta
+    touched (plus a halo of their neighbors) with the batched fold-in
+    operator, and publish the result as the next snapshot generation.
+
+        cli ingest --delta day2.txt --cache-dir g.cache
+        cli refit --graph g.cache --snapshots snaps/ --delta day2.txt
+
+    The PR 8 health detectors watch the restricted objective: divergence
+    or plateau-before-tol marks accumulated drift and ESCALATES to a
+    full fit (--escalate never publishes the refit F regardless). The
+    refit_cost_ratio (refit wall vs the snapshot's recorded full-fit
+    wall) and touched_frac land in the telemetry final, the perf ledger
+    records them, and `cli perf diff` VERDICTS both."""
+    import os
+
+    from bigclam_tpu.models.refit import (
+        touched_rows_from_delta,
+        warm_start_refit,
+    )
+    from bigclam_tpu.serve.snapshot import (
+        ServingSnapshot,
+        SnapshotError,
+        publish_snapshot,
+    )
+    from bigclam_tpu.utils.profiling import StageProfile
+
+    if args.mesh or args.distributed or getattr(
+        args, "store_native", False
+    ):
+        raise SystemExit(
+            "error: refit is single-chip for now (the sharded refit "
+            "rides the ROADMAP item 1 pod drill) — drop --mesh/"
+            "--distributed/--store-native"
+        )
+    prof = StageProfile()
+    try:
+        with prof.stage("snapshot_load"):
+            snap = ServingSnapshot.load(args.snapshots)
+    except SnapshotError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 1
+    k = args.k or snap.k
+    if k != snap.k:
+        raise SystemExit(
+            f"error: --k {k} does not match the published snapshot's "
+            f"k={snap.k} (a refit continues the same model)"
+        )
+    with prof.stage("graph_load"):
+        g, cfg = _build(args, k)
+    if g.num_nodes != snap.n:
+        raise SystemExit(
+            f"error: graph has {g.num_nodes} nodes but the snapshot "
+            f"was published for {snap.n} — wrong graph/snapshot pair "
+            "(deltas never grow N; re-ingest + full fit for new nodes)"
+        )
+    with prof.stage("model_build"):
+        model = _make_model(g, cfg, args)
+    if tel is not None:
+        tel.commit_gate()
+    if snap.representation == "sparse":
+        from bigclam_tpu.ops.sparse_members import to_dense
+
+        F_prev = to_dense(snap.ids, snap.w, snap.n, snap.k)
+    else:
+        F_prev = np.asarray(snap.F[: snap.n, : snap.k], np.float64)
+    with prof.stage("touched"):
+        touched = touched_rows_from_delta(g.raw_ids, args.delta)
+    with prof.stage("refit"):
+        res = warm_start_refit(
+            model, F_prev, touched,
+            halo=args.halo,
+            max_rounds=args.refit_rounds,
+            batch=args.refit_batch,
+            foldin_max_iters=args.foldin_max_iters,
+            conv_tol=cfg.conv_tol,
+        )
+    F_final = res.F
+    total_wall = res.wall_s
+    full_llh = None
+    escalated_full = False
+    if res.escalated and args.escalate == "full":
+        print(
+            f"[bigclam] refit escalated "
+            f"({[a['check'] for a in res.anomalies]}): running a full "
+            "fit warm-started from the refit F",
+            file=sys.stderr,
+        )
+        import time as _time
+
+        t0 = _time.perf_counter()
+        with prof.stage("full_fit"):
+            full = model.fit(res.F)
+        total_wall = round(total_wall + _time.perf_counter() - t0, 4)
+        F_final = full.F
+        full_llh = full.llh
+        escalated_full = True
+    base_wall = snap.meta.get("fit_wall_s")
+    ratio = (
+        round(total_wall / float(base_wall), 6)
+        if isinstance(base_wall, (int, float)) and base_wall and not (
+            isinstance(base_wall, bool)
+        )
+        else None
+    )
+    out = {
+        "n": g.num_nodes,
+        "edges": g.num_edges,
+        "k": k,
+        "representation": cfg.representation,
+        "from_generation": int(snap.step),
+        "touched": res.touched,
+        "refit_nodes": res.refit_nodes,
+        "touched_frac": res.touched_frac,
+        "halo": res.halo,
+        "rounds": res.rounds,
+        "foldin_iters": res.foldin_iters,
+        "converged": res.converged,
+        "escalated": res.escalated,
+        "escalated_full_fit": escalated_full,
+        "refit_wall_s": total_wall,
+        "baseline_fit_wall_s": base_wall,
+        "refit_cost_ratio": ratio,
+        "restricted_llh": res.llh,
+    }
+    if full_llh is not None:
+        out["llh"] = full_llh
+    if not args.no_publish:
+        with prof.stage("publish"):
+            path = publish_snapshot(
+                args.snapshots, step=None, F=F_final,
+                raw_ids=g.raw_ids, num_edges=g.num_edges, cfg=cfg,
+                meta={
+                    "refit": True,
+                    "seed": cfg.seed,
+                    # the full-fit cost baseline propagates through
+                    # refit generations so cost ratios keep meaning
+                    # "vs a from-scratch fit", not "vs the last refit"
+                    "fit_wall_s": base_wall,
+                    "touched_frac": res.touched_frac,
+                    "refit_rounds": res.rounds,
+                    **({"llh": full_llh} if full_llh is not None
+                       else {}),
+                },
+            )
+        from bigclam_tpu.utils.checkpoint import published_step_of
+
+        out["published"] = path
+        out["generation"] = published_step_of(path) if path else None
+    if tel is not None:
+        tel.set_final(out)
+    print(json.dumps(out))
+    return 0
+
+
 def cmd_eval(args) -> int:
     from bigclam_tpu.evaluation import avg_f1, overlapping_nmi
     from bigclam_tpu.ops.extraction import load_communities
@@ -1399,6 +1696,38 @@ def main(argv=None) -> int:
         "--export-gexf", default=None,
         help="write a Gephi-compatible GEXF with community attributes",
     )
+    p_fit.add_argument(
+        "--follow", default=None, metavar="DELTA_DIR",
+        help="after the fit + publish, watch this directory for new "
+             "edge-delta files and run the continuous loop per file: "
+             "delta re-ingest (touched shard ranges only) -> warm-start "
+             "refit -> publish the next snapshot generation (ISSUE 15; "
+             "needs a cache --graph and --publish-dir; a running `cli "
+             "serve --watch-snapshots` hot-swaps each generation)",
+    )
+    p_fit.add_argument(
+        "--follow-max", type=int, default=0,
+        help="stop after this many deltas (0 = keep watching)",
+    )
+    p_fit.add_argument(
+        "--follow-interval", type=float, default=0.5,
+        help="seconds between delta-directory polls",
+    )
+    p_fit.add_argument(
+        "--follow-timeout", type=float, default=None,
+        help="exit when no new delta arrives for this many seconds "
+             "(default: watch forever)",
+    )
+    p_fit.add_argument(
+        "--refit-halo", type=int, default=1,
+        help="--follow refits touched rows plus this many hops of "
+             "neighbors (0 = strictly touched rows)",
+    )
+    p_fit.add_argument(
+        "--refit-rounds", type=int, default=12,
+        help="--follow block-coordinate sweep cap per delta (health "
+             "detectors may escalate to a full fit earlier)",
+    )
     p_fit.set_defaults(fn=cmd_fit)
 
     p_sweep = sub.add_parser("sweep", help="automatic K selection over a log grid")
@@ -1424,8 +1753,21 @@ def main(argv=None) -> int:
         help="compile a SNAP edge list into a binary graph-shard cache "
              "(streaming, memory-bounded; reports edges/sec + peak RSS)",
     )
-    p_ing.add_argument("--graph", required=True, help="SNAP edge-list path")
+    p_ing.add_argument(
+        "--graph", default=None,
+        help="SNAP edge-list path (required for a full compile; "
+             "ignored with --delta, which appends to an existing cache)",
+    )
     p_ing.add_argument("--cache-dir", required=True)
+    p_ing.add_argument(
+        "--delta", default=None, metavar="EDGE_FILE",
+        help="append this edge file to the EXISTING --cache-dir by "
+             "rebuilding only the touched node ranges (ISSUE 15: "
+             "untouched shard blobs stay byte-identical, seed scores "
+             "re-bake for touched shards only, manifest bumps "
+             "delta_seq; new node ids refuse — re-run a full ingest). "
+             "jax-free like the rest of this entry",
+    )
     p_ing.add_argument(
         "--shards", type=int, default=8,
         help="node-range shards (match the target mesh's node-shard count "
@@ -1648,9 +1990,66 @@ def main(argv=None) -> int:
              "rate) to a perf-ledger JSONL; `cli perf diff` then "
              "VERDICTS serve p99 against the matched serve baseline",
     )
-    p_srv.add_argument("--no-self-heal", action="store_true")
+    # note: serve has no self-heal knob — a serving replica opens the
+    # cache READ-ONLY (a heal racing the delta pipeline would rebuild
+    # pre-delta blobs over the writer's work; ISSUE 15)
     p_srv.add_argument("--quiet", action="store_true")
     p_srv.set_defaults(fn=cmd_serve)
+
+    p_ref = sub.add_parser(
+        "refit",
+        help="warm-start incremental refit (ISSUE 15): start from the "
+             "latest published snapshot, re-optimize only the rows a "
+             "delta touched (+ halo) via batched fold-in, publish the "
+             "next generation; health detectors escalate accumulated "
+             "drift to a full fit",
+    )
+    _add_common(p_ref)
+    p_ref.add_argument(
+        "--snapshots", required=True,
+        help="snapshot directory (`cli fit --publish-dir`): the latest "
+             "published F is the warm start, and the refit publishes "
+             "the next generation here",
+    )
+    p_ref.add_argument(
+        "--delta", required=True, metavar="EDGE_FILE",
+        help="the delta edge file that was applied to the cache (`cli "
+             "ingest --delta`): its endpoints are the touched rows",
+    )
+    p_ref.add_argument(
+        "--k", type=int, default=None,
+        help="community count (default: the snapshot's k; a mismatch "
+             "refuses — a refit continues the same model)",
+    )
+    p_ref.add_argument(
+        "--halo", type=int, default=1,
+        help="refit touched rows plus this many hops of neighbors",
+    )
+    p_ref.add_argument(
+        "--refit-rounds", type=int, default=12,
+        help="block-coordinate sweep cap (detectors may stop earlier)",
+    )
+    p_ref.add_argument(
+        "--refit-batch", type=int, default=512,
+        help="fold-in rows per device batch (padded to a power of two "
+             "for compile-cache reuse)",
+    )
+    p_ref.add_argument(
+        "--foldin-max-iters", type=int, default=100,
+        help="per-node fold-in iteration cap inside each batch",
+    )
+    p_ref.add_argument(
+        "--escalate", default="full", choices=["full", "never"],
+        help="on a divergence/plateau detector firing against the "
+             "restricted objective: run a full fit warm-started from "
+             "the refit F (full, default), or publish the refit F "
+             "anyway with the escalated flag recorded (never)",
+    )
+    p_ref.add_argument(
+        "--no-publish", action="store_true",
+        help="skip publishing the result (measurement/CI runs)",
+    )
+    p_ref.set_defaults(fn=cmd_refit)
 
     p_pre = sub.add_parser(
         "preflight",

@@ -87,6 +87,20 @@ class ShardCorruption(ValueError):
         self.shard = shard
 
 
+def rows_of_raw_ids(values: np.ndarray, order: np.ndarray,
+                    raw_sorted: np.ndarray):
+    """Internal rows of raw node ids via the sorted raw-id table: the
+    ONE remap used by the quarantine rebuild, the delta re-ingest, and
+    the refit's touched-row discovery (models.refit) — the unknown-id
+    clamp must never diverge between them. Returns (rows, known) with
+    `rows` valid only where `known`; callers decide how an unknown id
+    errors (source-changed vs delta-cannot-grow-N)."""
+    pos = np.searchsorted(raw_sorted, values)
+    clamped = np.minimum(pos, raw_sorted.size - 1)
+    known = raw_sorted[clamped] == values
+    return order[clamped], known
+
+
 def is_cache_dir(path: str) -> bool:
     """True when `path` is a graph-cache directory (has a manifest)."""
     return os.path.isdir(path) and os.path.exists(
@@ -222,6 +236,14 @@ class GraphStore:
     @property
     def balanced(self) -> bool:
         return bool(self.manifest.get("balanced", False))
+
+    @property
+    def delta_seq(self) -> int:
+        """How many edge deltas have been applied since compile (ISSUE
+        15): 0 on a freshly compiled cache, bumped by every
+        ``apply_delta``. Part of the cache's workload identity — two
+        caches at different delta_seq hold different graphs."""
+        return int(self.manifest.get("delta_seq", 0))
 
     def shard_files(self, s: int) -> Tuple[str, str]:
         """Absolute (indptr, indices) blob paths of shard s."""
@@ -529,14 +551,67 @@ class GraphStore:
         local_indptr, indices = self._rebuild_shard_arrays(s)
         return self._write_shard_blobs(s, local_indptr, indices)
 
+    def _raw_id_order(self):
+        """(order, raw_sorted) of the cache's raw-id table — the raw ->
+        internal-row translation every range-scoped edge source shares
+        (covers balanced caches: raw_ids.npy is in FINAL node order)."""
+        raw_final = self.load_raw_ids(verify=True)   # corrupt table: raise
+        order = np.argsort(raw_final, kind="stable")
+        return order, raw_final[order]
+
+    def _mapped_range_pairs(
+        self,
+        path: str,
+        lo: int,
+        hi: int,
+        order: np.ndarray,
+        raw_sorted: np.ndarray,
+        shard: Optional[int] = None,
+        what: str = "source",
+    ) -> np.ndarray:
+        """RANGE-SCOPED edge source (ISSUE 15 satellite): stream ONE edge
+        file and return the directed internal pairs whose source row
+        falls in [lo, hi) — raw ids remapped through the cache's table,
+        self-loops dropped, symmetrized. Shared by the quarantine rebuild
+        (source + every recorded delta file) and apply_delta's touched-
+        row discovery; unknown raw ids refuse with a re-ingest hint (the
+        file changed since it was ingested)."""
+        parts: List[np.ndarray] = []
+        for pairs in stream_edge_list(path, DEFAULT_CHUNK_BYTES):
+            if pairs.size == 0:
+                continue
+            mapped, known = rows_of_raw_ids(pairs, order, raw_sorted)
+            if not known.all():
+                raise ShardCorruption(
+                    f"{path}: contains node ids absent from the cache's "
+                    f"raw-id table — {what} changed since ingest; re-run "
+                    "ingest",
+                    shard=shard,
+                )
+            mapped = mapped[mapped[:, 0] != mapped[:, 1]]
+            both = np.concatenate([mapped, mapped[:, ::-1]], axis=0)
+            keep = both[(both[:, 0] >= lo) & (both[:, 0] < hi)]
+            if keep.size:
+                parts.append(keep)
+        return (
+            np.concatenate(parts, axis=0)
+            if parts
+            else np.empty((0, 2), dtype=np.int64)
+        )
+
+    def _delta_entries(self) -> List[dict]:
+        """Manifest records of every applied delta (ISSUE 15): the
+        quarantine rebuild must replay them on top of the source, and
+        each is verified against its recorded size first (a delta file
+        that changed since apply cannot reproduce the cache)."""
+        return list(self.manifest.get("deltas", []))
+
     def _rebuild_shard_arrays(self, s: int):
-        """Re-ingest shard `s` IN MEMORY: stream the source edge list,
-        remap raw ids through the cache's raw-id table (covers balanced
-        caches — raw_ids.npy is stored in final node order), keep
-        directed edges whose source row falls in this shard's node range,
-        dedup, and validate against the manifest's edge count. Touches no
-        cache files, so callers can sequence it before any destructive
-        step."""
+        """Re-ingest shard `s` IN MEMORY: stream the source edge list
+        PLUS every recorded delta file through the range-scoped edge
+        source (_mapped_range_pairs), dedup, and validate against the
+        manifest's edge count. Touches no cache files, so callers can
+        sequence it before any destructive step."""
         entry = self.manifest["shards"][s]
         source = self.manifest.get("source", {}).get("path")
         if not source or not os.path.exists(source):
@@ -546,35 +621,38 @@ class GraphStore:
                 "re-run ingest",
                 shard=s,
             )
-        raw_final = self.load_raw_ids(verify=True)   # corrupt table: raise
-        order = np.argsort(raw_final, kind="stable")
-        raw_sorted = raw_final[order]
-        n = self.num_nodes
+        order, raw_sorted = self._raw_id_order()
         lo, hi = int(entry["lo"]), int(entry["hi"])
-        parts: List[np.ndarray] = []
-        for pairs in stream_edge_list(source, DEFAULT_CHUNK_BYTES):
-            if pairs.size == 0:
-                continue
-            pos = np.searchsorted(raw_sorted, pairs)
-            known = raw_sorted[np.minimum(pos, n - 1)] == pairs
-            if not known.all():
+        parts = [
+            self._mapped_range_pairs(
+                source, lo, hi, order, raw_sorted, shard=s
+            )
+        ]
+        for d in self._delta_entries():
+            dpath = d.get("path")
+            if not dpath or not os.path.exists(dpath):
                 raise ShardCorruption(
-                    f"{source}: contains node ids absent from the cache's "
-                    "raw-id table — source changed since ingest; re-run "
-                    "ingest",
+                    f"{self.directory}: shard {s} rebuild needs applied "
+                    f"delta file {dpath!r}, which is unavailable — "
+                    "re-run ingest",
                     shard=s,
                 )
-            mapped = order[pos]
-            mapped = mapped[mapped[:, 0] != mapped[:, 1]]
-            both = np.concatenate([mapped, mapped[:, ::-1]], axis=0)
-            keep = both[(both[:, 0] >= lo) & (both[:, 0] < hi)]
-            if keep.size:
-                parts.append(keep)
-        both = (
-            np.concatenate(parts, axis=0)
-            if parts
-            else np.empty((0, 2), dtype=np.int64)
-        )
+            if "bytes" in d and os.path.getsize(dpath) != int(d["bytes"]):
+                raise ShardCorruption(
+                    f"{dpath}: size changed since it was applied "
+                    f"({os.path.getsize(dpath)} vs {d['bytes']} bytes) — "
+                    "delta file changed; re-run ingest",
+                    shard=s,
+                )
+            parts.append(
+                self._mapped_range_pairs(
+                    dpath, lo, hi, order, raw_sorted, shard=s,
+                    what="applied delta",
+                )
+            )
+        both = np.concatenate([p for p in parts if p.size], axis=0) if any(
+            p.size for p in parts
+        ) else np.empty((0, 2), dtype=np.int64)
         src, dst = dedup_directed(both)
         local_indptr = np.zeros(hi - lo + 1, dtype=np.int64)
         if src.size:
@@ -627,6 +705,240 @@ class GraphStore:
             )
         return restamped
 
+    # ------------------------------ incremental edge deltas (ISSUE 15)
+    def _shard_pairs_from_blobs(
+        self, s: int, files_read: List[str]
+    ) -> np.ndarray:
+        """Shard `s`'s directed pairs from its OWN blobs — the O(shard)
+        half of a delta merge (the quarantine path re-streams the full
+        source; a delta rebuild must not). crc-verified; self-heal
+        quarantines + retries once like any load."""
+        entry = self.manifest["shards"][s]
+        try:
+            ip, dx = self._read_shard_blobs(
+                s, entry, True, False, files_read
+            )
+        except ShardCorruption as e:
+            if not self.self_heal:
+                raise
+            self.quarantine_and_rebuild(s, reason=str(e))
+            entry = self.manifest["shards"][s]
+            ip, dx = self._read_shard_blobs(
+                s, entry, True, False, files_read
+            )
+        lo = int(entry["lo"])
+        src = lo + np.repeat(
+            np.arange(ip.shape[0] - 1, dtype=np.int64), np.diff(ip)
+        )
+        return np.stack([src, dx.astype(np.int64)], axis=1)
+
+    def apply_delta(
+        self,
+        delta_path: str,
+        seed_rebake: bool = True,
+        profile=None,
+    ) -> Dict:
+        """Append an edge file to this cache by rebuilding ONLY the
+        touched node ranges (ISSUE 15 tentpole — the delta re-ingest).
+
+        The delta is parsed once (it is small), mapped through the raw-id
+        table, and scattered to the shards owning its endpoints; each
+        touched shard is rebuilt as existing-blob pairs + delta pairs ->
+        dedup -> fresh blobs (O(shard + delta), never O(source text) —
+        the range-scoped edge source satellite). Untouched shard blobs
+        are left BYTE-IDENTICAL. The manifest bumps `delta_seq`, records
+        the delta file (so quarantine rebuilds replay it), re-stamps the
+        touched shards' crcs and edge counts, and — when seed scores are
+        baked — re-bakes phi for the touched shards only (their
+        conductance sees the updated graph exactly; untouched shards
+        keep their pre-delta phi blobs, a documented staleness).
+
+        New NODES refuse with a re-ingest hint: the shard geometry is
+        sized to N at compile time, and growing N re-shards everything —
+        that is a full `cli ingest`, not a delta.
+
+        Returns the delta report: edges_added (directed), touched shard
+        ids, touched internal rows, touched_frac, files_read (the
+        isolation contract — only touched shards' blobs are opened), and
+        seconds. A crash mid-apply leaves crc mismatches the self-heal
+        path repairs back to the PRE-delta cache (the manifest — written
+        last — still describes it), after which the delta can simply be
+        re-applied."""
+        import time
+
+        t0 = time.perf_counter()
+        if not os.path.exists(delta_path):
+            raise ValueError(f"{delta_path}: no such delta edge file")
+        files_read: List[str] = ["raw_ids.npy"]
+        order, raw_sorted = self._raw_id_order()
+        n = self.num_nodes
+        rows = self.rows_per_shard
+        # parse ONCE, raw -> internal, loops dropped, symmetrized
+        raw_pairs = 0
+        parts: List[np.ndarray] = []
+        for pairs in stream_edge_list(delta_path, DEFAULT_CHUNK_BYTES):
+            if pairs.size == 0:
+                continue
+            raw_pairs += int(pairs.shape[0])
+            mapped, known = rows_of_raw_ids(pairs, order, raw_sorted)
+            if not known.all():
+                bad = pairs[~known.all(axis=1)][:3].tolist()
+                raise ValueError(
+                    f"{delta_path}: contains node ids absent from the "
+                    f"cache (e.g. {bad}) — deltas cannot grow N (the "
+                    "shard geometry is sized at compile time); re-run "
+                    "`cli ingest` on the merged edge list"
+                )
+            mapped = mapped[mapped[:, 0] != mapped[:, 1]]
+            if mapped.size:
+                parts.append(
+                    np.concatenate([mapped, mapped[:, ::-1]], axis=0)
+                )
+        both = (
+            np.concatenate(parts, axis=0)
+            if parts
+            else np.empty((0, 2), dtype=np.int64)
+        )
+        if both.size == 0:
+            # nothing to merge (empty file / self-loops only): a pure
+            # no-op — recording it would make the quarantine rebuild
+            # depend on a file that contributes nothing
+            return {
+                "delta_path": os.path.abspath(delta_path),
+                "delta_seq": self.delta_seq,
+                "raw_pairs": raw_pairs,
+                "edges_added": 0,
+                "num_directed_edges": self.num_directed_edges,
+                "touched_shards": [],
+                "touched_rows": np.empty(0, dtype=np.int64),
+                "touched_frac": 0.0,
+                "phi_rebaked_shards": [],
+                "files_read": tuple(files_read),
+                "seconds": round(time.perf_counter() - t0, 4),
+            }
+        touched_rows = np.unique(both[:, 0])
+        touched_shards = sorted(
+            {int(r // rows) for r in touched_rows.tolist()}
+        )
+        old_total = self.num_directed_edges
+        # merge each touched shard: existing blob pairs + delta pairs
+        for s in touched_shards:
+            entry = self.manifest["shards"][s]
+            lo, hi = int(entry["lo"]), int(entry["hi"])
+            add = both[(both[:, 0] >= lo) & (both[:, 0] < hi)]
+            existing = self._shard_pairs_from_blobs(s, files_read)
+            src, dst = dedup_directed(
+                np.concatenate([existing, add], axis=0)
+            )
+            local_indptr = np.zeros(hi - lo + 1, dtype=np.int64)
+            if src.size:
+                np.cumsum(
+                    np.bincount(src - lo, minlength=hi - lo),
+                    out=local_indptr[1:],
+                )
+            indices = dst.astype(np.int32)
+            # write fresh blobs atomically but stamp the MANIFEST only
+            # once at the end: a crash mid-apply then reads as crc
+            # mismatches against the old manifest, and the self-heal
+            # rebuild (source + previously recorded deltas) restores the
+            # pre-delta cache instead of a half-applied one
+            for rel, arr in ((entry["indptr"], local_indptr),
+                             (entry["indices"], indices)):
+                path = os.path.join(self.directory, rel)
+                tmp = f"{path}.{os.getpid()}.tmp"
+                with open(tmp, "wb") as f:
+                    np.save(f, arr)
+                    f.flush()
+                    os.fsync(f.fileno())
+                os.replace(tmp, path)
+            entry["edges"] = int(indices.shape[0])
+            entry["crc32"] = {
+                **entry["crc32"],
+                "indptr": _crc32_file(
+                    os.path.join(self.directory, entry["indptr"])
+                ),
+                "indices": _crc32_file(
+                    os.path.join(self.directory, entry["indices"])
+                ),
+            }
+            if profile is not None:
+                profile.sample_rss()
+        new_total = sum(
+            int(e["edges"]) for e in self.manifest["shards"]
+        )
+        self.manifest["num_directed_edges"] = new_total
+        self.manifest["num_undirected_edges"] = new_total // 2
+        seq = self.delta_seq + 1
+        self.manifest["delta_seq"] = seq
+        self.manifest.setdefault("deltas", []).append({
+            "path": os.path.abspath(delta_path),
+            "bytes": os.path.getsize(delta_path),
+            "raw_pairs": raw_pairs,
+            "seq": seq,
+            "touched_shards": touched_shards,
+        })
+        # touched-shard phi re-bake: exact conductance on the UPDATED
+        # graph for touched rows (degrees re-read from every indptr blob
+        # — O(N) ints; the pair sweep reads neighbor shards' indices, so
+        # the strict only-touched files_read contract applies to caches
+        # without baked seeds)
+        rebaked: List[int] = []
+        if (
+            seed_rebake
+            and touched_shards
+            and self.manifest.get("seed_scores", {}).get("baked")
+        ):
+            meta = self.manifest["seed_scores"]
+            deg_final = np.zeros(max(n, 1), dtype=np.int64)
+            for e in self.manifest["shards"]:
+                lo, hi = int(e["lo"]), int(e["hi"])
+                if hi <= lo:
+                    continue
+                ip = np.load(os.path.join(self.directory, e["indptr"]))
+                deg_final[lo:hi] = np.diff(ip)
+            bake_seed_scores(
+                self.directory, self.manifest["shards"], deg_final[:n],
+                new_total, cap=meta.get("cap"), seed=meta.get("seed") or 0,
+                profile=profile, only_shards=set(touched_shards),
+            )
+            rebaked = touched_shards
+        _atomic_json(
+            os.path.join(self.directory, MANIFEST_NAME), self.manifest
+        )
+        seconds = time.perf_counter() - t0
+        out = {
+            "delta_path": os.path.abspath(delta_path),
+            "delta_seq": seq,
+            "raw_pairs": raw_pairs,
+            "edges_added": new_total - old_total,
+            "num_directed_edges": new_total,
+            "touched_shards": touched_shards,
+            "touched_rows": touched_rows,
+            "touched_frac": (
+                round(touched_rows.size / n, 6) if n else 0.0
+            ),
+            "phi_rebaked_shards": rebaked,
+            "files_read": tuple(files_read),
+            "seconds": round(seconds, 4),
+        }
+        from bigclam_tpu.obs import telemetry as _obs
+
+        tel = _obs.current()
+        if tel is not None:
+            tel.event(
+                "delta_ingest",
+                edges_added=int(out["edges_added"]),
+                touched_shards=len(touched_shards),
+                shards=touched_shards,
+                touched_rows=int(touched_rows.size),
+                touched_frac=out["touched_frac"],
+                delta_seq=seq,
+                phi_rebaked=len(rebaked),
+                cache_dir=self.directory,
+                seconds=out["seconds"],
+            )
+        return out
+
 
 # --------------------------------------------------------------------------
 # ingest-time seed bake (ISSUE 9): conductance scores next to the shards
@@ -660,6 +972,7 @@ def bake_seed_scores(
     cap: Optional[int] = None,
     seed: int = 0,
     profile=None,
+    only_shards=None,
 ) -> None:
     """Compute per-node ego-net conductance OUT OF CORE over the written
     shard blobs and bake per-shard phi blobs next to them (mutates
@@ -678,6 +991,12 @@ def bake_seed_scores(
     capped lists come from the same splitmix64 sampler
     (seeding.capped_neighbor_lists keyed by GLOBAL row id), so the
     estimates match triangle_counts_sampled up to float summation order.
+
+    `only_shards` (ISSUE 15: the delta re-ingest's touched-shard phi
+    refresh) restricts the OUTER sweeps and the phi writes to those
+    shards: their scores see the whole updated graph (inner pair sweeps
+    still read neighbor shards), every other shard's phi blob is left
+    byte-identical.
     """
     # lazy: ops.seeding is imported only here so the default ingest path
     # stays jax-free AND cheap to import (seeding's module deps are numpy
@@ -699,7 +1018,9 @@ def bake_seed_scores(
 
     # --- pass 1: S1(u) = sum of neighbor degrees, one shard at a time ---
     s1 = np.zeros(n, dtype=np.float64)
-    for e in shard_table:
+    for s, e in enumerate(shard_table):
+        if only_shards is not None and s not in only_shards:
+            continue
         lo, hi = int(e["lo"]), int(e["hi"])
         if hi <= lo:
             continue
@@ -734,7 +1055,10 @@ def bake_seed_scores(
         # capped lists are computed ONCE per shard and spilled to scratch
         # blobs riding the same BoundedBlobCache as the raw CSR: the pair
         # sweep reads each shard O(S) times, and the per-hub Fisher-Yates
-        # sampler (a Python loop) must not rerun per pair
+        # sampler (a Python loop) must not rerun per pair. Computed
+        # LAZILY on first read — a touched-shard delta rebake
+        # (only_shards) then samples only the shards its sweeps actually
+        # touch, not the whole graph per delta (ISSUE 15)
         import tempfile
 
         # system tmp, not cache_dir: a crashed bake must not leave scratch
@@ -742,26 +1066,27 @@ def bake_seed_scores(
         scratch = tempfile.mkdtemp(prefix="bigclam_seed_bake_")
 
         def capped_csr_of(idx: int) -> tuple:
+            ipath = os.path.join(scratch, f"{idx}.indptr.npy")
+            dpath = os.path.join(scratch, f"{idx}.indices.npy")
+            if not os.path.exists(ipath):
+                ip, dx = shard_csr(shard_table[idx])
+                ip_c, dx_c = capped_neighbor_lists(
+                    ip, dx, cap, stream_seed,
+                    row_offset=int(shard_table[idx]["lo"]),
+                )
+                np.save(ipath, ip_c)
+                np.save(dpath, dx_c)
+                if profile is not None:
+                    profile.sample_rss()
             return (
-                np.asarray(
-                    blobs.get(os.path.join(scratch, f"{idx}.indptr.npy")),
-                    np.int64,
-                ),
-                blobs.get(os.path.join(scratch, f"{idx}.indices.npy")),
+                np.asarray(blobs.get(ipath), np.int64),
+                blobs.get(dpath),
             )
 
     try:
-        if scratch is not None:
-            for s, e in enumerate(shard_table):
-                ip, dx = shard_csr(e)
-                ip_c, dx_c = capped_neighbor_lists(
-                    ip, dx, cap, stream_seed, row_offset=int(e["lo"])
-                )
-                np.save(os.path.join(scratch, f"{s}.indptr.npy"), ip_c)
-                np.save(os.path.join(scratch, f"{s}.indices.npy"), dx_c)
-                if profile is not None:
-                    profile.sample_rss()
         for a, ea in enumerate(shard_table):
+            if only_shards is not None and a not in only_shards:
+                continue
             lo_a, hi_a = int(ea["lo"]), int(ea["hi"])
             if hi_a <= lo_a:
                 continue
@@ -778,10 +1103,13 @@ def bake_seed_scores(
                 lo_b, hi_b = int(eb["lo"]), int(eb["hi"])
                 if hi_b <= lo_b:
                     continue
-                ipb, dxb = shard_csr(eb) if cap is None else capped_csr_of(b)
+                # intersect FIRST: shard b's (possibly lazily sampled)
+                # arrays are only loaded when shard a actually has
+                # neighbors there
                 sel = np.flatnonzero((dxa >= lo_b) & (dxa < hi_b))
                 if sel.size == 0:
                     continue
+                ipb, dxb = shard_csr(eb) if cap is None else capped_csr_of(b)
                 v_rows = dxa[sel].astype(np.int64) - lo_b
                 cnt_v = (ipb[v_rows + 1] - ipb[v_rows]).astype(np.int64)
                 # chunk the selected edges so the expansion stays bounded
@@ -841,6 +1169,8 @@ def bake_seed_scores(
 
     # --- write per-shard phi blobs, stamp the table in place ---
     for s, e in enumerate(shard_table):
+        if only_shards is not None and s not in only_shards:
+            continue
         lo, hi = int(e["lo"]), int(e["hi"])
         name = _phi_name(s)
         np.save(os.path.join(cache_dir, name), phi[lo:hi])

@@ -131,6 +131,30 @@ def stream_edge_list(
             yield pending.popleft().result()
 
 
+def scan_edge_files(directory: str, seen=()) -> List[str]:
+    """Unprocessed edge files of a delta directory, in NAME order (the
+    continuous fit->publish->serve loop's watch primitive, ISSUE 15):
+    plain files not in `seen` (absolute paths), skipping dotfiles and
+    in-flight temporaries (`.tmp`/`.part` suffixes — publish deltas by
+    writing to a temp name and renaming, the same atomicity discipline
+    as the snapshot publisher). Name order IS the application order, so
+    producers should use sortable names (delta_000001.txt ...)."""
+    seen = set(seen)
+    out: List[str] = []
+    try:
+        names = sorted(os.listdir(directory))
+    except OSError:
+        return out
+    for name in names:
+        if name.startswith(".") or name.endswith((".tmp", ".part")):
+            continue
+        path = os.path.abspath(os.path.join(directory, name))
+        if path in seen or not os.path.isfile(path):
+            continue
+        out.append(path)
+    return out
+
+
 class BoundedBlobCache:
     """np.load results keyed by path with at most `capacity` blobs resident
     (LRU). The ingest-time seed bake (graph/store.bake_seed_scores) sweeps

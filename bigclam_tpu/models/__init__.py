@@ -10,11 +10,19 @@ from bigclam_tpu.models.quality import (
     fit_quality,
     fit_quality_device,
 )
+from bigclam_tpu.models.refit import (
+    RefitResult,
+    follow_deltas,
+    warm_start_refit,
+)
 from bigclam_tpu.models.sparse import SparseBigClamModel
 
 __all__ = [
     "BigClamModel",
     "SparseBigClamModel",
+    "RefitResult",
+    "warm_start_refit",
+    "follow_deltas",
     "TrainState",
     "FitResult",
     "prepare_graph",

@@ -384,6 +384,56 @@ def random_init_F(g, cfg: BigClamConfig, seed: Optional[int] = None) -> np.ndarr
     ).astype(np.float64)
 
 
+# row-keyed counter RNG (ISSUE 15 satellite / ROADMAP 1a): splitmix64
+# finalizer constants, identical to the native sampler's PRNG
+# (ops.seeding._splitmix64 / graph/native bc_splitmix64)
+_SM_GAMMA = np.uint64(0x9E3779B97F4A7C15)
+_SM_M1 = np.uint64(0xBF58476D1CE4E5B9)
+_SM_M2 = np.uint64(0x94D049BB133111EB)
+_ROW_MIX = np.uint64(0xA24BAED4963EE407)
+
+
+def _splitmix64_vec(x: np.ndarray) -> np.ndarray:
+    """Vectorized splitmix64 finalizer over a uint64 array (wrapping
+    arithmetic; same avalanche as ops.seeding._splitmix64)."""
+    z = x + _SM_GAMMA
+    z = (z ^ (z >> np.uint64(30))) * _SM_M1
+    z = (z ^ (z >> np.uint64(27))) * _SM_M2
+    return z ^ (z >> np.uint64(31))
+
+
+def rowkeyed_init_rows(
+    lo: int, hi: int, k: int, seed: int
+) -> np.ndarray:
+    """Bernoulli(0.5) {0,1} float64 rows [lo, hi) of the ROW-KEYED
+    counter init: entry (r, c) is a pure function of (seed, global row
+    r, column c), so any row range generates bit-identically to the
+    same slice of the host-global array — the per-host init_state
+    refactor ROADMAP item 1a names (a store-native host materializes
+    O(N_loc * K), never O(N * K)). Same {0,1} distribution as
+    random_init_F; a DIFFERENT stream (np.default_rng vs splitmix64),
+    so the two inits are distinct trajectories by construction."""
+    if hi <= lo:
+        return np.empty((0, k), dtype=np.float64)
+    base = _splitmix64_vec(np.asarray(seed, dtype=np.uint64).reshape(1))
+    rows = np.arange(lo, hi, dtype=np.uint64)[:, None]
+    cols = np.arange(k, dtype=np.uint64)[None, :]
+    z = _splitmix64_vec((rows * _ROW_MIX + cols) ^ base)
+    return ((z >> np.uint64(63)) & np.uint64(1)).astype(np.float64)
+
+
+def rowkeyed_init_F(
+    g, cfg: BigClamConfig, seed: Optional[int] = None
+) -> np.ndarray:
+    """Host-global (N, K) twin of rowkeyed_init_rows — the comparison
+    baseline for the per-host store-native init (bit-identical slices
+    at matching seeds, pinned by tests/test_delta.py)."""
+    return rowkeyed_init_rows(
+        0, g.num_nodes, cfg.num_communities,
+        cfg.seed if seed is None else seed,
+    )
+
+
 def _lcm(a: int, b: int) -> int:
     import math
 
@@ -1423,8 +1473,13 @@ class BigClamModel(MemoryAccountedModel):
         self._node_multiple_csr = gbt.n_pad
         return device_grouped_tiles(gbt, self.dtype, kc=kc)
 
-    def init_state(self, F0: np.ndarray) -> TrainState:
+    def init_state(self, F0: Optional[np.ndarray] = None) -> TrainState:
         n, k = self.g.num_nodes, self.cfg.num_communities
+        if F0 is None:
+            # row-keyed counter init (ISSUE 15 satellite): the same bits
+            # any per-host range generation produces — single-chip just
+            # materializes the whole range
+            F0 = rowkeyed_init_F(self.g, self.cfg)
         assert F0.shape == (n, k), (F0.shape, (n, k))
         F = jnp.zeros((self.n_pad, self.k_pad), self.dtype)
         F = F.at[:n, :k].set(jnp.asarray(F0, self.dtype))
@@ -1615,3 +1670,31 @@ class BigClamModel(MemoryAccountedModel):
             np.asarray(llh),
             np.asarray(iters),
         )
+
+    def refit_commit(
+        self, state: TrainState, nodes, rows: np.ndarray
+    ) -> TrainState:
+        """Scatter freshly folded rows back into the state (the
+        warm-start incremental refit's commit half, ISSUE 15): F rows
+        replaced, sumF updated by the row delta — everything else
+        (llh/it/health) is refit-round bookkeeping the restricted loop
+        owns (models.refit.warm_start_refit)."""
+        from bigclam_tpu.ops.foldin import apply_rows
+
+        k = self.cfg.num_communities
+        rows_p = np.zeros((len(nodes), self.k_pad), dtype=np.float64)
+        rows_p[:, :k] = rows
+        F, sumF = apply_rows(
+            state.F, state.sumF, jnp.asarray(np.asarray(nodes, np.int64)),
+            jnp.asarray(rows_p, self.dtype),
+        )
+        return state._replace(F=F, sumF=sumF)
+
+    def warm_start_refit(self, F_prev: np.ndarray, touched, **kw):
+        """Incremental warm-start refit from a previous F restricted to
+        the touched rows + halo (ISSUE 15 tentpole; see
+        models.refit.warm_start_refit for the round/escalation
+        semantics)."""
+        from bigclam_tpu.models.refit import warm_start_refit
+
+        return warm_start_refit(self, F_prev, touched, **kw)

@@ -250,8 +250,14 @@ class SparseBigClamModel(MemoryAccountedModel):
         self._step, self.engaged_path = self._step_cache[key]
 
     # ------------------------------------------------------------ state
-    def init_state(self, F0: np.ndarray) -> SparseTrainState:
+    def init_state(
+        self, F0: Optional[np.ndarray] = None
+    ) -> SparseTrainState:
         n, k = self.g.num_nodes, self.cfg.num_communities
+        if F0 is None:
+            from bigclam_tpu.models.bigclam import rowkeyed_init_F
+
+            F0 = rowkeyed_init_F(self.g, self.cfg)
         assert F0.shape == (n, k), (F0.shape, (n, k))
         ids, w, truncated = sm.from_dense(
             np.asarray(F0), self.m, self.k_pad, self.n_pad
@@ -496,6 +502,32 @@ class SparseBigClamModel(MemoryAccountedModel):
             np.asarray(llh),
             np.asarray(iters),
         )
+
+    def refit_commit(
+        self, state: SparseTrainState, nodes, rows: np.ndarray
+    ) -> SparseTrainState:
+        """Sparse twin of BigClamModel.refit_commit (ISSUE 15): freshly
+        folded DENSE rows are re-sparsified to top-M member lists
+        (ops.sparse_members.from_dense — the init-time truncation rule)
+        and scattered into the slot arrays; sumF re-reduces from the
+        member lists so it can never drift from the truncation."""
+        nodes_arr = jnp.asarray(np.asarray(nodes, np.int64))
+        ids_b, w_b, _ = sm.from_dense(
+            np.asarray(rows, np.float64), self.m, self.k_pad, len(nodes)
+        )
+        ids = state.ids.at[nodes_arr].set(jnp.asarray(ids_b))
+        w = state.F.at[nodes_arr].set(jnp.asarray(w_b, self.dtype))
+        return state._replace(
+            ids=ids, F=w, sumF=sm.sparse_sumF(ids, w, self.k_pad)
+        )
+
+    def warm_start_refit(self, F_prev: np.ndarray, touched, **kw):
+        """Incremental warm-start refit restricted to touched rows +
+        halo (ISSUE 15; see models.refit.warm_start_refit) — the state
+        stays M-sized, only each fold-in query window densifies."""
+        from bigclam_tpu.models.refit import warm_start_refit
+
+        return warm_start_refit(self, F_prev, touched, **kw)
 
     def state_nbytes(self, state: Optional[SparseTrainState] = None) -> int:
         """Affiliation-state footprint in bytes (ids + weights + sumF):

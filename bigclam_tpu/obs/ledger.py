@@ -280,6 +280,24 @@ def build_record(
     )
     mix = final.get("serve_mix")
     rec["serve_mix"] = str(mix) if mix else None
+    # incremental refit (ISSUE 15): cost ratio vs the last full fit and
+    # the touched fraction — both VERDICTED by `cli perf diff` (a refit
+    # silently re-touching the whole graph, or costing as much as the
+    # full fit it exists to avoid, is a regression even at flat step
+    # time). The `refit` entry point (match_key element 0) keeps these
+    # records from ever cross-baselining a fit or serve record.
+    for field in ("refit_cost_ratio", "touched_frac"):
+        v = final.get(field)
+        rec[field] = (
+            _round6(float(v))
+            if isinstance(v, _NUM) and not isinstance(v, bool)
+            else None
+        )
+    rr = final.get("refit_rounds")
+    rec["refit_rounds"] = (
+        int(rr) if isinstance(rr, _NUM) and not isinstance(rr, bool)
+        else None
+    )
     if note:
         rec["note"] = note
     return rec
@@ -558,6 +576,22 @@ def diff_records(
     ) and isinstance(new.get("host_rss_modeled_bytes"), _NUM):
         check("host_rss_modeled_bytes", base["host_rss_modeled_bytes"],
               new["host_rss_modeled_bytes"])
+    # incremental-refit verdicts (ISSUE 15): refit_cost_ratio growing
+    # past the band means the warm-start stopped saving work vs the
+    # full fit it replaces; touched_frac growing means a delta of the
+    # same shape started touching more of the graph (halo/discovery
+    # regression). Both only exist on `refit` entries, which the match
+    # key (entry element 0) keeps disjoint from fit/serve baselines.
+    if isinstance(base.get("refit_cost_ratio"), _NUM) and isinstance(
+        new.get("refit_cost_ratio"), _NUM
+    ):
+        check("refit_cost_ratio", base["refit_cost_ratio"],
+              new["refit_cost_ratio"])
+    if isinstance(base.get("touched_frac"), _NUM) and isinstance(
+        new.get("touched_frac"), _NUM
+    ):
+        check("touched_frac", base["touched_frac"],
+              new["touched_frac"])
     # convergence verdicts (ISSUE 8): iteration count to tolerance is
     # VERDICTED (same cfg + workload + seed ⇒ deterministic up to float
     # summation order — growth past the band is a real optimizer

@@ -597,10 +597,24 @@ def f0_init_rss_bytes(n: int, k: int, n_pad: int, k_pad: int,
     """The host-global O(N*K) F0 init: the float64 (N, K) init array
     (seeding / random_init_F), the padded float64 staging copy
     (init_state), and the dtype cast handed to the device upload. THE
-    dominant host term on every path today — store-native shrinks the
-    graph stages to O(shard) but the F0 upload is still host-global
-    (ROADMAP item 1a names the per-host init_state refactor)."""
+    dominant host term on the in-memory trainers — the store-native
+    trainers now default to the PER-HOST row-keyed counter init
+    (ISSUE 15 satellite, ROADMAP 1a closed there:
+    rowkeyed_f0_rss_bytes), and only an explicit host-global F0 upload
+    (conductance seeding) still pays this."""
     return float(n * k * 8 + n_pad * k_pad * (8 + itemsize))
+
+
+def rowkeyed_f0_rss_bytes(n_pad: int, k_pad: int, itemsize: int,
+                          processes: int) -> float:
+    """The PER-HOST row-keyed counter init (ISSUE 15 satellite /
+    ROADMAP 1a): each host materializes only its own padded row range —
+    the float64 local block (rowkeyed_init_rows + zero staging) plus
+    the dtype cast handed to make_array_from_process_local_data. The
+    uint64 counter lattice is freed before the cast, so it shares the
+    same budget term."""
+    rows_local = n_pad / max(processes, 1)
+    return float(rows_local * k_pad * (8 + itemsize))
 
 
 def host_rss_model(
@@ -616,11 +630,20 @@ def host_rss_model(
     chunk_bytes: int = 0,
     representation: str = "dense",
     sparse_m: int = 0,
+    rowkeyed_f0: Optional[bool] = None,
 ) -> HostModel:
     """Per-stage host-RSS model of a fit entry (per HOST, not per
-    device). Stages are sequential; the peak is the max stage. The
-    `f0_init` stage is host-global O(N*K) on every trainer today and is
-    flagged as such (ROADMAP 1a)."""
+    device). Stages are sequential; the peak is the max stage.
+
+    `rowkeyed_f0` (default: follows store_native) prices the `f0_init`
+    stage at the PER-HOST row-keyed counter init the store-backed
+    trainers now default to (ISSUE 15 satellite — O(N_loc*K); the
+    dominant flag then moves to the arg-max remaining stage, typically
+    `extract`, which stays host-global). With it False the stage is the
+    host-global O(N*K) upload (the in-memory trainers, and store-native
+    runs seeded from an explicit host-global F0 — conductance seeding's
+    init_F is still a host-global array, the open remainder of
+    ROADMAP 1a)."""
     n_pad = n_pad or n
     k_pad = k_pad or k
     p = max(processes, 1)
@@ -652,18 +675,28 @@ def host_rss_model(
         "seeding", 24.0 * n,
         note="conductance phi/degree/order arrays (O(N))",
     ))
+    if rowkeyed_f0 is None:
+        rowkeyed_f0 = store_native
     if representation == "sparse" and sparse_m:
         f0 = float(n * k * 8 + n_pad * sparse_m * (8 + itemsize + 4))
         note = (
             "dense (N, K) float64 F0 sparsified to top-M host-side — "
             "the dense staging is still O(N*K) (ROADMAP 1a)"
         )
+    elif rowkeyed_f0:
+        f0 = rowkeyed_f0_rss_bytes(n_pad, k_pad, itemsize, p)
+        note = (
+            "per-host row-keyed counter F0 init (ISSUE 15: O(N_loc*K), "
+            "ROADMAP 1a closed on the store-native path; an explicit "
+            "host-global F0 — conductance seeding — re-opens it)"
+        )
     else:
         f0 = f0_init_rss_bytes(n, k, n_pad, k_pad, itemsize)
         note = (
             "host-global O(N*K) F0 init + padded staging — the "
-            "dominant host term (ROADMAP 1a: per-host init_state is "
-            "the open refactor; --store-native does NOT shrink this)"
+            "dominant host term (ROADMAP 1a: closed for store-native "
+            "random inits via the per-host row-keyed counter init; "
+            "this in-memory/explicit-F0 path still pays it)"
         )
     stages.append(HostStage("f0_init", f0, note=note))
     stages.append(HostStage(

@@ -110,6 +110,18 @@ EVENT_KINDS = {
     "snapshot_swap": {"step": (int,)},
     # a running server hot-swapped to a newly published snapshot
     # (utils.checkpoint publish/latest; `previous` = the old generation)
+    # --- incremental graph deltas (ISSUE 15) ---
+    "delta_ingest": {"edges_added": (int,), "touched_shards": (int,)},
+    # one applied edge delta (GraphStore.apply_delta): directed edges
+    # added, how many shard ranges were rebuilt (touched_frac /
+    # delta_seq / phi_rebaked ride as extras). Untouched shard blobs
+    # are byte-identical by contract
+    "refit": {"touched": (int,), "rounds": (int,)},
+    # one warm-start incremental refit (models.refit.warm_start_refit):
+    # delta-touched rows, block-coordinate rounds run; refit_nodes /
+    # touched_frac / escalated / converged / foldin_iters ride as
+    # extras. An escalation additionally fires `anomaly` events
+    # (source="refit") carrying the detector findings
     # --- memory accounting (obs.memory, ISSUE 12) ---
     "memory_model": {"buffer": (str,), "bytes": _NUM},
     # one buffer of a trainer's static memory model, baked at step

@@ -192,6 +192,23 @@ def make_foldin_fit(
     return jax.jit(fit, donate_argnums=(0,))
 
 
+@jax.jit
+def apply_rows(
+    F: jax.Array,
+    sumF: jax.Array,
+    nodes: jax.Array,
+    rows: jax.Array,
+) -> Tuple[jax.Array, jax.Array]:
+    """Commit a folded row batch into a frozen state (ISSUE 15: the
+    warm-start refit's write half): F[nodes] <- rows, sumF updated by
+    the exact row delta (no O(N*K) re-reduction per batch — the refit
+    sweeps many batches per round). Padded columns are zero in `rows`
+    by construction, so sumF's padding stays inert."""
+    old = F[nodes]
+    F = F.at[nodes].set(rows)
+    return F, sumF + (rows - old).sum(axis=0)
+
+
 # ------------------------------------------------- frozen-state gathers
 def gather_neighbor_rows(F: jax.Array, nbr_ids: jax.Array) -> jax.Array:
     """Dense frozen rows for a padded neighbor batch: (B, D, K). Padding

@@ -44,6 +44,8 @@ from bigclam_tpu.models.bigclam import (
     attach_donating,
     edge_chunk_bound,
     restore_checkpoint,
+    rowkeyed_init_F,
+    rowkeyed_init_rows,
     run_fit_loop,
 )
 from bigclam_tpu.ops import diagnostics as dx
@@ -1323,8 +1325,13 @@ class ShardedBigClamModel(MemoryAccountedModel):
             note_step_build(self.cfg, type(self).__name__)
         self._step = cache[key]
 
-    def init_state(self, F0: np.ndarray) -> TrainState:
+    def init_state(self, F0: Optional[np.ndarray] = None) -> TrainState:
         n, k = self.g.num_nodes, self.cfg.num_communities
+        if F0 is None:
+            # row-keyed counter init (ISSUE 15 satellite): the HOST-
+            # GLOBAL materialization of the same bits the store-backed
+            # trainers generate per host — the bit-identity baseline
+            F0 = rowkeyed_init_F(self.g, self.cfg)
         assert F0.shape == (n, k), (F0.shape, (n, k))
         F_host = np.zeros((self.n_pad, self.k_pad), dtype=np.float64)
         F_host[:n, :k] = self._to_internal_rows(F0)
@@ -1528,6 +1535,35 @@ class _StoreBackedMixin:
                 self.store, verify=self._shard_verify
             )
         return self.host_shard
+
+    def init_state(self, F0: Optional[np.ndarray] = None) -> TrainState:
+        """PER-HOST init (ISSUE 15 satellite — the last global-memory
+        site of ROADMAP item 1a): with F0=None each host seeds ONLY its
+        own row range from the row-keyed counter RNG
+        (models.bigclam.rowkeyed_init_rows — entry (r, c) is a pure
+        function of (seed, r, c)) and places it process-locally, so no
+        host ever materializes the O(N*K) F0 array. Bit-identical to
+        the host-global `init_state(None)` of the in-memory trainers at
+        matching seeds (pinned by tests/test_delta.py). An explicit F0
+        keeps the host-global upload path (conductance seeding)."""
+        if F0 is not None:
+            return super().init_state(F0)
+        n, k = self.g.num_nodes, self.cfg.num_communities
+        fspec = NamedSharding(self.mesh, P(NODES_AXIS, K_AXIS))
+        lo, hi = addressable_row_bounds(
+            fspec, (self.n_pad, self.k_pad)
+        )
+        local = np.zeros((hi - lo, self.k_pad), dtype=np.float64)
+        live_hi = min(hi, n)
+        if live_hi > lo:
+            local[: live_hi - lo, :k] = rowkeyed_init_rows(
+                lo, live_hi, k, self.cfg.seed
+            )
+        F = jax.make_array_from_process_local_data(
+            fspec, np.ascontiguousarray(local.astype(self.dtype)),
+            (self.n_pad, self.k_pad),
+        )
+        return self.reset_state(F)
 
     def _store_rows_ok(self) -> bool:
         """The store-native CSR layouts keep trainer shard rows == the

@@ -321,11 +321,41 @@ class MembershipServer:
     def generation(self) -> int:
         return self._snapshot.step
 
+    def _refresh_store(self) -> None:
+        """Re-open the graph cache's manifest before a swap (ISSUE 15):
+        the continuous delta pipeline mutates the cache UNDER a running
+        server (edge counts, delta_seq), and verifying a post-delta
+        snapshot against the stale in-memory manifest would refuse
+        every new generation. One JSON parse when a store is attached;
+        the suggest adjacency cache is dropped only when the graph
+        actually changed (delta_seq moved)."""
+        if self._store is None:
+            return
+        from bigclam_tpu.graph.store import GraphStore
+
+        try:
+            fresh = GraphStore.open(
+                self._store.directory, self_heal=self._store.self_heal
+            )
+        except ValueError:
+            return          # torn manifest mid-delta: retry next poll
+        # store swap + adjacency invalidation under ONE lock hold: a
+        # suggest batch racing between them could rebuild _adj from the
+        # OLD store and cache the stale adjacency forever
+        with self._lock:
+            changed = fresh.manifest.get(
+                "delta_seq", 0
+            ) != self._store.manifest.get("delta_seq", 0)
+            self._store = fresh
+            if changed:
+                self._adj = None            # adjacency changed
+
     def hot_swap(self, step: Optional[int] = None) -> int:
         """Swap to the latest (or a named) published snapshot. The load
         + index build happens OUTSIDE the lock; taking the lock then
         drains the in-flight batch, so queries keep queueing throughout
         and none is dropped. Returns the new generation's step."""
+        self._refresh_store()
         new = ServingSnapshot.load(
             self.snapshot_dir, step=step, store=self._store
         )
@@ -347,17 +377,22 @@ class MembershipServer:
         return new.step
 
     def maybe_reload(self) -> Optional[int]:
-        """Hot-swap iff a newer snapshot is published (the watcher's
+        """Hot-swap iff a NEWER snapshot is published (the watcher's
         poll; the cheap no-change case is one latest.json read). The
         load goes through the FALLBACK path (step=None), so a corrupt
         newest publication resolves to the best loadable snapshot —
-        which may be the one already serving (then: no swap)."""
+        which may be the one already serving (then: no swap). The
+        generation NEVER moves backward (ISSUE 15 satellite): a stale
+        latest.json racing a newer snap_ archive — or a pointer rolled
+        back by a crashed publisher — resolves to an older step, and an
+        older step is never installed over the one already serving."""
         latest = CheckpointManager(self.snapshot_dir).latest()
-        if latest is None or latest == self._snapshot.step:
+        if latest is None or latest <= self._snapshot.step:
             return None
+        self._refresh_store()
         new = ServingSnapshot.load(self.snapshot_dir, store=self._store)
-        if new.step == self._snapshot.step:
-            return None     # newest publication unreadable: keep serving
+        if new.step <= self._snapshot.step:
+            return None     # newest publication unreadable/stale: keep
         return self._install(new)
 
     def _watch_loop(self, interval: float) -> None:
@@ -405,6 +440,12 @@ class MembershipServer:
             if self._graph is not None:
                 self._adj = (self._graph.indptr, self._graph.indices)
             elif self._store is not None:
+                # re-open the manifest first: the delta pipeline may
+                # have rewritten shard blobs since this handle was
+                # opened, and reading them against a stale manifest
+                # would raise (or worse, self-heal-revert a writer's
+                # work — which is why serve opens stores read-only)
+                self._refresh_store()
                 g = self._store.load_graph()
                 self._adj = (g.indptr, g.indices)
             else:

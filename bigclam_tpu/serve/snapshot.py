@@ -55,7 +55,7 @@ FOLDIN_CFG_FIELDS = (
 
 def publish_snapshot(
     directory: str,
-    step: int,
+    step: Optional[int] = None,
     F: Optional[np.ndarray] = None,
     ids: Optional[np.ndarray] = None,
     w: Optional[np.ndarray] = None,
@@ -66,7 +66,10 @@ def publish_snapshot(
 ) -> str:
     """Publish a serving snapshot (dense: F; sparse: ids + w) through the
     checkpoint manager's atomic publish(). `cfg` (a BigClamConfig) stamps
-    the objective constants; `num_edges` feeds the delta threshold."""
+    the objective constants; `num_edges` feeds the delta threshold.
+    step=None takes the NEXT generation under the publish lock
+    (CheckpointManager.publish_next — the continuous refit loop's
+    strictly-monotonic publication path, ISSUE 15)."""
     if (F is None) == (ids is None or w is None):
         raise ValueError("publish_snapshot needs F (dense) XOR ids+w (sparse)")
     arrays: Dict[str, np.ndarray] = {}
@@ -107,7 +110,10 @@ def publish_snapshot(
         for f in FOLDIN_CFG_FIELDS:
             m[f] = getattr(cfg, f)
         m.setdefault("k", cfg.num_communities)
-    return CheckpointManager(directory).publish(step, arrays, meta=m)
+    cm = CheckpointManager(directory)
+    if step is None:
+        return cm.publish_next(arrays, meta=m)[1]
+    return cm.publish(step, arrays, meta=m)
 
 
 def pad_neighbor_batch(

@@ -60,6 +60,16 @@ def _array_crc32(arr: np.ndarray) -> int:
     return zlib.crc32(np.ascontiguousarray(arr).tobytes())
 
 
+def published_step_of(path: str) -> int:
+    """Generation step of a published snapshot archive path — the ONE
+    place that knows the `snap_<step>.npz` naming scheme outside the
+    manager's own path builders (callers must never slice filenames)."""
+    name = os.path.basename(path)
+    if not (name.startswith("snap_") and name.endswith(".npz")):
+        raise ValueError(f"{path}: not a published snapshot archive")
+    return int(name[5:-4])
+
+
 class CheckpointManager:
     def __init__(self, directory: str, keep: int = 3):
         self.directory = directory
@@ -204,16 +214,84 @@ class CheckpointManager:
         docstring): fsync-rename archive + crc32 sidecar under the
         `snap_` prefix, then an atomic `latest.json` pointer update. The
         pointer flip is the publication instant — a concurrent reader
-        resolves either the previous snapshot or this one, complete."""
+        resolves either the previous snapshot or this one, complete.
+
+        The pointer NEVER moves backward (ISSUE 15 satellite): when a
+        newer generation is already published, the archive is written
+        but latest.json is left pointing at the newer step — a slow
+        publisher losing a race cannot roll the serving fleet back.
+        The check-then-flip runs under the publish lock, so two
+        racing publishers cannot interleave between the read and the
+        replace."""
         path = self._snap_path(step)
         self._write_archive(path, step, arrays, meta)
+        with self._publish_lock():
+            self._flip_pointer_locked(step)
+        return path
+
+    def _publish_lock(self):
+        """Exclusive cross-process publish lock (fcntl on a lock file
+        inside the snapshot dir). ONE acquisition per publication —
+        fcntl locks are per open-file-description, so nesting two
+        acquisitions in one process would self-deadlock; callers that
+        already hold it use the *_locked helpers directly."""
+        import contextlib
+        import fcntl
+
+        lock_path = os.path.join(self.directory, "publish.lock")
+
+        @contextlib.contextmanager
+        def held():
+            with open(lock_path, "w") as lock:
+                fcntl.flock(lock.fileno(), fcntl.LOCK_EX)
+                yield
+
+        return held()
+
+    def _flip_pointer_locked(self, step: int) -> None:
+        """Atomically point latest.json at `step` unless a NEWER
+        readable generation is already published (never backward).
+        Caller holds the publish lock."""
+        current = self._pointer_step()
+        if current is not None and current > step and os.path.exists(
+            self._snap_path(current)
+        ):
+            return
         lp = os.path.join(self.directory, "latest.json")
         with open(lp + ".tmp", "w") as f:
             json.dump({"step": step}, f)
             f.flush()
             os.fsync(f.fileno())
         os.replace(lp + ".tmp", lp)
-        return path
+
+    def _pointer_step(self) -> Optional[int]:
+        """The raw latest.json step (no archive-existence fallback)."""
+        try:
+            with open(os.path.join(self.directory, "latest.json")) as f:
+                return int(json.load(f)["step"])
+        except (OSError, ValueError, KeyError, TypeError):
+            return None
+
+    def publish_next(
+        self,
+        arrays: Dict[str, np.ndarray],
+        meta: Optional[Dict[str, Any]] = None,
+    ) -> Tuple[int, str]:
+        """Publish at the NEXT generation: step = newest published + 1,
+        chosen and written under an exclusive file lock so concurrent
+        publishers (the continuous refit loop racing a manual `cli fit
+        --publish-dir`, ISSUE 15) always take strictly monotonic,
+        distinct generations. Returns (step, path)."""
+        with self._publish_lock():
+            steps = self.published_steps()
+            head = max(
+                steps[-1] if steps else 0, self._pointer_step() or 0
+            )
+            step = head + 1
+            path = self._snap_path(step)
+            self._write_archive(path, step, arrays, meta)
+            self._flip_pointer_locked(step)
+        return step, path
 
     def published_steps(self) -> list[int]:
         out = []

@@ -52,6 +52,37 @@ def test_cli_ingest_stays_jax_free(tmp_path):
     assert out["shards"] == 2 and out["n"] == 4
 
 
+def test_cli_ingest_delta_stays_jax_free(tmp_path):
+    """ISSUE 15 satellite: the delta re-ingest is part of the jax-free
+    ingest entry — it runs on data-prep hosts next to the full compile."""
+    edges = tmp_path / "g.txt"
+    edges.write_text(
+        "".join(
+            f"{u}\t{v}\n"
+            for u, v in [(i, (i + 1) % 8) for i in range(8)]
+        )
+    )
+    cache = str(tmp_path / "cache")
+    r = _run_jaxfree(
+        ["ingest", "--graph", str(edges), "--cache-dir", cache,
+         "--shards", "2", "--quiet"],
+        str(tmp_path),
+    )
+    assert r.returncode == 0, (r.stdout, r.stderr)
+    delta = tmp_path / "delta.txt"
+    delta.write_text("0\t3\n1\t5\n")
+    r = _run_jaxfree(
+        ["ingest", "--delta", str(delta), "--cache-dir", cache,
+         "--quiet"],
+        str(tmp_path),
+    )
+    assert r.returncode == 0, (r.stdout, r.stderr)
+    out = json.loads(r.stdout.strip().splitlines()[-1])
+    assert out["delta_seq"] == 1
+    assert out["edges_added"] > 0
+    assert out["touched_shards"]
+
+
 def test_cli_report_and_watch_stay_jax_free(tmp_path):
     # the telemetry dir is produced here (jax loaded in THIS process is
     # irrelevant — the contract is about the reading entries), rendered

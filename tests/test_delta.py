@@ -1,0 +1,572 @@
+"""Incremental graph deltas (ISSUE 15): touched-range delta re-ingest,
+warm-start incremental refit, the per-host row-keyed init, the continuous
+follow loop, and the refit ledger fields."""
+
+import json
+import os
+import threading
+
+import numpy as np
+import pytest
+
+import jax
+
+from bigclam_tpu.config import BigClamConfig
+from bigclam_tpu.graph import build_graph
+from bigclam_tpu.graph.store import GraphStore, compile_graph_cache
+from bigclam_tpu.graph.stream import scan_edge_files
+from bigclam_tpu.models import BigClamModel, SparseBigClamModel
+from bigclam_tpu.models.bigclam import (
+    rowkeyed_init_F,
+    rowkeyed_init_rows,
+)
+from bigclam_tpu.models.refit import (
+    expand_halo,
+    follow_deltas,
+    touched_rows_from_delta,
+    warm_start_refit,
+)
+from bigclam_tpu.obs import RunTelemetry, install, uninstall
+from bigclam_tpu.obs import ledger as L
+from bigclam_tpu.obs.schema import validate_events_file
+from bigclam_tpu.obs.telemetry import EVENTS_NAME
+from bigclam_tpu.utils.checkpoint import CheckpointManager
+
+N = 200
+SHARDS = 4
+
+
+def _write_edges(path, edges):
+    with open(path, "w") as f:
+        for u, v in edges:
+            f.write(f"{u}\t{v}\n")
+
+
+def _base_edges(n=N, extra=500, seed=0):
+    """Ring (every id present => internal row == raw id) + random."""
+    rng = np.random.default_rng(seed)
+    edges = [(i, (i + 1) % n) for i in range(n)]
+    edges += [
+        (int(u), int(v))
+        for u, v in rng.integers(0, n, (extra, 2))
+        if u != v
+    ]
+    return edges
+
+
+def _delta_edges(lo=0, hi=50, stride=2, shift=9):
+    """Edges confined to rows [lo, hi) — touches only their shard."""
+    return [
+        (i, lo + (i + shift - lo) % (hi - lo))
+        for i in range(lo, hi, stride)
+        if i != lo + (i + shift - lo) % (hi - lo)
+    ]
+
+
+@pytest.fixture()
+def cache(tmp_path):
+    text = str(tmp_path / "g.txt")
+    _write_edges(text, _base_edges())
+    store = compile_graph_cache(
+        text, str(tmp_path / "cache"), num_shards=SHARDS
+    )
+    return store, text
+
+
+# --------------------------------------------------- delta re-ingest
+def test_apply_delta_bit_identical_to_full_build(tmp_path, cache):
+    store, text = cache
+    delta = str(tmp_path / "delta.txt")
+    _write_edges(delta, _delta_edges())
+    info = store.apply_delta(delta)
+    assert info["delta_seq"] == 1
+    assert info["edges_added"] > 0
+    combined = str(tmp_path / "combined.txt")
+    with open(combined, "w") as f:
+        f.write(open(text).read())
+        f.write(open(delta).read())
+    g_delta = GraphStore.open(store.directory).load_graph()
+    g_full = build_graph(combined)
+    np.testing.assert_array_equal(
+        np.asarray(g_delta.indptr), np.asarray(g_full.indptr)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(g_delta.indices), np.asarray(g_full.indices)
+    )
+    np.testing.assert_array_equal(g_delta.raw_ids, g_full.raw_ids)
+
+
+def test_apply_delta_untouched_blobs_and_files_read(tmp_path, cache):
+    store, _ = cache
+    before = {}
+    for s in range(SHARDS):
+        ip, dx = store.shard_files(s)
+        phi = os.path.join(store.directory, f"shard_{s:05d}.phi.npy")
+        before[s] = (
+            open(ip, "rb").read(), open(dx, "rb").read(),
+            open(phi, "rb").read(),
+        )
+    delta = str(tmp_path / "delta.txt")
+    _write_edges(delta, _delta_edges())     # rows [0, 50): shard 0 only
+    info = store.apply_delta(delta)
+    assert info["touched_shards"] == [0]
+    # only the touched shard's blobs (+ raw_ids) were read
+    assert set(info["files_read"]) == {
+        "raw_ids.npy", "shard_00000.indptr.npy",
+        "shard_00000.indices.npy",
+    }
+    for s in range(1, SHARDS):
+        ip, dx = store.shard_files(s)
+        phi = os.path.join(store.directory, f"shard_{s:05d}.phi.npy")
+        now = (
+            open(ip, "rb").read(), open(dx, "rb").read(),
+            open(phi, "rb").read(),
+        )
+        assert now == before[s], f"untouched shard {s} changed"
+    ip0, dx0 = store.shard_files(0)
+    assert open(dx0, "rb").read() != before[0][1]
+
+
+def test_apply_delta_phi_touched_matches_fresh_ingest(tmp_path, cache):
+    store, text = cache
+    delta = str(tmp_path / "delta.txt")
+    _write_edges(delta, _delta_edges())
+    info = store.apply_delta(delta)
+    assert info["phi_rebaked_shards"] == info["touched_shards"]
+    combined = str(tmp_path / "combined.txt")
+    with open(combined, "w") as f:
+        f.write(open(text).read())
+        f.write(open(delta).read())
+    fresh = compile_graph_cache(
+        combined, str(tmp_path / "cache2"), num_shards=SHARDS
+    )
+    for s in info["touched_shards"]:
+        a = np.load(
+            os.path.join(store.directory, f"shard_{s:05d}.phi.npy")
+        )
+        b = np.load(
+            os.path.join(fresh.directory, f"shard_{s:05d}.phi.npy")
+        )
+        np.testing.assert_array_equal(a, b)
+
+
+def test_apply_delta_refuses_new_nodes(tmp_path, cache):
+    store, _ = cache
+    delta = str(tmp_path / "delta.txt")
+    _write_edges(delta, [(0, N + 7)])       # N+7 never ingested
+    with pytest.raises(ValueError, match="cannot grow N"):
+        store.apply_delta(delta)
+    assert store.delta_seq == 0             # nothing applied
+
+
+def test_apply_delta_idempotent_duplicates(tmp_path, cache):
+    store, _ = cache
+    delta = str(tmp_path / "delta.txt")
+    _write_edges(delta, [(0, 1), (1, 2)])   # already in the ring
+    info = store.apply_delta(delta)
+    assert info["edges_added"] == 0
+    assert info["delta_seq"] == 1           # still recorded
+
+
+def test_apply_delta_empty_file_is_a_noop(tmp_path, cache):
+    """An empty/self-loop-only delta must not mutate the manifest:
+    recording it would make every future quarantine rebuild depend on
+    a file that contributes nothing."""
+    store, _ = cache
+    delta = str(tmp_path / "empty.txt")
+    with open(delta, "w") as f:
+        f.write("# nothing\n3 3\n")          # comment + self-loop only
+    before = json.load(
+        open(os.path.join(store.directory, "manifest.json"))
+    )
+    info = store.apply_delta(delta)
+    assert info["edges_added"] == 0
+    assert info["delta_seq"] == 0
+    assert info["touched_shards"] == []
+    after = json.load(
+        open(os.path.join(store.directory, "manifest.json"))
+    )
+    assert after == before
+
+
+def test_quarantine_rebuild_replays_deltas(tmp_path, cache):
+    store, _ = cache
+    delta = str(tmp_path / "delta.txt")
+    _write_edges(delta, _delta_edges())
+    store.apply_delta(delta)
+    good = GraphStore.open(store.directory).load_graph()
+    # corrupt the touched shard's indices blob
+    _, dx = store.shard_files(0)
+    raw = bytearray(open(dx, "rb").read())
+    raw[-1] ^= 0xFF
+    open(dx, "wb").write(bytes(raw))
+    healed = GraphStore.open(store.directory, self_heal=True).load_graph()
+    np.testing.assert_array_equal(
+        np.asarray(healed.indices), np.asarray(good.indices)
+    )
+
+
+def test_quarantine_rebuild_refuses_changed_delta(tmp_path, cache):
+    from bigclam_tpu.graph.store import ShardCorruption
+
+    store, _ = cache
+    delta = str(tmp_path / "delta.txt")
+    _write_edges(delta, _delta_edges())
+    store.apply_delta(delta)
+    _write_edges(delta, _delta_edges() + [(3, 17)])   # mutate the file
+    with pytest.raises(ShardCorruption, match="delta file changed"):
+        GraphStore.open(store.directory).rebuild_shard(0)
+
+
+# ----------------------------------------------- row-keyed counter init
+def test_rowkeyed_rows_match_global_slice():
+    full = rowkeyed_init_rows(0, 500, 16, seed=7)
+    np.testing.assert_array_equal(
+        full[123:456], rowkeyed_init_rows(123, 456, 16, seed=7)
+    )
+    assert set(np.unique(full)) <= {0.0, 1.0}
+    assert 0.4 < full.mean() < 0.6           # Bernoulli(0.5)
+    assert not np.array_equal(
+        full, rowkeyed_init_rows(0, 500, 16, seed=8)
+    )
+
+
+def test_store_native_per_host_init_bit_identical_trajectory(tmp_path):
+    from bigclam_tpu.parallel import (
+        ShardedBigClamModel,
+        StoreShardedBigClamModel,
+        make_mesh,
+    )
+
+    text = str(tmp_path / "g.txt")
+    _write_edges(text, _base_edges(n=96, extra=200, seed=2))
+    store = compile_graph_cache(
+        text, str(tmp_path / "cache"), num_shards=2
+    )
+    g = store.load_graph()
+    cfg = BigClamConfig(num_communities=6, max_iters=25, seed=11)
+    mesh = make_mesh((2, 1), jax.devices()[:2])
+    m_store = StoreShardedBigClamModel(store, cfg, mesh)
+    m_mem = ShardedBigClamModel(g, cfg, mesh)
+    s_store = m_store.init_state(None)       # per-host generation
+    s_mem = m_mem.init_state(None)           # host-global twin
+    np.testing.assert_array_equal(
+        np.asarray(s_store.F), np.asarray(s_mem.F)
+    )
+    st1, llh1, it1, h1 = m_store.fit_state(s_store)
+    st2, llh2, it2, h2 = m_mem.fit_state(s_mem)
+    assert it1 == it2 and h1 == h2
+    np.testing.assert_array_equal(
+        np.asarray(st1.F), np.asarray(st2.F)
+    )
+
+
+def test_rowkeyed_init_matches_single_chip(tmp_path):
+    text = str(tmp_path / "g.txt")
+    _write_edges(text, _base_edges(n=64, extra=100, seed=4))
+    g = build_graph(text)
+    cfg = BigClamConfig(num_communities=4, max_iters=5, seed=5)
+    model = BigClamModel(g, cfg)
+    state = model.init_state(None)
+    np.testing.assert_array_equal(
+        np.asarray(state.F)[: g.num_nodes, :4],
+        rowkeyed_init_F(g, cfg),
+    )
+
+
+# --------------------------------------------------- warm-start refit
+@pytest.fixture(scope="module")
+def refit_world(tmp_path_factory):
+    """Cache + converged fit + applied delta, shared by refit tests."""
+    tmp = tmp_path_factory.mktemp("refit")
+    text = str(tmp / "g.txt")
+    _write_edges(text, _base_edges(n=150, extra=450, seed=3))
+    store = compile_graph_cache(
+        text, str(tmp / "cache"), num_shards=SHARDS
+    )
+    cfg = BigClamConfig(num_communities=6, max_iters=200, seed=0)
+    g0 = store.load_graph()
+    model0 = BigClamModel(g0, cfg)
+    res0 = model0.fit(model0.random_init())
+    delta = str(tmp / "delta.txt")
+    _write_edges(delta, _delta_edges(lo=0, hi=40, stride=3, shift=11))
+    info = store.apply_delta(delta)
+    g1 = store.load_graph()
+    return store, cfg, res0, delta, info, g1
+
+
+def test_expand_halo(refit_world):
+    _, _, _, _, _, g = refit_world
+    touched = np.asarray([0, 5])
+    h0 = expand_halo(g.indptr, g.indices, touched, 0)
+    np.testing.assert_array_equal(h0, touched)
+    h1 = expand_halo(g.indptr, g.indices, touched, 1)
+    assert set(touched) < set(h1.tolist())
+    nbrs = set(
+        np.asarray(g.indices)[g.indptr[0]: g.indptr[1]].tolist()
+    )
+    assert nbrs <= set(h1.tolist())
+
+
+def test_touched_rows_from_delta(refit_world):
+    _, _, _, delta, info, g = refit_world
+    rows = touched_rows_from_delta(g.raw_ids, delta)
+    np.testing.assert_array_equal(rows, info["touched_rows"])
+
+
+def test_warm_start_refit_tracks_scratch_fit(refit_world):
+    import jax.numpy as jnp  # noqa: F401
+
+    from bigclam_tpu.ops.objective import loglikelihood
+
+    store, cfg, res0, delta, info, g = refit_world
+    model = BigClamModel(g, cfg)
+    r = warm_start_refit(
+        model, res0.F, info["touched_rows"], halo=1, max_rounds=10
+    )
+    assert r.converged and not r.escalated
+    assert 0 < r.touched_frac < 1.0
+    assert r.refit_nodes >= r.touched
+    scratch = model.fit(model.random_init())
+    st = model.init_state(r.F)
+    llh_refit = float(loglikelihood(st.F, st.sumF, model.edges, cfg))
+    rel = abs(1.0 - llh_refit / scratch.llh)
+    assert rel < 0.05, (llh_refit, scratch.llh, rel)
+    # restricted work: far fewer sweeps than the full fit's iterations
+    assert r.rounds < scratch.num_iters
+
+
+def test_warm_start_refit_fixed_point_without_delta(refit_world):
+    """On an UNCHANGED graph the previous F is near a fixed point: the
+    refit converges in a couple of rounds and barely moves the rows."""
+    store, cfg, res0, _, _, g1 = refit_world
+    model = BigClamModel(g1, cfg)
+    base = model.fit(model.random_init())
+    r = warm_start_refit(
+        model, base.F, np.arange(0, 30), halo=0, max_rounds=8
+    )
+    assert r.converged
+    np.testing.assert_allclose(r.F, base.F, atol=2e-2)
+
+
+def test_refit_escalates_on_plateau(refit_world):
+    store, cfg, res0, _, info, g = refit_world
+    model = BigClamModel(g, cfg)
+    r = warm_start_refit(
+        model, res0.F, info["touched_rows"], halo=1, max_rounds=10,
+        conv_tol=1e-12,
+        thresholds={"plateau_floor": 0.5, "plateau_patience": 2},
+    )
+    assert r.escalated
+    assert any(a["check"] == "plateau" for a in r.anomalies)
+
+
+def test_warm_start_refit_sparse(refit_world):
+    store, cfg, res0, _, info, g = refit_world
+    scfg = cfg.replace(representation="sparse", sparse_m=6)
+    smodel = SparseBigClamModel(g, scfg)
+    r = smodel.warm_start_refit(
+        res0.F, info["touched_rows"], halo=0, max_rounds=4
+    )
+    assert r.F.shape == (g.num_nodes, 6)
+    assert np.isfinite(r.llh)
+    assert r.rounds >= 1
+
+
+def test_delta_and_refit_events_schema_valid(tmp_path):
+    text = str(tmp_path / "g.txt")
+    _write_edges(text, _base_edges(n=80, extra=150, seed=6))
+    store = compile_graph_cache(
+        text, str(tmp_path / "cache"), num_shards=2
+    )
+    cfg = BigClamConfig(num_communities=4, max_iters=40, seed=0)
+    tdir = str(tmp_path / "tel")
+    tel = install(RunTelemetry(tdir, entry="refit", device_memory=False))
+    try:
+        delta = str(tmp_path / "delta.txt")
+        _write_edges(delta, _delta_edges(lo=0, hi=30, stride=4))
+        info = store.apply_delta(delta)
+        g = store.load_graph()
+        model = BigClamModel(g, cfg)
+        warm_start_refit(
+            model, model.random_init(), info["touched_rows"],
+            halo=0, max_rounds=3,
+        )
+    finally:
+        tel.finalize()
+        uninstall(tel)
+    n, errors = validate_events_file(os.path.join(tdir, EVENTS_NAME))
+    assert not errors, errors[:5]
+    kinds = [
+        json.loads(ln)["kind"]
+        for ln in open(os.path.join(tdir, EVENTS_NAME))
+    ]
+    assert "delta_ingest" in kinds and "refit" in kinds
+
+
+# ------------------------------------------------ the continuous loop
+def test_scan_edge_files_order_and_filters(tmp_path):
+    d = tmp_path / "deltas"
+    d.mkdir()
+    (d / "b.txt").write_text("0 1\n")
+    (d / "a.txt").write_text("0 1\n")
+    (d / "c.tmp").write_text("")
+    (d / ".hidden").write_text("")
+    got = scan_edge_files(str(d))
+    assert [os.path.basename(p) for p in got] == ["a.txt", "b.txt"]
+    got2 = scan_edge_files(str(d), seen=got[:1])
+    assert [os.path.basename(p) for p in got2] == ["b.txt"]
+    assert scan_edge_files(str(tmp_path / "missing")) == []
+
+
+def test_follow_deltas_publishes_monotonic_generations(tmp_path):
+    text = str(tmp_path / "g.txt")
+    _write_edges(text, _base_edges(n=100, extra=250, seed=9))
+    store = compile_graph_cache(
+        text, str(tmp_path / "cache"), num_shards=2
+    )
+    cfg = BigClamConfig(num_communities=4, max_iters=80, seed=0)
+    g = store.load_graph()
+    model = BigClamModel(g, cfg)
+    res = model.fit(model.random_init())
+    snaps = str(tmp_path / "snaps")
+    from bigclam_tpu.serve.snapshot import publish_snapshot
+
+    publish_snapshot(
+        snaps, step=res.num_iters, F=res.F, raw_ids=g.raw_ids,
+        num_edges=g.num_edges, cfg=cfg, meta={"fit_wall_s": 1.0},
+    )
+    g0 = CheckpointManager(snaps).latest()
+    ddir = tmp_path / "deltas"
+    ddir.mkdir()
+    _write_edges(
+        str(ddir / "delta_000.txt"), _delta_edges(lo=0, hi=30, stride=4)
+    )
+    _write_edges(
+        str(ddir / "delta_001.txt"),
+        _delta_edges(lo=0, hi=40, stride=5, shift=13),
+    )
+    # an empty delta must be SKIPPED: no refit, no generation churn
+    # (named to sort FIRST, so the loop meets it before the real ones)
+    (ddir / "a_empty.txt").write_text("# nothing\n")
+    # a POISON delta (unknown node id) must be refused and skipped —
+    # never crash the loop (also sorts before the real deltas)
+    (ddir / "b_poison.txt").write_text("0\t999999\n")
+    out = follow_deltas(
+        store, cfg, res.F, snaps, str(ddir),
+        max_deltas=2, timeout_s=30, interval_s=0.05, quiet=True,
+    )
+    assert out["generations"] == 2
+    assert len(out["processed"]) == 2
+    assert len(out["skipped_empty"]) == 1
+    assert len(out["failed"]) == 1
+    assert out["failed"][0].endswith("b_poison.txt")
+    steps = CheckpointManager(snaps).published_steps()
+    assert steps[-2:] == [g0 + 1, g0 + 2]
+    assert CheckpointManager(snaps).latest() == g0 + 2
+    assert store.delta_seq == 2
+    # the from-scratch cost baseline propagates through loop-published
+    # generations (a later `cli refit` needs it for refit_cost_ratio)
+    _, _, meta = CheckpointManager(snaps).load_published()
+    assert meta.get("fit_wall_s") == 1.0
+    # a restarted loop skips already-recorded deltas
+    out2 = follow_deltas(
+        store, cfg, res.F, snaps, str(ddir),
+        max_deltas=1, timeout_s=0.2, interval_s=0.05, quiet=True,
+    )
+    assert out2["generations"] == 0
+
+
+# ------------------------------------------------------- ledger fields
+def _report(final, entry="refit"):
+    return {
+        "run": final.get("run", "r1"),
+        "entry": entry,
+        "pid": 0,
+        "wall_s": 2.0,
+        "processes": 1,
+        "fingerprint": {
+            "host": "h", "platform": "cpu", "backend": "cpu",
+            "device_kind": "cpu", "devices": 1,
+        },
+        "final": final,
+    }
+
+
+def test_ledger_records_refit_fields_and_verdicts():
+    final = {
+        "n": 150, "edges": 700, "k": 6,
+        "refit_cost_ratio": 0.2, "touched_frac": 0.3,
+        "refit_rounds": 3,
+    }
+    base = L.build_record(_report(final))
+    assert base["refit_cost_ratio"] == 0.2
+    assert base["touched_frac"] == 0.3
+    assert base["refit_rounds"] == 3
+    # identical re-run: PASS
+    d = L.diff_records(base, L.build_record(_report(final)))
+    assert not d["regression"]
+    # cost ratio blowing past the band: REGRESSION
+    worse = dict(final, refit_cost_ratio=0.9)
+    d = L.diff_records(base, L.build_record(_report(worse)))
+    assert d["regression"]
+    assert any(
+        c["metric"] == "refit_cost_ratio" and c["regression"]
+        for c in d["checks"]
+    )
+    # touched_frac creeping up: REGRESSION too
+    wider = dict(final, touched_frac=0.8)
+    d = L.diff_records(base, L.build_record(_report(wider)))
+    assert d["regression"]
+
+
+def test_ledger_refit_never_baselines_fit(tmp_path):
+    final = {"n": 150, "edges": 700, "k": 6}
+    fit_rec = L.build_record(_report(dict(final, run="fit1"), "fit"))
+    refit_rec = L.build_record(
+        _report(
+            dict(final, run="refit1", refit_cost_ratio=0.2,
+                 touched_frac=0.3),
+            "refit",
+        )
+    )
+    led = L.PerfLedger(str(tmp_path / "ledger.jsonl"))
+    led.append(fit_rec)
+    led.append(refit_rec)
+    assert led.baseline_for(refit_rec) is None
+    assert L.match_key(fit_rec) != L.match_key(refit_rec)
+
+
+# ------------------------------------------------------------ cli e2e
+def test_cli_refit_end_to_end(tmp_path, capsys):
+    from bigclam_tpu.cli import main
+
+    text = str(tmp_path / "g.txt")
+    _write_edges(text, _base_edges(n=100, extra=250, seed=12))
+    cache = str(tmp_path / "cache")
+    assert main(
+        ["ingest", "--graph", text, "--cache-dir", cache,
+         "--shards", "2", "--quiet"]
+    ) == 0
+    snaps = str(tmp_path / "snaps")
+    assert main(
+        ["fit", "--graph", cache, "--k", "4", "--max-iters", "80",
+         "--publish-dir", snaps, "--quiet"]
+    ) == 0
+    delta = str(tmp_path / "delta.txt")
+    _write_edges(delta, _delta_edges(lo=0, hi=30, stride=4))
+    assert main(
+        ["ingest", "--delta", delta, "--cache-dir", cache, "--quiet"]
+    ) == 0
+    capsys.readouterr()
+    rc = main(
+        ["refit", "--graph", cache, "--snapshots", snaps,
+         "--delta", delta, "--quiet"]
+    )
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rc == 0
+    assert out["touched"] > 0
+    assert out["refit_cost_ratio"] is not None
+    assert out["generation"] > out["from_generation"]
+    # the published refit snapshot is loadable and is the latest
+    assert CheckpointManager(snaps).latest() == out["generation"]

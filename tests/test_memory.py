@@ -260,7 +260,7 @@ def test_host_model_f0_is_dominant_and_flagged():
     assert hm.peak_bytes() == dom.bytes
 
 
-def test_host_model_store_native_shrinks_graph_not_f0():
+def test_host_model_store_native_shrinks_graph_and_f0():
     kw = dict(n=100_000, directed_edges=2_000_000, k=1000, itemsize=4,
               n_pad=100_352, k_pad=1024)
     host_global = M.host_rss_model(**kw)
@@ -269,8 +269,19 @@ def test_host_model_store_native_shrinks_graph_not_f0():
     hg = {s.stage: s.bytes for s in host_global.stages}
     st = {s.stage: s.bytes for s in store.stages}
     assert st["shard_load"] < hg["graph_load"] / 4
-    # the F0 init is STILL host-global (ROADMAP 1a) — unchanged
-    assert st["f0_init"] == hg["f0_init"]
+    # ISSUE 15 satellite: store-native F0 is the PER-HOST row-keyed
+    # counter init — O(N_loc*K), 1/processes of the padded staging;
+    # the dominant flag MOVES off f0_init (to the still-host-global
+    # extract stage, the next ROADMAP 1a frontier)
+    assert st["f0_init"] < hg["f0_init"] / 4
+    assert st["f0_init"] == M.rowkeyed_f0_rss_bytes(100_352, 1024, 4, 8)
+    assert host_global.dominant().stage == "f0_init"
+    assert store.dominant().stage == "extract"
+    # explicit host-global F0 (conductance seeding) re-opens the term
+    explicit = M.host_rss_model(**kw, store_native=True, processes=8,
+                                num_shards=8, rowkeyed_f0=False)
+    ex = {s.stage: s.bytes for s in explicit.stages}
+    assert ex["f0_init"] == hg["f0_init"]
 
 
 def test_ingest_stage_uses_the_gate_budget_formula():

@@ -704,4 +704,100 @@ def test_cli_fit_publishes_snapshot(tmp_path, fitted, capsys):
     assert out["published"].endswith(".npz")
     snap = ServingSnapshot.load(pub)
     assert snap.n == g.num_nodes and snap.k == 4
-    assert CheckpointManager(pub).latest() == out["iters"]
+    # fit publishes the NEXT generation (publish_next, ISSUE 15), not
+    # the iteration count — a faster re-fit must still be served
+    assert CheckpointManager(pub).latest() == out["generation"] == 1
+    rc = main(
+        [
+            "fit", "--graph", str(edges), "--k", "4", "--max-iters", "10",
+            "--init", "random", "--publish-dir", pub, "--quiet",
+            "--health-every", "0",
+        ]
+    )
+    assert rc == 0
+    out2 = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out2["generation"] == 2
+    assert CheckpointManager(pub).latest() == 2
+
+
+# ------------------------------------- rapid republish (ISSUE 15 sat.)
+def test_publish_next_generations_strictly_monotonic_concurrent(
+    tmp_path,
+):
+    """Concurrent publishers (the follow loop racing a manual `cli fit
+    --publish-dir`) must take distinct, strictly increasing generations
+    — publish_next serializes the step choice under the publish lock."""
+    d = str(tmp_path / "snaps")
+    steps = []
+    lock = threading.Lock()
+    errors = []
+
+    def publisher(i):
+        try:
+            for j in range(5):
+                # a fresh manager per call = independent publishers
+                s, path = CheckpointManager(d).publish_next(
+                    {"F": np.full(3, i * 10 + j, np.float64)}
+                )
+                with lock:
+                    steps.append(s)
+        except Exception as e:   # noqa: BLE001
+            errors.append(e)
+
+    threads = [
+        threading.Thread(target=publisher, args=(i,)) for i in range(4)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors, errors
+    assert len(steps) == 20
+    assert len(set(steps)) == 20            # no duplicated generation
+    assert sorted(steps) == list(range(1, 21))
+    cm = CheckpointManager(d)
+    assert cm.latest() == 20
+    assert cm.load_published()[0] == 20
+
+
+def test_publish_pointer_never_moves_backward(tmp_path):
+    d = str(tmp_path / "snaps")
+    cm = CheckpointManager(d)
+    cm.publish(7, {"F": np.ones(2)})
+    # a slow publisher losing the race writes an OLDER generation:
+    # the archive lands, the pointer must not roll back
+    cm.publish(5, {"F": np.zeros(2)})
+    assert cm.latest() == 7
+    assert 5 in cm.published_steps()        # archive still published
+    cm.publish(9, {"F": np.ones(2)})
+    assert cm.latest() == 9
+
+
+def test_serve_watcher_never_swaps_backward(tmp_path, fitted):
+    """latest.json racing a newer snap_ archive (or a pointer rolled
+    back by a crashed publisher) must never swap a serving generation
+    backward."""
+    g, truth, cfg, model, res = fitted
+    d = str(tmp_path / "snaps")
+    for step in (5, 7):
+        publish_snapshot(
+            d, step=step, F=res.F, raw_ids=g.raw_ids,
+            num_edges=g.num_edges, cfg=cfg,
+        )
+    server = MembershipServer(d, graph=g)
+    try:
+        assert server.generation == 7
+        # simulate the race: pointer names the OLDER generation
+        with open(os.path.join(d, "latest.json"), "w") as f:
+            json.dump({"step": 5}, f)
+        assert server.maybe_reload() is None
+        assert server.generation == 7       # never backward
+        # a genuinely newer publication still swaps forward
+        publish_snapshot(
+            d, step=9, F=res.F, raw_ids=g.raw_ids,
+            num_edges=g.num_edges, cfg=cfg,
+        )
+        assert server.maybe_reload() == 9
+        assert server.generation == 9
+    finally:
+        server.close()
