@@ -667,6 +667,9 @@ def _emit(jax, spec, g, cfg, F0, backend, model, configs, enron_eps,
         # top-M measurement lives in configs["sparse"] with its own
         # bytes/edge model
         "representation": "dense",
+        # node-axis partition identity (ISSUE 16): part of the perf
+        # ledger's match key — a 2d record never baselines against 1d
+        "partition": getattr(cfg, "partition", "1d"),
         "backend": backend,
         "config": configs["enron"]["config"],
         "graph_source": configs["enron"].get("graph_source"),
@@ -725,6 +728,7 @@ def _emit(jax, spec, g, cfg, F0, backend, model, configs, enron_eps,
                 "n": g.num_nodes,
                 "edges": g.num_directed_edges // 2,
                 "representation": record["representation"],
+                "partition": record["partition"],
                 # the ledger's roofline fields (obs.ledger): hbm_frac is
                 # the denominator "is it actually fast" gates against —
                 # with the VARIANT of the cost model it was quoted

@@ -78,6 +78,20 @@ def _add_common(p: argparse.ArgumentParser) -> None:
              " around the ICI ring (O(N/dp) peak memory, pod-scale)",
     )
     p.add_argument(
+        "--partition", default="1d", choices=["1d", "2d"],
+        help="node-axis partition for --mesh runs: 1d (default) shards "
+             "nodes dp ways and all-gathers F; 2d tiles the edge set "
+             "over a (rows, cols) grid — each chip gathers only its row "
+             "group's src blocks plus the capped closure rows its edges "
+             "touch (communication-avoiding at large K; see DESIGN.md)",
+    )
+    p.add_argument(
+        "--replica-cols", type=int, default=1,
+        help="columns of the --partition 2d grid (rows = p / cols; must "
+             "divide the chip count; 1 reproduces the 1d trajectory "
+             "bit-for-bit on the 2d schedule)",
+    )
+    p.add_argument(
         "--csr-kernels", default="auto", choices=["auto", "on", "off"],
         help="blocked-CSR Pallas kernel path (auto: on for TPU backends "
              "when the layout fits; on: require, error if unsupported)",
@@ -358,6 +372,8 @@ def _build(args, k: int):
         sparse_m=getattr(args, "sparse_m", 64),
         support_every=getattr(args, "support_every", 1),
         health_every=max(getattr(args, "health_every", 0) or 0, 0),
+        partition=getattr(args, "partition", "1d"),
+        replica_cols=max(getattr(args, "replica_cols", 1) or 1, 1),
     )
     g = _load_graph(args)
     return g, cfg
@@ -394,6 +410,68 @@ def _make_model(g, cfg, args):
             "--representation sparse yet (the sparse trainers build "
             "member-list state from the in-memory graph)"
         )
+    if cfg.partition == "2d":
+        # the 2D closure-gather schedule (ISSUE 16): its own trainer
+        # family on a (rows, cols, k) mesh — refuse the combinations it
+        # does not speak up front, with the knob that does
+        if not args.mesh:
+            raise SystemExit(
+                "error: --partition 2d needs --mesh p,1 (the 2D edge-"
+                "block layout is a sharded schedule)"
+            )
+        if args.distributed:
+            raise SystemExit(
+                "error: --partition 2d is single-controller for now "
+                "(the multi-host 2D mesh rides the ROADMAP item 1 pod "
+                "drill)"
+            )
+        if cfg.representation == "sparse":
+            raise SystemExit(
+                "error: --partition 2d runs the dense-F closure "
+                "schedule; --representation sparse stays on the 1d "
+                "member exchange (preflight prices sparse x 2d "
+                "forward-looking)"
+            )
+        if args.schedule == "ring":
+            raise SystemExit(
+                "error: --partition 2d is its own closure-gather "
+                "schedule — drop --schedule ring"
+            )
+        if cfg.use_pallas_csr:
+            raise SystemExit(
+                "error: --csr-kernels on is not supported with "
+                "--partition 2d yet (the closure schedule is XLA-only; "
+                "the closure table is already the flat row layout the "
+                "fused dst-DMA consumes — use --csr-kernels auto)"
+            )
+        import jax
+
+        from bigclam_tpu.parallel import (
+            StoreTwoDShardedBigClamModel,
+            TwoDShardedBigClamModel,
+            make_mesh_2d,
+            twod_mesh_shape,
+        )
+
+        dp, tp = (int(x) for x in args.mesh.split(","))
+        if tp != 1:
+            raise SystemExit(
+                "error: --partition 2d needs --mesh p,1 — the k axis "
+                "rides the 2D mesh unsharded (use --replica-cols to "
+                "shape the (rows, cols) grid)"
+            )
+        rows, cols = twod_mesh_shape(cfg, dp)
+        mesh = make_mesh_2d((rows, cols), jax.devices()[:dp])
+        if store_native:
+            store = getattr(args, "_store", None)
+            if store is None:
+                raise SystemExit(
+                    "error: --store-native needs --graph (or "
+                    "--cache-dir) to be a compiled graph cache (run "
+                    "`cli ingest` first)"
+                )
+            return StoreTwoDShardedBigClamModel(store, cfg, mesh)
+        return TwoDShardedBigClamModel(g, cfg, mesh, balance=args.balance)
     if args.mesh or args.distributed:
         import jax
 
@@ -466,9 +544,15 @@ def _make_model(g, cfg, args):
 
 
 def _mesh_label(mesh) -> str:
-    """'dpxtp' identity of a mesh for the perf ledger's match key."""
-    from bigclam_tpu.parallel.mesh import K_AXIS, NODES_AXIS
+    """'dpxtp' identity of a mesh for the perf ledger's match key; a 2D
+    (rows, cols, k) mesh labels as 'rowsxcols' — the ledger's partition
+    field keeps it from colliding with a 1D 'dpxtp' string."""
+    from bigclam_tpu.parallel.mesh import (
+        COLS_AXIS, K_AXIS, NODES_AXIS, ROWS_AXIS,
+    )
 
+    if ROWS_AXIS in mesh.shape:
+        return f"{mesh.shape[ROWS_AXIS]}x{mesh.shape[COLS_AXIS]}"
     return f"{mesh.shape[NODES_AXIS]}x{mesh.shape[K_AXIS]}"
 
 
@@ -713,6 +797,9 @@ def _cmd_fit(args, tel=None) -> int:
         # fused run; the reason says WHY when it is a fallback
         "kernel_path": getattr(model, "engaged_path", ""),
         "kernel_path_reason": getattr(model, "path_reason", ""),
+        # node-axis partition identity (ISSUE 16): joins the ledger
+        # match key — a 2d run never baselines against a 1d run
+        "partition": cfg.partition,
     }
     if mesh is not None:
         # execution-shape identity (obs.ledger.match_key, ISSUE 10): a
@@ -986,6 +1073,8 @@ def _cmd_ingest(args, tel=None) -> int:
         seed_bake=not args.no_seed_bake,
         seed_cap=args.seed_cap,
         seed=args.seed,
+        closure_bake=not getattr(args, "no_closure_bake", False),
+        closure_cap=max(getattr(args, "closure_cap", 0) or 0, 0),
     )
     out = {
         "cache_dir": args.cache_dir,
@@ -996,6 +1085,10 @@ def _cmd_ingest(args, tel=None) -> int:
         # from the manifest, not the flag: the work guard can skip an
         # uncapped bake on hub-heavy graphs (store.SEED_BAKE_EXACT_MAX_WORK)
         "seed_baked": store.manifest.get("seed_scores", {}).get(
+            "baked", False
+        ),
+        # 2D closure gather lists (manifest v3, ISSUE 16)
+        "closure_baked": store.manifest.get("closure", {}).get(
             "baked", False
         ),
         "chunk_bytes": args.chunk_bytes,
@@ -1098,6 +1191,9 @@ def _cmd_profile(args, tel=None) -> int:
         "edges": g.num_edges,
         "k": cfg.num_communities,
         "representation": cfg.representation,
+        # ledger match-key identity (ISSUE 16): the profile/bench entry
+        # stamps the partition exactly like fit does
+        "partition": cfg.partition,
     }
     if mesh is not None:
         out["mesh"] = _mesh_label(mesh)
@@ -1215,6 +1311,7 @@ def cmd_preflight(args) -> int:
     from bigclam_tpu.obs import memory as M
 
     shard_counts = None
+    closure_pairs = None
     rows_per_shard = 0
     notes: list = []
     if is_cache_dir(args.graph):
@@ -1223,6 +1320,11 @@ def cmd_preflight(args) -> int:
         directed = 2 * args.edges if args.edges else w["directed_edges"]
         rows_per_shard = w["rows_per_shard"]
         shard_counts = w["shard_edge_counts"]
+        # baked closure pair counts (manifest v3): exact 2D closure-
+        # exchange pricing instead of the coupon-collector estimate
+        cl = w.get("closure") or {}
+        if cl.get("baked"):
+            closure_pairs = cl.get("pair_counts")
     elif os.path.isfile(args.graph):
         if not args.nodes:
             print(
@@ -1253,6 +1355,10 @@ def cmd_preflight(args) -> int:
         dp, tp = (int(x) for x in args.mesh.split(","))
     else:
         dp, tp = max(args.devices, 1), 1
+    if closure_pairs is not None and len(closure_pairs) != dp:
+        # pair counts are per STORE shard — only exact when the cache
+        # shard grid IS the device grid (the 2D trainers require that)
+        closure_pairs = None
     if shard_counts:
         # aggregate the cache's per-shard counts into TRAINER shards
         # (dp groups of contiguous store shards). dp == 1 included: the
@@ -1285,29 +1391,36 @@ def cmd_preflight(args) -> int:
 
     from bigclam_tpu.config import BigClamConfig
 
-    p = M.preflight(
-        n,
-        directed,
-        args.k,
-        dp=dp,
-        tp=tp,
-        itemsize=8 if args.dtype == "float64" else 4,
-        num_candidates=args.max_backtracks + 1,
-        representation=args.representation,
-        sparse_m=args.sparse_m,
-        support_every=args.support_every,
-        schedule=args.schedule,
-        store_native=args.store_native,
-        health_every=max(args.health_every or 0, 0),
-        edge_chunk=args.edge_chunk or BigClamConfig.edge_chunk,
-        shard_edge_counts=shard_counts,
-        device_hbm_bytes=hbm,
-        host_ram_bytes=host_ram,
-        processes=max(args.processes, 1),
-        chunk_bytes=args.chunk_bytes,
-        csr_block_b=args.csr_block_b,
-        rows_per_shard=rows_per_shard,
-    )
+    try:
+        p = M.preflight(
+            n,
+            directed,
+            args.k,
+            dp=dp,
+            tp=tp,
+            itemsize=8 if args.dtype == "float64" else 4,
+            num_candidates=args.max_backtracks + 1,
+            representation=args.representation,
+            sparse_m=args.sparse_m,
+            support_every=args.support_every,
+            schedule=args.schedule,
+            store_native=args.store_native,
+            health_every=max(args.health_every or 0, 0),
+            edge_chunk=args.edge_chunk or BigClamConfig.edge_chunk,
+            shard_edge_counts=shard_counts,
+            device_hbm_bytes=hbm,
+            host_ram_bytes=host_ram,
+            processes=max(args.processes, 1),
+            chunk_bytes=args.chunk_bytes,
+            csr_block_b=args.csr_block_b,
+            rows_per_shard=rows_per_shard,
+            partition=getattr(args, "partition", "1d"),
+            replica_cols=max(getattr(args, "replica_cols", 1) or 1, 1),
+            closure_pair_counts=closure_pairs,
+        )
+    except ValueError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 1
     p["notes"] = notes + p["notes"]
     if args.json:
         print(json.dumps(p, sort_keys=True))
@@ -1804,6 +1917,19 @@ def main(argv=None) -> int:
         help="PRNG seed the capped scorer's sample stream derives from "
              "(match the fit's --seed for identical rankings)",
     )
+    p_ing.add_argument(
+        "--no-closure-bake", action="store_true",
+        help="skip baking the per-shard-pair closure gather lists "
+             "(manifest v3; default: bake — the 2D trainers then load "
+             "exact touched-dst-row lists instead of streaming them "
+             "from the CSR at build time)",
+    )
+    p_ing.add_argument(
+        "--closure-cap", type=int, default=0,
+        help="rows per (requester, contributor) closure list before the "
+             "pair degrades to the full dst block (0 = uncapped); the "
+             "2D all_to_all buffer scales with the BAKED cap",
+    )
     p_ing.add_argument("--overwrite", action="store_true")
     p_ing.add_argument(
         "--telemetry-dir", default=None,
@@ -2107,6 +2233,16 @@ def main(argv=None) -> int:
     p_pre.add_argument("--schedule", default="allgather",
                        choices=["allgather", "ring"])
     p_pre.add_argument("--store-native", action="store_true")
+    p_pre.add_argument(
+        "--partition", default="1d", choices=["1d", "2d"],
+        help="price the 1d all-gather layout or the 2d closure-gather "
+             "layout (a 1d does-not-fit verdict names --partition 2d "
+             "when it would relax the binding gather)",
+    )
+    p_pre.add_argument(
+        "--replica-cols", type=int, default=1,
+        help="columns of the --partition 2d grid (rows = p / cols)",
+    )
     p_pre.add_argument("--health-every", type=int, default=10)
     p_pre.add_argument("--max-backtracks", type=int, default=15)
     p_pre.add_argument("--edge-chunk", type=int, default=None)

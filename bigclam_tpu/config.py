@@ -277,6 +277,23 @@ class BigClamConfig:
                                         # re-reading the (N, K) carry
                                         # accumulators (measurably cheaper)
     mesh_shape: Tuple[int, int] = (1, 1)  # (node-shards, k-shards) = (DP, TP-analog)
+    partition: str = "1d"               # node-axis partition of the dense
+                                        # sharded families (ISSUE 16):
+                                        # "1d" = every chip gathers full F
+                                        # (all_gather over "nodes"); "2d" =
+                                        # (rows x cols) edge-block layout
+                                        # where each chip exchanges only its
+                                        # baked closure rows
+                                        # (parallel.twod). STEP-BAKED and a
+                                        # perf-ledger match-key field: 1d
+                                        # and 2d runs never share a compiled
+                                        # step or a baseline
+    replica_cols: int = 1               # C in the (R x C) 2d mesh; the
+                                        # node-shard count dp must divide by
+                                        # it (R = dp // C). 1 keeps the 1D
+                                        # edge layout with the closure
+                                        # exchange replacing all_gather(F).
+                                        # Ignored under partition="1d"
     use_pallas: Optional[bool] = None   # fused VMEM candidate kernel; None =
                                         # auto (on for TPU backends when tile
                                         # constraints are met)

@@ -64,8 +64,14 @@ from bigclam_tpu.graph.stream import (
 # graph bytes are identical); only load_seed_scores refuses on them, with
 # a re-ingest hint, and fit-time seeding falls back to the streaming
 # conductance pass.
-MANIFEST_VERSION = 2
-SUPPORTED_MANIFEST_VERSIONS = (1, 2)
+# v3 (ISSUE 16): ingest-baked neighborhood-closure gather lists
+# (shard_*.closure.npy + per-entry "closure" crc) — the per-shard-pair
+# touched-row ids the 2D edge-block partition exchanges instead of a full
+# F all_gather. Same migration contract: v1/v2 caches still LOAD; only
+# load_closure_lists refuses on them (re-ingest hint), and the 2D
+# trainers fall back to streaming the lists from the host's own CSR.
+MANIFEST_VERSION = 3
+SUPPORTED_MANIFEST_VERSIONS = (1, 2, 3)
 MANIFEST_NAME = "manifest.json"
 QUARANTINE_DIR = "quarantine"
 
@@ -171,6 +177,42 @@ class ShardSeedScores:
         stream with `seeding_degree_cap=cap, seed=seed` (the stream seed
         only matters once a cap engages the sampler)."""
         return self.cap == cap and (cap is None or self.seed == seed)
+
+
+@dataclasses.dataclass(frozen=True)
+class ShardClosure:
+    """One shard's baked neighborhood-closure gather lists (ISSUE 16).
+
+    Per peer shard b (== a 2D trainer node block when num_shards == R*C):
+
+      * ``out_ids[b]``   — sorted unique GLOBAL dst ids this shard's edges
+        touch inside b: the rows this shard must GATHER from b's owner.
+      * ``in_ids[b]``    — sorted unique GLOBAL row ids of THIS shard that
+        have >= 1 edge into b: the rows this shard must SEND to b's owner.
+        By edge symmetry in_ids(s)[b] == out_ids(b)[s] — each side of an
+        exchange derives its half from its OWN blob, which is what keeps
+        the per-host files_read isolation contract intact.
+      * ``edge_counts[b]`` — directed edges from this shard into b, so
+        every host agrees on padded 2D edge-block geometry manifest-only.
+
+    A ``None`` list is the capped-buffer overflow sentinel (the bake's
+    per-pair cap was exceeded): consumers degrade that pair to the full
+    dst block — correctness is never cap-dependent, only bytes."""
+
+    out_ids: Tuple[Optional[np.ndarray], ...]
+    in_ids: Tuple[Optional[np.ndarray], ...]
+    edge_counts: Tuple[int, ...]
+
+
+@dataclasses.dataclass(frozen=True)
+class ShardClosureLists:
+    """Closure lists for a host's shard range, same files_read isolation
+    contract as HostShard: exactly the owned shards' closure blobs are
+    opened. ``cap`` echoes the bake's per-pair cap (0 = uncapped)."""
+
+    shards: Dict[int, "ShardClosure"]
+    cap: int
+    files_read: Tuple[str, ...]
 
 
 class GraphStore:
@@ -330,6 +372,24 @@ class GraphStore:
             "shard_edge_counts": [
                 int(e["edges"]) for e in self.manifest["shards"]
             ],
+            # 2D-partition closure summary (ISSUE 16): per-pair touched-row
+            # counts straight off the manifest so `cli preflight` prices
+            # the closure exchange exactly (-1 = capped-overflow pair ->
+            # consumers degrade it to the full dst block).
+            "closure": (
+                {
+                    "baked": True,
+                    "cap": int(
+                        self.manifest.get("closure", {}).get("cap", 0)
+                    ),
+                    "pair_counts": [
+                        [int(c) for c in e["closure"]["out_counts"]]
+                        for e in self.manifest["shards"]
+                    ],
+                }
+                if all("closure" in e for e in self.manifest["shards"])
+                else {"baked": False}
+            ),
         }
 
     def load_shard_range(
@@ -453,6 +513,78 @@ class GraphStore:
             cap=meta.get("cap"),
             seed=meta.get("seed"),
             files_read=tuple(files_read),
+        )
+
+    def load_closure_lists(
+        self,
+        first_shard: int = 0,
+        last_shard: Optional[int] = None,
+        verify: bool = True,
+    ) -> ShardClosureLists:
+        """The ingest-baked neighborhood-closure gather lists of shards
+        [first_shard, last_shard), reading ONLY those shards' closure
+        blobs (ISSUE 16 — the 2D partition's per-host exchange sets).
+
+        Raises ValueError with a re-ingest hint on caches compiled before
+        format v3 or with the closure bake disabled — the 2D trainers
+        degrade to streaming the lists from the host's own CSR instead."""
+        S = self.num_shards
+        last = S if last_shard is None else last_shard
+        if not (0 <= first_shard < last <= S):
+            raise ValueError(
+                f"shard range [{first_shard}, {last}) outside [0, {S})"
+            )
+        entries = self.manifest["shards"][first_shard:last]
+        if any("closure" not in e for e in entries):
+            raise ValueError(
+                f"{self.directory}: cache has no baked closure gather "
+                "lists (compiled before format v3, or with the closure "
+                "bake disabled) — re-ingest to bake closures "
+                "(`python -m bigclam_tpu.cli ingest`); the 2D trainers "
+                "fall back to streaming the lists from the cached CSR"
+            )
+        files_read: List[str] = []
+        cap = int(self.manifest.get("closure", {}).get("cap", 0))
+        shards: Dict[int, ShardClosure] = {}
+        for i, e in enumerate(entries):
+            meta = e["closure"]
+            ids = np.asarray(
+                self._load_blob(
+                    meta["ids"], e["crc32"].get("closure"), verify, False,
+                    files_read, shard=first_shard + i,
+                ),
+                np.int32,
+            )
+            out_counts = np.asarray(meta["out_counts"], dtype=np.int64)
+            in_counts = np.asarray(meta["in_counts"], dtype=np.int64)
+            lens = np.concatenate(
+                [np.maximum(out_counts, 0), np.maximum(in_counts, 0)]
+            )
+            if int(lens.sum()) != ids.shape[0]:
+                raise ShardCorruption(
+                    f"{self.directory}: shard {first_shard + i} closure "
+                    f"blob holds {ids.shape[0]} ids, manifest counts sum "
+                    f"to {int(lens.sum())} — cache corrupted; re-run "
+                    "ingest",
+                    shard=first_shard + i,
+                )
+            bounds = np.concatenate([[0], np.cumsum(lens)])
+            parts = [
+                ids[bounds[j]:bounds[j + 1]] for j in range(lens.size)
+            ]
+            shards[first_shard + i] = ShardClosure(
+                out_ids=tuple(
+                    None if c < 0 else parts[b]
+                    for b, c in enumerate(out_counts)
+                ),
+                in_ids=tuple(
+                    None if c < 0 else parts[S + b]
+                    for b, c in enumerate(in_counts)
+                ),
+                edge_counts=tuple(int(c) for c in meta["edge_counts"]),
+            )
+        return ShardClosureLists(
+            shards=shards, cap=cap, files_read=tuple(files_read)
         )
 
     def load_raw_ids(self, verify: bool = True) -> np.ndarray:
@@ -813,6 +945,7 @@ class GraphStore:
                 "touched_rows": np.empty(0, dtype=np.int64),
                 "touched_frac": 0.0,
                 "phi_rebaked_shards": [],
+                "closure_rebaked_shards": [],
                 "files_read": tuple(files_read),
                 "seconds": round(time.perf_counter() - t0, 4),
             }
@@ -902,6 +1035,18 @@ class GraphStore:
                 profile=profile, only_shards=set(touched_shards),
             )
             rebaked = touched_shards
+        # touched-shard closure re-bake: EXACT (a shard's closure depends
+        # only on its own edge lists, and deltas symmetrize, so every
+        # shard whose lists changed is in touched_shards)
+        closure_rebaked: List[int] = []
+        if touched_shards and self.manifest.get("closure", {}).get("baked"):
+            bake_closure_lists(
+                self.directory, self.manifest["shards"],
+                self.rows_per_shard,
+                cap=int(self.manifest["closure"].get("cap", 0)),
+                profile=profile, only_shards=set(touched_shards),
+            )
+            closure_rebaked = touched_shards
         _atomic_json(
             os.path.join(self.directory, MANIFEST_NAME), self.manifest
         )
@@ -918,6 +1063,7 @@ class GraphStore:
                 round(touched_rows.size / n, 6) if n else 0.0
             ),
             "phi_rebaked_shards": rebaked,
+            "closure_rebaked_shards": closure_rebaked,
             "files_read": tuple(files_read),
             "seconds": round(seconds, 4),
         }
@@ -1179,6 +1325,110 @@ def bake_seed_scores(
 
 
 # --------------------------------------------------------------------------
+# closure bake (ISSUE 16): per-shard-pair gather lists next to the shards
+# --------------------------------------------------------------------------
+
+
+def _closure_name(s: int) -> str:
+    return f"shard_{s:05d}.closure.npy"
+
+
+def closure_pair_lists(
+    lo: int,
+    indptr: np.ndarray,
+    indices: np.ndarray,
+    rows_per_shard: int,
+    num_shards: int,
+    cap: int = 0,
+):
+    """Per dst-shard closure lists of ONE shard's CSR — the single
+    derivation shared by the ingest bake and the 2D trainers' v2
+    streaming fallback (they must never diverge: the send side of an
+    exchange is the mirror of some other shard's gather side).
+
+    Returns (out_ids, in_ids, edge_counts) over peer shards b:
+    out_ids[b] = sorted unique GLOBAL dst ids in b, in_ids[b] = sorted
+    unique GLOBAL src rows of this shard with an edge into b,
+    edge_counts[b] = directed edges into b. cap > 0 replaces any list
+    longer than cap with None (the overflow sentinel — consumers degrade
+    that pair to the full dst block)."""
+    S = num_shards
+    dx = np.asarray(indices, dtype=np.int64)
+    src = lo + np.repeat(
+        np.arange(indptr.shape[0] - 1, dtype=np.int64), np.diff(indptr)
+    )
+    dshard = dx // rows_per_shard
+    order = np.argsort(dshard, kind="stable")
+    dx_s, src_s, dshard_s = dx[order], src[order], dshard[order]
+    bounds = np.searchsorted(dshard_s, np.arange(S + 1))
+    out_ids: List[Optional[np.ndarray]] = []
+    in_ids: List[Optional[np.ndarray]] = []
+    edge_counts: List[int] = []
+    for b in range(S):
+        sl = slice(int(bounds[b]), int(bounds[b + 1]))
+        edge_counts.append(sl.stop - sl.start)
+        out = np.unique(dx_s[sl])
+        ins = np.unique(src_s[sl])
+        out_ids.append(None if cap and out.size > cap else out)
+        in_ids.append(None if cap and ins.size > cap else ins)
+    return out_ids, in_ids, edge_counts
+
+
+def bake_closure_lists(
+    cache_dir: str,
+    shard_table: List[dict],
+    rows_per_shard: int,
+    cap: int = 0,
+    profile=None,
+    only_shards=None,
+) -> None:
+    """Bake per-shard closure blobs next to the CSR blobs (mutates
+    `shard_table` entries in place with "closure" metadata + crcs; the
+    caller writes the manifest).
+
+    One sweep per shard over its OWN blobs only — O(S) blob loads total,
+    and a touched-shard delta rebake (`only_shards`, mirroring the phi
+    rebake contract) is exact because a shard's closure depends on
+    nothing but its own edge lists. The blob is a single int32 npy:
+    concat(out lists for b=0..S-1, then in lists), with lengths in the
+    manifest entry (-1 marks a capped-overflow pair whose list is
+    omitted)."""
+    S = len(shard_table)
+    for s, e in enumerate(shard_table):
+        if only_shards is not None and s not in only_shards:
+            continue
+        lo, hi = int(e["lo"]), int(e["hi"])
+        ip = np.load(os.path.join(cache_dir, e["indptr"])).astype(
+            np.int64, copy=False
+        )
+        dx = np.load(os.path.join(cache_dir, e["indices"]))
+        out_ids, in_ids, edge_counts = closure_pair_lists(
+            lo, ip, dx, rows_per_shard, S, cap=cap
+        )
+        parts = [a for a in out_ids + in_ids if a is not None]
+        blob = (
+            np.concatenate(parts)
+            if parts
+            else np.empty(0, dtype=np.int64)
+        ).astype(np.int32)
+        name = _closure_name(s)
+        np.save(os.path.join(cache_dir, name), blob)
+        e["closure"] = {
+            "ids": name,
+            "out_counts": [
+                -1 if a is None else int(a.size) for a in out_ids
+            ],
+            "in_counts": [
+                -1 if a is None else int(a.size) for a in in_ids
+            ],
+            "edge_counts": [int(c) for c in edge_counts],
+        }
+        e["crc32"]["closure"] = _crc32_file(os.path.join(cache_dir, name))
+        if profile is not None:
+            profile.sample_rss()
+
+
+# --------------------------------------------------------------------------
 # compile: text -> cache, out of core
 # --------------------------------------------------------------------------
 
@@ -1265,6 +1515,8 @@ def compile_graph_cache(
     seed_bake: bool = True,
     seed_cap: Optional[int] = None,
     seed: int = 0,
+    closure_bake: bool = True,
+    closure_cap: int = 0,
 ) -> GraphStore:
     """Compile a SNAP edge list into a binary shard cache, out of core.
 
@@ -1286,6 +1538,13 @@ def compile_graph_cache(
                re-streaming the graph. seed_cap engages the degree-capped
                splitmix64 estimator (exact when cap >= max degree); `seed`
                is the cfg-level PRNG seed its stream derives from
+      closure_bake (closure_bake=True, the default) per-shard-pair
+               neighborhood-closure gather lists baked next to the shards
+               (bake_closure_lists — one sweep per shard over its own
+               blobs), so the 2D-partition trainers read exchange sets
+               instead of re-deriving them. closure_cap bounds the
+               per-pair list length (0 = uncapped; overflow pairs degrade
+               to full-block exchange)
 
     Shard s owns node rows [s*rows, (s+1)*rows) with
     rows = ceil(max(N, num_shards) / num_shards) — exactly the contiguous
@@ -1328,7 +1587,7 @@ def compile_graph_cache(
         return _compile(
             text_path, cache_dir, spill_dir, manifest_path, num_shards,
             chunk_bytes, workers, balance, profile, seed_bake, seed_cap,
-            seed,
+            seed, closure_bake, closure_cap,
         )
     finally:
         shutil.rmtree(spill_dir, ignore_errors=True)
@@ -1337,6 +1596,7 @@ def compile_graph_cache(
 def _compile(
     text_path, cache_dir, spill_dir, manifest_path, num_shards,
     chunk_bytes, workers, balance, profile, seed_bake, seed_cap, seed,
+    closure_bake, closure_cap,
 ) -> GraphStore:
     # --- scan: parse chunks, spill raw pairs, merge unique raw ids ---
     chunk_paths: List[str] = []
@@ -1500,6 +1760,15 @@ def _compile(
             )
             profile.sample_rss()
 
+    # --- closure bake: 2D-partition gather lists (ISSUE 16) ---
+    if closure_bake:
+        with profile.stage("closure_bake"):
+            bake_closure_lists(
+                cache_dir, shard_table, rows, cap=closure_cap,
+                profile=profile,
+            )
+            profile.sample_rss()
+
     manifest = {
         "format_version": MANIFEST_VERSION,
         "num_nodes": n,
@@ -1517,6 +1786,11 @@ def _compile(
             if seed_bake
             else {"baked": False, "skipped": bake_skipped}
             if bake_skipped
+            else {"baked": False}
+        ),
+        "closure": (
+            {"baked": True, "cap": int(closure_cap)}
+            if closure_bake
             else {"baked": False}
         ),
         "source": {

@@ -81,7 +81,13 @@ DEFAULTS: Dict[str, float] = {
 def wire_bytes(op: str, payload_bytes: float, participants: int) -> float:
     """Per-device wire bytes of ONE occurrence of a collective moving a
     `payload_bytes` local array over `participants` (see module
-    docstring for the conventions). Size-1 axes cost nothing."""
+    docstring for the conventions). Size-1 axes cost nothing.
+
+    The 2D partition (ISSUE 16) adds three single-pass ops: a
+    `reduce_scatter`/`psum_scatter` of an s-byte local array sends
+    s*(p-1)/p (each device keeps its own 1/p slice — half a ring
+    allreduce), and an `all_to_all` of an s-byte local buffer likewise
+    moves s*(p-1)/p (the self slice never touches the wire)."""
     p = max(int(participants), 1)
     if p <= 1:
         return 0.0
@@ -91,6 +97,8 @@ def wire_bytes(op: str, payload_bytes: float, participants: int) -> float:
         return float(payload_bytes)
     if op in ("psum", "pmax", "pmin"):
         return 2.0 * float(payload_bytes) * (p - 1) / p
+    if op in ("reduce_scatter", "psum_scatter", "all_to_all"):
+        return float(payload_bytes) * (p - 1) / p
     raise ValueError(f"unknown collective op {op!r}")
 
 
@@ -186,11 +194,16 @@ def sharded_step_model(
     edge_slots: int = 0,
     health_every: int = 0,
     model: str = "ShardedBigClamModel",
+    health_participants: Optional[int] = None,
 ) -> CommsModel:
     """Collective sites of the all-gather sharded step (parallel/sharded
     .py, XLA and CSR schedules — same collectives at tp == 1; tp > 1
     adds the per-edge partial-dot psums over "k"). `edge_slots` is the
-    PER-SHARD padded edge-slot count (only the tp > 1 sites read it)."""
+    PER-SHARD padded edge-slot count (only the tp > 1 sites read it).
+    `health_participants` is the device count of the health-pack psums —
+    they run OUTSIDE shard_map on the global arrays, so the reduction
+    spans the whole mesh (dp*tp), not just the node axis; None keeps the
+    historical dp default for callers that never shard "k"."""
     n_loc = n_pad // max(dp, 1)
     k_loc = k_pad // max(tp, 1)
     sites = [
@@ -219,7 +232,7 @@ def sharded_step_model(
     if health_every and health_every > 0:
         sites.append(Site(
             "sharded/psum_health", "psum", 3 * 4, 1.0 / health_every,
-            dp, "health", "nodes",
+            int(health_participants or dp), "health", "mesh",
         ))
     return CommsModel(
         family="sharded", model=model, sites=tuple(sites),
@@ -238,6 +251,7 @@ def ring_step_model(
     bucket_slots: int = 0,
     health_every: int = 0,
     model: str = "RingBigClamModel",
+    health_participants: Optional[int] = None,
 ) -> CommsModel:
     """Collective sites of the ring-pass step (parallel/ring.py): the
     F-shard rotation replaces the all-gather — two full rotations per
@@ -277,7 +291,7 @@ def ring_step_model(
     if health_every and health_every > 0:
         sites.append(Site(
             "ring/psum_health", "psum", 3 * 4, 1.0 / health_every,
-            dp, "health", "nodes",
+            int(health_participants or dp), "health", "mesh",
         ))
     return CommsModel(
         family="ring", model=model, sites=tuple(sites),
@@ -298,6 +312,7 @@ def sparse_step_model(
     support_every: int = 1,
     health_every: int = 0,
     model: str = "SparseShardedBigClamModel",
+    health_participants: Optional[int] = None,
 ) -> CommsModel:
     """Collective sites of the sparse-representation sharded step
     (parallel/sparse_sharded.py + sparse_collectives.py). The member
@@ -343,18 +358,89 @@ def sparse_step_model(
     if health_every and health_every > 0:
         # support-churn psum runs every step when health is on (the
         # latch needs it); grad stats ride the cadence
+        hp = int(health_participants or dp)
         sites.append(Site(
-            "sparse/psum_health", "psum", 4, 1, dp, "health", "nodes",
+            "sparse/psum_health", "psum", 4, 1, hp, "health", "mesh",
         ))
         sites.append(Site(
             "sparse/psum_grad_stats", "psum", 3 * 4,
-            1.0 / max(int(health_every), 1), dp, "health", "nodes",
+            1.0 / max(int(health_every), 1), hp, "health", "mesh",
         ))
     return CommsModel(
         family="sparse", model=model, sites=tuple(sites),
         params={"n_pad": n_pad, "m": m, "k_pad": k_pad, "dp": dp,
                 "itemsize": itemsize, "cap": cap, "mode": mode,
                 "support_every": sup},
+    )
+
+
+def twod_step_model(
+    n_pad: int,
+    k_pad: int,
+    rows: int,
+    cols: int,
+    itemsize: int,
+    num_candidates: int,
+    edge_slots: int = 0,
+    closure_cap: int = 1,
+    health_every: int = 0,
+    model: str = "TwoDShardedBigClamModel",
+    row_bytes: Optional[float] = None,
+) -> CommsModel:
+    """Collective sites of the 2D edge-block step (parallel/twod.py).
+    `row_bytes` overrides the per-row wire width of the F gather and
+    the closure exchange (default k_pad * itemsize) — the sparse
+    preflight prices its m ids+weights member rows through the same
+    schedule.
+
+    The communication-avoiding trade against the 1D all-gather, per
+    device per step: the dense (n_pad/p)*k_pad gather shrinks by the
+    row-group factor (participants cols, not p) and the rest of F moves
+    only as the CAPPED closure all_to_all over rows — closure_cap rows
+    per peer group instead of whole blocks. The price is the
+    partial-group grad psum plus the candidate/LLH psum_scatters over
+    cols (zero at cols == 1), which is why `cli preflight` prices both
+    layouts instead of assuming 2d wins everywhere."""
+    p = max(rows * cols, 1)
+    n_blk = n_pad // p
+    n_row = cols * n_blk
+    rb = float(row_bytes) if row_bytes else float(k_pad * itemsize)
+    sites = [
+        # processor row's src rows: 1/rows of the 1D dense gather
+        Site("twod/allgather_srcF", "all_gather",
+             n_blk * rb, 1, cols, "gather", "cols"),
+        # capped closure exchange: the (rows, cap, k) send buffer, self
+        # slice never on the wire
+        Site("twod/alltoall_closure", "all_to_all",
+             rows * closure_cap * rb, 1, rows,
+             "exchange", "rows"),
+        # row-group gradient completion (full psum: the candidate pass
+        # re-reads grad at every group src row)
+        Site("twod/psum_grad", "psum",
+             n_row * k_pad * itemsize, 1, cols, "reduce", "cols"),
+        # tentpole (c): candidate/LLH accumulators reduced AND scattered
+        # in one pass — each chip keeps only its own block's columns
+        Site("twod/psum_scatter_cand", "psum_scatter",
+             num_candidates * n_row * itemsize, 1, cols,
+             "reduce", "cols"),
+        Site("twod/psum_scatter_nbr_llh", "psum_scatter",
+             n_row * itemsize, 1, cols, "reduce", "cols"),
+        Site("twod/psum_sumF", "psum",
+             k_pad * itemsize, 2, p, "reduce", "mesh"),
+        Site("twod/psum_scalars", "psum",
+             _scalar_payload(itemsize, num_candidates), 1, p,
+             "reduce", "mesh"),
+    ]
+    if health_every and health_every > 0:
+        sites.append(Site(
+            "twod/psum_health", "psum", 3 * 4, 1.0 / health_every,
+            p, "health", "mesh",
+        ))
+    return CommsModel(
+        family="twod", model=model, sites=tuple(sites),
+        params={"n_pad": n_pad, "k_pad": k_pad, "rows": rows,
+                "cols": cols, "itemsize": itemsize,
+                "edge_slots": edge_slots, "closure_cap": closure_cap},
     )
 
 
@@ -388,6 +474,14 @@ def measured_payloads(family: str, state) -> Dict[str, float]:
             out["ring/ppermute_F_rot"] = f
         if s is not None:
             out["ring/psum_sumF"] = s
+    elif family == "twod":
+        # the F block IS the all_gather payload (and the per-row unit of
+        # the closure exchange); the closure send buffer itself is a step
+        # transient, not state — it stays modeled
+        if f is not None:
+            out["twod/allgather_srcF"] = f
+        if s is not None:
+            out["twod/psum_sumF"] = s
     return out
 
 

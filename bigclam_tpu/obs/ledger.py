@@ -164,6 +164,12 @@ def build_record(
         # outcome (None on single-chip runs — matches on the None)
         "processes": int(report.get("processes", 1) or 1),
         "mesh": final.get("mesh"),
+        # node-axis partition (ISSUE 16): a 2D (rows, cols) closure-
+        # gather run moves a fraction of the 1D all-gather's bytes at
+        # equal device count — its step times and comms totals must
+        # never baseline against a 1D run of the same cfg/mesh. None
+        # (1D entry points that predate the stamp) matches only None
+        "partition": final.get("partition"),
         "wall_s": float(report.get("wall_s", 0.0) or 0.0),
         "steps": len(secs),
         "step_p10": _round6(_percentile(secs, 10)),
@@ -336,6 +342,10 @@ def match_key(rec: Dict[str, Any]) -> Tuple:
         # as every match-key widening
         rec.get("processes"),
         rec.get("mesh"),
+        # node-axis partition (ISSUE 16): 1d vs 2d runs do different
+        # collective work at equal mesh size — None (pre-r20 records)
+        # matches only None, the usual rebaseline rule
+        rec.get("partition"),
         # the resolved edge-kernel path (ISSUE 13): fused vs split vs
         # xla runs do different per-edge work — None (pre-r17 records /
         # entry points that never stamp it) matches only None, the same

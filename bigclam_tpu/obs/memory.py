@@ -575,6 +575,80 @@ def sparse_memory_model(
     )
 
 
+def twod_memory_model(
+    n_pad: int,
+    k_pad: int,
+    rows: int,
+    cols: int,
+    itemsize: int,
+    num_candidates: int,
+    graph_bytes: Dict[str, float],
+    closure_cap: int = 1,
+    m: int = 0,
+    health_on: bool = False,
+    donate: bool = True,
+    rollback: bool = False,
+    fd_bytes: float = 0.0,
+    comms: Optional[CommsModel] = None,
+    model: str = "TwoDShardedBigClamModel",
+) -> MemoryModel:
+    """2D edge-block trainer (parallel.twod): the O(N * K_loc) gathered
+    F of the 1D schedule is replaced by the processor row's own src rows
+    (cols blocks) plus the CAPPED closure table (rows * cap rows) — the
+    memory claim that pairs with twod_step_model's wire claim. With
+    m > 0 this prices the sparse-representation layout (member rows of
+    m ids+weights instead of k_pad floats) — forward-looking preflight
+    pricing; the wired 2d trainer is dense."""
+    p = max(rows * cols, 1)
+    n_blk = n_pad // p
+    row_b = m * (4.0 + itemsize) if m else float(k_pad * itemsize)
+    feat = m if m else k_pad
+    state = (
+        sparse_state_buffers(n_pad, m, k_pad, p, itemsize,
+                             num_candidates, health_on)
+        if m else
+        dense_state_buffers(n_pad, k_pad, p, 1, itemsize,
+                            num_candidates, health_on)
+    )
+    buffers = (
+        state
+        + _graph_buffers(graph_bytes)
+        + _scratch_buffers(_total(state), donate, rollback)
+        + ([Buffer(
+            "transient/F_rowgather", cols * n_blk * row_b, "transient",
+            note="processor row's src rows — 1/rows of the 1D "
+                 "F_allgather, the schedule's whole point",
+        )] if cols > 1 else [])
+        + [
+            Buffer(
+                "transient/closure_recv", rows * closure_cap * row_b,
+                "transient",
+                note="capped closure table (rows * cap dst rows); the "
+                     "send staging lives only across the exchange and "
+                     "is the collective/in_flight buffer below",
+            ),
+            Buffer(
+                "transient/grad_row", cols * n_blk * feat * itemsize,
+                "transient",
+                note="row-group gradient before the cols psum",
+            ),
+            Buffer(
+                "transient/candidates",
+                num_candidates * cols * n_blk * itemsize, "transient",
+            ),
+        ]
+        + _fd_buffers(fd_bytes, False, "per-block closure-row gather")
+        + collective_buffers(comms)
+    )
+    return MemoryModel(
+        family="twod", model=model, buffers=tuple(buffers),
+        params={"n_pad": n_pad, "k_pad": k_pad, "rows": rows,
+                "cols": cols, "itemsize": itemsize, "m": m,
+                "closure_cap": closure_cap, "donate": donate,
+                "rollback": rollback},
+    )
+
+
 # -------------------------------------------------------- host RSS model
 def ingest_rss_bytes(
     chunk_bytes: int, n: int, directed_edges: int, num_shards: int
@@ -856,6 +930,9 @@ def preflight(
     chunk_bytes: int = 0,
     csr_block_b: int = 256,
     rows_per_shard: int = 0,
+    partition: str = "1d",
+    replica_cols: int = 1,
+    closure_pair_counts: Optional[Sequence[Sequence[int]]] = None,
 ) -> Dict[str, Any]:
     """The jax-free capacity verdict (`cli preflight`): build the same
     memory + comms models the trainer would bake, from workload numbers
@@ -871,6 +948,26 @@ def preflight(
     sparse = representation == "sparse"
     if sparse:
         tp = 1
+    partition = str(partition or "1d")
+    cols2 = max(int(replica_cols), 1)
+    if partition not in ("1d", "2d"):
+        raise ValueError(f"unknown partition {partition!r} (1d or 2d)")
+    if partition == "2d":
+        if schedule == "ring":
+            raise ValueError(
+                "partition=2d is its own closure-gather schedule — "
+                "drop --schedule ring"
+            )
+        if tp != 1:
+            raise ValueError(
+                "partition=2d requires tp == 1 (the k axis rides the "
+                "2d mesh unsharded)"
+            )
+        if dp % cols2:
+            raise ValueError(
+                f"replica_cols={cols2} does not divide the {dp}-chip "
+                "mesh"
+            )
     n_pad = _round_up(max(n, dp), dp)
     k_pad = _round_up(k, tp)
     k_loc = k_pad // tp
@@ -893,7 +990,62 @@ def preflight(
         )
 
     # --- graph buffers + comms model per family ---
-    if sparse:
+    if partition == "2d":
+        rows2 = dp // cols2
+        n_blk = n_pad // dp
+        feat2 = m if sparse else k_pad
+        row_b2 = m * (4.0 + itemsize) if sparse else float(k_pad
+                                                           * itemsize)
+        # closure rows per pair: exact requester-group unions are upper
+        # bounded off the baked manifest when given, else the
+        # coupon-collector touched-row estimate on a uniform random
+        # graph — the estimate the COMMS2D gate checks against measured
+        cap2 = 0
+        if closure_pair_counts and len(closure_pair_counts) == dp:
+            for i in range(rows2):
+                for b in range(dp):
+                    tot, over = 0, False
+                    for s in range(i * cols2, (i + 1) * cols2):
+                        c = int(closure_pair_counts[s][b])
+                        if c < 0:
+                            over = True
+                            break
+                        tot += c
+                    cap2 = max(cap2, n_blk if over else min(tot, n_blk))
+        else:
+            e_pair = directed_edges / max(rows2 * dp, 1)
+            cap2 = int(math.ceil(
+                n_blk * (1.0 - math.exp(-e_pair / max(n_blk, 1)))
+            ))
+            notes.append(
+                "closure rows estimated (coupon-collector, uniform "
+                "random graph) — bake closures (`cli ingest`) and pass "
+                "the cache for exact pair counts"
+            )
+        cap2 = max(min(cap2, n_blk), 1)
+        slots, _chunk = _chunk_geometry(max_shard, edge_chunk,
+                                        gather_cols, itemsize)
+        graph = {
+            "graph/edge_blocks": slots * (8.0 + itemsize),
+            "graph/closure_send_idx": float(rows2 * cap2 * 4),
+        }
+        comms = _comms.twod_step_model(
+            n_pad, feat2, rows2, cols2, itemsize, num_candidates,
+            edge_slots=slots, closure_cap=cap2,
+            health_every=health_every, row_bytes=row_b2,
+        ) if dp > 1 else None
+        mm = twod_memory_model(
+            n_pad, k_pad, rows2, cols2, itemsize, num_candidates,
+            graph, closure_cap=cap2, m=m, health_on=health_every > 0,
+            donate=donate, rollback=rollback, comms=comms,
+        )
+        if sparse:
+            notes.append(
+                "sparse x 2d is priced forward-looking — the wired 2d "
+                "trainer is dense (`--partition 2d` without "
+                "--representation sparse)"
+            )
+    elif sparse:
         slots, _chunk = _chunk_geometry(max_shard, edge_chunk, m,
                                         itemsize)
         graph = {"graph/edge_blocks": slots * (8.0 + itemsize)}
@@ -1009,11 +1161,27 @@ def preflight(
                 f"--mesh {dp * 2},{tp}: per-device state/graph shrink "
                 "~1/dp"
             )
-        if schedule != "ring" and dp > 1:
+        if schedule != "ring" and partition == "1d" and dp > 1:
             knobs.append(
                 "--schedule ring: O(2 * N/dp) rotating shards replace "
                 "the full per-device F gather "
                 f"({_fmt_bytes(mm.buffer_bytes().get('transient/F_allgather', 0))})"
+            )
+        if partition == "1d" and dp * tp >= 4:
+            p2 = dp * tp
+            c_hint = int(math.isqrt(p2))
+            while c_hint > 1 and p2 % c_hint:
+                c_hint -= 1
+            gname = ("transient/members_allgather" if sparse
+                     else "transient/F_allgather")
+            gb = mm.buffer_bytes().get(gname, 0)
+            knobs.append(
+                f"--partition 2d --replica-cols {c_hint} (mesh "
+                f"{p2},1): the O(N) "
+                f"{'member' if sparse else 'F'} gather "
+                f"({_fmt_bytes(gb)}) shrinks to the processor row's "
+                "1/rows slice plus the capped closure exchange "
+                "(~3-4/sqrt(p) of the 1D wire at scale)"
             )
     if not fits_host:
         if not store_native:
@@ -1062,6 +1230,8 @@ def preflight(
             "representation": representation,
             **({"sparse_m": m} if sparse else {}),
             "mesh": f"{dp}x{tp}",
+            "partition": partition,
+            **({"replica_cols": cols2} if partition == "2d" else {}),
             "schedule": schedule,
             "store_native": bool(store_native),
             "itemsize": itemsize,

@@ -1,4 +1,4 @@
-from bigclam_tpu.parallel.mesh import make_mesh
+from bigclam_tpu.parallel.mesh import make_mesh, make_mesh_2d
 from bigclam_tpu.parallel.multihost import (
     initialize_distributed,
     load_host_seed_scores,
@@ -15,17 +15,26 @@ from bigclam_tpu.parallel.sharded import (
     StoreShardedBigClamModel,
 )
 from bigclam_tpu.parallel.sparse_sharded import SparseShardedBigClamModel
+from bigclam_tpu.parallel.twod import (
+    StoreTwoDShardedBigClamModel,
+    TwoDShardedBigClamModel,
+    twod_mesh_shape,
+)
 
 __all__ = [
     "initialize_distributed",
     "load_host_seed_scores",
     "load_host_shard",
     "make_mesh",
+    "make_mesh_2d",
     "make_multihost_mesh",
     "put_sharded",
+    "twod_mesh_shape",
     "RingBigClamModel",
     "ShardedBigClamModel",
     "SparseShardedBigClamModel",
     "StoreRingBigClamModel",
     "StoreShardedBigClamModel",
+    "StoreTwoDShardedBigClamModel",
+    "TwoDShardedBigClamModel",
 ]

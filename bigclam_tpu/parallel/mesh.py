@@ -28,6 +28,16 @@ from jax.sharding import Mesh
 NODES_AXIS = "nodes"
 K_AXIS = "k"
 
+# 2D edge-block partitioning (ISSUE 16 / ROADMAP item 4): the node axis is
+# factored into processor rows x replica cols per arXiv:2002.10083. F stays
+# fully sharded over BOTH axes (block b = i*C + j on chip (i, j) — no
+# replication anywhere); "cols" is the replica-group axis for the src-row
+# gather / grad psum / candidate psum_scatter, "rows" is the group axis for
+# the capped closure all_to_all. A trivial size-1 "k" axis keeps the shared
+# 1D helpers (_rowdot, armijo_tail_select_sharded) usable unchanged.
+ROWS_AXIS = "rows"
+COLS_AXIS = "cols"
+
 
 def make_mesh(
     shape: Tuple[int, int] = (1, 1),
@@ -48,3 +58,27 @@ def make_mesh(
         )
     arr = np.asarray(devices).reshape(dp, tp)
     return Mesh(arr, (NODES_AXIS, K_AXIS))
+
+
+def make_mesh_2d(
+    shape: Tuple[int, int],
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """Build a (rows, cols, k=1) mesh for the 2D edge-block partition.
+
+    shape = (dp_rows, replica_cols); their product must equal the device
+    count used. Device order is row-major, so chip (i, j) = device i*C + j
+    owns node block b = i*C + j under P(("rows", "cols")) — the same
+    contiguous block order the 1D node axis uses, which is what makes the
+    C=1 degeneration bit-identical to the 1D schedule. The size-1 "k" axis
+    exists only so axis-named helpers shared with the 1D trainers resolve;
+    2D does not shard the community axis (refused at model build).
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    rows, cols = shape
+    if rows * cols != len(devices):
+        raise ValueError(
+            f"2d mesh shape {shape} needs {rows * cols} devices, got {len(devices)}"
+        )
+    arr = np.asarray(devices).reshape(rows, cols, 1)
+    return Mesh(arr, (ROWS_AXIS, COLS_AXIS, K_AXIS))

@@ -963,6 +963,7 @@ class RingBigClamModel(ShardedBigClamModel):
             bucket_slots=self._bucket_slots_per_phase(),
             health_every=self.cfg.health_every,
             model=type(self).__name__,
+            health_participants=self.mesh.size,
         )
 
     def _build_memory_model(self):

@@ -819,6 +819,23 @@ class ShardedBigClamModel(MemoryAccountedModel):
         # comms Sites just built)
         self._bake_memory_model()
 
+    # ------------------------------------------------- mesh/layout hooks
+    # The 2D edge-block partition (parallel/twod.py, ISSUE 16) reuses this
+    # class's fit/checkpoint/state machinery on a (rows, cols, k) mesh;
+    # everything axis-named goes through these three hooks so the
+    # subclass swaps the layout without forking the plumbing.
+    def _node_shards(self) -> int:
+        """How many ways the node axis is sharded (dp here; R*C in 2D)."""
+        return self.mesh.shape[NODES_AXIS]
+
+    def _fspec(self) -> NamedSharding:
+        """Sharding of F (and any (n_pad, k_pad) state array)."""
+        return NamedSharding(self.mesh, P(NODES_AXIS, K_AXIS))
+
+    def _espec(self) -> NamedSharding:
+        """Sharding of the (shards, C, chunk) edge-block arrays."""
+        return NamedSharding(self.mesh, P(NODES_AXIS, None, None))
+
     @property
     def engaged_path(self) -> str:
         """Edge-sweep implementation this trainer compiled (see
@@ -860,6 +877,7 @@ class ShardedBigClamModel(MemoryAccountedModel):
             edge_slots=self._edge_slots_per_shard(),
             health_every=self.cfg.health_every,
             model=type(self).__name__,
+            health_participants=self.mesh.size,
         )
 
     def _shard_edge_counts(self) -> np.ndarray:
@@ -867,7 +885,7 @@ class ShardedBigClamModel(MemoryAccountedModel):
         the balance event's work distribution (the store trainers read
         the manifest instead: no global CSR exists there)."""
         return shard_edge_counts(
-            self.g.src, self.n_pad, self.mesh.shape[NODES_AXIS]
+            self.g.src, self.n_pad, self._node_shards()
         )
 
     def _emit_comms_and_balance(self) -> None:
@@ -877,7 +895,7 @@ class ShardedBigClamModel(MemoryAccountedModel):
         _comms.emit_model(self.comms)
         if _obs.current() is None:
             return
-        dp = self.mesh.shape[NODES_AXIS]
+        dp = self._node_shards()
         fields = dict(self._pad_stats or {})
         fields["model"] = type(self).__name__
         fields["dp"] = dp
@@ -1335,8 +1353,7 @@ class ShardedBigClamModel(MemoryAccountedModel):
         assert F0.shape == (n, k), (F0.shape, (n, k))
         F_host = np.zeros((self.n_pad, self.k_pad), dtype=np.float64)
         F_host[:n, :k] = self._to_internal_rows(F0)
-        fspec = NamedSharding(self.mesh, P(NODES_AXIS, K_AXIS))
-        F = put_sharded(F_host.astype(self.dtype), fspec)
+        F = put_sharded(F_host.astype(self.dtype), self._fspec())
         return self.reset_state(F)
 
     def reset_state(self, F: jax.Array) -> TrainState:
@@ -1390,7 +1407,7 @@ class ShardedBigClamModel(MemoryAccountedModel):
             # node-shard count: a run with either different must not restore
             "balanced": self._perm is not None,
             "node_shards": (
-                self.mesh.shape[NODES_AXIS] if self._perm is not None else 0
+                self._node_shards() if self._perm is not None else 0
             ),
             # rng lineage for --resume auto (see BigClamModel._ckpt_meta)
             "seed": self.cfg.seed,
@@ -1405,8 +1422,7 @@ class ShardedBigClamModel(MemoryAccountedModel):
         }
 
     def _state_from_arrays(self, arrays: dict) -> TrainState:
-        fspec = NamedSharding(self.mesh, P(NODES_AXIS, K_AXIS))
-        F = put_sharded(np.asarray(arrays["F"], self.dtype), fspec)
+        F = put_sharded(np.asarray(arrays["F"], self.dtype), self._fspec())
         return TrainState(
             F=F,
             sumF=F.sum(axis=0),
@@ -1520,9 +1536,8 @@ class _StoreBackedMixin:
         the step builder both need it), after checking the mesh places
         this process's rows where process-major shard ownership says."""
         if self.host_shard is None:
-            dp = self.mesh.shape[NODES_AXIS]
-            espec = NamedSharding(self.mesh, P(NODES_AXIS, None, None))
-            lo_s, hi_s = addressable_row_bounds(espec, (dp, 1, 1))
+            dp = self._node_shards()
+            lo_s, hi_s = addressable_row_bounds(self._espec(), (dp, 1, 1))
             ids = host_shard_ids(dp)
             if (ids.start, ids.stop) != (lo_s, hi_s):
                 raise ValueError(
@@ -1549,7 +1564,7 @@ class _StoreBackedMixin:
         if F0 is not None:
             return super().init_state(F0)
         n, k = self.g.num_nodes, self.cfg.num_communities
-        fspec = NamedSharding(self.mesh, P(NODES_AXIS, K_AXIS))
+        fspec = self._fspec()
         lo, hi = addressable_row_bounds(
             fspec, (self.n_pad, self.k_pad)
         )

@@ -442,6 +442,7 @@ class SparseShardedBigClamModel(SparseBigClamModel):
             support_every=self.cfg.support_every,
             health_every=self.cfg.health_every,
             model=type(self).__name__,
+            health_participants=self.mesh.size,
         )
 
     def comms_measured(self, state: SparseTrainState):

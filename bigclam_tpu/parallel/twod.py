@@ -1,0 +1,855 @@
+"""Communication-avoiding 2D edge-block partitioning (ISSUE 16).
+
+Kills the dense F all-gather for the large-K regime. The 1D sharded step
+(parallel/sharded.py) all-gathers the FULL (N_pad, K_pad) F every
+iteration — (p-1)/p * N*K*itemsize per chip per step, flat in p: at
+Friendster scale with K = 25,000 that one transient is the capacity wall
+long before FLOPs are. Here the node axis is factored into R processor
+rows x C replica cols (the classic 2D SpMM factorization, arXiv:2002.10083
+lineage) and each chip exchanges only
+
+  * its processor row's F rows  — all_gather over "cols",  N*K/(R*C) * (C-1)
+    wire bytes: 1/R of the 1D gather, and
+  * the CLOSURE of its edge block's dst columns — a capped all_to_all over
+    "rows" of just the rows some edge actually touches (gather lists baked
+    at ingest, graph/store.bake_closure_lists).
+
+Layout (mesh from parallel.mesh.make_mesh_2d — axes "rows" x "cols" x a
+trivial size-1 "k" so helpers shared with the 1D families resolve):
+
+  F          (N_pad, K_pad)  sharded P(("rows","cols"), "k") — block
+                             b = i*C + j on chip (i, j); NO replication
+                             anywhere (the accumulator/scratch state is
+                             likewise replica-sharded: tentpole (c))
+  edges      (p, c, chunk)   P(("rows","cols")): chip (i, j) owns the edge
+                             BLOCK (src in processor row i's node blocks,
+                             dst in column stripe {b : b % C == j}); src is
+                             stored group-LOCAL, dst as a CLOSURE position
+  send_idx   (p, R, cap)     P(("rows","cols")): block-local rows chip
+                             (i', j) must send each requester row group
+
+Step (chip (i, j)): all_gather F over "cols" -> the C*n_blk src rows of
+row group i; gather own rows listed in send_idx and all_to_all over
+"rows" -> closure_flat, the (R*cap, K) table of every dst row this block
+touches; the same fused grad/LLH + 16-candidate scans as the 1D XLA step
+(dst indices pre-baked as closure positions); partial-group psum of grad
+over "cols"; psum_scatter of the candidate/LLH accumulators over "cols"
+(each chip Armijo-selects ONLY its own n_blk rows); scalar psums over
+both axes. At C == 1 every "cols" collective is skipped at trace time and
+the schedule degenerates to the 1D sharded step bit-for-bit (pinned by
+scripts/comms2d_gate.py).
+
+The whole schedule is expressible in shard_map over named axes —
+lax.all_gather / lax.all_to_all / lax.psum_scatter partial-group
+collectives all accept a single mesh axis — so no jax custom_partitioning
+escape hatch is needed (DESIGN.md records the analysis). The fused Pallas
+superstep is NOT wired to this mesh: the closure table is laid out as the
+flat row table its dst-DMA consumes, but the kernels ride the 1d families
+for now (explicit path_reason fallback; use_pallas_csr=True refuses).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from bigclam_tpu.config import BigClamConfig
+from bigclam_tpu.graph.csr import Graph
+from bigclam_tpu.models.bigclam import (
+    TrainState,
+    _round_up,
+    attach_donating,
+    edge_chunk_bound,
+)
+from bigclam_tpu.ops import diagnostics as dx
+from bigclam_tpu.ops.objective import EdgeChunks, edge_terms
+from bigclam_tpu.parallel.mesh import COLS_AXIS, K_AXIS, ROWS_AXIS
+from bigclam_tpu.parallel.multihost import put_host_local, put_sharded
+from bigclam_tpu.parallel.sharded import (
+    ShardedBigClamModel,
+    _StoreBackedMixin,
+    _StoreGraphView,
+    _mark_varying,
+    _rowdot,
+    _shard_health,
+    armijo_tail_select_sharded,
+)
+from bigclam_tpu.utils.compat import shard_map
+
+
+def twod_mesh_shape(cfg: BigClamConfig, num_devices: int) -> Tuple[int, int]:
+    """(R, C) for `num_devices` chips under cfg.replica_cols."""
+    C = max(int(cfg.replica_cols or 1), 1)
+    if num_devices % C:
+        raise ValueError(
+            f"replica_cols={C} does not divide the device count "
+            f"{num_devices}; pick a divisor"
+        )
+    return (num_devices // C, C)
+
+
+@dataclasses.dataclass(frozen=True)
+class TwoDLayout:
+    """Host-side 2D edge-block layout: the (blocks, c, chunk) edge arrays
+    (global rows from twod_shard_edges, this host's rows from
+    twod_shard_edges_local), the (blocks, R, cap) contributor send lists,
+    and the telemetry counts the comms/balance models price from."""
+
+    edges: EdgeChunks
+    send_idx: np.ndarray
+    cap: int
+    block_edge_counts: np.ndarray      # per edge block, row-major (i, j)
+    closure_rows: int                  # real (unpadded) closure rows/step
+
+
+def _remap_dst(dsel: np.ndarray, unions, n_blk: int, C: int,
+               cap: int) -> np.ndarray:
+    """Global dst ids -> closure positions i_con*cap + rank-in-union."""
+    pos = np.empty(dsel.shape[0], dtype=np.int64)
+    icon = (dsel // n_blk) // C
+    for i_con in np.unique(icon):
+        sel = icon == i_con
+        pos[sel] = i_con * cap + np.searchsorted(
+            unions[int(i_con)], dsel[sel]
+        )
+    return pos
+
+
+def twod_shard_edges(
+    g: Graph,
+    cfg: BigClamConfig,
+    R: int,
+    C: int,
+    n_pad: int,
+    dtype,
+    chunk_bound: int = 0,
+) -> TwoDLayout:
+    """Partition directed edges into R*C edge BLOCKS: block (i, j) holds
+    the edges with src in processor row i's node blocks and dst in column
+    stripe j ({b : b % C == j}).
+
+    CSR order means each row group's edges are one contiguous slice and
+    the stable stripe selection preserves it, so at C == 1 the layout is
+    exactly shard_edges' (same slices, same chunk geometry, same src
+    rebase/padding) — the bit-identity anchor. src is group-LOCAL
+    ([0, C*n_blk); pad = last local row, mask 0); dst is stored as a
+    CLOSURE position i_con*cap + rank (pad 0 — a real gathered row whose
+    contribution is masked to an exact +0.0)."""
+    p = R * C
+    n_blk = n_pad // p
+    group_rows = C * n_blk
+    gsrc = np.asarray(g.src)
+    gdst = np.asarray(g.dst)
+    gb = np.searchsorted(
+        gsrc, np.arange(0, n_pad + group_rows, group_rows)
+    )
+    sel: Dict[Tuple[int, int], Tuple[np.ndarray, np.ndarray]] = {}
+    lists: Dict[Tuple[int, int, int], np.ndarray] = {}
+    counts = np.zeros((R, C), dtype=np.int64)
+    for i in range(R):
+        s_i = gsrc[gb[i]:gb[i + 1]].astype(np.int64)
+        d_i = gdst[gb[i]:gb[i + 1]].astype(np.int64)
+        dblk = d_i // n_blk
+        for j in range(C):
+            m = (dblk % C) == j
+            dsel = d_i[m]
+            sel[(i, j)] = (s_i[m] - i * group_rows, dsel)
+            counts[i, j] = dsel.size
+            icon = dblk[m] // C
+            for i_con in range(R):
+                # union over the group's shards of out(s -> block): the
+                # rows of block (i_con, j) this edge block must gather
+                lists[(i, j, i_con)] = np.unique(dsel[icon == i_con])
+    cap = max(1, max((u.size for u in lists.values()), default=1))
+    max_count = int(counts.max()) if counts.size else 1
+    chunk = min(chunk_bound or cfg.edge_chunk, max(max_count, 1))
+    c = max(1, -(-max_count // chunk))
+    padded = c * chunk
+    src = np.full((p, padded), group_rows - 1, dtype=np.int32)
+    dst = np.zeros((p, padded), dtype=np.int32)
+    mask = np.zeros((p, padded), dtype=np.float32)
+    send_idx = np.zeros((p, R, cap), dtype=np.int32)
+    for i in range(R):
+        for j in range(C):
+            b = i * C + j
+            s_l, d_l = sel[(i, j)]
+            m = s_l.size
+            src[b, :m] = s_l
+            dst[b, :m] = _remap_dst(
+                d_l, {ic: lists[(i, j, ic)] for ic in range(R)},
+                n_blk, C, cap,
+            )
+            mask[b, :m] = 1.0
+            # contributor side of the SAME lists: block b sends each
+            # requester row group the rows that group's edges touch
+            lo_b = b * n_blk
+            for i_req in range(R):
+                u = lists[(i_req, j, i)]
+                send_idx[b, i_req, :u.size] = (u - lo_b).astype(np.int32)
+    return TwoDLayout(
+        edges=EdgeChunks(
+            src=src.reshape(p, c, chunk),
+            dst=dst.reshape(p, c, chunk),
+            mask=mask.reshape(p, c, chunk).astype(dtype),
+        ),
+        send_idx=send_idx,
+        cap=cap,
+        block_edge_counts=counts,
+        closure_rows=int(sum(u.size for u in lists.values())),
+    )
+
+
+def twod_shard_edges_local(
+    shard,
+    pair_lists: Dict[int, tuple],
+    cfg: BigClamConfig,
+    R: int,
+    C: int,
+    n_pad: int,
+    dtype,
+    chunk_bound: int = 0,
+) -> TwoDLayout:
+    """This host's rows of the 2D edge blocks, from a graph-store slice
+    (graph/store.HostShard) — the out-of-core twin of twod_shard_edges:
+    no global CSR exists anywhere.
+
+    `pair_lists` maps each OWNED shard s to its (out_ids, in_ids,
+    edge_counts) closure triple — the ingest-baked v3 lists
+    (GraphStore.load_closure_lists) or the v2 streaming fallback
+    (store.closure_pair_lists on the host's own CSR). Both sides of every
+    exchange come from the host's OWN shards: the gather unions from the
+    requester group's out-lists, the send lists from the owned block's
+    in-lists — identical sets by edge symmetry (in(b)[s] == out(s)[b]),
+    which is what keeps files_read isolation intact. A None pair (the
+    bake's cap overflow) degrades to the FULL dst block on both sides.
+    Padded geometry (chunk count, closure cap) is agreed cross-host via
+    one-int max exchanges (multihost.global_max_int), mirroring the CSR
+    tile pad contract."""
+    from bigclam_tpu.parallel.multihost import global_max_int
+
+    p = R * C
+    n_blk = n_pad // p
+    group_rows = C * n_blk
+    if shard.rows_per_shard != n_blk:
+        raise ValueError(
+            f"cache rows_per_shard={shard.rows_per_shard} != trainer "
+            f"block rows {n_blk} (n_pad={n_pad}, rows*cols={p}); "
+            "recompile the cache with num_shards == rows*cols"
+        )
+    own = list(shard.shard_ids)
+    if own and (own[0] % C or len(own) % C):
+        raise ValueError(
+            "store-native 2d needs every process to own whole processor "
+            f"rows: first owned shard {own[0]} and owned count {len(own)} "
+            f"must be multiples of replica_cols={C} — use dp_rows "
+            "divisible by the process count (or fewer cols)"
+        )
+    n = shard.num_nodes
+
+    def full_block(b: int) -> np.ndarray:
+        return np.arange(b * n_blk, min((b + 1) * n_blk, n), dtype=np.int64)
+
+    def union_over_group(i_req: int, b_con: int, side: int) -> np.ndarray:
+        """Union over requester group i_req's shards of the pair lists
+        against block b_con; side 0 = out (gather), 1 = in (send). The
+        overflow decision matches across sides because the paired lists
+        have equal sizes."""
+        parts = []
+        for s in range(i_req * C, (i_req + 1) * C):
+            lst = (
+                pair_lists[s][0][b_con] if side == 0
+                else pair_lists[b_con][1][s]
+            )
+            if lst is None:
+                return full_block(b_con)
+            parts.append(np.asarray(lst, dtype=np.int64))
+        if not parts:
+            return np.empty(0, dtype=np.int64)
+        return np.unique(np.concatenate(parts))
+
+    groups = range(own[0] // C, (own[-1] + 1) // C) if own else range(0)
+    unions: Dict[Tuple[int, int, int], np.ndarray] = {}
+    for i in groups:
+        for j in range(C):
+            for i_con in range(R):
+                unions[(i, j, i_con)] = union_over_group(
+                    i, i_con * C + j, side=0
+                )
+    sends: Dict[Tuple[int, int], np.ndarray] = {}
+    for b in own:
+        for i_req in range(R):
+            sends[(b, i_req)] = union_over_group(i_req, b, side=1)
+    local_cap = max(
+        [u.size for u in unions.values()]
+        + [u.size for u in sends.values()] + [1]
+    )
+    cap = global_max_int(int(local_cap))
+
+    deg = np.diff(shard.indptr)
+    blocks: Dict[Tuple[int, int], Tuple[np.ndarray, np.ndarray]] = {}
+    counts: Dict[Tuple[int, int], int] = {}
+    for i in groups:
+        glo = min(i * group_rows, n)
+        ghi = min((i + 1) * group_rows, n)
+        e0 = int(shard.indptr[glo - shard.lo])
+        e1 = int(shard.indptr[ghi - shard.lo])
+        srcs = np.repeat(
+            np.arange(glo, ghi, dtype=np.int64),
+            deg[glo - shard.lo: ghi - shard.lo],
+        )
+        dsts = np.asarray(shard.indices[e0:e1], dtype=np.int64)
+        stripe = (dsts // n_blk) % C
+        for j in range(C):
+            m = stripe == j
+            blocks[(i, j)] = (srcs[m] - i * group_rows, dsts[m])
+            counts[(i, j)] = int(m.sum())
+            want = sum(
+                pair_lists[s][2][i_con * C + j]
+                for s in range(i * C, (i + 1) * C)
+                for i_con in range(R)
+            )
+            if counts[(i, j)] != want:
+                raise ValueError(
+                    f"edge block ({i}, {j}): closure lists say {want} "
+                    f"directed edges but the loaded CSR holds "
+                    f"{counts[(i, j)]} — cache inconsistent (partially "
+                    "rebuilt, or loaded with verify=False?)"
+                )
+    max_count = global_max_int(
+        max(list(counts.values()) + [1])
+    )
+    chunk = min(chunk_bound or cfg.edge_chunk, max(max_count, 1))
+    c = max(1, -(-max_count // chunk))
+    padded = c * chunk
+    n_local = len(own)
+    src = np.full((n_local, padded), group_rows - 1, dtype=np.int32)
+    dst = np.zeros((n_local, padded), dtype=np.int32)
+    mask = np.zeros((n_local, padded), dtype=np.float32)
+    send_idx = np.zeros((n_local, R, cap), dtype=np.int32)
+    local_counts = np.zeros(n_local, dtype=np.int64)
+    for row, b in enumerate(own):
+        i, j = b // C, b % C
+        s_l, d_l = blocks[(i, j)]
+        m = s_l.size
+        local_counts[row] = m
+        src[row, :m] = s_l
+        dst[row, :m] = _remap_dst(
+            d_l, {ic: unions[(i, j, ic)] for ic in range(R)},
+            n_blk, C, cap,
+        )
+        mask[row, :m] = 1.0
+        lo_b = b * n_blk
+        for i_req in range(R):
+            u = sends[(b, i_req)]
+            send_idx[row, i_req, :u.size] = (u - lo_b).astype(np.int32)
+    return TwoDLayout(
+        edges=EdgeChunks(
+            src=src.reshape(n_local, c, chunk),
+            dst=dst.reshape(n_local, c, chunk),
+            mask=mask.reshape(n_local, c, chunk).astype(dtype),
+        ),
+        send_idx=send_idx,
+        cap=cap,
+        block_edge_counts=local_counts,
+        closure_rows=int(sum(u.size for u in unions.values())),
+    )
+
+
+def make_twod_train_step(
+    mesh: Mesh, edges: EdgeChunks, send_idx, cfg: BigClamConfig
+) -> Callable[[TrainState], TrainState]:
+    """One jitted 2D-partitioned iteration. Same math as the 1D XLA
+    sharded step — the Jacobi candidate pass, the Armijo acceptance, the
+    segment-sum sweeps are shared or verbatim — with the dense F
+    all-gather replaced by the row-group gather + capped closure
+    all_to_all, and the Armijo accumulators replica-sharded via
+    psum_scatter (tentpole (c): no chip ever holds another block's
+    candidate table past the scatter).
+
+    At C == 1 (and R == 1) every "cols" ("rows") collective is skipped at
+    TRACE time, which with the layout degeneration makes trajectories
+    bit-identical to the 1D sharded step (gate-pinned)."""
+    R = mesh.shape[ROWS_AXIS]
+    C = mesh.shape[COLS_AXIS]
+    cap = int(send_idx.shape[-1])
+    both = (ROWS_AXIS, COLS_AXIS)
+
+    def step_shard(F_blk, src, dst, mask, sidx, it):
+        # squeeze the leading per-block axis shard_map leaves on the blocks
+        src, dst, mask, sidx = src[0], dst[0], mask[0], sidx[0]
+        adt = jnp.dtype(cfg.accum_dtype) if cfg.accum_dtype else F_blk.dtype
+        etas = jnp.asarray(cfg.step_candidates, F_blk.dtype)
+        n_blk = F_blk.shape[0]
+        n_row = C * n_blk
+
+        # row group's src rows: 1/R of the 1D dense gather (skipped whole
+        # at C == 1 — each block is its own row group slice)
+        if C > 1:
+            F_row = lax.all_gather(F_blk, COLS_AXIS, axis=0, tiled=True)
+        else:
+            F_row = F_blk
+        sumF = lax.psum(F_blk.sum(axis=0), both)
+
+        # capped closure exchange: each block sends every requester row
+        # group exactly the rows that group's edges touch (ingest-baked
+        # lists); received table is indexed by the pre-baked dst positions
+        send = F_blk[sidx.reshape(-1)].reshape(R, cap, F_blk.shape[1])
+        if R > 1:
+            closure = lax.all_to_all(
+                send, ROWS_AXIS, split_axis=0, concat_axis=0
+            )
+        else:
+            closure = send
+        closure_flat = closure.reshape(R * cap, F_blk.shape[1])
+
+        def grad_body(carry, sdm):
+            nbr_llh, nbr_grad = carry
+            s, d, m = sdm
+            fs, fd = F_row[s], closure_flat[d]
+            x = lax.psum(jnp.einsum("ek,ek->e", fs, fd), K_AXIS)
+            omp, ell = edge_terms(x, cfg)
+            coeff = m / omp
+            nbr_llh = nbr_llh + jax.ops.segment_sum(
+                (ell * m).astype(adt), s, num_segments=n_row,
+                indices_are_sorted=True,
+            )
+            nbr_grad = nbr_grad + jax.ops.segment_sum(
+                fd * coeff[:, None], s, num_segments=n_row,
+                indices_are_sorted=True,
+            )
+            return (nbr_llh, nbr_grad), None
+
+        (nbr_llh, nbr_grad), _ = lax.scan(
+            grad_body,
+            (
+                _mark_varying(jnp.zeros(n_row, adt), both),
+                _mark_varying(
+                    jnp.zeros((n_row, F_blk.shape[1]), F_blk.dtype), both
+                ),
+            ),
+            (src, dst, mask),
+        )
+        # partial-group reductions: grad rows stay within the row group
+        # ("cols" psum), never crossing processor rows; the per-node LLH
+        # accumulator lands replica-sharded (each chip keeps its block)
+        if C > 1:
+            nbr_grad = lax.psum(nbr_grad, COLS_AXIS)
+            nbr_llh_own = lax.psum_scatter(
+                nbr_llh, COLS_AXIS, scatter_dimension=0, tiled=True
+            )
+        else:
+            nbr_llh_own = nbr_llh
+        grad_row = nbr_grad - sumF[None, :] + F_row
+        if C > 1:
+            j = lax.axis_index(COLS_AXIS)
+            grad_own = lax.dynamic_slice_in_dim(
+                grad_row, j * n_blk, n_blk, axis=0
+            )
+        else:
+            grad_own = grad_row
+        node_llh_own = nbr_llh_own + (
+            -lax.psum(F_blk @ sumF, K_AXIS) + _rowdot(F_blk, F_blk)
+        ).astype(adt)
+        llh_cur = lax.psum(node_llh_own.sum(), both)
+
+        def cand_body(cand, sdm):
+            s, d, m = sdm
+            fs, gs, fd = F_row[s], grad_row[s], closure_flat[d]
+
+            def one_eta(eta):
+                nf = jnp.clip(fs + eta * gs, cfg.min_f, cfg.max_f)
+                xc = lax.psum(jnp.einsum("ek,ek->e", nf, fd), K_AXIS)
+                _, ellc = edge_terms(xc, cfg)
+                return jax.ops.segment_sum(
+                    (ellc * m).astype(adt), s, num_segments=n_row,
+                    indices_are_sorted=True,
+                )
+
+            return cand + lax.map(one_eta, etas), None
+
+        cand_nbr, _ = lax.scan(
+            cand_body,
+            _mark_varying(
+                jnp.zeros((len(cfg.step_candidates), n_row), adt), both
+            ),
+            (src, dst, mask),
+        )
+        # tentpole (c): the (nc, C*n_blk) candidate table is reduced AND
+        # scattered in one collective — each chip keeps only its own
+        # block's columns, so Armijo state is sharded over the replica
+        # axis instead of replicated across it
+        if C > 1:
+            cand_own = lax.psum_scatter(
+                cand_nbr, COLS_AXIS, scatter_dimension=1, tiled=True
+            )
+        else:
+            cand_own = cand_nbr
+
+        F_new, sum_loc, hist = armijo_tail_select_sharded(
+            F_blk, grad_own, node_llh_own, cand_own, sumF, cfg,
+            with_stats=True,
+        )
+        sumF_new = lax.psum(sum_loc, both)
+        hist = lax.psum(hist, both)
+        if dx.health_on(cfg):
+            gstats = dx.gated_grad_stats(
+                cfg, it, grad_own, node_axis=both, k_axis=K_AXIS
+            )
+        else:
+            gstats = dx.zero_grad_stats()
+        return (
+            F_new, sumF_new, llh_cur.astype(F_blk.dtype), it + 1, hist,
+            gstats,
+        )
+
+    nspec = P((ROWS_AXIS, COLS_AXIS), None, None)
+
+    def step(state: TrainState, src, dst, mask, sidx) -> TrainState:
+        F_new, sumF, llh, it, hist, gstats = shard_map(
+            step_shard,
+            mesh=mesh,
+            in_specs=(
+                P((ROWS_AXIS, COLS_AXIS), K_AXIS),
+                nspec, nspec, nspec, nspec, P(),
+            ),
+            out_specs=(
+                P((ROWS_AXIS, COLS_AXIS), K_AXIS),
+                P(K_AXIS), P(), P(), P(), P(),
+            ),
+        )(state.F, src, dst, mask, sidx, state.it)
+        return TrainState(
+            F=F_new, sumF=sumF, llh=llh, it=it, accept_hist=hist,
+            health=_shard_health(cfg, state, F_new, sumF, hist, gstats),
+        )
+
+    # edge/send arrays as jit ARGUMENTS (multi-controller: no closing over
+    # non-addressable-device arrays; see make_sharded_csr_train_step)
+    jitted = jax.jit(step)
+
+    def step_fn(state):
+        return jitted(state, edges.src, edges.dst, edges.mask, send_idx)
+
+    step_fn.jitted = jitted
+    step_fn.jit_args = (edges.src, edges.dst, edges.mask, send_idx)
+    return attach_donating(step_fn, step, fixed_args=step_fn.jit_args)
+
+
+class TwoDShardedBigClamModel(ShardedBigClamModel):
+    """2D edge-block trainer over a (rows, cols, k=1) mesh.
+
+    Same API and math as ShardedBigClamModel — fit/checkpoint/serve
+    machinery is inherited through the mesh/layout hooks — but the step
+    exchanges closure rows instead of all-gathering F. cfg.partition is
+    step-baked: this class refuses to build unless cfg says "2d" (the
+    perf ledger keys on it), and the CSR/fused kernel families refuse
+    with an explicit reason (the closure schedule is XLA-only for now)."""
+
+    def __init__(
+        self,
+        g: Graph,
+        cfg: BigClamConfig,
+        mesh: Mesh,
+        dtype=None,
+        balance: bool = False,
+    ):
+        self.g = g
+        self.cfg = cfg
+        self.mesh = mesh
+        for ax in (ROWS_AXIS, COLS_AXIS, K_AXIS):
+            if ax not in mesh.shape:
+                raise ValueError(
+                    "partition='2d' needs a (rows, cols, k) mesh from "
+                    f"make_mesh_2d; got axes {tuple(mesh.shape)}"
+                )
+        R, C = mesh.shape[ROWS_AXIS], mesh.shape[COLS_AXIS]
+        if mesh.shape[K_AXIS] != 1:
+            raise ValueError(
+                "partition='2d' does not shard the community axis: the "
+                "mesh 'k' axis must be 1 (TP rides the 1d families)"
+            )
+        if cfg.partition != "2d":
+            raise ValueError(
+                f"cfg.partition={cfg.partition!r} on the 2d trainer: the "
+                "step and the perf-ledger match key are partition-baked — "
+                "set partition='2d'"
+            )
+        if cfg.replica_cols != C:
+            raise ValueError(
+                f"cfg.replica_cols={cfg.replica_cols} != mesh cols {C}; "
+                "build the mesh from the config (twod_mesh_shape)"
+            )
+        if cfg.use_pallas_csr is True:
+            raise ValueError(
+                "use_pallas_csr=True is not supported under "
+                "partition='2d': the closure-gather schedule is XLA-only "
+                "— drop the override, or run --partition 1d for the "
+                "fused kernels"
+            )
+        self.R, self.C = R, C
+        self.p = R * C
+        self.dtype = dtype or (
+            jnp.float64 if cfg.dtype == "float64" else jnp.float32
+        )
+        if cfg.min_f != 0.0:
+            raise ValueError("sharded padding requires min_f == 0.0")
+        self.n_pad = _round_up(max(g.num_nodes, self.p), self.p)
+        self.k_pad = cfg.num_communities
+        self._csr_wanted = False
+        self._csr_reason = (
+            "partition=2d runs the XLA closure-gather schedule; the "
+            "fused/CSR kernels ride the 1d families (the closure table "
+            "is already the flat row layout their dst-DMA consumes — "
+            "see DESIGN.md)"
+        )
+        self._perm = None
+        self.g_original = g
+        if balance and self.p > 1:
+            from bigclam_tpu.parallel.balance import balance_graph
+
+            self.g, self._perm = balance_graph(g, self.p, self.n_pad)
+        self._pad_stats = None
+        self._build_edges_and_step()
+        from bigclam_tpu.models.bigclam import (
+            log_engaged_path,
+            step_cfg_key,
+        )
+        from bigclam_tpu.obs import note_step_build
+
+        self._step_cache = {step_cfg_key(self.cfg): self._step}
+        self.path_reason = self._csr_reason
+        note_step_build(self.cfg, type(self).__name__)
+        log_engaged_path(
+            type(self).__name__, self.engaged_path, self.path_reason
+        )
+        self.comms = self._build_comms_model()
+        self._emit_comms_and_balance()
+        self._bake_memory_model()
+
+    # ------------------------------------------------- mesh/layout hooks
+    def _node_shards(self) -> int:
+        return self.p
+
+    def _fspec(self) -> NamedSharding:
+        return NamedSharding(self.mesh, P((ROWS_AXIS, COLS_AXIS), K_AXIS))
+
+    def _espec(self) -> NamedSharding:
+        return NamedSharding(self.mesh, P((ROWS_AXIS, COLS_AXIS), None, None))
+
+    def _memory_dp(self) -> int:
+        return self.p
+
+    @property
+    def engaged_path(self) -> str:
+        return "xla_2d"
+
+    # ------------------------------------------------------ layout/step
+    def _build_edges_and_step(self) -> None:
+        bound = edge_chunk_bound(self.cfg, max(self.k_pad, 1), self.dtype)
+        layout = twod_shard_edges(
+            self.g, self.cfg, self.R, self.C, self.n_pad, np.float32,
+            chunk_bound=bound,
+        )
+        self._commit_layout(
+            layout,
+            src=put_sharded(layout.edges.src, self._espec()),
+            dst=put_sharded(layout.edges.dst, self._espec()),
+            mask=put_sharded(
+                layout.edges.mask.astype(self.dtype), self._espec()
+            ),
+            send=put_sharded(layout.send_idx, self._espec()),
+        )
+
+    def _commit_layout(self, layout: TwoDLayout, src, dst, mask,
+                       send) -> None:
+        from bigclam_tpu.ops.csr_tiles import tile_pad_stats
+
+        self._pad_stats = dict(tile_pad_stats(layout.edges.mask))
+        self._pad_stats["closure_cap"] = int(layout.cap)
+        self._pad_stats["closure_slots_padded"] = (
+            self.p * self.R * int(layout.cap)
+        )
+        self._pad_stats["closure_rows"] = int(layout.closure_rows)
+        self._twod_cap = int(layout.cap)
+        self._block_counts = layout.block_edge_counts
+        self.edges = EdgeChunks(src=src, dst=dst, mask=mask)
+        self._send_idx = send
+        self._step = make_twod_train_step(
+            self.mesh, self.edges, self._send_idx, self.cfg
+        )
+
+    def rebuild_step(self) -> None:
+        from bigclam_tpu.models.bigclam import step_cfg_key
+
+        key = step_cfg_key(self.cfg)
+        cache = self._step_cache
+        if key not in cache:
+            cache[key] = make_twod_train_step(
+                self.mesh, self.edges, self._send_idx, self.cfg
+            )
+            from bigclam_tpu.obs import note_step_build
+
+            note_step_build(self.cfg, type(self).__name__)
+        self._step = cache[key]
+
+    # ------------------------------------------------------ observability
+    def _build_comms_model(self):
+        from bigclam_tpu.obs import comms as _comms
+
+        return _comms.twod_step_model(
+            n_pad=self.n_pad,
+            k_pad=self.k_pad,
+            rows=self.R,
+            cols=self.C,
+            itemsize=jnp.dtype(self.dtype).itemsize,
+            num_candidates=len(self.cfg.step_candidates),
+            edge_slots=self._edge_slots_per_shard(),
+            closure_cap=self._twod_cap,
+            health_every=self.cfg.health_every,
+            model=type(self).__name__,
+        )
+
+    def _shard_edge_counts(self) -> np.ndarray:
+        return np.asarray(self._block_counts, dtype=np.int64).reshape(-1)
+
+    def _graph_device_arrays(self) -> dict:
+        return {
+            "graph/edges_src": self.edges.src,
+            "graph/edges_dst": self.edges.dst,
+            "graph/edges_mask": self.edges.mask,
+            "graph/closure_send_idx": self._send_idx,
+        }
+
+    def _build_memory_model(self):
+        from bigclam_tpu.obs import memory as _mem
+
+        cfg = self.cfg
+        return _mem.twod_memory_model(
+            self.n_pad,
+            self.k_pad,
+            self.R,
+            self.C,
+            jnp.dtype(self.dtype).itemsize,
+            len(cfg.step_candidates),
+            self._graph_buffer_bytes(),
+            closure_cap=self._twod_cap,
+            health_on=int(getattr(cfg, "health_every", 0) or 0) > 0,
+            donate=bool(cfg.donate_state),
+            rollback=int(getattr(cfg, "rollback_budget", 0) or 0) > 0,
+            fd_bytes=self._memory_fd_bytes(),
+            comms=self.comms,
+            model=type(self).__name__,
+        )
+
+
+class StoreTwoDShardedBigClamModel(_StoreBackedMixin,
+                                   TwoDShardedBigClamModel):
+    """2D trainer fed per-host from a compiled graph cache.
+
+    Each process loads ONLY its own shard blobs and closure blobs;
+    requester gather unions and contributor send lists are both derived
+    from the host's OWN lists (edge symmetry — see twod_shard_edges_local),
+    so the global CSR and the global closure never exist on any host. On
+    pre-v3 caches the lists are streamed from the host's own CSR slice
+    (explicit path_reason note; `cli ingest` re-bakes them). Requires
+    num_shards == rows*cols and whole-processor-row process ownership
+    ((num_shards / process_count) % replica_cols == 0) so the edge-block
+    redistribution stays host-internal."""
+
+    def __init__(self, store, cfg: BigClamConfig, mesh: Mesh, dtype=None,
+                 verify: bool = True):
+        self._store_init(store, mesh, verify)
+        super().__init__(
+            _StoreGraphView(store), cfg, mesh, dtype=dtype, balance=False,
+        )
+
+    def _store_init(self, store, mesh: Mesh, verify: bool) -> None:
+        p = mesh.shape[ROWS_AXIS] * mesh.shape[COLS_AXIS]
+        if store.num_shards != p:
+            raise ValueError(
+                f"cache has {store.num_shards} shards but the 2d mesh "
+                f"has rows*cols={p} node blocks; recompile with "
+                f"--shards {p}"
+            )
+        self.store = store
+        self._shard_verify = verify
+        self.host_shard = None
+
+    def _pair_lists(self, shard) -> Dict[int, tuple]:
+        """Owned shards' closure triples: baked v3 lists when the cache
+        has them, else the v2 streaming fallback on the host's own CSR
+        (recorded in path_reason — same derivation, more host time)."""
+        from bigclam_tpu.graph.store import closure_pair_lists
+
+        own = list(shard.shard_ids)
+        entries = self.store.manifest["shards"]
+        if own and all("closure" in entries[s] for s in own):
+            cl = self.store.load_closure_lists(
+                own[0], own[-1] + 1, verify=self._shard_verify
+            )
+            return {
+                s: (sc.out_ids, sc.in_ids, sc.edge_counts)
+                for s, sc in cl.shards.items()
+            }
+        self._csr_reason += (
+            "; closure gather lists streamed from the cached CSR (cache "
+            "format < v3 — re-ingest to bake closures)"
+        )
+        rps = shard.rows_per_shard
+        n = shard.num_nodes
+        out: Dict[int, tuple] = {}
+        for s in own:
+            glo, ghi = min(s * rps, n), min((s + 1) * rps, n)
+            a = int(shard.indptr[glo - shard.lo])
+            b = int(shard.indptr[ghi - shard.lo])
+            ip = shard.indptr[glo - shard.lo: ghi - shard.lo + 1] - a
+            out[s] = closure_pair_lists(
+                glo, ip, shard.indices[a:b], rps, self.p, cap=0
+            )
+        return out
+
+    def _build_edges_and_step(self) -> None:
+        shard = self._load_host_shard()
+        bound = edge_chunk_bound(self.cfg, max(self.k_pad, 1), self.dtype)
+        local = twod_shard_edges_local(
+            shard, self._pair_lists(shard), self.cfg, self.R, self.C,
+            self.n_pad, np.float32, chunk_bound=bound,
+        )
+        gshape = (self.p,) + local.edges.src.shape[1:]
+        sshape = (self.p, self.R, local.cap)
+        self._commit_layout(
+            local,
+            src=put_host_local(local.edges.src, self._espec(), gshape),
+            dst=put_host_local(local.edges.dst, self._espec(), gshape),
+            mask=put_host_local(
+                local.edges.mask.astype(self.dtype), self._espec(), gshape
+            ),
+            send=put_host_local(local.send_idx, self._espec(), sshape),
+        )
+
+    def _shard_edge_counts(self) -> np.ndarray:
+        """Per edge-BLOCK counts from the v3 manifest's per-pair closure
+        counts (block (i, j) = group i's edges into stripe j); pre-v3
+        caches fall back to the per-shard totals — the stripe split is
+        not manifest-visible there."""
+        entries = self.store.manifest["shards"]
+        if all("closure" in e for e in entries):
+            per_pair = np.asarray(
+                [e["closure"]["edge_counts"] for e in entries],
+                dtype=np.int64,
+            )                                   # (S, S): s -> b'
+            R, C, p = self.R, self.C, self.p
+            out = np.zeros(p, dtype=np.int64)
+            for i in range(R):
+                grp = per_pair[i * C:(i + 1) * C].sum(axis=0)   # (S,)
+                for j in range(C):
+                    out[i * C + j] = grp[j::C].sum()
+            return out
+        return np.asarray(
+            [int(e["edges"]) for e in entries], dtype=np.int64
+        )

@@ -1,0 +1,288 @@
+"""2D-partition comms gate (ISSUE 16): prove, on CPU fakes, that the
+communication-avoiding 2D edge-block schedule actually avoids
+communication — and changes nothing else.
+
+Five check groups, the ISSUE 16 acceptance criteria verbatim:
+
+  curve         modeled bytes/step vs p at fixed work on a SPARSE
+                uniform toy (N=1024, avg degree ~4): 2D at (R,C)=(p,1)
+                strictly
+                below the 1D (p-1)/p full-F all-gather pricing at every
+                p in {2,4,8}, and the 2D/1D ratio IMPROVES as p grows
+                (the closure touched-fraction 1-exp(-deg/p) shrinks
+                while 1D keeps shipping every row)
+  reconcile     the static 2D comms model agrees (<=2% band) with the
+                LIVE device buffers via the same remeasure path the 1D
+                families gate on (obs.comms.measured_payloads, family
+                "twod"), for both the (4,1) and the (2,2) grids
+  identity      the 2D trajectory at C=1 is bit-identical to the 1D
+                trainer (same llh scalar, array-equal F) — the closure
+                gather is a layout change, not a math change; the (2,2)
+                grid (partial-group psums + psum_scatter) stays inside
+                the documented LLH band of 1D
+  preflight     `cli preflight` flips the Friendster-scale verdict
+                (N=65.6M, K=25000, sparse m=48, 64 v5e chips) from
+                "does not fit" (exit 2, the 1D members all-gather
+                binding, knobs naming --partition 2d) to "fits"
+                (exit 0) under --partition 2d --replica-cols 8
+  perf diff     the perf ledger refuses to baseline across partitions:
+                an identical re-run baselines clean (exit 0), the same
+                record restamped partition=2d finds NO baseline
+                (exit 1) — a 2d run can never diff against a 1d run
+
+    python scripts/comms2d_gate.py [COMMS2D_r20.json]
+
+Exit 0 iff every check passes.
+"""
+
+import contextlib
+import io
+import json
+import os
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def main() -> int:
+    out_path = sys.argv[1] if len(sys.argv) > 1 else None
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from bigclam_tpu.utils.dist import request_cpu_devices
+
+    request_cpu_devices(8)
+
+    from bigclam_tpu.config import BigClamConfig
+    from bigclam_tpu.graph.ingest import graph_from_edges
+    from bigclam_tpu.obs import RunTelemetry, install, uninstall
+    from bigclam_tpu.obs import ledger as L
+    from bigclam_tpu.obs.report import load_events
+    from bigclam_tpu.parallel import (
+        ShardedBigClamModel,
+        TwoDShardedBigClamModel,
+        make_mesh,
+        make_mesh_2d,
+    )
+    from bigclam_tpu.utils.profiling import StageProfile
+
+    checks = {}
+    detail = {}
+    devs = jax.devices()
+
+    # the curve needs a SPARSE graph with edges spread UNIFORMLY over
+    # shard pairs: 2D undercuts 1D iff the closure cap < rows-per-
+    # block, and the per-pair touched fraction n_blk*(1-exp(-e_pair/
+    # n_blk)) only shrinks with p when e_pair ~ E/p^2. (A planted-
+    # partition toy concentrates every edge on the diagonal pairs —
+    # its touched fraction stays ~1-exp(-deg) at every p.) Uniform
+    # Erdos-Renyi-style pairs at avg degree ~4: touched ~0.86 at p=2
+    # down to ~0.39 at p=8.
+    rng = np.random.default_rng(0)
+    n_toy, m_toy = 1024, 2048
+    pairs = rng.integers(0, n_toy, size=(4 * m_toy, 2))
+    pairs = pairs[pairs[:, 0] != pairs[:, 1]]
+    pairs = np.unique(np.sort(pairs, axis=1), axis=0)
+    g = graph_from_edges(pairs[rng.permutation(len(pairs))[:m_toy]],
+                         num_nodes=n_toy)
+    K = 8
+    F0 = np.abs(rng.standard_normal((g.num_nodes, K))).astype(np.float32)
+    detail["toy"] = {
+        "n": g.num_nodes,
+        "edges": g.num_edges,
+        "avg_degree": round(2 * g.num_edges / g.num_nodes, 2),
+    }
+
+    def cfg(**kw):
+        d = dict(num_communities=K, max_iters=6, conv_tol=0.0,
+                 health_every=2, seed=0)
+        d.update(kw)
+        return BigClamConfig(**d)
+
+    # --- 1. bytes/step vs p: 2D strictly below 1D, ratio improving ----
+    curve = {}
+    ratios = []
+    models_1d = {}
+    models_2d = {}
+    for p in (2, 4, 8):
+        m1 = ShardedBigClamModel(g, cfg(), make_mesh((p, 1), devs[:p]))
+        m2 = TwoDShardedBigClamModel(
+            g, cfg(partition="2d", replica_cols=1),
+            make_mesh_2d((p, 1), devs[:p]),
+        )
+        b1 = m1.comms.bytes_per_step()
+        b2 = m2.comms.bytes_per_step()
+        cap = int(m2._pad_stats["closure_cap"])
+        n_blk = int(m2.n_pad // m2.p)
+        curve[str(p)] = {
+            "bytes_1d": round(b1, 1),
+            "bytes_2d": round(b2, 1),
+            "ratio": round(b2 / b1, 4),
+            "closure_cap": cap,
+            "rows_per_block": n_blk,
+            "touched_fraction": round(cap / n_blk, 4),
+        }
+        checks[f"curve_p{p}_2d_below_1d"] = b2 < b1
+        checks[f"curve_p{p}_cap_below_full_block"] = cap < n_blk
+        ratios.append(b2 / b1)
+        models_1d[p] = m1
+        models_2d[p] = m2
+    detail["curve"] = curve
+    checks["curve_ratio_improves_with_p"] = (
+        ratios[0] > ratios[1] > ratios[2]
+    )
+
+    # --- 2. modeled vs measured (<=2%), same remeasure path as 1D -----
+    agreements = {}
+
+    def agree(name, modeled, measured):
+        rel = abs(measured - modeled) / max(modeled, 1e-9)
+        agreements[name] = {
+            "modeled_bytes_per_step": round(modeled, 1),
+            "measured_bytes_per_step": round(measured, 1),
+            "rel_diff": round(rel, 6),
+        }
+        checks[f"agree_{name}"] = rel <= 0.02
+
+    st1 = models_1d[4].init_state(F0)
+    agree("1d_dp4", models_1d[4].comms.bytes_per_step(),
+          models_1d[4].comms_measured(st1).bytes_per_step())
+    st2 = models_2d[4].init_state(F0)
+    agree("2d_4x1", models_2d[4].comms.bytes_per_step(),
+          models_2d[4].comms_measured(st2).bytes_per_step())
+    m22 = TwoDShardedBigClamModel(
+        g, cfg(partition="2d", replica_cols=2),
+        make_mesh_2d((2, 2), devs[:4]),
+    )
+    st22 = m22.init_state(F0)
+    agree("2d_2x2", m22.comms.bytes_per_step(),
+          m22.comms_measured(st22).bytes_per_step())
+    detail["agreements"] = agreements
+
+    # --- 3. bit-identity at C=1, LLH band at (2,2) --------------------
+    # the 1D dp=4 fit runs under telemetry so its finalized report
+    # feeds the perf-ledger refusal check below
+    work = tempfile.mkdtemp(prefix="comms2d_gate_")
+    tdir = os.path.join(work, "fit1d")
+    tel = install(RunTelemetry(tdir, entry="fit", quiet=True))
+    try:
+        with StageProfile().stage("fit"):
+            r1 = models_1d[4].fit(F0.copy())
+        tel.set_final({
+            "llh": r1.llh, "iters": r1.num_iters, "n": g.num_nodes,
+            "edges": g.num_edges, "k": K, "mesh": "4x1",
+            "partition": "1d",
+        })
+        rep = tel.finalize()
+    finally:
+        uninstall(tel)
+
+    r2 = models_2d[4].fit(F0.copy())
+    F1, F2 = np.asarray(r1.F), np.asarray(r2.F)
+    checks["identity_c1_llh_equal"] = r1.llh == r2.llh
+    checks["identity_c1_F_array_equal"] = bool(np.array_equal(F1, F2))
+    r22 = m22.fit(F0.copy())
+    rel_llh = abs(r22.llh - r1.llh) / max(abs(r1.llh), 1.0)
+    detail["identity"] = {
+        "llh_1d": r1.llh,
+        "llh_2d_4x1": r2.llh,
+        "llh_2d_2x2": r22.llh,
+        "rel_llh_2x2_vs_1d": rel_llh,
+    }
+    checks["llh_band_2x2"] = rel_llh < 5e-3
+
+    # --- 4. preflight flips the Friendster-scale verdict --------------
+    from bigclam_tpu.cli import main as cli_main
+
+    fake = os.path.join(work, "edges.txt")
+    with open(fake, "w") as f:
+        f.write("0 1\n")
+    base_args = [
+        "preflight", "--graph", fake,
+        "--nodes", "65608366", "--edges", "1806067135",
+        "--k", "25000", "--representation", "sparse",
+        "--sparse-m", "48", "--device-kind", "v5e",
+        "--mesh", "64,1", "--json",
+    ]
+
+    def run_preflight(extra):
+        buf = io.StringIO()
+        with contextlib.redirect_stdout(buf):
+            rc = cli_main(base_args + extra)
+        return rc, json.loads(buf.getvalue())
+
+    rc_1d, p_1d = run_preflight([])
+    rc_2d, p_2d = run_preflight(["--partition", "2d",
+                                 "--replica-cols", "8"])
+    checks["preflight_1d_does_not_fit"] = rc_1d == 2 and not p_1d["fits"]
+    checks["preflight_1d_names_2d_knob"] = any(
+        "--partition 2d" in k for k in p_1d["knobs"]
+    )
+    checks["preflight_2d_fits"] = rc_2d == 0 and p_2d["fits"]
+    detail["preflight"] = {
+        "binding_1d": p_1d.get("binding"),
+        "hbm_1d": p_1d.get("hbm_modeled_bytes"),
+        "hbm_2d": p_2d.get("hbm_modeled_bytes"),
+        "rc_1d": rc_1d,
+        "rc_2d": rc_2d,
+    }
+
+    # --- 5. perf ledger refuses to baseline across partitions ---------
+    events = load_events(tdir) or []
+    secs = [e["sec_per_iter"] for e in events
+            if e.get("kind") == "step"
+            and isinstance(e.get("sec_per_iter"), (int, float))]
+    base_rec = L.build_record(rep, secs or [0.01] * 6)
+    checks["record_carries_partition"] = base_rec.get("partition") == "1d"
+    ledger_path = os.path.join(work, "ledger.jsonl")
+    led = L.PerfLedger(ledger_path)
+    led.append(base_rec)
+    led.append(dict(base_rec, run="rerun", ts=base_rec["ts"] + 1))
+    rc_same = cli_main(["perf", "diff", "--ledger", ledger_path])
+    checks["perf_diff_same_partition_baselines"] = rc_same == 0
+    # the SAME record restamped 2d: everything else about the run is
+    # identical, yet it must find no 1d baseline to diff against
+    led.append(dict(base_rec, run="as-2d", ts=base_rec["ts"] + 2,
+                    partition="2d"))
+    rc_cross = cli_main(["perf", "diff", "--ledger", ledger_path])
+    checks["perf_diff_partition_refusal"] = rc_cross == 1
+    detail["perf_diff"] = {"same_rc": rc_same, "cross_rc": rc_cross}
+
+    ok = all(checks.values())
+    artifact = {
+        "gate": "comms2d_r20",
+        "created_unix": round(time.time(), 1),
+        "pass": ok,
+        "checks": checks,
+        "detail": detail,
+        "device": str(jax.devices()[0]),
+        "jax": jax.__version__,
+        "note": (
+            "2D closure-gather schedule strictly under the 1D full-F "
+            "all-gather bytes/step at p in {2,4,8} on a degree-4 sparse "
+            "toy, with the 2D/1D ratio improving as p grows; static 2D "
+            "comms model within 2% of live buffers for (4,1) and (2,2); "
+            "C=1 trajectory bit-identical to 1D and (2,2) inside the "
+            "LLH band; cli preflight flips the Friendster-K25K-64xv5e "
+            "verdict to FITS under --partition 2d --replica-cols 8; "
+            "perf ledger refuses cross-partition baselines."
+        ),
+    }
+    line = json.dumps(artifact, sort_keys=True)
+    print(line)
+    if out_path:
+        with open(out_path, "w") as f:
+            f.write(line + "\n")
+    if not ok:
+        bad = sorted(k for k, v in checks.items() if not v)
+        print(f"FAILED checks: {bad}", file=sys.stderr)
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

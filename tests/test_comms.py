@@ -506,3 +506,77 @@ def test_sync_tracking_is_thread_safe_and_cheap():
         t.join()
     assert tel.last_sync_s == pytest.approx(0.001)
     tel.finalize()
+
+
+# ------------------------------------------------ 2D partition (ISSUE 16)
+def test_wire_byte_scatter_conventions():
+    # reduce_scatter / psum_scatter / all_to_all: each participant keeps
+    # its own 1/p slice off the wire
+    for op in ("reduce_scatter", "psum_scatter", "all_to_all"):
+        assert comms.wire_bytes(op, 100.0, 4) == 75.0
+        assert comms.wire_bytes(op, 100.0, 1) == 0.0
+
+
+def test_twod_model_arithmetic_by_hand():
+    # n_pad=128, rows=2, cols=2 -> p=4, n_blk=32; k_pad=8 f32 -> 32 B/row
+    cm = comms.twod_step_model(
+        n_pad=128, k_pad=8, rows=2, cols=2, itemsize=4,
+        num_candidates=16, closure_cap=10,
+    )
+    sites = cm.site_bytes()
+    # src-row gather over cols only: (cols-1) * 32*32 = 1024
+    assert sites["twod/allgather_srcF"] == 1024.0
+    # capped closure all_to_all over rows: (2*10*32) * (2-1)/2 = 320
+    assert sites["twod/alltoall_closure"] == 320.0
+    # partial-group grad psum of the (cols*n_blk, k) row group
+    assert sites["twod/psum_grad"] == 2048.0
+    # candidate/LLH accumulators reduced AND scattered: keep 1/cols
+    assert sites["twod/psum_scatter_cand"] == 16 * 64 * 4 / 2
+    assert sites["twod/psum_scatter_nbr_llh"] == 64 * 4 / 2
+    # sumF reduces over the WHOLE mesh, twice a step: 2 * 2*32*(3/4)
+    assert sites["twod/psum_sumF"] == 96.0
+    assert cm.family == "twod"
+    assert cm.bytes_per_step() == pytest.approx(sum(sites.values()))
+
+
+def test_twod_model_undercuts_1d_iff_cap_below_block():
+    kw = dict(n_pad=1024, k_pad=16, itemsize=4, num_candidates=16)
+    one_d = comms.sharded_step_model(dp=4, tp=1, **kw)
+    capped = comms.twod_step_model(rows=4, cols=1, closure_cap=64, **kw)
+    full = comms.twod_step_model(rows=4, cols=1, closure_cap=256, **kw)
+    assert capped.bytes_per_step() < one_d.bytes_per_step()
+    assert full.bytes_per_step() > capped.bytes_per_step()
+    # at cap == n_blk the closure exchange pays exactly the 1D gather
+    assert full.site_bytes()["twod/alltoall_closure"] == \
+        one_d.site_bytes()["sharded/all_gather_F"]
+
+
+def test_twod_model_agrees_with_measured(planted):
+    from bigclam_tpu.parallel import TwoDShardedBigClamModel, make_mesh_2d
+
+    g, F0 = planted
+    cfg = BigClamConfig(num_communities=4, dtype="float64", max_iters=2,
+                        partition="2d", replica_cols=2)
+    m = TwoDShardedBigClamModel(
+        g, cfg, make_mesh_2d((2, 2), jax.devices()[:4])
+    )
+    state = m.init_state(F0)
+    assert m.comms.family == "twod"
+    assert m.comms_measured(state).bytes_per_step() == pytest.approx(
+        m.comms.bytes_per_step(), rel=0.01
+    )
+
+
+def test_health_psum_prices_full_mesh():
+    # the health-pack psums run OUTSIDE shard_map on the global arrays:
+    # the reduction spans dp*tp, not just the node axis
+    base = dict(n_pad=128, k_pad=8, itemsize=4, num_candidates=16,
+                health_every=1)
+    dp_only = comms.sharded_step_model(dp=2, tp=2, **base)
+    mesh_wide = comms.sharded_step_model(dp=2, tp=2,
+                                         health_participants=4, **base)
+    h = next(s for s in mesh_wide.sites
+             if s.site == "sharded/psum_health")
+    assert h.participants == 4
+    assert mesh_wide.site_bytes()["sharded/psum_health"] > \
+        dp_only.site_bytes()["sharded/psum_health"]

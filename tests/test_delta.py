@@ -570,3 +570,32 @@ def test_cli_refit_end_to_end(tmp_path, capsys):
     assert out["generation"] > out["from_generation"]
     # the published refit snapshot is loadable and is the latest
     assert CheckpointManager(snaps).latest() == out["generation"]
+
+
+def test_apply_delta_rebakes_touched_closure(tmp_path, cache):
+    """ISSUE 16: the delta re-ingest rebakes the touched shards' closure
+    blobs exactly — the updated cache's gather lists must be byte-equal
+    to a fresh full ingest of the combined edge list."""
+    store, text = cache
+    delta = str(tmp_path / "delta.txt")
+    _write_edges(delta, _delta_edges())     # rows [0, 50): shard 0 only
+    info = store.apply_delta(delta)
+    assert info["touched_shards"] == [0]
+    combined = str(tmp_path / "combined.txt")
+    with open(combined, "w") as f:
+        f.write(open(text).read())
+        f.write(open(delta).read())
+    fresh = compile_graph_cache(
+        combined, str(tmp_path / "fresh_cache"), num_shards=SHARDS
+    )
+    after = GraphStore.open(store.directory).load_closure_lists()
+    want = fresh.load_closure_lists()
+    for s in range(SHARDS):
+        assert after.shards[s].edge_counts == want.shards[s].edge_counts
+        for b in range(SHARDS):
+            np.testing.assert_array_equal(
+                after.shards[s].out_ids[b], want.shards[s].out_ids[b]
+            )
+            np.testing.assert_array_equal(
+                after.shards[s].in_ids[b], want.shards[s].in_ids[b]
+            )

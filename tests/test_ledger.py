@@ -339,3 +339,29 @@ def test_maybe_append_env_primary_only(tmp_path, monkeypatch):
     assert not os.path.exists(path)
     assert L.maybe_append_env({**rep, "pid": 0}, [0.01]) is not None
     assert len(PerfLedger(path).load()) == 1
+
+
+def test_partition_breaks_the_match(tmp_path):
+    """ISSUE 16: a 2d run never baselines against a 1d run — the two
+    layouts move different bytes for the same config, so a cross-
+    partition diff would verdict the schedule change as a regression."""
+    led = PerfLedger(str(tmp_path / "ledger.jsonl"))
+    one_d = _report(run="one-d")
+    one_d["final"]["partition"] = "1d"
+    led.append(build_record(one_d, [0.01]))
+    for run, secs in (("two-d-a", 0.01), ("two-d-b", 0.011)):
+        rep = _report(run=run)
+        rep["final"]["partition"] = "2d"
+        led.append(build_record(rep, [secs]))
+    recs = led.load()
+    assert recs[0]["partition"] == "1d"
+    assert recs[1]["partition"] == "2d"
+    assert match_key(recs[0]) != match_key(recs[1])
+    # the later 2d run baselines the earlier 2d run, never the 1d one
+    assert led.baseline_for(recs[-1], recs)["run"] == "two-d-a"
+    assert led.baseline_for(recs[1], recs) is None
+    # legacy records carry no partition stamp and keep matching each
+    # other (None == None), not either stamped partition
+    legacy = build_record(_report(run="legacy"), [0.01])
+    assert legacy["partition"] is None
+    assert match_key(legacy) != match_key(recs[0])

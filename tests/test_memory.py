@@ -480,3 +480,85 @@ def test_heartbeat_stall_carries_hbm_modeled(tmp_path):
     ]
     assert stalls
     assert stalls[-1].get("hbm_modeled_bytes") == 1234.0
+
+
+# ------------------------------------------------ 2D partition (ISSUE 16)
+def test_twod_exact(planted):
+    from bigclam_tpu.parallel import TwoDShardedBigClamModel, make_mesh_2d
+
+    g, F0 = planted
+    m = TwoDShardedBigClamModel(
+        g, _cfg(health_every=1, partition="2d", replica_cols=2),
+        make_mesh_2d((2, 2), jax.devices()[:4]),
+    )
+    st = m.init_state(F0)
+    _reconcile_exact(m, st)
+    st = m._step(st)
+    _reconcile_exact(m, st)
+
+
+def test_twod_memory_model_arithmetic_by_hand():
+    # n_pad=128, rows=2, cols=2 -> p=4, n_blk=32; k_pad=8 f32 -> 32 B/row
+    mm = M.twod_memory_model(
+        128, 8, 2, 2, 4, 16, {"graph/edge_blocks": 1000.0},
+        closure_cap=10,
+    )
+    buf = mm.buffer_bytes()
+    assert buf["transient/F_rowgather"] == 2 * 32 * 32.0
+    assert buf["transient/closure_recv"] == 2 * 10 * 32.0
+    assert buf["transient/grad_row"] == 2 * 32 * 8 * 4
+    assert buf["transient/candidates"] == 16 * 2 * 32 * 4
+    assert buf["graph/edge_blocks"] == 1000.0
+    assert mm.family == "twod"
+    # C=1 holds its own src rows already: no row-gather transient at all
+    c1 = M.twod_memory_model(128, 8, 4, 1, 4, 16, {}, closure_cap=10)
+    assert "transient/F_rowgather" not in c1.buffer_bytes()
+
+
+def test_preflight_2d_flips_the_friendster_verdict():
+    # the ISSUE 16 acceptance numbers: Friendster (65.6M nodes, 1.8B
+    # undirected edges), K=25000 sparse m=48, 64 v5e chips. 1D: the
+    # O(N) member all-gather binds and the verdict names the 2d knob;
+    # 2d at (8, 8): fits.
+    kw = dict(dp=64, tp=1, itemsize=4, representation="sparse",
+              sparse_m=48,
+              device_hbm_bytes=M.DEVICE_HBM_BYTES["v5e"])
+    n, e2, k = 65_608_366, 2 * 1_806_067_135, 25_000
+    one_d = M.preflight(n, e2, k, **kw)
+    assert not one_d["fits"] and one_d["binding"] == "hbm"
+    assert any("--partition 2d" in kn for kn in one_d["knobs"])
+    two_d = M.preflight(n, e2, k, partition="2d", replica_cols=8, **kw)
+    assert two_d["fits"]
+    assert two_d["workload"]["partition"] == "2d"
+    assert two_d["workload"]["replica_cols"] == 8
+    assert two_d["hbm_bytes_per_device"] < one_d["hbm_bytes_per_device"]
+    # sparse x 2d is priced forward-looking only — the note says so
+    assert any("forward-looking" in nt for nt in two_d["notes"])
+
+
+def test_preflight_2d_exact_pair_counts_beat_the_estimate():
+    est = M.preflight(1024, 4096, 16, dp=4, partition="2d")
+    assert any("coupon-collector" in nt for nt in est["notes"])
+    counts = [[10] * 4 for _ in range(4)]
+    exact = M.preflight(1024, 4096, 16, dp=4, partition="2d",
+                        closure_pair_counts=counts)
+    assert not any("coupon-collector" in nt for nt in exact["notes"])
+    # baked 10-row pairs undercut the 162-row coupon-collector estimate
+    assert exact["comms_bytes_per_step"] < est["comms_bytes_per_step"]
+    # a -1 overflow sentinel degrades that pair to the full block
+    over = M.preflight(1024, 4096, 16, dp=4, partition="2d",
+                       closure_pair_counts=[[-1] * 4] + [[10] * 4] * 3)
+    assert over["comms_bytes_per_step"] > exact["comms_bytes_per_step"]
+
+
+def test_preflight_2d_refusals():
+    with pytest.raises(ValueError, match="closure-gather"):
+        M.preflight(1000, 4000, 8, dp=4, partition="2d",
+                    schedule="ring")
+    with pytest.raises(ValueError, match="tp == 1"):
+        M.preflight(1000, 4000, 8, dp=4, tp=2, partition="2d")
+    with pytest.raises(ValueError, match="does not divide"):
+        M.preflight(1000, 4000, 8, dp=4, partition="2d",
+                    replica_cols=3)
+    with pytest.raises(ValueError, match="unknown partition"):
+        M.preflight(1000, 4000, 8, dp=4, partition="3d")

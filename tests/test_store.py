@@ -473,3 +473,100 @@ def test_cli_fit_autocompiles_cache_dir(tmp_path):
     )
     assert r2.returncode == 0, r2.stderr
     assert "compiling graph cache" not in r2.stderr
+
+
+# ----------------------------------------------------------------------
+# ingest-baked closure gather lists (ISSUE 16)
+# ----------------------------------------------------------------------
+
+def _expected_closure(store):
+    """Recompute every shard's closure lists from the full CSR — the
+    oracle the baked blobs must match."""
+    from bigclam_tpu.graph.store import closure_pair_lists
+
+    g = store.load_graph(mmap=False)
+    ip, dx = np.asarray(g.indptr), np.asarray(g.indices)
+    cap = int(store.manifest["closure"].get("cap", 0))
+    out = {}
+    for s in range(store.num_shards):
+        lo, hi = store.node_range(s)
+        out[s] = closure_pair_lists(
+            lo, ip[lo:hi + 1] - ip[lo], dx[ip[lo]:ip[hi]],
+            store.rows_per_shard, store.num_shards, cap=cap,
+        )
+    return out
+
+
+def test_closure_bake_matches_recompute_and_symmetry(messy_text, tmp_path):
+    store = compile_graph_cache(messy_text, str(tmp_path / "c"),
+                                num_shards=4)
+    assert store.manifest["format_version"] == MANIFEST_VERSION
+    assert store.manifest["closure"]["baked"]
+    lists = store.load_closure_lists()
+    want = _expected_closure(store)
+    for s in range(4):
+        out_w, in_w, cnt_w = want[s]
+        sc = lists.shards[s]
+        assert list(sc.edge_counts) == cnt_w
+        for b in range(4):
+            np.testing.assert_array_equal(sc.out_ids[b], out_w[b])
+            np.testing.assert_array_equal(sc.in_ids[b], in_w[b])
+    # undirected symmetry: what s gathers FROM b (out) is exactly what
+    # b's own blob says it sends TO s (in) — both sides of the 2D
+    # exchange derive the same array from their OWN shard's blob
+    for s in range(4):
+        for b in range(4):
+            np.testing.assert_array_equal(
+                lists.shards[s].out_ids[b], lists.shards[b].in_ids[s]
+            )
+
+
+def test_closure_lists_files_read_isolation(messy_text, tmp_path):
+    store = compile_graph_cache(messy_text, str(tmp_path / "c"),
+                                num_shards=4)
+    lists = store.load_closure_lists(1, 2)
+    assert set(lists.shards) == {1}
+    assert len(lists.files_read) == 1
+    assert "shard_00001" in os.path.basename(lists.files_read[0])
+
+
+def test_closure_cap_overflow_sentinel(messy_text, tmp_path):
+    store = compile_graph_cache(messy_text, str(tmp_path / "cc"),
+                                num_shards=4, closure_cap=2)
+    lists = store.load_closure_lists()
+    assert lists.cap == 2
+    flat = [x for sc in lists.shards.values()
+            for x in sc.out_ids + sc.in_ids]
+    # a capped pair is the None sentinel (manifest count -1, list
+    # omitted from the blob), never a silently truncated list
+    assert any(x is None for x in flat)
+    assert all(x is None or len(x) <= 2 for x in flat)
+
+
+def test_v2_cache_refuses_closure_with_reingest_hint(messy_text,
+                                                    tmp_path):
+    store = compile_graph_cache(messy_text, str(tmp_path / "c2"),
+                                num_shards=4, closure_bake=False)
+    assert not store.manifest.get("closure", {}).get("baked")
+    with pytest.raises(ValueError, match="re-ingest to bake closures"):
+        store.load_closure_lists()
+
+
+def test_quarantine_rebuild_keeps_closure_valid(messy_text, tmp_path):
+    store = compile_graph_cache(messy_text, str(tmp_path / "cq"),
+                                num_shards=4)
+    before = store.load_closure_lists()
+    _, dx_path = store.shard_files(2)
+    size = os.path.getsize(dx_path)
+    with open(dx_path, "r+b") as f:
+        f.seek(size // 2)
+        f.write(b"\xff" * 8)
+    store.quarantine_and_rebuild(2, reason="test corruption")
+    after = GraphStore.open(store.directory).load_closure_lists()
+    for b in range(4):
+        np.testing.assert_array_equal(
+            after.shards[2].out_ids[b], before.shards[2].out_ids[b]
+        )
+        np.testing.assert_array_equal(
+            after.shards[2].in_ids[b], before.shards[2].in_ids[b]
+        )

@@ -451,8 +451,9 @@ def test_unbaked_cache_clear_error_and_manifest_migration(problem, tmp_path):
     with pytest.raises(ValueError, match="re-ingest to bake seeds"):
         old.load_seed_scores()
 
-    # unknown future versions still reject at open
-    manifest["format_version"] = 3
+    # unknown future versions still reject at open (v3 = the closure
+    # bake is a real, supported version now — 4 is the next unknown)
+    manifest["format_version"] = 4
     with open(mpath, "w") as f:
         json.dump(manifest, f)
     with pytest.raises(ValueError, match="format version"):

@@ -1,0 +1,203 @@
+"""2D edge-block partition tests (ISSUE 16) on the 8-device CPU fake.
+
+The degeneration contract is BIT-identity: at replica_cols=1 the 2D
+closure-gather schedule must reproduce the 1D all-gather trainer's
+trajectory exactly — the closure table changes which rows ride the wire,
+never what the step computes. The (R, C>1) grids trade the full-F gather
+for partial-group collectives and must stay inside the 1D LLH band.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+from jax.sharding import Mesh
+
+from bigclam_tpu.config import BigClamConfig
+from bigclam_tpu.graph.store import compile_graph_cache
+from bigclam_tpu.models.agm import sample_planted_graph
+from bigclam_tpu.parallel import (
+    ShardedBigClamModel,
+    StoreTwoDShardedBigClamModel,
+    TwoDShardedBigClamModel,
+    make_mesh,
+    make_mesh_2d,
+    twod_mesh_shape,
+)
+from bigclam_tpu.parallel.mesh import COLS_AXIS, K_AXIS, ROWS_AXIS
+
+K = 8
+
+
+def _cfg(**kw):
+    d = dict(num_communities=K, max_iters=6, conv_tol=0.0,
+             health_every=2, seed=0)
+    d.update(kw)
+    return BigClamConfig(**d)
+
+
+@pytest.fixture(scope="module")
+def planted():
+    rng = np.random.default_rng(0)
+    g, _ = sample_planted_graph(240, 4, p_in=0.3, rng=rng)
+    F0 = np.abs(rng.standard_normal((g.num_nodes, K))).astype(np.float32)
+    return g, F0
+
+
+@pytest.fixture(scope="module")
+def fit_1d(planted):
+    g, F0 = planted
+    m = ShardedBigClamModel(g, _cfg(), make_mesh((4, 1), jax.devices()[:4]))
+    return m.fit(F0.copy())
+
+
+@pytest.fixture(scope="module")
+def cache_v3(planted, tmp_path_factory):
+    g, _ = planted
+    tmp = tmp_path_factory.mktemp("twod_cache")
+    txt = str(tmp / "g.txt")
+    src, dst = np.asarray(g.src), np.asarray(g.dst)
+    with open(txt, "w") as f:
+        for s, d in zip(src.tolist(), dst.tolist()):
+            if s < d:
+                f.write(f"{s}\t{d}\n")
+    return txt, compile_graph_cache(txt, str(tmp / "cache"), num_shards=4)
+
+
+# ----------------------------------------------------------- mesh factoring
+def test_mesh_shape_from_cfg():
+    assert twod_mesh_shape(_cfg(partition="2d", replica_cols=2), 8) == (4, 2)
+    assert twod_mesh_shape(_cfg(partition="2d"), 4) == (4, 1)
+    with pytest.raises(ValueError, match="does not divide"):
+        twod_mesh_shape(_cfg(partition="2d", replica_cols=3), 8)
+
+
+# ----------------------------------------------------- trajectory contracts
+def test_c1_bit_identical_to_1d(planted, fit_1d):
+    g, F0 = planted
+    m = TwoDShardedBigClamModel(
+        g, _cfg(partition="2d", replica_cols=1),
+        make_mesh_2d((4, 1), jax.devices()[:4]),
+    )
+    assert m.engaged_path == "xla_2d"
+    r = m.fit(F0.copy())
+    assert r.llh == fit_1d.llh
+    assert np.array_equal(np.asarray(r.F), np.asarray(fit_1d.F))
+
+
+def test_2x2_within_llh_band(planted, fit_1d):
+    g, F0 = planted
+    m = TwoDShardedBigClamModel(
+        g, _cfg(partition="2d", replica_cols=2),
+        make_mesh_2d((2, 2), jax.devices()[:4]),
+    )
+    r = m.fit(F0.copy())
+    assert r.num_iters == fit_1d.num_iters
+    assert r.llh == pytest.approx(fit_1d.llh, rel=5e-3)
+
+
+def test_comms_model_prices_capped_closure(planted):
+    g, _ = planted
+    m = TwoDShardedBigClamModel(
+        g, _cfg(partition="2d", replica_cols=1),
+        make_mesh_2d((4, 1), jax.devices()[:4]),
+    )
+    assert m.comms.family == "twod"
+    sites = m.comms.site_bytes()
+    assert "twod/alltoall_closure" in sites
+    # C=1: the col-group gather and the partial-group reductions are
+    # free — only the closure exchange and the mesh-wide scalars pay
+    assert sites["twod/allgather_srcF"] == 0.0
+    assert sites["twod/psum_scatter_cand"] == 0.0
+    assert m._pad_stats["closure_cap"] <= m.n_pad // m.p
+
+
+# -------------------------------------------------------------- store-native
+def test_store_native_matches_in_memory(planted, cache_v3):
+    g, F0 = planted
+    _, store = cache_v3
+    assert store.manifest["closure"]["baked"]
+    for shape, cols in (((4, 1), 1), ((2, 2), 2)):
+        cfg = _cfg(partition="2d", replica_cols=cols)
+        mesh = make_mesh_2d(shape, jax.devices()[:4])
+        r_mem = TwoDShardedBigClamModel(g, cfg, mesh).fit(F0.copy())
+        r_st = StoreTwoDShardedBigClamModel(store, cfg, mesh).fit(F0.copy())
+        assert r_st.llh == r_mem.llh, shape
+        assert np.array_equal(np.asarray(r_st.F), np.asarray(r_mem.F))
+
+
+def test_v2_cache_streams_closure_fallback(planted, cache_v3,
+                                           tmp_path):
+    """A cache compiled without the closure bake (the v2 layout) still
+    trains — the gather lists stream from the host's own CSR, the path
+    reason says so, and the trajectory is unchanged."""
+    g, F0 = planted
+    txt, _ = cache_v3
+    store2 = compile_graph_cache(txt, str(tmp_path / "c2"),
+                                 num_shards=4, closure_bake=False)
+    assert not store2.manifest["closure"]["baked"]
+    cfg = _cfg(partition="2d", replica_cols=2)
+    mesh = make_mesh_2d((2, 2), jax.devices()[:4])
+    m = StoreTwoDShardedBigClamModel(store2, cfg, mesh)
+    assert "streamed from the cached CSR" in m.path_reason
+    r = m.fit(F0.copy())
+    r_mem = TwoDShardedBigClamModel(g, cfg, mesh).fit(F0.copy())
+    assert np.array_equal(np.asarray(r.F), np.asarray(r_mem.F))
+
+
+# ------------------------------------------------------------------ refusals
+def test_build_refusals(planted):
+    g, _ = planted
+    devs = jax.devices()
+    cfg2 = _cfg(partition="2d", replica_cols=1)
+    with pytest.raises(ValueError, match="rows, cols"):
+        TwoDShardedBigClamModel(g, cfg2, make_mesh((4, 1), devs[:4]))
+    with pytest.raises(ValueError, match="partition-baked"):
+        TwoDShardedBigClamModel(
+            g, _cfg(), make_mesh_2d((4, 1), devs[:4])
+        )
+    with pytest.raises(ValueError, match="replica_cols"):
+        TwoDShardedBigClamModel(
+            g, _cfg(partition="2d", replica_cols=2),
+            make_mesh_2d((4, 1), devs[:4]),
+        )
+    with pytest.raises(ValueError, match="XLA-only"):
+        TwoDShardedBigClamModel(
+            g, _cfg(partition="2d", replica_cols=1, use_pallas_csr=True),
+            make_mesh_2d((4, 1), devs[:4]),
+        )
+    with pytest.raises(ValueError, match="'k' axis must be 1"):
+        TwoDShardedBigClamModel(
+            g, cfg2,
+            Mesh(np.asarray(devs[:4]).reshape(2, 1, 2),
+                 (ROWS_AXIS, COLS_AXIS, K_AXIS)),
+        )
+
+
+def test_store_shard_grid_mismatch_refused(planted, cache_v3, tmp_path):
+    txt, _ = cache_v3
+    store2 = compile_graph_cache(txt, str(tmp_path / "c2s"), num_shards=2)
+    with pytest.raises(ValueError, match="--shards 4"):
+        StoreTwoDShardedBigClamModel(
+            store2, _cfg(partition="2d", replica_cols=2),
+            make_mesh_2d((2, 2), jax.devices()[:4]),
+        )
+
+
+def test_cli_refuses_2d_without_mesh(planted, tmp_path):
+    g, _ = planted
+    txt = str(tmp_path / "g.txt")
+    src, dst = np.asarray(g.src), np.asarray(g.dst)
+    with open(txt, "w") as f:
+        for s, d in zip(src.tolist(), dst.tolist()):
+            if s < d:
+                f.write(f"{s}\t{d}\n")
+    from bigclam_tpu.cli import main as cli_main
+
+    with pytest.raises(SystemExit, match="needs --mesh"):
+        cli_main(["fit", "--graph", txt, "--k", str(K),
+                  "--partition", "2d", "--max-iters", "1"])
+    with pytest.raises(SystemExit, match="closure-gather"):
+        cli_main(["fit", "--graph", txt, "--k", str(K),
+                  "--partition", "2d", "--mesh", "4,1",
+                  "--schedule", "ring", "--max-iters", "1"])
