@@ -138,7 +138,7 @@ def roofline_model(k: int) -> dict:
 
 
 FUSED_PATHS = ("csr_fused", "csr_fused_kb", "csr_ring_fused",
-               "csr_ring_fused_kb")
+               "csr_ring_fused_kb", "csr_fused_2d", "csr_fused_2d_kb")
 
 
 def roofline_model_fused(k: int) -> dict:
@@ -161,6 +161,26 @@ def roofline_model_fused(k: int) -> dict:
         "flops_per_edge_iter": flops_iter,
         "sweeps_per_iter": SWEEPS_PER_ITER,
         "variant": "fused",
+    }
+
+
+def roofline_model_fused_2d(k: int) -> dict:
+    """2D fused-superstep cost model (ISSUE 17): the per-edge VMEM DMA
+    traffic is the 1D fused model's (two dst-row DMAs per edge per
+    iteration, src block + grad/F_new writes amortizing to one
+    read + one write), PLUS one row-write equivalent per edge for the
+    closure-buffer staging: the capped closure all_to_all lands the
+    received rows in the compacted per-pair buffer the DMA descriptors
+    then index, so each touched row is written once per iteration before
+    the kernel reads it (at real average degrees the touched-row count
+    is below the edge count, making one row per edge the honest upper
+    bound — quoting the 1D fused model against a 2d run would overstate
+    hbm_frac by ~20%)."""
+    base = roofline_model_fused(k)
+    return {
+        **base,
+        "bytes_per_edge_iter": base["bytes_per_edge_iter"] + k * 4,
+        "variant": "fused_2d",
     }
 
 
@@ -204,16 +224,19 @@ def device_peaks(device_kind: str):
 
 def roofline_position(
     eps: float, k: int, device_kind: str, sparse_m: int = 0,
-    fused: bool = False,
+    fused: bool = False, path: str = "",
 ) -> dict:
     """The artifact's roofline record for one config: the cost model, the
     achieved HBM-bandwidth fraction (`hbm_frac`) and MXU utilization
     (`mfu`), or None fractions off the peaks table. sparse_m > 0 selects
     the sparse cost model (bytes/FLOPs per edge ∝ M, not K); fused=True
-    the fused-superstep model (no fd round-trip) — each keeps hbm_frac
-    honest for its path."""
+    the fused-superstep model (no fd round-trip); a csr_fused_2d[_kb]
+    `path` the 2d variant with the closure-buffer staging row — each
+    keeps hbm_frac honest for its path."""
     if sparse_m:
         model = roofline_model_sparse(sparse_m)
+    elif path.startswith("csr_fused_2d"):
+        model = roofline_model_fused_2d(k)
     elif fused:
         model = roofline_model_fused(k)
     else:
@@ -436,6 +459,7 @@ def _main(backend, cpu_fallback) -> None:
         "roofline": roofline_position(
             enron_eps, K_ENRON, kind,
             fused=model.engaged_path in FUSED_PATHS,
+            path=model.engaged_path,
         ),
     }
 
@@ -486,6 +510,7 @@ def _main(backend, cpu_fallback) -> None:
         "roofline": roofline_position(
             large_eps, LARGE_K, kind,
             fused=model_l.engaged_path in FUSED_PATHS,
+            path=model_l.engaged_path,
         ),
     }
 
@@ -529,6 +554,7 @@ def _main(backend, cpu_fallback) -> None:
             "roofline": roofline_position(
                 xlk_eps, XLK_K, kind,
                 fused=model_k.engaged_path in FUSED_PATHS,
+                path=model_k.engaged_path,
             ),
         }
     except Exception as e:           # noqa: BLE001 — recorded, not silent

@@ -427,22 +427,23 @@ def _make_model(g, cfg, args):
             )
         if cfg.representation == "sparse":
             raise SystemExit(
-                "error: --partition 2d runs the dense-F closure "
-                "schedule; --representation sparse stays on the 1d "
-                "member exchange (preflight prices sparse x 2d "
-                "forward-looking)"
+                "error: --partition 2d runs the dense-F closure-gather "
+                "schedule, and the sparse top-M member exchange shards "
+                "members over the 1d node axis — the two layouts have "
+                "no common F placement to train on. Alternatives: keep "
+                "--representation sparse on the 1d mesh (its capped "
+                "member exchange already avoids the dense all-gather), "
+                "or go dense to take the 2d closure schedule "
+                "(`cli preflight --partition 2d` prices both)"
             )
         if args.schedule == "ring":
             raise SystemExit(
                 "error: --partition 2d is its own closure-gather "
-                "schedule — drop --schedule ring"
-            )
-        if cfg.use_pallas_csr:
-            raise SystemExit(
-                "error: --csr-kernels on is not supported with "
-                "--partition 2d yet (the closure schedule is XLA-only; "
-                "the closure table is already the flat row layout the "
-                "fused dst-DMA consumes — use --csr-kernels auto)"
+                "schedule — each chip gathers only the closure rows its "
+                "edge block touches, so there is no resident F ring to "
+                "rotate (ring shards dst-F around the 1d node axis). "
+                "Alternatives: drop --schedule ring (2d replaces what "
+                "ring saves), or keep --schedule ring on the 1d mesh"
             )
         import jax
 
@@ -801,6 +802,13 @@ def _cmd_fit(args, tel=None) -> int:
         # match key — a 2d run never baselines against a 1d run
         "partition": cfg.partition,
     }
+    # 2D neighbor-grad exchange mode (ISSUE 17): the EFFECTIVE mode the
+    # trainer resolved (closure only when C>1 and the tables baked) —
+    # joins the ledger match key, so closure and dense-psum runs never
+    # cross-baseline; absent on 1d models, matching the key's None
+    gx = getattr(model, "grad_exchange", None)
+    if gx is not None:
+        out["grad_exchange"] = gx
     if mesh is not None:
         # execution-shape identity (obs.ledger.match_key, ISSUE 10): a
         # (4,1) run must never baseline against (2,2) — the collective
@@ -1195,6 +1203,9 @@ def _cmd_profile(args, tel=None) -> int:
         # stamps the partition exactly like fit does
         "partition": cfg.partition,
     }
+    gx = getattr(model, "grad_exchange", None)
+    if gx is not None:
+        out["grad_exchange"] = gx
     if mesh is not None:
         out["mesh"] = _mesh_label(mesh)
     cm = getattr(model, "comms", None)
@@ -1713,6 +1724,12 @@ def _cmd_refit(args, tel=None) -> int:
         "baseline_fit_wall_s": base_wall,
         "refit_cost_ratio": ratio,
         "restricted_llh": res.llh,
+        # resolved edge-kernel path (ISSUE 17 backfill): refit records
+        # were the one entry missing the ISSUE 13 stamp — without it a
+        # refit whose kernels fell back to XLA could baseline against a
+        # fused refit in the perf ledger
+        "kernel_path": getattr(model, "engaged_path", ""),
+        "kernel_path_reason": getattr(model, "path_reason", ""),
     }
     if full_llh is not None:
         out["llh"] = full_llh

@@ -294,6 +294,29 @@ class BigClamConfig:
                                         # edge layout with the closure
                                         # exchange replacing all_gather(F).
                                         # Ignored under partition="1d"
+    grad_exchange: str = "closure"      # 2D backward-path reduction over the
+                                        # cols axis (ISSUE 17): "closure" =
+                                        # touched-rows-only gather/all_to_all/
+                                        # scatter-add over the baked closure
+                                        # unions (psum only the capped union;
+                                        # runtime overflow falls back to a
+                                        # dense psum for that step, counted);
+                                        # "dense" = the PR 16 partial-group
+                                        # psum over the full row band (the
+                                        # A/B + baseline path). STEP-BAKED
+                                        # and a perf-ledger match-key field:
+                                        # the two exchanges never share a
+                                        # compiled step or a baseline. No-op
+                                        # at replica_cols=1 (no cols
+                                        # reduction exists)
+    closure_grad_cap: int = 0           # closure grad-exchange buffer
+                                        # capacity (rows sent per cols peer
+                                        # pair). 0 = auto: the largest baked
+                                        # pair union x sparse_cap_slack,
+                                        # clamped to the row-band size.
+                                        # Runtime overflow -> dense-psum
+                                        # fallback for that step (mirrors
+                                        # sparse_comm_cap's counters)
     use_pallas: Optional[bool] = None   # fused VMEM candidate kernel; None =
                                         # auto (on for TPU backends when tile
                                         # constraints are met)

@@ -352,6 +352,15 @@ class TrainState(NamedTuple):
     # the cfg.health_every cadence (ISSUE 8); None with health off — the
     # pre-health pytree, bit-identical trajectory.
     health: Optional[jax.Array] = None
+    # Capped-exchange counters of the update that PRODUCED this state:
+    # worst exchanged id count and the dense-fallback flag (int32
+    # scalars). Carried by the sparse representation's sumF allreduce
+    # and the 2D closure grad exchange (ISSUE 17); None on every other
+    # step — the pre-counter pytree. Present from reset_state on when a
+    # trainer engages them: donation needs the scratch state to be a
+    # pytree twin of the step output from iteration one.
+    comm_ids: Optional[jax.Array] = None
+    comm_dense: Optional[jax.Array] = None
 
 
 @dataclasses.dataclass(frozen=True)

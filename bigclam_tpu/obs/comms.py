@@ -386,6 +386,9 @@ def twod_step_model(
     health_every: int = 0,
     model: str = "TwoDShardedBigClamModel",
     row_bytes: Optional[float] = None,
+    grad_exchange: str = "dense",
+    grad_cap: int = 0,
+    fused: bool = False,
 ) -> CommsModel:
     """Collective sites of the 2D edge-block step (parallel/twod.py).
     `row_bytes` overrides the per-row wire width of the F gather and
@@ -397,10 +400,20 @@ def twod_step_model(
     device per step: the dense (n_pad/p)*k_pad gather shrinks by the
     row-group factor (participants cols, not p) and the rest of F moves
     only as the CAPPED closure all_to_all over rows — closure_cap rows
-    per peer group instead of whole blocks. The price is the
-    partial-group grad psum plus the candidate/LLH psum_scatters over
-    cols (zero at cols == 1), which is why `cli preflight` prices both
-    layouts instead of assuming 2d wins everywhere."""
+    per peer group instead of whole blocks. The price is the cols grad
+    reduction plus the candidate/LLH psum_scatters over cols (zero at
+    cols == 1), which is why `cli preflight` prices both layouts
+    instead of assuming 2d wins everywhere.
+
+    The grad reduction is grad_exchange-baked (ISSUE 17):
+    "dense" is the PR 16 full row-band psum; "closure" replaces it with
+    the two-phase touched-rows all_to_all over the baked pair lists —
+    2 * cols * grad_cap rows on the wire instead of the n_row band, a
+    strict win whenever grad_cap < n_blk. grad_cap == 0 under "closure"
+    means no block pair touched any row: the exchange is skipped at
+    trace time, priced 0 bytes. `fused` (kernel_path csr_fused_2d[_kb])
+    changes compute, not collectives — recorded in params for the
+    ledger, no site changes."""
     p = max(rows * cols, 1)
     n_blk = n_pad // p
     n_row = cols * n_blk
@@ -414,10 +427,36 @@ def twod_step_model(
         Site("twod/alltoall_closure", "all_to_all",
              rows * closure_cap * rb, 1, rows,
              "exchange", "rows"),
+    ]
+    if grad_exchange == "closure":
+        if grad_cap > 0:
+            # touched-rows grad exchange: phase A routes the (cols,
+            # grad_cap, k) partial-row buffer to the owner columns,
+            # phase B routes the complete sums back — count 2
+            sites.append(Site(
+                "twod/alltoall_grad_closure", "all_to_all",
+                cols * grad_cap * k_pad * itemsize, 2, cols,
+                "exchange", "cols",
+            ))
+            # the capped-exchange count pmax over cols + the counter
+            # replication over rows (comm_ids / comm_dense)
+            sites.append(Site(
+                "twod/pmax_grad_count", "pmax", 4, 1, cols,
+                "exchange", "cols",
+            ))
+            sites.append(Site(
+                "twod/pmax_grad_count_rows", "pmax", 4, 2, rows,
+                "exchange", "rows",
+            ))
+        # grad_cap == 0: every partial is exactly 0.0 — no exchange
+    else:
         # row-group gradient completion (full psum: the candidate pass
         # re-reads grad at every group src row)
-        Site("twod/psum_grad", "psum",
-             n_row * k_pad * itemsize, 1, cols, "reduce", "cols"),
+        sites.append(Site(
+            "twod/psum_grad", "psum",
+            n_row * k_pad * itemsize, 1, cols, "reduce", "cols",
+        ))
+    sites += [
         # tentpole (c): candidate/LLH accumulators reduced AND scattered
         # in one pass — each chip keeps only its own block's columns
         Site("twod/psum_scatter_cand", "psum_scatter",
@@ -440,8 +479,46 @@ def twod_step_model(
         family="twod", model=model, sites=tuple(sites),
         params={"n_pad": n_pad, "k_pad": k_pad, "rows": rows,
                 "cols": cols, "itemsize": itemsize,
-                "edge_slots": edge_slots, "closure_cap": closure_cap},
+                "edge_slots": edge_slots, "closure_cap": closure_cap,
+                "grad_exchange": grad_exchange, "grad_cap": grad_cap,
+                "fused": bool(fused)},
     )
+
+
+def twod_measured(model: CommsModel, state) -> CommsModel:
+    """Remeasured 2D model from a live TrainState: the dense payloads
+    from the state buffers (measured_payloads), plus — when the closure
+    grad exchange is engaged — the runtime counters' verdict on the
+    exchange site: while the sparse branch holds, the wire stays
+    cap-sized (the modeled payload IS the measured one — occupancy
+    below cap is headroom, same convention as the sparse-allreduce
+    exchange), but a step whose dense-fallback counter fired moved the
+    full row-band psum through that site, so the site is swapped for
+    its dense twin before bytes_per_step comparison."""
+    m = model.remeasure(measured_payloads("twod", state))
+    gcap = int(model.params.get("grad_cap", 0) or 0)
+    if (
+        model.params.get("grad_exchange") != "closure"
+        or gcap <= 0
+        or getattr(state, "comm_ids", None) is None
+        or not bool(int(state.comm_dense))
+    ):
+        return m
+    rows = int(model.params.get("rows", 1))
+    cols = int(model.params.get("cols", 1))
+    n_pad = int(model.params.get("n_pad", 0))
+    k_pad = int(model.params.get("k_pad", 0))
+    itemsize = int(model.params.get("itemsize", 4))
+    n_row = cols * (n_pad // max(rows * cols, 1))
+    dense = Site(
+        "twod/alltoall_grad_closure", "psum",
+        n_row * k_pad * itemsize, 1, cols, "exchange", "cols",
+    )
+    sites = tuple(
+        dense if s.site == "twod/alltoall_grad_closure" else s
+        for s in m.sites
+    )
+    return dataclasses.replace(m, sites=sites)
 
 
 # --------------------------------------------------------- reconciliation

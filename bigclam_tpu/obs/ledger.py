@@ -170,6 +170,12 @@ def build_record(
         # never baseline against a 1D run of the same cfg/mesh. None
         # (1D entry points that predate the stamp) matches only None
         "partition": final.get("partition"),
+        # 2D neighbor-grad exchange mode (ISSUE 17): a closure run ships
+        # cap-sized touched-row buffers where a dense run psums the full
+        # row band — comms totals and step times are not comparable, so
+        # the mode joins the match key. None (1D runs and pre-r21
+        # records) matches only None
+        "grad_exchange": final.get("grad_exchange"),
         "wall_s": float(report.get("wall_s", 0.0) or 0.0),
         "steps": len(secs),
         "step_p10": _round6(_percentile(secs, 10)),
@@ -346,6 +352,10 @@ def match_key(rec: Dict[str, Any]) -> Tuple:
         # collective work at equal mesh size — None (pre-r20 records)
         # matches only None, the usual rebaseline rule
         rec.get("partition"),
+        # 2D grad-exchange mode (ISSUE 17): closure vs dense backward
+        # collectives move different bytes — None (1D / pre-r21 records)
+        # matches only None, the usual rebaseline rule
+        rec.get("grad_exchange"),
         # the resolved edge-kernel path (ISSUE 13): fused vs split vs
         # xla runs do different per-edge work — None (pre-r17 records /
         # entry points that never stamp it) matches only None, the same

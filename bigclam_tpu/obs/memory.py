@@ -267,10 +267,13 @@ def _scalar_state_bytes(
 
 def dense_state_buffers(
     n_pad: int, k_pad: int, dp: int, tp: int, itemsize: int,
-    num_candidates: int, health_on: bool,
+    num_candidates: int, health_on: bool, extra_int32: int = 0,
 ) -> List[Buffer]:
     """Per-device bytes of the dense TrainState: F sharded P(nodes, k),
-    sumF sharded P(k) (replicated over nodes), scalars replicated."""
+    sumF sharded P(k) (replicated over nodes), scalars replicated.
+    `extra_int32` counts the exchange counters a capped-collective step
+    adds to the state (the 2D closure grad exchange's comm_ids/
+    comm_dense pair)."""
     n_loc = n_pad // max(dp, 1)
     k_loc = k_pad // max(tp, 1)
     return [
@@ -278,7 +281,10 @@ def dense_state_buffers(
         Buffer("state/sumF", k_loc * itemsize, "state"),
         Buffer(
             "state/scalars",
-            _scalar_state_bytes(itemsize, num_candidates, health_on),
+            _scalar_state_bytes(
+                itemsize, num_candidates, health_on,
+                extra_int32=extra_int32,
+            ),
             "state",
         ),
     ]
@@ -591,6 +597,9 @@ def twod_memory_model(
     fd_bytes: float = 0.0,
     comms: Optional[CommsModel] = None,
     model: str = "TwoDShardedBigClamModel",
+    fused: bool = False,
+    grad_exchange: str = "dense",
+    grad_cap: int = 0,
 ) -> MemoryModel:
     """2D edge-block trainer (parallel.twod): the O(N * K_loc) gathered
     F of the 1D schedule is replaced by the processor row's own src rows
@@ -598,17 +607,27 @@ def twod_memory_model(
     memory claim that pairs with twod_step_model's wire claim. With
     m > 0 this prices the sparse-representation layout (member rows of
     m ids+weights instead of k_pad floats) — forward-looking preflight
-    pricing; the wired 2d trainer is dense."""
+    pricing; the wired 2d trainer is dense.
+
+    ISSUE 17: `fused` re-prices the dst-row transient as the in-kernel
+    DMA scratch (kernel_path csr_fused_2d[_kb] — same rename as the 1D
+    fused model); grad_exchange="closure" adds the exchange counters to
+    the state scalars and the two-phase routing buffers (grad_cap rows
+    per peer, phases A+B) as the grad-exchange transient, replacing
+    nothing — the (n_row, K) grad band itself stays resident either
+    way."""
     p = max(rows * cols, 1)
     n_blk = n_pad // p
     row_b = m * (4.0 + itemsize) if m else float(k_pad * itemsize)
     feat = m if m else k_pad
+    closure_grad = grad_exchange == "closure"
     state = (
         sparse_state_buffers(n_pad, m, k_pad, p, itemsize,
                              num_candidates, health_on)
         if m else
         dense_state_buffers(n_pad, k_pad, p, 1, itemsize,
-                            num_candidates, health_on)
+                            num_candidates, health_on,
+                            extra_int32=2 if closure_grad else 0)
     )
     buffers = (
         state
@@ -630,14 +649,27 @@ def twod_memory_model(
             Buffer(
                 "transient/grad_row", cols * n_blk * feat * itemsize,
                 "transient",
-                note="row-group gradient before the cols psum",
+                note="row-group gradient before the cols reduction",
             ),
             Buffer(
                 "transient/candidates",
                 num_candidates * cols * n_blk * itemsize, "transient",
             ),
         ]
-        + _fd_buffers(fd_bytes, False, "per-block closure-row gather")
+        + ([Buffer(
+            "transient/grad_closure_exchange",
+            2.0 * cols * grad_cap * k_pad * itemsize
+            + n_blk * k_pad * itemsize,
+            "transient",
+            note="touched-rows grad exchange: (cols, cap, K) send + "
+                 "recv staging per phase plus the (n_blk, K) phase-A "
+                 "block accumulator",
+        )] if closure_grad and grad_cap > 0 else [])
+        + _fd_buffers(
+            fd_bytes, fused,
+            "per-tile closure-buffer rows" if fused
+            else "per-block closure-row gather",
+        )
         + collective_buffers(comms)
     )
     return MemoryModel(
@@ -645,7 +677,8 @@ def twod_memory_model(
         params={"n_pad": n_pad, "k_pad": k_pad, "rows": rows,
                 "cols": cols, "itemsize": itemsize, "m": m,
                 "closure_cap": closure_cap, "donate": donate,
-                "rollback": rollback},
+                "rollback": rollback, "fused": bool(fused),
+                "grad_exchange": grad_exchange, "grad_cap": grad_cap},
     )
 
 
@@ -1023,6 +1056,28 @@ def preflight(
                 "the cache for exact pair counts"
             )
         cap2 = max(min(cap2, n_blk), 1)
+        # ISSUE 17: the 2d verdict prices the COMBINED config the 2d
+        # trainer actually engages at scale — the fused superstep kernel
+        # path (dense only) plus the closure-compressed grad exchange
+        # over the cols axis. The grad cap is the worst per-(chip,
+        # block) touched-row count: exact-manifest upper bound when the
+        # pair counts are baked, coupon-collector otherwise.
+        fused2 = not sparse
+        gx2 = "closure" if (cols2 > 1 and not sparse) else "dense"
+        gcap2 = 0
+        if cols2 > 1 and not sparse:
+            if closure_pair_counts and len(closure_pair_counts) == dp:
+                for s_i in range(dp):
+                    for b in range(dp):
+                        c = int(closure_pair_counts[s_i][b])
+                        gcap2 = max(gcap2,
+                                    n_blk if c < 0 else min(c, n_blk))
+            else:
+                e_pair = directed_edges / max(dp * cols2, 1)
+                gcap2 = int(math.ceil(
+                    n_blk * (1.0 - math.exp(-e_pair / max(n_blk, 1)))
+                ))
+            gcap2 = max(min(gcap2, n_blk), 1)
         slots, _chunk = _chunk_geometry(max_shard, edge_chunk,
                                         gather_cols, itemsize)
         graph = {
@@ -1033,12 +1088,23 @@ def preflight(
             n_pad, feat2, rows2, cols2, itemsize, num_candidates,
             edge_slots=slots, closure_cap=cap2,
             health_every=health_every, row_bytes=row_b2,
+            grad_exchange=gx2, grad_cap=gcap2, fused=fused2,
         ) if dp > 1 else None
         mm = twod_memory_model(
             n_pad, k_pad, rows2, cols2, itemsize, num_candidates,
             graph, closure_cap=cap2, m=m, health_on=health_every > 0,
             donate=donate, rollback=rollback, comms=comms,
+            fused=fused2, grad_exchange=gx2, grad_cap=gcap2,
         )
+        if fused2:
+            notes.append(
+                "2d priced at the combined config: kernel_path "
+                "csr_fused_2d (fused superstep, closure rows feed the "
+                "dst DMA) + grad_exchange="
+                + gx2
+                + (f" (cap {gcap2} touched rows/peer)" if cols2 > 1
+                   else "")
+            )
         if sparse:
             notes.append(
                 "sparse x 2d is priced forward-looking — the wired 2d "
@@ -1172,12 +1238,62 @@ def preflight(
             c_hint = int(math.isqrt(p2))
             while c_hint > 1 and p2 % c_hint:
                 c_hint -= 1
+            c_src = "sqrt heuristic"
+            if closure_pair_counts and len(closure_pair_counts) == p2:
+                # BAKED pair counts (ISSUE 17 satellite): instead of the
+                # sqrt heuristic, price the closure exchange at every
+                # divisor grid and recommend the cheapest — the cap per
+                # (requester row, block) is the summed touched counts of
+                # the row's store shards, exactly what the 2d trainer
+                # will bake
+                n_blk2 = _round_up(max(n, p2), p2) // p2
+                row_b = (m * (4.0 + itemsize) if sparse
+                         else float(k_pad * itemsize))
+                best = None
+                for c_try in range(1, p2):
+                    if p2 % c_try:
+                        continue
+                    r_try = p2 // c_try
+                    cap_t = 0
+                    for i in range(r_try):
+                        for b in range(p2):
+                            tot, over = 0, False
+                            for s_i in range(i * c_try,
+                                             (i + 1) * c_try):
+                                cc = int(closure_pair_counts[s_i][b])
+                                if cc < 0:
+                                    over = True
+                                    break
+                                tot += cc
+                            cap_t = max(
+                                cap_t,
+                                n_blk2 if over else min(tot, n_blk2),
+                            )
+                    cap_t = max(min(cap_t, n_blk2), 1)
+                    bps = _comms.twod_step_model(
+                        n_pad, m if sparse else k_pad, r_try, c_try,
+                        itemsize, num_candidates, closure_cap=cap_t,
+                        health_every=health_every, row_bytes=row_b,
+                        grad_exchange=(
+                            "closure" if (c_try > 1 and not sparse)
+                            else "dense"
+                        ),
+                        grad_cap=(
+                            cap_t if (c_try > 1 and not sparse) else 0
+                        ),
+                        fused=not sparse,
+                    ).bytes_per_step()
+                    if best is None or bps < best[1]:
+                        best = (c_try, bps)
+                if best is not None:
+                    c_hint = best[0]
+                    c_src = "cheapest grid by baked closure pair counts"
             gname = ("transient/members_allgather" if sparse
                      else "transient/F_allgather")
             gb = mm.buffer_bytes().get(gname, 0)
             knobs.append(
                 f"--partition 2d --replica-cols {c_hint} (mesh "
-                f"{p2},1): the O(N) "
+                f"{p2},1; {c_src}): the O(N) "
                 f"{'member' if sparse else 'F'} gather "
                 f"({_fmt_bytes(gb)}) shrinks to the processor row's "
                 "1/rows slice plus the capped closure exchange "
@@ -1232,6 +1348,21 @@ def preflight(
             "mesh": f"{dp}x{tp}",
             "partition": partition,
             **({"replica_cols": cols2} if partition == "2d" else {}),
+            # the combined config the 2d price covers (ISSUE 17): the
+            # fused superstep kernel path + the resolved grad exchange
+            **(
+                {
+                    "kernel_path": (
+                        "csr_fused_2d" if not sparse else "xla_2d"
+                    ),
+                    "grad_exchange": (
+                        "closure" if (cols2 > 1 and not sparse)
+                        else "dense"
+                    ),
+                }
+                if partition == "2d"
+                else {}
+            ),
             "schedule": schedule,
             "store_native": bool(store_native),
             "itemsize": itemsize,
@@ -1271,7 +1402,14 @@ def render_preflight(p: Dict[str, Any]) -> str:
         f"  {w['representation']}"
         + (f" M={w['sparse_m']}" if w.get("sparse_m") else "")
         + f"  mesh {w['mesh']}  schedule {w['schedule']}"
-        + ("  store-native" if w["store_native"] else ""),
+        + ("  store-native" if w["store_native"] else "")
+        + (
+            f"  partition 2d(cols={w.get('replica_cols', 1)})"
+            f" {w.get('kernel_path', '')}"
+            f" grad_exchange={w.get('grad_exchange', '')}"
+            if w.get("partition") == "2d"
+            else ""
+        ),
         "",
         f"per-device HBM (modeled): {_fmt_bytes(p['hbm_bytes_per_device'])}"
         + (

@@ -43,6 +43,16 @@ time, with the DMA slicing kc columns per row), and the ring phases
 materialized, the K-blocked fused pass runs on the FLAT tile layout —
 which the store-native builders already produce — closing the
 grouped/K-blocked store-layout gap that used to fall back to XLA.
+
+The dst-id stream is POSITIONAL into whatever source buffer the caller
+passes — the kernels never assume it is the full gathered F. The 1D
+trainers hand the gathered row band with shard-order ids; the 2D
+edge-block trainers (round 21, parallel/twod.py) hand the received
+CLOSURE buffer (own block ‖ capped per-peer rows) with ids rewritten to
+closure positions at build time by twod_block_tiles. At replica_cols=1
+the closure buffer IS the gathered band in shard order, which is what
+makes the 2D fused trajectory bit-identical to the 1D one — the CI
+anchor pinning the relabeling as bookkeeping, not math.
 """
 
 from __future__ import annotations

@@ -99,8 +99,6 @@ def sparse_allreduce_sum(
     exchange-volume counters the gates assert on.
     """
     tids, tvals, count = compact_touched(vals, pres, cap, k_pad)
-    max_count = lax.pmax(count, axis_name)
-    overflow = max_count > cap
 
     def dense_branch(_):
         return lax.psum(vals, axis_name)
@@ -114,5 +112,119 @@ def sparse_allreduce_sum(
             .add(av.reshape(-1), mode="drop")
         )
 
-    out = lax.cond(overflow, dense_branch, sparse_branch, operand=None)
+    return capped_exchange(dense_branch, sparse_branch, count, cap, axis_name)
+
+
+def capped_exchange(
+    dense_fn, sparse_fn, count: jax.Array, cap: int, axis_name: str
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """The shared touched-ids exchange skeleton: pmax the per-shard
+    touched `count` over `axis_name`, run `sparse_fn` when every shard
+    fits the build-time `cap`, fall back to `dense_fn` FOR THIS STEP
+    otherwise — one compiled step, no retrace on overflow. Both
+    branches take the ignored cond operand. Returns (result, max count
+    over shards, dense_fallback flag int32) — the counter pair every
+    capped collective (sumF sparse-allreduce, 2D closure grad exchange)
+    surfaces to its gates."""
+    max_count = lax.pmax(count, axis_name)
+    overflow = max_count > cap
+    out = lax.cond(overflow, dense_fn, sparse_fn, operand=None)
     return out, max_count, overflow.astype(jnp.int32)
+
+
+def closure_grad_allreduce(
+    partial: jax.Array,
+    out_tab: jax.Array,
+    in_tab: jax.Array,
+    count: jax.Array,
+    cap: int,
+    axis_name: str,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Touched-rows-only replacement for the 2D trainer's dense
+    neighbor-grad psum over the cols axis (ISSUE 17 second leg — the
+    arXiv:1312.3020 insight promoted from the sparse representation to
+    the dense backward path via the baked closure lists).
+
+    Every chip of a processor row holds a dense `partial` (n_row, K) of
+    neighbor-grad contributions, but its edges only touch the rows its
+    baked closure lists name. Instead of psumming the full row band:
+
+      phase A (reduce):  chip j sends, for each peer c, the partial
+                 rows of BLOCK c its edges touched (`out_tab[c]`,
+                 group-local ids, sentinel >= n_row) — one all_to_all —
+                 and scatter-adds what it receives (`in_tab`,
+                 block-local ids, sentinel >= n_blk) into its own
+                 (n_blk, K) block accumulator, which then holds the
+                 cols-complete sums for its own rows.
+      phase B (broadcast): the reverse routes: chip j sends peer c the
+                 summed rows c touched (`in_tab[c]` again), receives
+                 the complete sums for the rows IT touched
+                 (`out_tab`), scatters them into a dense (n_row, K)
+                 and overwrites its own block slot with the exact
+                 accumulator (rows a chip touched in its OWN block
+                 would otherwise be double-counted by the scatter).
+
+    Untouched rows come back as their local partial — exactly 0.0,
+    never written by the segment-sum — so the result equals
+    lax.psum(partial, axis_name) up to float summation order, and
+    bit-exactly when each row's contributions are unchanged in count
+    (pinned by tests/test_fused2d.py). Tables are baked host-side
+    ((C, cap) int32 each); `count` is this chip's true worst pair
+    size, so an explicit cap below it degrades to the dense psum per
+    step via `capped_exchange` — same counters, no recompile."""
+    from bigclam_tpu.utils.compat import pcast_varying, vma_of
+
+    n_row, k = partial.shape
+    cols = out_tab.shape[0]
+    n_blk = n_row // cols
+    zero = jnp.zeros((), partial.dtype)
+
+    def dense_fn(_):
+        # the psum result is invariant over axis_name but the sparse
+        # branch is genuinely varying (each chip keeps different rows);
+        # cast so the cond branches agree in the VMA type system
+        out = lax.psum(partial, axis_name)
+        return (
+            pcast_varying(out, (axis_name,))
+            if axis_name not in vma_of(out) else out
+        )
+
+    def sparse_fn(_):
+        j = lax.axis_index(axis_name)
+        # phase A: route touched partials to their owner column
+        send = jnp.where(
+            (out_tab < n_row)[..., None],
+            partial[jnp.minimum(out_tab, n_row - 1).reshape(-1)]
+            .reshape(cols, cap, k),
+            zero,
+        )
+        recv = lax.all_to_all(send, axis_name, split_axis=0, concat_axis=0)
+        recv = jnp.where((in_tab < n_blk)[..., None], recv, zero)
+        blk = (
+            jnp.zeros((n_blk, k), partial.dtype)
+            .at[in_tab.reshape(-1)]
+            .add(recv.reshape(-1, k), mode="drop")
+        )
+        # phase B: route the complete sums back to every toucher
+        send2 = jnp.where(
+            (in_tab < n_blk)[..., None],
+            blk[jnp.minimum(in_tab, n_blk - 1).reshape(-1)]
+            .reshape(cols, cap, k),
+            zero,
+        )
+        recv2 = lax.all_to_all(send2, axis_name, split_axis=0, concat_axis=0)
+        recv2 = jnp.where((out_tab < n_row)[..., None], recv2, zero)
+        # rows this chip never touched are read by nothing downstream
+        # (the cand scan only gathers at its own src rows) and stay 0 —
+        # the same value their dense-psum sum would be in partial
+        full = (
+            jnp.zeros((n_row, k), partial.dtype)
+            .at[out_tab.reshape(-1)]
+            .add(recv2.reshape(-1, k), mode="drop")
+        )
+        # the own-block slot must come from the phase-A accumulator:
+        # rows of MY block touched only by OTHER columns are absent
+        # from my out_tab but still need their complete sums
+        return lax.dynamic_update_slice_in_dim(full, blk, j * n_blk, axis=0)
+
+    return capped_exchange(dense_fn, sparse_fn, count, cap, axis_name)

@@ -42,10 +42,24 @@ scripts/comms2d_gate.py).
 The whole schedule is expressible in shard_map over named axes —
 lax.all_gather / lax.all_to_all / lax.psum_scatter partial-group
 collectives all accept a single mesh axis — so no jax custom_partitioning
-escape hatch is needed (DESIGN.md records the analysis). The fused Pallas
-superstep is NOT wired to this mesh: the closure table is laid out as the
-flat row table its dst-DMA consumes, but the kernels ride the 1d families
-for now (explicit path_reason fallback; use_pallas_csr=True refuses).
+escape hatch is needed (DESIGN.md records the analysis).
+
+ISSUE 17 wires the round-17 FUSED Pallas superstep to this mesh: the
+closure table is already the flat row table the kernels' dst-DMA
+consumes, so the per-block CSR tiles store dst as closure POSITIONS and
+the in-kernel cur/next DMA descriptors stream compacted closure rows
+exactly the way the 1D dst-row gather does (kernel_path csr_fused_2d,
+csr_fused_2d_kb for the K-blocked large-K layout; C = 1 stays
+bit-identical to the 1D fused trainer). Only the fused superstep is
+wired — the split/grouped kernel suites have no closure-buffer DMA path
+and fall back with an explicit reason. The second ISSUE 17 leg replaces
+the dense neighbor-grad psum over "cols" with a touched-rows-only
+exchange over the baked closure lists (grad_exchange="closure",
+parallel.sparse_collectives.closure_grad_allreduce): two capped
+all_to_alls move only the rows some edge actually touched, with a
+per-step dense-psum fallback on cap overflow and the same
+comm_ids/comm_dense counters the sparse representation's allreduce
+rides.
 """
 
 from __future__ import annotations
@@ -106,6 +120,55 @@ class TwoDLayout:
     cap: int
     block_edge_counts: np.ndarray      # per edge block, row-major (i, j)
     closure_rows: int                  # real (unpadded) closure rows/step
+    # touched-rows grad-exchange tables (ISSUE 17 second leg; None at
+    # C == 1 where there is no cols reduction to compress). out/in are
+    # (local_blocks, C, grad_cap) int32 — out ids group-local with
+    # sentinel C*n_blk, in ids block-local with sentinel n_blk — and
+    # grad_counts is each block's TRUE worst pair size (the runtime
+    # overflow check against an explicit cfg.closure_grad_cap).
+    grad_out: Optional[np.ndarray] = None
+    grad_in: Optional[np.ndarray] = None
+    grad_counts: Optional[np.ndarray] = None
+    grad_cap: int = 0                  # baked table width (0 = no rows)
+    grad_pair_max: int = 0             # exact global worst pair size
+
+
+def _grad_table_cap(cfg: BigClamConfig, pair_max: int, n_blk: int) -> int:
+    """Exchange-table width: an explicit cfg.closure_grad_cap is clamped
+    to the block size (wider than n_blk can never pay — the dense psum
+    already moves n_blk rows); 0 means auto = the exact baked worst pair
+    size, so the auto cap never overflows at runtime."""
+    explicit = int(getattr(cfg, "closure_grad_cap", 0) or 0)
+    if explicit > 0:
+        return min(explicit, n_blk)
+    return int(pair_max)
+
+
+def _pack_grad_tables(
+    out_sets, in_sets, C: int, n_blk: int, group_rows: int, gcap: int
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Pack per-block touched-row sets into the fixed-width int32 tables
+    closure_grad_allreduce consumes. `out_sets[b][c]` are the group-local
+    rows of block c this edge block touches (sentinel-filled to
+    group_rows); `in_sets[b][c]` are the block-local rows of block b
+    that peer column c touches (sentinel n_blk). Entries past `gcap` are
+    truncated — the per-block true worst size in the returned counts is
+    what flips the runtime to the dense psum when that happens."""
+    nloc = len(out_sets)
+    out_tab = np.full((nloc, C, max(gcap, 1)), group_rows, dtype=np.int32)
+    in_tab = np.full((nloc, C, max(gcap, 1)), n_blk, dtype=np.int32)
+    counts = np.zeros(nloc, dtype=np.int32)
+    for r in range(nloc):
+        worst = 0
+        for c in range(C):
+            o = np.asarray(out_sets[r][c], dtype=np.int64)
+            i_ = np.asarray(in_sets[r][c], dtype=np.int64)
+            worst = max(worst, int(o.size), int(i_.size))
+            if gcap > 0:
+                out_tab[r, c, : min(o.size, gcap)] = o[:gcap]
+                in_tab[r, c, : min(i_.size, gcap)] = i_[:gcap]
+        counts[r] = worst
+    return out_tab, in_tab, counts
 
 
 def _remap_dst(dsel: np.ndarray, unions, n_blk: int, C: int,
@@ -192,6 +255,40 @@ def twod_shard_edges(
             for i_req in range(R):
                 u = lists[(i_req, j, i)]
                 send_idx[b, i_req, :u.size] = (u - lo_b).astype(np.int32)
+    grad_out = grad_in = grad_counts = None
+    grad_cap = pair_max = 0
+    if C > 1:
+        touched = {
+            (i, j): np.unique(sel[(i, j)][0])
+            for i in range(R) for j in range(C)
+        }
+
+        def seg(i: int, j: int, c: int) -> np.ndarray:
+            # touched(i, j) rows falling in block (i, c)'s group-local range
+            t = touched[(i, j)]
+            lo = np.searchsorted(t, c * n_blk)
+            hi = np.searchsorted(t, (c + 1) * n_blk)
+            return t[lo:hi]
+
+        pair_max = max(
+            (
+                seg(i, j, c).size
+                for i in range(R) for j in range(C) for c in range(C)
+            ),
+            default=0,
+        )
+        grad_cap = _grad_table_cap(cfg, pair_max, n_blk)
+        out_sets = [
+            [seg(i, j, c) for c in range(C)]
+            for i in range(R) for j in range(C)
+        ]
+        in_sets = [
+            [seg(i, c, j) - j * n_blk for c in range(C)]
+            for i in range(R) for j in range(C)
+        ]
+        grad_out, grad_in, grad_counts = _pack_grad_tables(
+            out_sets, in_sets, C, n_blk, group_rows, grad_cap
+        )
     return TwoDLayout(
         edges=EdgeChunks(
             src=src.reshape(p, c, chunk),
@@ -202,6 +299,11 @@ def twod_shard_edges(
         cap=cap,
         block_edge_counts=counts,
         closure_rows=int(sum(u.size for u in lists.values())),
+        grad_out=grad_out,
+        grad_in=grad_in,
+        grad_counts=grad_counts,
+        grad_cap=grad_cap,
+        grad_pair_max=int(pair_max),
     )
 
 
@@ -348,6 +450,46 @@ def twod_shard_edges_local(
         for i_req in range(R):
             u = sends[(b, i_req)]
             send_idx[row, i_req, :u.size] = (u - lo_b).astype(np.int32)
+    grad_out = grad_in = grad_counts = None
+    grad_cap = pair_max = 0
+    if C > 1:
+        def stripe_in(s_shard: int, j: int) -> np.ndarray:
+            # global ids of shard s_shard's rows with an edge into stripe
+            # j — the union of its baked in-lists against the stripe's
+            # blocks; by edge symmetry this equals the src-touched set.
+            # A None pair (bake cap overflow) degrades to the full block:
+            # a superset only adds rows whose partials are exactly 0.0,
+            # so store and in-memory trajectories still agree.
+            parts = []
+            for i_con in range(R):
+                lst = pair_lists[s_shard][1][i_con * C + j]
+                if lst is None:
+                    return full_block(s_shard)
+                parts.append(np.asarray(lst, dtype=np.int64))
+            if not parts:
+                return np.empty(0, dtype=np.int64)
+            return np.unique(np.concatenate(parts))
+
+        S: Dict[Tuple[int, int, int], np.ndarray] = {}
+        for i in groups:
+            for j in range(C):
+                for c_ in range(C):
+                    S[(i, j, c_)] = stripe_in(i * C + c_, j)
+        local_pair_max = max((v.size for v in S.values()), default=0)
+        pair_max = global_max_int(int(local_pair_max))
+        grad_cap = _grad_table_cap(cfg, pair_max, n_blk)
+        out_sets, in_sets = [], []
+        for b in own:
+            i, j = b // C, b % C
+            out_sets.append(
+                [S[(i, j, c_)] - i * group_rows for c_ in range(C)]
+            )
+            in_sets.append(
+                [S[(i, c_, j)] - b * n_blk for c_ in range(C)]
+            )
+        grad_out, grad_in, grad_counts = _pack_grad_tables(
+            out_sets, in_sets, C, n_blk, group_rows, grad_cap
+        )
     return TwoDLayout(
         edges=EdgeChunks(
             src=src.reshape(n_local, c, chunk),
@@ -358,11 +500,72 @@ def twod_shard_edges_local(
         cap=cap,
         block_edge_counts=local_counts,
         closure_rows=int(sum(u.size for u in unions.values())),
+        grad_out=grad_out,
+        grad_in=grad_in,
+        grad_counts=grad_counts,
+        grad_cap=grad_cap,
+        grad_pair_max=int(pair_max),
+    )
+
+
+def _closure_grad_wanted(cfg: BigClamConfig, C: int, grad_tabs) -> bool:
+    """Trace-time decision for the touched-rows grad exchange: cols to
+    reduce over, cfg says closure (the step-baked default), and the
+    layout baked tables. C == 1 is always 'dense' (there is no cols
+    reduction at all — both modes compile the identical step, which is
+    why the ledger stamps the EFFECTIVE mode)."""
+    return (
+        C > 1
+        and getattr(cfg, "grad_exchange", "closure") == "closure"
+        and grad_tabs is not None
+    )
+
+
+def _cols_grad_exchange(nbr_grad, gout, gin, gcnt, gcap, use_closure):
+    """Reduce neighbor-grad partials over the cols axis. Dense mode is
+    the PR 16 partial-group psum; closure mode routes only the baked
+    touched rows (sparse_collectives.closure_grad_allreduce) and returns
+    the (exchanged ids, dense-fallback) counter pair replicated over the
+    whole mesh. gcap == 0 (nothing touched anywhere) skips the exchange
+    at trace time — every partial is exactly 0.0, so the sum already is
+    the psum."""
+    zero = jnp.zeros((), jnp.int32)
+    if not use_closure:
+        return lax.psum(nbr_grad, COLS_AXIS), zero, zero
+    if gcap <= 0:
+        return nbr_grad, zero, zero
+    from bigclam_tpu.parallel.sparse_collectives import (
+        closure_grad_allreduce,
+    )
+
+    out, cnt, fb = closure_grad_allreduce(
+        nbr_grad, gout, gin, gcnt, gcap, COLS_AXIS
+    )
+    return out, lax.pmax(cnt, ROWS_AXIS), lax.pmax(fb, ROWS_AXIS)
+
+
+def _twod_health(cfg, state, F_new, sumF, hist, gstats, cnt, fb, gcap):
+    """Health record for a closure-grad step: the shared pack plus the
+    exchange counters latched max-since-last-sample into the
+    exchanged_ids / dense_fallback / cap_occupancy event slots (the same
+    surface the sparse representation's allreduce reports through)."""
+    if not dx.health_on(cfg):
+        return None
+    extras = {
+        "exchanged_ids": cnt,
+        "dense_fallback": fb,
+        "cap_occupancy": cnt.astype(jnp.float32) / jnp.float32(max(gcap, 1)),
+    }
+    extras, carry = dx.latch_extras(state.health, extras)
+    return dx.health_pack(
+        cfg, state.it, state.F, F_new, sumF, hist, gstats,
+        extras=extras, skip_carry=carry,
     )
 
 
 def make_twod_train_step(
-    mesh: Mesh, edges: EdgeChunks, send_idx, cfg: BigClamConfig
+    mesh: Mesh, edges: EdgeChunks, send_idx, cfg: BigClamConfig,
+    grad_tabs: Optional[dict] = None,
 ) -> Callable[[TrainState], TrainState]:
     """One jitted 2D-partitioned iteration. Same math as the 1D XLA
     sharded step — the Jacobi candidate pass, the Armijo acceptance, the
@@ -370,7 +573,10 @@ def make_twod_train_step(
     all-gather replaced by the row-group gather + capped closure
     all_to_all, and the Armijo accumulators replica-sharded via
     psum_scatter (tentpole (c): no chip ever holds another block's
-    candidate table past the scatter).
+    candidate table past the scatter). With grad_exchange="closure" and
+    baked tables (`grad_tabs`: out/in/count device arrays + the int
+    cap), the cols grad psum becomes the touched-rows exchange and the
+    returned state carries the comm_ids/comm_dense counters.
 
     At C == 1 (and R == 1) every "cols" ("rows") collective is skipped at
     TRACE time, which with the layout degeneration makes trajectories
@@ -379,9 +585,16 @@ def make_twod_train_step(
     C = mesh.shape[COLS_AXIS]
     cap = int(send_idx.shape[-1])
     both = (ROWS_AXIS, COLS_AXIS)
+    use_closure = _closure_grad_wanted(cfg, C, grad_tabs)
+    gcap = int(grad_tabs["cap"]) if use_closure else 0
 
-    def step_shard(F_blk, src, dst, mask, sidx, it):
+    def step_shard(F_blk, src, dst, mask, sidx, *rest):
         # squeeze the leading per-block axis shard_map leaves on the blocks
+        if use_closure:
+            gout, gin, gcnt, it = rest
+            gout, gin, gcnt = gout[0], gin[0], gcnt[0]
+        else:
+            (it,) = rest
         src, dst, mask, sidx = src[0], dst[0], mask[0], sidx[0]
         adt = jnp.dtype(cfg.accum_dtype) if cfg.accum_dtype else F_blk.dtype
         etas = jnp.asarray(cfg.step_candidates, F_blk.dtype)
@@ -438,8 +651,14 @@ def make_twod_train_step(
         # partial-group reductions: grad rows stay within the row group
         # ("cols" psum), never crossing processor rows; the per-node LLH
         # accumulator lands replica-sharded (each chip keeps its block)
+        cnt = fb = jnp.zeros((), jnp.int32)
         if C > 1:
-            nbr_grad = lax.psum(nbr_grad, COLS_AXIS)
+            if use_closure:
+                nbr_grad, cnt, fb = _cols_grad_exchange(
+                    nbr_grad, gout, gin, gcnt, gcap, True
+                )
+            else:
+                nbr_grad = lax.psum(nbr_grad, COLS_AXIS)
             nbr_llh_own = lax.psum_scatter(
                 nbr_llh, COLS_AXIS, scatter_dimension=0, tiled=True
             )
@@ -503,26 +722,40 @@ def make_twod_train_step(
             )
         else:
             gstats = dx.zero_grad_stats()
-        return (
+        out = (
             F_new, sumF_new, llh_cur.astype(F_blk.dtype), it + 1, hist,
             gstats,
         )
+        return out + (cnt, fb) if use_closure else out
 
     nspec = P((ROWS_AXIS, COLS_AXIS), None, None)
+    cspec = P((ROWS_AXIS, COLS_AXIS))
+    extra_in = (nspec, nspec, cspec) if use_closure else ()
+    extra_out = (P(), P()) if use_closure else ()
 
-    def step(state: TrainState, src, dst, mask, sidx) -> TrainState:
-        F_new, sumF, llh, it, hist, gstats = shard_map(
+    def step(state: TrainState, src, dst, mask, sidx, *gt) -> TrainState:
+        outs = shard_map(
             step_shard,
             mesh=mesh,
             in_specs=(
                 P((ROWS_AXIS, COLS_AXIS), K_AXIS),
-                nspec, nspec, nspec, nspec, P(),
-            ),
+                nspec, nspec, nspec, nspec,
+            ) + extra_in + (P(),),
             out_specs=(
                 P((ROWS_AXIS, COLS_AXIS), K_AXIS),
                 P(K_AXIS), P(), P(), P(), P(),
-            ),
-        )(state.F, src, dst, mask, sidx, state.it)
+            ) + extra_out,
+        )(state.F, src, dst, mask, sidx, *gt, state.it)
+        if use_closure:
+            F_new, sumF, llh, it, hist, gstats, cnt, fb = outs
+            return TrainState(
+                F=F_new, sumF=sumF, llh=llh, it=it, accept_hist=hist,
+                health=_twod_health(
+                    cfg, state, F_new, sumF, hist, gstats, cnt, fb, gcap
+                ),
+                comm_ids=cnt, comm_dense=fb,
+            )
+        F_new, sumF, llh, it, hist, gstats = outs
         return TrainState(
             F=F_new, sumF=sumF, llh=llh, it=it, accept_hist=hist,
             health=_shard_health(cfg, state, F_new, sumF, hist, gstats),
@@ -531,12 +764,414 @@ def make_twod_train_step(
     # edge/send arrays as jit ARGUMENTS (multi-controller: no closing over
     # non-addressable-device arrays; see make_sharded_csr_train_step)
     jitted = jax.jit(step)
+    gt_args = (
+        (grad_tabs["out"], grad_tabs["in"], grad_tabs["count"])
+        if use_closure else ()
+    )
 
     def step_fn(state):
-        return jitted(state, edges.src, edges.dst, edges.mask, send_idx)
+        return jitted(
+            state, edges.src, edges.dst, edges.mask, send_idx, *gt_args
+        )
 
     step_fn.jitted = jitted
-    step_fn.jit_args = (edges.src, edges.dst, edges.mask, send_idx)
+    step_fn.jit_args = (
+        edges.src, edges.dst, edges.mask, send_idx
+    ) + gt_args
+    return attach_donating(step_fn, step, fixed_args=step_fn.jit_args)
+
+
+def twod_block_tiles(
+    layout: TwoDLayout, C: int, n_blk: int, block_b: int, tile_t: int,
+    pad_tiles: Optional[int] = None,
+):
+    """Per edge-block flat CSR tiles from a committed 2D layout: src is
+    already group-local ([0, C*n_blk)) and CSR-sorted within each block,
+    dst already a closure POSITION — both stream through
+    ops.csr_tiles.build_block_tiles_arrays untouched, so the fused
+    kernels' cur/next DMA descriptors read the compacted closure buffer
+    exactly the way the 1D path reads the all-gathered F. Returns the
+    stacked ShardedBlockTiles (leading axis = this host's edge blocks),
+    padded to `pad_tiles` tiles (None = the local max; store callers pass
+    the cross-host agreed pad)."""
+    from bigclam_tpu.ops.csr_tiles import stack_block_tile_parts
+
+    parts = _twod_tile_parts(layout, C, n_blk, block_b, tile_t)
+    return stack_block_tile_parts(
+        parts, pad_tiles or max(p.n_tiles for p in parts)
+    )
+
+
+def _twod_tile_parts(
+    layout: TwoDLayout, C: int, n_blk: int, block_b: int, tile_t: int
+) -> list:
+    """Per edge-block BlockTiles (first stage of twod_block_tiles) — the
+    store probe needs the un-stacked parts to run the cross-host
+    pad-tiles exchange before stacking."""
+    from bigclam_tpu.ops.csr_tiles import build_block_tiles_arrays
+
+    group_rows = C * n_blk
+    nloc = layout.edges.src.shape[0]
+    src2 = np.asarray(layout.edges.src).reshape(nloc, -1)
+    dst2 = np.asarray(layout.edges.dst).reshape(nloc, -1)
+    counts = np.asarray(layout.block_edge_counts).reshape(-1)
+    parts = []
+    for r in range(nloc):
+        m = int(counts[r])
+        parts.append(
+            build_block_tiles_arrays(
+                src2[r, :m], dst2[r, :m], group_rows, block_b, tile_t
+            )
+        )
+    return parts
+
+
+def make_twod_csr_train_step(
+    mesh: Mesh, tiles: dict, send_idx, cfg: BigClamConfig,
+    grad_tabs: Optional[dict] = None,
+) -> Callable[[TrainState], TrainState]:
+    """The fused-Pallas 2D iteration (ISSUE 17 tentpole): the XLA
+    schedule's prologue — row-group gather, sumF psum, capped closure
+    all_to_all — verbatim, then the per-edge-block sweeps run in the
+    round-17 fused kernels with the closure buffer as the dst-DMA
+    source. Dispatch:
+
+      C == 1, flat : fused_superstep_csr — the whole superstep in one
+                     kernel; every psum spans both axes, which at C == 1
+                     is the 1D NODES axis, so trajectories are
+                     BIT-identical to the 1D fused trainer (gate-pinned).
+      C == 1, kc   : train_pass_csr_kblocked_fused + the 1D finish.
+      C >  1, flat : _grad_blocks_fused / _cand_blocks_fused around the
+                     cols grad exchange (closure or dense) and the
+                     psum_scatter accumulators of the XLA schedule.
+      C >  1, kc   : the K-block scans of train_pass_csr_kblocked_fused
+                     inlined so the grad exchange and the -sumF + F fold
+                     happen OUTSIDE the kernels, between the scans.
+    """
+    from bigclam_tpu.ops.linesearch import accept_stats
+    from bigclam_tpu.ops.pallas_csr import TilesDev, cand_nbr_from_x_csr
+    from bigclam_tpu.ops.pallas_fused import (
+        _cand_blocks_fused,
+        _grad_blocks_fused,
+        cand_dots_fused,
+        edge_dots_fused,
+        fused_superstep_csr,
+        grad_nbr_from_x_fused,
+        train_pass_csr_kblocked_fused,
+    )
+
+    interp = cfg.pallas_interpret
+    R = mesh.shape[ROWS_AXIS]
+    C = mesh.shape[COLS_AXIS]
+    cap = int(send_idx.shape[-1])
+    both = (ROWS_AXIS, COLS_AXIS)
+    block_b = tiles["block_b"]
+    tile_t = tiles["tile_t"]
+    n_blocks = tiles["n_blocks"]
+    kc = tiles.get("kc", 0)
+    num_s = None  # bound below from cfg
+    use_closure = _closure_grad_wanted(cfg, C, grad_tabs)
+    gcap = int(grad_tabs["cap"]) if use_closure else 0
+
+    def gather_closure(F_blk, sidx):
+        """The shared prologue: row-group F gather, global sumF, capped
+        closure exchange — identical collectives to the XLA step."""
+        if C > 1:
+            F_row = lax.all_gather(F_blk, COLS_AXIS, axis=0, tiled=True)
+        else:
+            F_row = F_blk
+        sumF = lax.psum(F_blk.sum(axis=0), both)
+        send = F_blk[sidx.reshape(-1)].reshape(R, cap, F_blk.shape[1])
+        if R > 1:
+            closure = lax.all_to_all(
+                send, ROWS_AXIS, split_axis=0, concat_axis=0
+            )
+        else:
+            closure = send
+        return F_row, sumF, closure.reshape(R * cap, F_blk.shape[1])
+
+    def tiles_dev(srcl, dstt, maskt, bid, seq=None, with_kc=False):
+        return TilesDev(
+            src_local=srcl, dst=dstt, mask=maskt, block_id=bid,
+            block_b=block_b, tile_t=tile_t, n_blocks=n_blocks,
+            seq=seq, **({"kc": kc} if with_kc else {}),
+        )
+
+    def step_shard_c1(F_blk, srcl, dstt, maskt, bid, seq, sidx, it):
+        # one-pass fused superstep, C == 1: n_row == n_blk, psums over
+        # both axes ARE the 1D NODES psums — bit-identity anchor
+        srcl, dstt, maskt, bid, seq, sidx = (
+            srcl[0], dstt[0], maskt[0], bid[0], seq[0], sidx[0]
+        )
+        td = tiles_dev(srcl, dstt, maskt, bid, seq=seq)
+        F_row, sumF, closure_flat = gather_closure(F_blk, sidx)
+        F_new, grad, node_llh, ok = fused_superstep_csr(
+            F_blk, sumF, td, cfg, interpret=interp, F_gather=closure_flat
+        )
+        llh_cur = lax.psum(node_llh.sum(), both)
+        sumF_new = lax.psum(F_new.sum(axis=0), both)
+        hist = lax.psum(accept_stats(ok > 0), both)
+        if dx.health_on(cfg):
+            gstats = dx.gated_grad_stats(
+                cfg, it, grad, node_axis=both, k_axis=K_AXIS
+            )
+        else:
+            gstats = dx.zero_grad_stats()
+        return (
+            F_new, sumF_new, llh_cur.astype(F_blk.dtype), it + 1, hist,
+            gstats,
+        )
+
+    def step_shard_kb_c1(F_blk, srcl, dstt, maskt, bid, sidx, it):
+        # K-blocked fused, C == 1: the 1D fused_kb step with the closure
+        # buffer as the gather source (k_axis psums are identity — the
+        # 2D mesh's k axis is 1, same as the 1D dp mesh)
+        srcl, dstt, maskt, bid, sidx = (
+            srcl[0], dstt[0], maskt[0], bid[0], sidx[0]
+        )
+        td = tiles_dev(srcl, dstt, maskt, bid, with_kc=True)
+        adt = jnp.dtype(cfg.accum_dtype) if cfg.accum_dtype else F_blk.dtype
+        F_row, sumF, closure_flat = gather_closure(F_blk, sidx)
+        grad, llh_nbr, cand_nbr = train_pass_csr_kblocked_fused(
+            F_blk, sumF, td, cfg, k_axis=K_AXIS, interpret=interp,
+            F_gather=closure_flat,
+        )
+        node_llh = llh_nbr.astype(adt) + (
+            -lax.psum(F_blk @ sumF, K_AXIS) + _rowdot(F_blk, F_blk)
+        ).astype(adt)
+        F_new, sum_loc, hist = armijo_tail_select_sharded(
+            F_blk, grad, node_llh, cand_nbr.astype(adt), sumF, cfg,
+            with_stats=True,
+        )
+        sumF_new = lax.psum(sum_loc, both)
+        llh_cur = lax.psum(node_llh.sum(), both)
+        hist = lax.psum(hist, both)
+        if dx.health_on(cfg):
+            gstats = dx.gated_grad_stats(
+                cfg, it, grad, node_axis=both, k_axis=K_AXIS
+            )
+        else:
+            gstats = dx.zero_grad_stats()
+        return (
+            F_new, sumF_new, llh_cur.astype(F_blk.dtype), it + 1, hist,
+            gstats,
+        )
+
+    def tail_cn(F_blk, nbr_grad, nbr_llh, cnt, fb, sumF, F_row,
+                closure_flat, td, it, cand_fn):
+        """C > 1 epilogue shared by the flat and kb variants: grad row
+        assembly, psum_scatter accumulators, Armijo on own block."""
+        adt = jnp.dtype(cfg.accum_dtype) if cfg.accum_dtype else F_blk.dtype
+        n_blk = F_blk.shape[0]
+        nbr_llh_own = lax.psum_scatter(
+            nbr_llh, COLS_AXIS, scatter_dimension=0, tiled=True
+        )
+        grad_row = nbr_grad - sumF[None, :] + F_row
+        j = lax.axis_index(COLS_AXIS)
+        grad_own = lax.dynamic_slice_in_dim(
+            grad_row, j * n_blk, n_blk, axis=0
+        )
+        node_llh_own = nbr_llh_own + (
+            -lax.psum(F_blk @ sumF, K_AXIS) + _rowdot(F_blk, F_blk)
+        ).astype(adt)
+        llh_cur = lax.psum(node_llh_own.sum(), both)
+        cand_nbr = cand_fn(grad_row).astype(adt)
+        cand_own = lax.psum_scatter(
+            cand_nbr, COLS_AXIS, scatter_dimension=1, tiled=True
+        )
+        F_new, sum_loc, hist = armijo_tail_select_sharded(
+            F_blk, grad_own, node_llh_own, cand_own, sumF, cfg,
+            with_stats=True,
+        )
+        sumF_new = lax.psum(sum_loc, both)
+        hist = lax.psum(hist, both)
+        if dx.health_on(cfg):
+            gstats = dx.gated_grad_stats(
+                cfg, it, grad_own, node_axis=both, k_axis=K_AXIS
+            )
+        else:
+            gstats = dx.zero_grad_stats()
+        out = (
+            F_new, sumF_new, llh_cur.astype(F_blk.dtype), it + 1, hist,
+            gstats,
+        )
+        return out + (cnt, fb) if use_closure else out
+
+    def step_shard_flat_cn(F_blk, srcl, dstt, maskt, bid, sidx, *rest):
+        if use_closure:
+            gout, gin, gcnt, it = rest
+            gout, gin, gcnt = gout[0], gin[0], gcnt[0]
+        else:
+            gout = gin = gcnt = None
+            (it,) = rest
+        srcl, dstt, maskt, bid, sidx = (
+            srcl[0], dstt[0], maskt[0], bid[0], sidx[0]
+        )
+        td = tiles_dev(srcl, dstt, maskt, bid)
+        adt = jnp.dtype(cfg.accum_dtype) if cfg.accum_dtype else F_blk.dtype
+        n_row = C * F_blk.shape[0]
+        k = F_blk.shape[1]
+        F_row, sumF, closure_flat = gather_closure(F_blk, sidx)
+        gparts, lparts = _grad_blocks_fused(
+            F_row, td, cfg, closure_flat, interpret=interp
+        )
+        nbr_grad = gparts.reshape(n_row, k)
+        nbr_llh = lparts.reshape(n_row).astype(adt)
+        nbr_grad, cnt, fb = _cols_grad_exchange(
+            nbr_grad, gout, gin, gcnt, gcap, use_closure
+        )
+
+        def cand_fn(grad_row):
+            cparts = _cand_blocks_fused(
+                F_row, grad_row, td, cfg, closure_flat, interpret=interp
+            )
+            return cparts.transpose(1, 0, 2).reshape(num_s, n_row)
+
+        return tail_cn(
+            F_blk, nbr_grad, nbr_llh, cnt, fb, sumF, F_row, closure_flat,
+            td, it, cand_fn,
+        )
+
+    def step_shard_kb_cn(F_blk, srcl, dstt, maskt, bid, sidx, *rest):
+        if use_closure:
+            gout, gin, gcnt, it = rest
+            gout, gin, gcnt = gout[0], gin[0], gcnt[0]
+        else:
+            gout = gin = gcnt = None
+            (it,) = rest
+        srcl, dstt, maskt, bid, sidx = (
+            srcl[0], dstt[0], maskt[0], bid[0], sidx[0]
+        )
+        td = tiles_dev(srcl, dstt, maskt, bid, with_kc=True)
+        adt = jnp.dtype(cfg.accum_dtype) if cfg.accum_dtype else F_blk.dtype
+        n_row = C * F_blk.shape[0]
+        k = F_blk.shape[1]
+        n_kb = k // kc
+        F_row, sumF, closure_flat = gather_closure(F_blk, sidx)
+        n_tiles = td.src_local.shape[0]
+
+        # the train_pass_csr_kblocked_fused scans, inlined: the fold and
+        # the cols exchange must happen between the grad scan and the
+        # candidate scan, outside the kernels
+        def dots_kb(x_acc, kb):
+            return x_acc + edge_dots_fused(
+                F_row, td, closure_flat, kb, kc, interpret=interp
+            ), None
+
+        x, _ = lax.scan(
+            dots_kb,
+            _mark_varying(
+                jnp.zeros((n_tiles, 1, tile_t), F_blk.dtype), both
+            ),
+            jnp.arange(n_kb),
+        )
+        x = lax.psum(x, K_AXIS)
+
+        def consume_kb(carry, kb):
+            gkb, ln = grad_nbr_from_x_fused(
+                x, td, closure_flat, kb, kc, cfg, interpret=interp
+            )
+            return carry, (gkb, ln)
+
+        _, (gs, lns) = lax.scan(consume_kb, 0, jnp.arange(n_kb))
+        nbr_grad = gs.transpose(1, 0, 2).reshape(n_row, k)
+        nbr_llh = lns[0].astype(adt)
+        nbr_grad, cnt, fb = _cols_grad_exchange(
+            nbr_grad, gout, gin, gcnt, gcap, use_closure
+        )
+
+        def cand_fn(grad_row):
+            def cand_kb(xc_acc, kb):
+                gkb = lax.dynamic_slice_in_dim(
+                    grad_row, kb * kc, kc, axis=1
+                )
+                return xc_acc + cand_dots_fused(
+                    F_row, gkb, td, closure_flat, kb, kc, cfg,
+                    interpret=interp,
+                ), None
+
+            xc, _ = lax.scan(
+                cand_kb,
+                _mark_varying(
+                    jnp.zeros((n_tiles, num_s, tile_t), F_blk.dtype), both
+                ),
+                jnp.arange(n_kb),
+            )
+            xc = lax.psum(xc, K_AXIS)
+            return cand_nbr_from_x_csr(xc, td, cfg, interpret=interp)
+
+        return tail_cn(
+            F_blk, nbr_grad, nbr_llh, cnt, fb, sumF, F_row, closure_flat,
+            td, it, cand_fn,
+        )
+
+    num_s = len(cfg.step_candidates)
+    if C == 1:
+        step_shard = step_shard_kb_c1 if kc else step_shard_c1
+    else:
+        step_shard = step_shard_kb_cn if kc else step_shard_flat_cn
+
+    nspec = P((ROWS_AXIS, COLS_AXIS), None, None)
+    cspec = P((ROWS_AXIS, COLS_AXIS))
+
+    tile_args = [
+        tiles["src_local"], tiles["dst"], tiles["mask"], tiles["block_id"],
+    ]
+    if step_shard is step_shard_c1:
+        tile_args.append(tiles["seq"])
+    tile_args.append(send_idx)
+    counters_out = use_closure and C > 1
+    gt_args = (
+        (grad_tabs["out"], grad_tabs["in"], grad_tabs["count"])
+        if counters_out else ()
+    )
+    extra_in = (nspec, nspec, cspec) if counters_out else ()
+    extra_out = (P(), P()) if counters_out else ()
+
+    def spec_for(arr) -> P:
+        return P((ROWS_AXIS, COLS_AXIS), *([None] * (arr.ndim - 1)))
+
+    def step(state: TrainState, *targs) -> TrainState:
+        # check_vma=False as on the 1D CSR steps: pallas_call's
+        # interpret-mode lowering mixes varying and replicated operands
+        # in ways the VMA type check cannot express yet
+        outs = shard_map(
+            step_shard,
+            mesh=mesh,
+            in_specs=(
+                (P((ROWS_AXIS, COLS_AXIS), K_AXIS),)
+                + tuple(spec_for(a) for a in targs[: len(tile_args)])
+                + extra_in + (P(),)
+            ),
+            out_specs=(
+                P((ROWS_AXIS, COLS_AXIS), K_AXIS),
+                P(K_AXIS), P(), P(), P(), P(),
+            ) + extra_out,
+            check_vma=False,
+        )(state.F, *targs, state.it)
+        if counters_out:
+            F_new, sumF, llh, it, hist, gstats, cnt, fb = outs
+            return TrainState(
+                F=F_new, sumF=sumF, llh=llh, it=it, accept_hist=hist,
+                health=_twod_health(
+                    cfg, state, F_new, sumF, hist, gstats, cnt, fb, gcap
+                ),
+                comm_ids=cnt, comm_dense=fb,
+            )
+        F_new, sumF, llh, it, hist, gstats = outs
+        return TrainState(
+            F=F_new, sumF=sumF, llh=llh, it=it, accept_hist=hist,
+            health=_shard_health(cfg, state, F_new, sumF, hist, gstats),
+        )
+
+    jitted = jax.jit(step)
+    all_args = tuple(tile_args) + gt_args
+
+    def step_fn(state):
+        return jitted(state, *all_args)
+
+    step_fn.jitted = jitted
+    step_fn.jit_args = all_args
     return attach_donating(step_fn, step, fixed_args=step_fn.jit_args)
 
 
@@ -547,8 +1182,15 @@ class TwoDShardedBigClamModel(ShardedBigClamModel):
     machinery is inherited through the mesh/layout hooks — but the step
     exchanges closure rows instead of all-gathering F. cfg.partition is
     step-baked: this class refuses to build unless cfg says "2d" (the
-    perf ledger keys on it), and the CSR/fused kernel families refuse
-    with an explicit reason (the closure schedule is XLA-only for now)."""
+    perf ledger keys on it). The round-17 FUSED superstep engages here
+    exactly as on the 1D trainer (auto on TPU, use_pallas_csr
+    override, the same economy/shape gates) with per-edge-block tiles
+    whose dst-DMA streams the closure buffer — kernel_path
+    csr_fused_2d[_kb]; the split/grouped/ring kernel suites stay on the
+    1d families (explicit reason, no silent fallback). The cols grad
+    reduction is grad_exchange-baked: "closure" (default) routes only
+    the baked touched rows, "dense" keeps the PR 16 partial-group
+    psum."""
 
     def __init__(
         self,
@@ -584,12 +1226,14 @@ class TwoDShardedBigClamModel(ShardedBigClamModel):
                 f"cfg.replica_cols={cfg.replica_cols} != mesh cols {C}; "
                 "build the mesh from the config (twod_mesh_shape)"
             )
-        if cfg.use_pallas_csr is True:
+        if getattr(cfg, "grad_exchange", "closure") not in (
+            "closure", "dense"
+        ):
             raise ValueError(
-                "use_pallas_csr=True is not supported under "
-                "partition='2d': the closure-gather schedule is XLA-only "
-                "— drop the override, or run --partition 1d for the "
-                "fused kernels"
+                f"grad_exchange={cfg.grad_exchange!r}: the 2d cols grad "
+                "reduction is step-baked as 'closure' (touched-rows "
+                "exchange over the baked lists) or 'dense' (partial-"
+                "group psum)"
             )
         self.R, self.C = R, C
         self.p = R * C
@@ -600,19 +1244,36 @@ class TwoDShardedBigClamModel(ShardedBigClamModel):
             raise ValueError("sharded padding requires min_f == 0.0")
         self.n_pad = _round_up(max(g.num_nodes, self.p), self.p)
         self.k_pad = cfg.num_communities
-        self._csr_wanted = False
         self._csr_reason = (
-            "partition=2d runs the XLA closure-gather schedule; the "
-            "fused/CSR kernels ride the 1d families (the closure table "
-            "is already the flat row layout their dst-DMA consumes — "
-            "see DESIGN.md)"
+            "partition=2d XLA closure-gather schedule (fused superstep "
+            "not engaged)"
         )
+        self._probe_layout = None
+        self._probe_tiles = None
+        self._grad_tabs_dev = None
+        # fused-superstep engagement, mirroring the 1D trainer's gates
+        # (tp is pinned to 1 — the 2D mesh's k axis is trivial); when
+        # engaged the paddings are re-derived for the tile geometry
+        self._csr_wanted = (
+            self._csr_static_ok(1) and self._csr_economy_ok(self.p)
+        )
+        if self._csr_wanted:
+            self.n_pad = _round_up(
+                max(g.num_nodes, self.p), self.p * self._csr_shape[0]
+            )
+            self.k_pad = self._csr_k_pad
+            self._csr_reason = ""
         self._perm = None
         self.g_original = g
         if balance and self.p > 1:
             from bigclam_tpu.parallel.balance import balance_graph
 
             self.g, self._perm = balance_graph(g, self.p, self.n_pad)
+            # the economy probe ran on the pre-balance graph; relabeling
+            # invalidates its cached layout (engagement stands — balance
+            # only evens the layout further)
+            self._probe_layout = None
+            self._probe_tiles = None
         self._pad_stats = None
         self._build_edges_and_step()
         from bigclam_tpu.models.bigclam import (
@@ -646,11 +1307,100 @@ class TwoDShardedBigClamModel(ShardedBigClamModel):
 
     @property
     def engaged_path(self) -> str:
-        return "xla_2d"
+        if not self._csr_wanted:
+            return "xla_2d"
+        return (
+            "csr_fused_2d_kb" if getattr(self, "_csr_kc", 0)
+            else "csr_fused_2d"
+        )
+
+    @property
+    def _closure_grad_on(self) -> bool:
+        """Whether the CURRENT cfg's step carries the touched-rows grad
+        exchange (and therefore the comm_ids/comm_dense counters)."""
+        return _closure_grad_wanted(
+            self.cfg, self.C, self._grad_tabs_dev
+        )
+
+    @property
+    def grad_exchange(self) -> str:
+        """The EFFECTIVE step-baked grad-exchange mode — what the perf
+        ledger stamps. C == 1 reports "dense": there is no cols
+        reduction at all, so both cfg values compile the identical
+        step and their baselines must keep matching."""
+        return "closure" if self._closure_grad_on else "dense"
+
+    # --------------------------------------------- fused-kernel engagement
+    def _csr_static_ok(self, tp: int) -> bool:
+        if not super()._csr_static_ok(tp):
+            return False
+        if not self._csr_fused:
+            msg = (
+                "partition='2d' wires only the FUSED superstep — the "
+                "split/grouped kernel suites have no closure-buffer DMA "
+                "path; drop csr_fused=False, or run --partition 1d for "
+                "the split suite"
+            )
+            if self.cfg.use_pallas_csr is True:
+                raise ValueError(f"use_pallas_csr=True but {msg}")
+            self._csr_reason = msg
+            return False
+        return True
+
+    def _csr_economy_ok(self, p: int) -> bool:
+        """Probe the per-edge-block tile layout's padding economy on the
+        prospective fused paddings (pre-balance graph, like the 1D
+        probe); caches the layout AND tiles for the commit."""
+        from bigclam_tpu.ops.csr_tiles import layout_economical
+
+        cfg = self.cfg
+        block_b, tile_t = self._csr_shape
+        n_pad = _round_up(max(self.g.num_nodes, p), p * block_b)
+        bound = edge_chunk_bound(cfg, max(self._csr_k_pad, 1), self.dtype)
+        layout = twod_shard_edges(
+            self.g, cfg, self.R, self.C, n_pad, np.float32,
+            chunk_bound=bound,
+        )
+        sbt = twod_block_tiles(
+            layout, self.C, n_pad // p, block_b, tile_t
+        )
+        slots = sbt.src_local.size
+        e = max(self.g.num_directed_edges, 1)
+        if layout_economical(slots, e, p * sbt.n_blocks, tile_t):
+            self._probe_layout = layout
+            self._probe_tiles = sbt
+            self._csr_nb = None
+            return True
+        if cfg.use_pallas_csr is True:
+            raise ValueError(
+                f"use_pallas_csr=True but the 2d fused layout is "
+                f"uneconomical: {slots - e} padded edge slots on {e} "
+                "edges (power-law skew? try balance=True or "
+                "--partition 1d)"
+            )
+        self._csr_reason = (
+            f"2d fused layout uneconomical: {slots - e} padded edge "
+            f"slots on {e} edges"
+        )
+        return False
 
     # ------------------------------------------------------ layout/step
     def _build_edges_and_step(self) -> None:
         bound = edge_chunk_bound(self.cfg, max(self.k_pad, 1), self.dtype)
+        if self._csr_wanted:
+            layout, sbt = self._probe_layout, self._probe_tiles
+            self._probe_layout = self._probe_tiles = None
+            if layout is None:        # balance relabeled after the probe
+                layout = twod_shard_edges(
+                    self.g, self.cfg, self.R, self.C, self.n_pad,
+                    np.float32, chunk_bound=bound,
+                )
+                sbt = twod_block_tiles(
+                    layout, self.C, self.n_pad // self.p,
+                    *self._csr_shape,
+                )
+            self._commit_csr_layout(layout, sbt)
+            return
         layout = twod_shard_edges(
             self.g, self.cfg, self.R, self.C, self.n_pad, np.float32,
             chunk_bound=bound,
@@ -665,22 +1415,109 @@ class TwoDShardedBigClamModel(ShardedBigClamModel):
             send=put_sharded(layout.send_idx, self._espec()),
         )
 
-    def _commit_layout(self, layout: TwoDLayout, src, dst, mask,
-                       send) -> None:
+    def _nspec(self, ndim: int) -> NamedSharding:
+        return NamedSharding(
+            self.mesh, P((ROWS_AXIS, COLS_AXIS), *([None] * (ndim - 1)))
+        )
+
+    def _place_block_array(self, a: np.ndarray):
+        """Device placement for a (blocks, ...) host array — the
+        in-memory builder holds all blocks; the store twin overrides
+        with the host-local placement."""
+        return put_sharded(a, self._nspec(a.ndim))
+
+    def _commit_grad_tables(self, layout: TwoDLayout) -> None:
+        """Device-place the touched-rows exchange tables (baked whenever
+        C > 1 — cheap, and rebuild_step can then toggle
+        grad_exchange without a relayout)."""
+        self._grad_cap = int(layout.grad_cap)
+        self._grad_pair_max = int(layout.grad_pair_max)
+        self._grad_tabs_dev = None
+        if layout.grad_out is not None:
+            self._grad_tabs_dev = {
+                "out": self._place_block_array(layout.grad_out),
+                "in": self._place_block_array(layout.grad_in),
+                "count": self._place_block_array(
+                    layout.grad_counts.astype(np.int32)
+                ),
+                "cap": int(layout.grad_cap),
+            }
+
+    def _commit_pad_stats(self, layout: TwoDLayout, mask_host) -> None:
         from bigclam_tpu.ops.csr_tiles import tile_pad_stats
 
-        self._pad_stats = dict(tile_pad_stats(layout.edges.mask))
+        self._pad_stats = dict(tile_pad_stats(mask_host))
         self._pad_stats["closure_cap"] = int(layout.cap)
         self._pad_stats["closure_slots_padded"] = (
             self.p * self.R * int(layout.cap)
         )
         self._pad_stats["closure_rows"] = int(layout.closure_rows)
+        if layout.grad_out is not None:
+            self._pad_stats["grad_cap"] = int(layout.grad_cap)
+            self._pad_stats["grad_pair_max"] = int(layout.grad_pair_max)
+
+    def _commit_layout(self, layout: TwoDLayout, src, dst, mask,
+                       send) -> None:
+        self._commit_pad_stats(layout, layout.edges.mask)
         self._twod_cap = int(layout.cap)
         self._block_counts = layout.block_edge_counts
+        self._commit_grad_tables(layout)
         self.edges = EdgeChunks(src=src, dst=dst, mask=mask)
         self._send_idx = send
+        self._tiles_dev = None
         self._step = make_twod_train_step(
-            self.mesh, self.edges, self._send_idx, self.cfg
+            self.mesh, self.edges, self._send_idx, self.cfg,
+            grad_tabs=self._grad_tabs_dev,
+        )
+
+    def _commit_csr_layout(self, layout: TwoDLayout, sbt) -> None:
+        """Commit the fused path: per-edge-block tiles on device (same
+        dict layout as the 1D flat fused tiles), the closure send lists,
+        and the grad tables; the chunked edge arrays stay host-side —
+        the kernels stream the tile arrays instead."""
+        from bigclam_tpu.parallel.sharded import _fused_tile_extras
+
+        nloc, nt, t = sbt.src_local.shape
+        place = self._place_block_array
+        tiles = {
+            "src_local": place(
+                sbt.src_local.reshape(nloc, nt, 1, t).astype(np.int32)
+            ),
+            "dst": place(sbt.dst.astype(np.int32)),
+            "mask": place(
+                sbt.mask.reshape(nloc, nt, 1, t).astype(self.dtype)
+            ),
+            "block_id": place(sbt.block_id.astype(np.int32)),
+            "block_b": sbt.block_b,
+            "tile_t": sbt.tile_t,
+            "n_blocks": sbt.n_blocks,
+        }
+        _fused_tile_extras(
+            tiles, sbt.block_id, self._csr_kc, 1,
+            lambda a: place(np.asarray(a)),
+        )
+        self._commit_pad_stats(layout, sbt.mask)
+        self._pad_stats["pad_tiles"] = int(nt)
+        self._twod_cap = int(layout.cap)
+        self._block_counts = layout.block_edge_counts
+        self._commit_grad_tables(layout)
+        self.edges = None                  # not used by the fused step
+        self._tiles_dev = tiles
+        self._send_idx = self._place_block_array(layout.send_idx)
+        self._step = make_twod_csr_train_step(
+            self.mesh, tiles, self._send_idx, self.cfg,
+            grad_tabs=self._grad_tabs_dev,
+        )
+
+    def _make_step(self):
+        if self._csr_wanted:
+            return make_twod_csr_train_step(
+                self.mesh, self._tiles_dev, self._send_idx, self.cfg,
+                grad_tabs=self._grad_tabs_dev,
+            )
+        return make_twod_train_step(
+            self.mesh, self.edges, self._send_idx, self.cfg,
+            grad_tabs=self._grad_tabs_dev,
         )
 
     def rebuild_step(self) -> None:
@@ -689,13 +1526,47 @@ class TwoDShardedBigClamModel(ShardedBigClamModel):
         key = step_cfg_key(self.cfg)
         cache = self._step_cache
         if key not in cache:
-            cache[key] = make_twod_train_step(
-                self.mesh, self.edges, self._send_idx, self.cfg
-            )
+            cache[key] = self._make_step()
             from bigclam_tpu.obs import note_step_build
 
             note_step_build(self.cfg, type(self).__name__)
         self._step = cache[key]
+
+    # ----------------------------------------------------- state plumbing
+    def _with_counters(self, state: TrainState) -> TrainState:
+        """Zero exchange counters when the closure grad exchange is
+        engaged: attach_donating's scratch must be a pytree twin of the
+        step output from iteration one."""
+        if self._closure_grad_on:
+            return state._replace(
+                comm_ids=jnp.zeros((), jnp.int32),
+                comm_dense=jnp.zeros((), jnp.int32),
+            )
+        return state
+
+    def reset_state(self, F: jax.Array) -> TrainState:
+        return self._with_counters(super().reset_state(F))
+
+    def _state_from_arrays(self, arrays: dict) -> TrainState:
+        return self._with_counters(super()._state_from_arrays(arrays))
+
+    def _memory_state_arrays(self, state) -> list:
+        return super()._memory_state_arrays(state) + [
+            getattr(state, "comm_ids", None),
+            getattr(state, "comm_dense", None),
+        ]
+
+    def last_comm(self, state) -> Tuple[int, bool]:
+        """(worst exchanged id count, dense-fallback?) of the last step;
+        (0, False) when the closure grad exchange is not engaged."""
+        if getattr(state, "comm_ids", None) is None:
+            return 0, False
+        return int(state.comm_ids), bool(int(state.comm_dense))
+
+    def comms_measured(self, state):
+        from bigclam_tpu.obs import comms as _comms
+
+        return _comms.twod_measured(self.comms, state)
 
     # ------------------------------------------------------ observability
     def _build_comms_model(self):
@@ -712,18 +1583,38 @@ class TwoDShardedBigClamModel(ShardedBigClamModel):
             closure_cap=self._twod_cap,
             health_every=self.cfg.health_every,
             model=type(self).__name__,
+            grad_exchange=self.grad_exchange,
+            grad_cap=self._grad_cap if self._closure_grad_on else 0,
+            fused=self._csr_wanted,
         )
 
     def _shard_edge_counts(self) -> np.ndarray:
         return np.asarray(self._block_counts, dtype=np.int64).reshape(-1)
 
     def _graph_device_arrays(self) -> dict:
-        return {
-            "graph/edges_src": self.edges.src,
-            "graph/edges_dst": self.edges.dst,
-            "graph/edges_mask": self.edges.mask,
-            "graph/closure_send_idx": self._send_idx,
-        }
+        if self._csr_wanted:
+            t = self._tiles_dev
+            out = {
+                "graph/tiles_src": t["src_local"],
+                "graph/tiles_dst": t["dst"],
+                "graph/tiles_mask": t["mask"],
+                "graph/tiles_block_id": t["block_id"],
+                "graph/closure_send_idx": self._send_idx,
+            }
+            if t.get("seq") is not None:
+                out["graph/tiles_seq"] = t["seq"]
+        else:
+            out = {
+                "graph/edges_src": self.edges.src,
+                "graph/edges_dst": self.edges.dst,
+                "graph/edges_mask": self.edges.mask,
+                "graph/closure_send_idx": self._send_idx,
+            }
+        if self._grad_tabs_dev is not None:
+            out["graph/grad_out_tab"] = self._grad_tabs_dev["out"]
+            out["graph/grad_in_tab"] = self._grad_tabs_dev["in"]
+            out["graph/grad_count"] = self._grad_tabs_dev["count"]
+        return out
 
     def _build_memory_model(self):
         from bigclam_tpu.obs import memory as _mem
@@ -744,6 +1635,9 @@ class TwoDShardedBigClamModel(ShardedBigClamModel):
             fd_bytes=self._memory_fd_bytes(),
             comms=self.comms,
             model=type(self).__name__,
+            fused=self._csr_wanted,
+            grad_exchange=self.grad_exchange,
+            grad_cap=self._grad_cap if self._closure_grad_on else 0,
         )
 
 
@@ -813,7 +1707,82 @@ class StoreTwoDShardedBigClamModel(_StoreBackedMixin,
             )
         return out
 
+    def _csr_static_ok(self, tp: int) -> bool:
+        if not super()._csr_static_ok(tp):
+            return False
+        return self._store_rows_ok()
+
+    def _csr_economy_ok(self, p: int) -> bool:
+        """Store-native twin of the 2D economy probe: the edge-block
+        layout and per-block tiles are built from this host's shard and
+        closure blobs only, tile counts padded to the cross-host max so
+        shard_map stays SPMD. The accept decision prices the GLOBAL
+        padded slot count (manifest edge totals + the agreed pad), so
+        engage/fallback matches the in-memory trainer on the same
+        graph."""
+        from bigclam_tpu.obs import trace as _trace
+        from bigclam_tpu.ops.csr_tiles import (
+            layout_economical,
+            stack_block_tile_parts,
+        )
+
+        cfg = self.cfg
+        block_b, tile_t = self._csr_shape
+        shard = self._load_host_shard()
+        n_pad = p * self.store.rows_per_shard
+        bound = edge_chunk_bound(cfg, max(self._csr_k_pad, 1), self.dtype)
+        with _trace.span(
+            "sharded/tile_build", dp=p, source="store"
+        ) as _sp:
+            layout = twod_shard_edges_local(
+                shard, self._pair_lists(shard), cfg, self.R, self.C,
+                n_pad, np.float32, chunk_bound=bound,
+            )
+            parts = _twod_tile_parts(
+                layout, self.C, n_pad // p, block_b, tile_t
+            )
+            local_max = max(pt.n_tiles for pt in parts)
+            pad_tiles = self._store_pad_tiles_for(local_max)
+            sbt = stack_block_tile_parts(parts, pad_tiles)
+            _sp.set(local_tiles=int(local_max), pad_tiles=int(pad_tiles))
+        e = max(self.store.num_directed_edges, 1)
+        slots = p * pad_tiles * tile_t          # global, all edge blocks
+        if layout_economical(slots, e, p * sbt.n_blocks, tile_t):
+            self._probe_layout = layout
+            self._probe_tiles = sbt
+            self._csr_nb = None
+            return True
+        if cfg.use_pallas_csr is True:
+            raise ValueError(
+                f"use_pallas_csr=True but the store-backed 2d fused "
+                f"layout is uneconomical: {slots - e} padded edge slots "
+                f"on {e} edges (power-law skew? re-ingest with --balance "
+                "or --partition 1d)"
+            )
+        self._csr_reason = (
+            f"store-backed 2d fused layout uneconomical: {slots - e} "
+            f"padded edge slots on {e} edges"
+        )
+        return False
+
+    def _place_block_array(self, a: np.ndarray):
+        # this host's edge blocks only; the global leading axis is the
+        # full rows*cols block count
+        return put_host_local(
+            a, self._nspec(a.ndim), (self.p,) + a.shape[1:]
+        )
+
+    def _commit_pad_stats(self, layout: TwoDLayout, mask_host) -> None:
+        super()._commit_pad_stats(layout, mask_host)
+        # THIS host's slots only — no global mask exists on any host
+        self._pad_stats["scope"] = "host_local"
+
     def _build_edges_and_step(self) -> None:
+        if self._csr_wanted:
+            layout, sbt = self._probe_layout, self._probe_tiles
+            self._probe_layout = self._probe_tiles = None
+            self._commit_csr_layout(layout, sbt)
+            return
         shard = self._load_host_shard()
         bound = edge_chunk_bound(self.cfg, max(self.k_pad, 1), self.dtype)
         local = twod_shard_edges_local(

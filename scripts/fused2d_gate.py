@@ -1,0 +1,388 @@
+"""Fused-2D gate (ISSUE 17): prove, on CPU fakes, that the fused Pallas
+superstep engages on the 2D edge-block path without changing the math,
+and that the closure grad exchange actually shrinks the grad wire.
+
+Check groups, the ISSUE 17 acceptance criteria verbatim:
+
+  engage        --partition 2d + CSR kernels engages kernel_path
+                csr_fused_2d on the in-memory AND store-native
+                trainers, csr_fused_2d_kb on the K-blocked layout —
+                reported, not silently fallen back from
+  identity      the fused 2D trajectory at C=1 is bit-identical to the
+                1D FUSED trainer (same llh scalar, array-equal F) for
+                both the flat and K-blocked kernels — the closure
+                buffer feeding the DMA descriptors is a relabeling of
+                the same gathered rows; (2,2) stays inside the 5e-3
+                LLH band and its closure-grad fit equals its dense-grad
+                fit bit-exactly
+  grad curve    modeled closure-grad bytes strictly below the dense
+                psum_grad they replace at p in {4,8} (grids (2,2) and
+                (2,4)) on a uniform sparse toy, with the touched cap
+                below rows-per-block — and modeled within 2% of the
+                live remeasure on the closure config
+  overflow      an explicit closure_grad_cap below the true pair
+                maximum falls back to the dense psum PER STEP inside
+                the same compiled executable (counters latch, exactly
+                one compile) and the trajectory equals the dense run
+                bit-exactly
+  memory        the fused 2D closure config reconciles modeled-vs-live
+                HBM at drift 0 on the CPU fake
+  ledger        fused-vs-XLA are SEPARATE baselines: a same-config
+                re-run baselines clean (exit 0), the same record
+                restamped kernel_path=xla_2d finds NO baseline
+                (exit 1), and restamping grad_exchange=dense refuses
+                the same way
+  preflight     the Friendster-K=25K dense 2D verdict prices the
+                COMBINED config (workload names kernel_path
+                csr_fused_2d + grad_exchange closure, note says so),
+                and the round-20 sparse 2D flip keeps exit 0
+
+    python scripts/fused2d_gate.py [FUSED2D_r21.json]
+
+Exit 0 iff every check passes.
+"""
+
+import contextlib
+import io
+import json
+import os
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def main() -> int:
+    out_path = sys.argv[1] if len(sys.argv) > 1 else None
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from bigclam_tpu.utils.dist import request_cpu_devices
+
+    request_cpu_devices(8)
+
+    from bigclam_tpu.config import BigClamConfig
+    from bigclam_tpu.graph.ingest import graph_from_edges
+    from bigclam_tpu.graph.store import compile_graph_cache
+    from bigclam_tpu.models.agm import sample_planted_graph
+    from bigclam_tpu.obs import RunTelemetry, install, uninstall
+    from bigclam_tpu.obs import ledger as L
+    from bigclam_tpu.obs.report import load_events
+    from bigclam_tpu.parallel import (
+        ShardedBigClamModel,
+        StoreTwoDShardedBigClamModel,
+        TwoDShardedBigClamModel,
+        make_mesh,
+        make_mesh_2d,
+    )
+    from bigclam_tpu.utils.profiling import StageProfile
+
+    checks = {}
+    detail = {}
+    devs = jax.devices()
+    K = 8
+    # interpret-mode Pallas on the CPU fake; tile shapes sized to the
+    # 240-node planted toy (n_blk=60 at p=4 -> block_b=30 divides it on
+    # the (4,1) and (2,2) grids)
+    FUSED = dict(use_pallas_csr=True, pallas_interpret=True,
+                 csr_block_b=30, csr_tile_t=64)
+
+    def cfg(**kw):
+        d = dict(num_communities=K, max_iters=6, conv_tol=0.0,
+                 health_every=2, seed=0)
+        d.update(kw)
+        return BigClamConfig(**d)
+
+    rng = np.random.default_rng(0)
+    g, _ = sample_planted_graph(240, 4, p_in=0.3, rng=rng)
+    F0 = np.abs(rng.standard_normal((g.num_nodes, K))).astype(np.float32)
+
+    # --- 1. engagement + C=1 bit-identity vs the 1D FUSED trainer -----
+    m1 = ShardedBigClamModel(g, cfg(**FUSED), make_mesh((4, 1), devs[:4]))
+    checks["engage_1d_fused_anchor"] = m1.engaged_path == "csr_fused"
+    m2 = TwoDShardedBigClamModel(
+        g, cfg(partition="2d", replica_cols=1, **FUSED),
+        make_mesh_2d((4, 1), devs[:4]),
+    )
+    checks["engage_2d_fused"] = m2.engaged_path == "csr_fused_2d"
+
+    work = tempfile.mkdtemp(prefix="fused2d_gate_")
+    tdir = os.path.join(work, "fit2d")
+    tel = install(RunTelemetry(tdir, entry="fit", quiet=True))
+    try:
+        with StageProfile().stage("fit"):
+            r2 = m2.fit(F0.copy())
+        tel.set_final({
+            "llh": r2.llh, "iters": r2.num_iters, "n": g.num_nodes,
+            "edges": g.num_edges, "k": K, "mesh": "4x1",
+            "partition": "2d", "kernel_path": m2.engaged_path,
+            "grad_exchange": m2.grad_exchange,
+        })
+        rep = tel.finalize()
+    finally:
+        uninstall(tel)
+    r1 = m1.fit(F0.copy())
+    checks["identity_c1_llh_equal"] = r1.llh == r2.llh
+    checks["identity_c1_F_array_equal"] = bool(
+        np.array_equal(np.asarray(r1.F), np.asarray(r2.F))
+    )
+
+    m1kb = ShardedBigClamModel(
+        g, cfg(csr_k_block=4, **FUSED), make_mesh((4, 1), devs[:4])
+    )
+    m2kb = TwoDShardedBigClamModel(
+        g, cfg(partition="2d", replica_cols=1, csr_k_block=4, **FUSED),
+        make_mesh_2d((4, 1), devs[:4]),
+    )
+    checks["engage_2d_fused_kb"] = m2kb.engaged_path == "csr_fused_2d_kb"
+    r1kb, r2kb = m1kb.fit(F0.copy()), m2kb.fit(F0.copy())
+    checks["identity_c1_kb_llh_equal"] = r1kb.llh == r2kb.llh
+    checks["identity_c1_kb_F_array_equal"] = bool(
+        np.array_equal(np.asarray(r1kb.F), np.asarray(r2kb.F))
+    )
+
+    # store-native engagement + equality with the in-memory fused run
+    txt = os.path.join(work, "g.txt")
+    src, dst = np.asarray(g.src), np.asarray(g.dst)
+    with open(txt, "w") as f:
+        for s, d in zip(src.tolist(), dst.tolist()):
+            if s < d:
+                f.write(f"{s}\t{d}\n")
+    store = compile_graph_cache(txt, os.path.join(work, "cache"),
+                                num_shards=4)
+    mst = StoreTwoDShardedBigClamModel(
+        store, cfg(partition="2d", replica_cols=1, **FUSED),
+        make_mesh_2d((4, 1), devs[:4]),
+    )
+    checks["engage_2d_fused_store"] = mst.engaged_path == "csr_fused_2d"
+    rst = mst.fit(F0.copy())
+    checks["identity_store_equals_in_memory"] = (
+        rst.llh == r2.llh
+        and bool(np.array_equal(np.asarray(rst.F), np.asarray(r2.F)))
+    )
+    detail["identity"] = {
+        "llh_1d_fused": r1.llh, "llh_2d_fused": r2.llh,
+        "llh_1d_fused_kb": r1kb.llh, "llh_2d_fused_kb": r2kb.llh,
+        "llh_2d_fused_store": rst.llh,
+    }
+
+    # --- 2. (2,2): LLH band + closure grad == dense grad bit-exactly --
+    m22 = {}
+    fit22 = {}
+    for gx in ("closure", "dense"):
+        m22[gx] = TwoDShardedBigClamModel(
+            g, cfg(partition="2d", replica_cols=2, grad_exchange=gx,
+                   **FUSED),
+            make_mesh_2d((2, 2), devs[:4]),
+        )
+        fit22[gx] = m22[gx].fit(F0.copy())
+    checks["engage_2x2_fused"] = (
+        m22["closure"].engaged_path == "csr_fused_2d"
+        and m22["closure"].grad_exchange == "closure"
+    )
+    checks["identity_2x2_closure_equals_dense"] = (
+        fit22["closure"].llh == fit22["dense"].llh
+        and bool(np.array_equal(np.asarray(fit22["closure"].F),
+                                np.asarray(fit22["dense"].F)))
+    )
+    rel_llh = abs(fit22["closure"].llh - r1.llh) / max(abs(r1.llh), 1.0)
+    checks["llh_band_2x2"] = rel_llh < 5e-3
+    detail["identity"]["rel_llh_2x2_vs_1d"] = rel_llh
+
+    # --- 3. grad curve on a uniform sparse toy at p in {4,8} ----------
+    # same regime argument as the round-20 gate: closure undercuts
+    # dense iff the touched cap < rows-per-block, which needs edges
+    # spread uniformly over block pairs (a planted toy's cliques touch
+    # whole blocks — the model honestly prices those at >= dense, see
+    # tests/test_fused2d.py's honest-curve test)
+    n_toy, m_toy = 1024, 2048
+    pairs = rng.integers(0, n_toy, size=(4 * m_toy, 2))
+    pairs = pairs[pairs[:, 0] != pairs[:, 1]]
+    pairs = np.unique(np.sort(pairs, axis=1), axis=0)
+    gt = graph_from_edges(pairs[rng.permutation(len(pairs))[:m_toy]],
+                          num_nodes=n_toy)
+    Ft = np.abs(rng.standard_normal((gt.num_nodes, K))).astype(np.float32)
+    curve = {}
+    for rows, cols in ((2, 2), (2, 4)):
+        p = rows * cols
+        mc = TwoDShardedBigClamModel(
+            gt, cfg(partition="2d", replica_cols=cols,
+                    grad_exchange="closure"),
+            make_mesh_2d((rows, cols), devs[:p]),
+        )
+        md = TwoDShardedBigClamModel(
+            gt, cfg(partition="2d", replica_cols=cols,
+                    grad_exchange="dense"),
+            make_mesh_2d((rows, cols), devs[:p]),
+        )
+        sc, sd = mc.comms.site_bytes(), md.comms.site_bytes()
+        closure_b = (sc["twod/alltoall_grad_closure"]
+                     + sc["twod/pmax_grad_count"]
+                     + sc["twod/pmax_grad_count_rows"])
+        dense_b = sd["twod/psum_grad"]
+        n_blk = mc.n_pad // p
+        curve[f"{rows}x{cols}"] = {
+            "grad_bytes_closure": round(closure_b, 1),
+            "grad_bytes_dense": round(dense_b, 1),
+            "ratio": round(closure_b / dense_b, 4),
+            "grad_cap": int(mc._grad_cap),
+            "rows_per_block": int(n_blk),
+        }
+        checks[f"grad_p{p}_closure_below_dense"] = closure_b < dense_b
+        checks[f"grad_p{p}_cap_below_block"] = mc._grad_cap < n_blk
+        if (rows, cols) == (2, 2):
+            st = mc.init_state(Ft)
+            st = mc._step(st)
+            modeled = mc.comms.bytes_per_step()
+            measured = mc.comms_measured(st).bytes_per_step()
+            rel = abs(measured - modeled) / max(modeled, 1e-9)
+            curve["2x2"]["model_vs_measured_rel"] = round(rel, 6)
+            checks["grad_model_vs_measured_2pct"] = rel <= 0.02
+    detail["grad_curve"] = curve
+
+    # --- 4. overflow: per-step dense fallback, one compile ------------
+    mof = TwoDShardedBigClamModel(
+        g, cfg(partition="2d", replica_cols=2, grad_exchange="closure",
+               closure_grad_cap=1, **FUSED),
+        make_mesh_2d((2, 2), devs[:4]),
+    )
+    rof = mof.fit(F0.copy())
+    stof = mof.init_state(F0)
+    stof = mof._step(stof)
+    ids, fell_back = mof.last_comm(stof)
+    checks["overflow_counter_latches"] = fell_back and ids > 1
+    checks["overflow_equals_dense_fit"] = (
+        rof.llh == fit22["dense"].llh
+        and bool(np.array_equal(np.asarray(rof.F),
+                                np.asarray(fit22["dense"].F)))
+    )
+    detail["overflow"] = {"cap": 1, "true_ids": int(ids),
+                          "pair_max": int(mof._grad_pair_max)}
+
+    # --- 5. memory: fused closure config reconciles at drift 0 --------
+    st22 = m22["closure"].init_state(F0)
+    st22 = m22["closure"]._step(st22)
+    rec = m22["closure"].memory_reconcile(st22)
+    checks["memory_drift_zero"] = rec["ok"] and rec["drift_frac"] == 0.0
+    detail["memory"] = {
+        "modeled_bytes": rec["modeled_bytes"],
+        "measured_bytes": rec["measured_bytes"],
+        "drift_frac": rec["drift_frac"],
+    }
+
+    # --- 6. perf ledger: fused-vs-XLA are separate baselines ----------
+    from bigclam_tpu.cli import main as cli_main
+
+    events = load_events(tdir) or []
+    secs = [e["sec_per_iter"] for e in events
+            if e.get("kind") == "step"
+            and isinstance(e.get("sec_per_iter"), (int, float))]
+    base_rec = L.build_record(rep, secs or [0.01] * 6)
+    checks["record_carries_kernel_path"] = (
+        base_rec.get("kernel_path") == "csr_fused_2d"
+    )
+    ledger_path = os.path.join(work, "ledger.jsonl")
+    led = L.PerfLedger(ledger_path)
+    led.append(base_rec)
+    led.append(dict(base_rec, run="rerun", ts=base_rec["ts"] + 1))
+    rc_same = cli_main(["perf", "diff", "--ledger", ledger_path])
+    checks["perf_diff_same_config_baselines"] = rc_same == 0
+    # the SAME record restamped as the XLA path: the A/B twin must
+    # find no fused baseline to diff against
+    led.append(dict(base_rec, run="as-xla", ts=base_rec["ts"] + 2,
+                    kernel_path="xla_2d"))
+    rc_path = cli_main(["perf", "diff", "--ledger", ledger_path])
+    checks["perf_diff_kernel_path_refusal"] = rc_path == 1
+    # ... and the same for the grad exchange mode (the C=1 base run is
+    # grad_exchange=dense — restamp it as a closure run)
+    led.append(dict(base_rec, run="as-closure-grad",
+                    ts=base_rec["ts"] + 3, grad_exchange="closure"))
+    rc_gx = cli_main(["perf", "diff", "--ledger", ledger_path])
+    checks["perf_diff_grad_exchange_refusal"] = rc_gx == 1
+    detail["perf_diff"] = {"same_rc": rc_same, "path_rc": rc_path,
+                           "grad_rc": rc_gx}
+
+    # --- 7. preflight: Friendster dense-2D names the combined config --
+    fake = os.path.join(work, "edges.txt")
+    with open(fake, "w") as f:
+        f.write("0 1\n")
+    base_args = [
+        "preflight", "--graph", fake,
+        "--nodes", "65608366", "--edges", "1806067135",
+        "--k", "25000", "--device-kind", "v5e",
+        "--mesh", "64,1", "--json",
+    ]
+
+    def run_preflight(extra):
+        buf = io.StringIO()
+        with contextlib.redirect_stdout(buf):
+            rc = cli_main(base_args + extra)
+        return rc, json.loads(buf.getvalue())
+
+    rc_d2, p_d2 = run_preflight(["--partition", "2d",
+                                 "--replica-cols", "8"])
+    w2 = p_d2.get("workload", {})
+    checks["preflight_dense2d_names_fused"] = (
+        w2.get("kernel_path") == "csr_fused_2d"
+    )
+    checks["preflight_dense2d_names_closure_grad"] = (
+        w2.get("grad_exchange") == "closure"
+    )
+    checks["preflight_dense2d_combined_note"] = any(
+        "csr_fused_2d" in n and "grad_exchange" in n
+        for n in p_d2.get("notes", [])
+    )
+    # the round-20 flip must survive: sparse m=48 on the 2d grid fits
+    rc_s2, p_s2 = run_preflight([
+        "--representation", "sparse", "--sparse-m", "48",
+        "--partition", "2d", "--replica-cols", "8",
+    ])
+    checks["preflight_sparse2d_still_fits"] = rc_s2 == 0 and p_s2["fits"]
+    detail["preflight"] = {
+        "dense2d_rc": rc_d2,
+        "dense2d_kernel_path": w2.get("kernel_path"),
+        "dense2d_grad_exchange": w2.get("grad_exchange"),
+        "sparse2d_rc": rc_s2,
+    }
+
+    ok = all(checks.values())
+    artifact = {
+        "gate": "fused2d_r21",
+        "created_unix": round(time.time(), 1),
+        "pass": ok,
+        "checks": checks,
+        "detail": detail,
+        "device": str(jax.devices()[0]),
+        "jax": jax.__version__,
+        "note": (
+            "fused Pallas superstep engages on the 2D edge-block path "
+            "(csr_fused_2d / _kb / store-native) with C=1 bit-identity "
+            "to the 1D fused trainer and (2,2) inside the LLH band; "
+            "closure grad exchange strictly under the dense psum at "
+            "p in {4,8} on a degree-4 sparse toy with modeled bytes "
+            "within 2% of live buffers; cap overflow degrades to the "
+            "dense psum per step inside one executable and matches the "
+            "dense trajectory bit-exactly; memory reconciles at drift "
+            "0; kernel_path and grad_exchange are both perf-ledger "
+            "baseline keys; cli preflight prices Friendster-K25K dense "
+            "2D as the combined fused+closure-grad config and keeps "
+            "the round-20 sparse flip."
+        ),
+    }
+    line = json.dumps(artifact, sort_keys=True)
+    print(line)
+    if out_path:
+        with open(out_path, "w") as f:
+            f.write(line + "\n")
+    if not ok:
+        bad = sorted(k for k, v in checks.items() if not v)
+        print(f"FAILED checks: {bad}", file=sys.stderr)
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

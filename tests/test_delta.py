@@ -568,6 +568,9 @@ def test_cli_refit_end_to_end(tmp_path, capsys):
     assert out["touched"] > 0
     assert out["refit_cost_ratio"] is not None
     assert out["generation"] > out["from_generation"]
+    # refit stamps the engaged kernel path like fit/profile do (round
+    # 21 backfill): the warm-start steps run the same compiled step
+    assert out["kernel_path"]
     # the published refit snapshot is loadable and is the latest
     assert CheckpointManager(snaps).latest() == out["generation"]
 

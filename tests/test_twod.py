@@ -161,9 +161,20 @@ def test_build_refusals(planted):
             g, _cfg(partition="2d", replica_cols=2),
             make_mesh_2d((4, 1), devs[:4]),
         )
-    with pytest.raises(ValueError, match="XLA-only"):
+    # ISSUE 17: the fused superstep now ENGAGES on 2d — use_pallas_csr
+    # no longer refuses on partition; on this toy graph with the default
+    # (TPU-sized) tile shape the refusal is the economy gate's
+    with pytest.raises(ValueError, match="uneconomical"):
         TwoDShardedBigClamModel(
             g, _cfg(partition="2d", replica_cols=1, use_pallas_csr=True),
+            make_mesh_2d((4, 1), devs[:4]),
+        )
+    # the split/grouped kernel suites stay 1d-only — an explicit
+    # csr_fused=False override refuses with the pointer to 1d
+    with pytest.raises(ValueError, match="partition 1d"):
+        TwoDShardedBigClamModel(
+            g, _cfg(partition="2d", replica_cols=1, use_pallas_csr=True,
+                    csr_fused=False),
             make_mesh_2d((4, 1), devs[:4]),
         )
     with pytest.raises(ValueError, match="'k' axis must be 1"):
