@@ -269,10 +269,10 @@ def _open_telemetry(args, entry: str):
             entry=entry,
             heartbeat_s=getattr(args, "heartbeat_s", 0.0),
             quiet=getattr(args, "quiet", False),
-            # ingest and serve are jax-free entries (serve only imports
-            # jax lazily for fold-in): device sampling would initialize
-            # a backend they never use
-            device_memory=entry not in ("ingest", "serve"),
+            # ingest, serve, and route are jax-free entries (serve only
+            # imports jax lazily for fold-in; the router never does):
+            # device sampling would initialize a backend they never use
+            device_memory=entry not in ("ingest", "serve", "route"),
             auto_gate=not getattr(args, "distributed", False),
             heartbeat_escalate=getattr(args, "heartbeat_escalate", 0),
             # passed THROUGH rather than via os.environ: an env mutation
@@ -704,6 +704,12 @@ def _cmd_fit(args, tel=None) -> int:
                 "error: --follow needs --publish-dir (each refit "
                 "publishes a snapshot generation the server swaps to)"
             )
+        if getattr(args, "publish_shards", 0) > 1:
+            raise SystemExit(
+                "error: --follow publishes single archives — it cannot "
+                "feed a fleet yet (drop --publish-shards; re-run `cli "
+                "fit --publish-shards` per generation instead)"
+            )
         if args.mesh or args.distributed or cfg.quality_mode or (
             cfg.representation == "sparse"
         ):
@@ -849,26 +855,84 @@ def _cmd_fit(args, tel=None) -> int:
 
             from bigclam_tpu.utils.checkpoint import published_step_of
 
-            path = publish_snapshot(
-                args.publish_dir,
-                # step=None: the NEXT generation under the publish lock
-                # (ISSUE 15). Iteration counts made terrible steps — a
-                # re-fit converging in fewer iterations would publish a
-                # "lower" generation the never-backward pointer rule
-                # then rightly refused to serve
-                step=None,
-                F=res.F,
-                raw_ids=g.raw_ids,
-                num_edges=g.num_edges,
-                cfg=cfg,
-                # fit_wall_s/iters: the full-fit cost baseline `cli
-                # refit` prices its refit_cost_ratio against (ISSUE 15)
-                meta={"llh": res.llh, "seed": cfg.seed,
-                      "fit_wall_s": fit_wall_s,
-                      "fit_iters": res.num_iters},
-            )
-            out["published"] = path
-            out["generation"] = published_step_of(path)
+            # fit_wall_s/iters: the full-fit cost baseline `cli refit`
+            # prices its refit_cost_ratio against (ISSUE 15)
+            pub_meta = {"llh": res.llh, "seed": cfg.seed,
+                        "fit_wall_s": fit_wall_s,
+                        "fit_iters": res.num_iters}
+            shards = int(getattr(args, "publish_shards", 0) or 0)
+            if shards > 1:
+                # fleet publication (ISSUE 18 tentpole): per-shard
+                # row-range archives + a generation manifest, under the
+                # same publish-lock monotonicity as single archives. A
+                # store-backed fit slices on the store's host ranges
+                # (each serving shard then covers whole cache shards —
+                # its adjacency loads without touching neighbors);
+                # store-less fits take equal row slices
+                from bigclam_tpu.serve.snapshot import (
+                    publish_fleet_snapshot,
+                )
+
+                store = getattr(args, "_store", None)
+                ranges = None
+                if store is not None:
+                    try:
+                        ranges = store.host_ranges(shards)
+                    except ValueError:
+                        pass    # shards does not divide the cache
+                if ranges is None:
+                    n = g.num_nodes
+                    ranges = [
+                        (s * n // shards, (s + 1) * n // shards)
+                        for s in range(shards)
+                    ]
+                kw = {}
+                if cfg.representation == "sparse":
+                    # sparse fits publish M-sized member lists, never a
+                    # densified N*K block — re-sparsify the extracted F
+                    # (top-M per row; lossless whenever M held the live
+                    # support, which the fit's cap guarantees)
+                    from bigclam_tpu.ops.sparse_members import from_dense
+
+                    m_pub = int(out.get("sparse_m", cfg.sparse_m))
+                    ids_pub, w_pub, _ = from_dense(
+                        res.F, m_pub, cfg.num_communities, g.num_nodes
+                    )
+                    kw = {"ids": ids_pub, "w": w_pub}
+                else:
+                    kw = {"F": res.F}
+                step, path = publish_fleet_snapshot(
+                    args.publish_dir,
+                    ranges,
+                    raw_ids=g.raw_ids,
+                    num_edges=g.num_edges,
+                    cfg=cfg,
+                    meta=pub_meta,
+                    **kw,
+                )
+                out["published"] = path
+                out["generation"] = step
+                out["publish_shards"] = shards
+                if tel is not None:
+                    tel.event("fleet_publish", step=step, shards=shards)
+            else:
+                path = publish_snapshot(
+                    args.publish_dir,
+                    # step=None: the NEXT generation under the publish
+                    # lock (ISSUE 15). Iteration counts made terrible
+                    # steps — a re-fit converging in fewer iterations
+                    # would publish a "lower" generation the
+                    # never-backward pointer rule then rightly refused
+                    # to serve
+                    step=None,
+                    F=res.F,
+                    raw_ids=g.raw_ids,
+                    num_edges=g.num_edges,
+                    cfg=cfg,
+                    meta=pub_meta,
+                )
+                out["published"] = path
+                out["generation"] = published_step_of(path)
         if args.save_f:
             np.save(args.save_f, res.F)
             out["save_f"] = args.save_f
@@ -1362,6 +1426,41 @@ def cmd_preflight(args) -> int:
               file=sys.stderr)
         return 1
 
+    if getattr(args, "serve", False):
+        # serving-fleet pricing (ISSUE 18 satellite): per-replica RSS
+        # (sparse-aware snapshot + inverted index + cache + adjacency
+        # slice) and fleet QPS capacity vs --qps-target — jax-free,
+        # before a single replica process is launched
+        host_ram = (
+            float(args.host_ram_gb) * (1 << 30)
+            if args.host_ram_gb else 0.0
+        )
+        try:
+            p = M.serve_preflight(
+                n,
+                directed,
+                args.k,
+                shards=args.serve_shards,
+                replicas=args.serve_replicas,
+                representation=args.representation,
+                sparse_m=args.sparse_m,
+                itemsize=8 if args.dtype == "float64" else 4,
+                cache_slots=args.serve_cache_slots,
+                avg_memberships=args.avg_memberships,
+                qps_target=args.qps_target,
+                qps_per_replica=args.qps_per_replica,
+                host_ram_bytes=host_ram,
+            )
+        except ValueError as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 1
+        p["notes"] = notes + p["notes"]
+        if args.json:
+            print(json.dumps(p, sort_keys=True))
+        else:
+            print(M.render_serve_preflight(p))
+        return 0 if p["fits"] else 2
+
     if args.mesh:
         dp, tp = (int(x) for x in args.mesh.split(","))
     else:
@@ -1510,6 +1609,15 @@ def _cmd_serve(args, tel=None) -> int:
     from bigclam_tpu.serve.snapshot import SnapshotError
     from bigclam_tpu.utils.profiling import StageProfile
 
+    if bool(args.snapshots) == bool(getattr(args, "fleet", None)):
+        print(
+            "error: serve needs exactly one of --snapshots (single-"
+            "process) or --fleet (shard-replica mode)",
+            file=sys.stderr,
+        )
+        return 1
+    if getattr(args, "fleet", None):
+        return _cmd_serve_fleet_replica(args, tel)
     prof = StageProfile()
     store = graph = None
     if args.graph:
@@ -1561,6 +1669,8 @@ def _cmd_serve(args, tel=None) -> int:
                 foldin_conv_tol=args.foldin_conv_tol,
                 foldin_max_deg=args.foldin_max_deg,
                 watch_interval_s=args.watch_snapshots,
+                max_queue_depth=getattr(args, "max_queue_depth", 0),
+                shed_wait_s=getattr(args, "shed_wait_ms", 0.0) / 1e3,
             )
     except SnapshotError as e:
         print(f"error: {e}", file=sys.stderr)
@@ -1585,6 +1695,226 @@ def _cmd_serve(args, tel=None) -> int:
                 print(json.dumps(r))
     finally:
         server.close()
+    if tel is not None:
+        tel.set_final(out)
+    print(json.dumps(out))
+    return 1 if out.get("serve_errors") else 0
+
+
+def _cmd_serve_fleet_replica(args, tel=None) -> int:
+    """One shard replica of a serving fleet (ISSUE 18): load this
+    shard's rows of the latest fleet generation (`cli fit
+    --publish-shards`) and answer the line-framed JSON sub-query
+    protocol over TCP until a `stop` op or Ctrl-C.
+
+        cli serve --fleet snaps/ --fleet-shard 0 --listen 127.0.0.1:0 \\
+            --graph g.cache --max-queue-depth 256 --shed-wait-ms 50
+
+    `cli route` is the client; N replicas of the same shard bind
+    different ports and the router dispatches to the least loaded.
+    --watch-snapshots polls for newer fleet generations (the replica
+    holds the two newest; the router flips fleet-wide, barrier-free)."""
+    from bigclam_tpu.graph.store import GraphStore, is_cache_dir
+    from bigclam_tpu.serve.fleet import ReplicaServer, ShardReplica
+    from bigclam_tpu.serve.snapshot import SnapshotError
+
+    if not args.listen:
+        print("error: --fleet needs --listen HOST:PORT",
+              file=sys.stderr)
+        return 1
+    host, _, port_s = args.listen.rpartition(":")
+    try:
+        port = int(port_s)
+    except ValueError:
+        print(
+            f"error: --listen {args.listen!r}: port must be an integer",
+            file=sys.stderr,
+        )
+        return 1
+    store = None
+    if args.graph:
+        if not is_cache_dir(args.graph):
+            print(
+                "error: --fleet replicas need a compiled cache --graph "
+                "(suggest_for reads the shard's adjacency range from "
+                "the store; text graphs have no ranges)",
+                file=sys.stderr,
+            )
+            return 1
+        # read-only, like every serving path (ISSUE 15)
+        store = GraphStore.open(args.graph, self_heal=False)
+    try:
+        replica = ShardReplica(
+            args.fleet,
+            args.fleet_shard,
+            store=store,
+            cache_slots=args.cache_slots,
+            foldin_max_iters=args.foldin_max_iters,
+            foldin_conv_tol=args.foldin_conv_tol,
+            foldin_max_deg=args.foldin_max_deg,
+            watch_interval_s=args.watch_snapshots,
+        )
+    except (SnapshotError, ValueError) as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 1
+    server = ReplicaServer(
+        replica,
+        host=host or "127.0.0.1",
+        port=port,
+        max_batch=args.max_batch,
+        budget_s=args.latency_budget_ms / 1e3,
+        max_queue_depth=getattr(args, "max_queue_depth", 0),
+        shed_wait_s=getattr(args, "shed_wait_ms", 0.0) / 1e3,
+    )
+    # the bound endpoint, printed BEFORE serving starts: the launcher
+    # (scripts/fleet_gate.py, an operator script) reads this line to
+    # learn the port when --listen ended in :0
+    print(
+        json.dumps(
+            {
+                "listening": f"{server.host}:{server.port}",
+                "shard": args.fleet_shard,
+                "generations": replica.generations,
+            }
+        ),
+        flush=True,
+    )
+    if tel is not None:
+        tel.commit_gate()
+    try:
+        server.serve_until_stopped()
+    except KeyboardInterrupt:
+        pass
+    out = replica.status()
+    out["shed"] = server._batcher.shed
+    server.close()
+    if tel is not None:
+        tel.set_final(out)
+    print(json.dumps(out))
+    return 1 if out.get("errors") else 0
+
+
+def cmd_route(args) -> int:
+    tel = _open_telemetry(args, "route")
+    try:
+        return _cmd_route(args, tel)
+    finally:
+        _close_telemetry(tel)
+
+
+def _parse_endpoints(spec: str, timeout_s: float):
+    """--endpoints 'host:port,host:port,...' -> TcpReplica transports."""
+    from bigclam_tpu.serve.router import TcpReplica
+
+    out = []
+    for item in spec.split(","):
+        item = item.strip()
+        if not item:
+            continue
+        host, _, port_s = item.rpartition(":")
+        try:
+            port = int(port_s)
+        except ValueError:
+            raise SystemExit(
+                f"error: --endpoints {item!r}: expected HOST:PORT"
+            )
+        out.append(
+            TcpReplica(host or "127.0.0.1", port, timeout_s=timeout_s)
+        )
+    if not out:
+        raise SystemExit("error: --endpoints named no endpoints")
+    return out
+
+
+def _cmd_route(args, tel=None) -> int:
+    """jax-free fleet query router (ISSUE 18): route the same three
+    query families over a sharded replica fleet.
+
+        cli route --fleet snaps/ \\
+            --endpoints 127.0.0.1:7001,127.0.0.1:7002 \\
+            --queries load.jsonl --results answers.jsonl
+
+    communities_of / suggest_for go to their node's shard (least-loaded
+    healthy replica); members_of scatter-gathers every shard and merges
+    under the sorted-by-raw-id contract. Every query is pinned to the
+    fleet-wide serving generation (the max generation EVERY healthy
+    replica of EVERY shard holds) — a mid-stream publication flips the
+    whole fleet at once, never a mixed answer. Stats carry the same
+    serve_* keys as `cli serve` plus per-shard latency tables, so the
+    perf ledger and `cli perf diff` verdict them with one code path.
+    --stop sends a stop op to every endpoint instead (fleet teardown)."""
+    from bigclam_tpu.serve.router import FleetRouter, RouterError
+
+    endpoints = _parse_endpoints(
+        args.endpoints, args.request_timeout_s
+    )
+    if args.stop:
+        stopped = 0
+        for t in endpoints:
+            try:
+                t.request({"family": "stop"})
+                stopped += 1
+            except Exception as e:   # noqa: BLE001 — best-effort stop
+                print(
+                    f"note: {t.host}:{t.port}: {e}", file=sys.stderr
+                )
+            t.close()
+        print(json.dumps({"stopped": stopped, "of": len(endpoints)}))
+        return 0 if stopped == len(endpoints) else 1
+    queries = [_parse_query_spec(s) for s in (args.query or [])]
+    if args.queries:
+        with open(args.queries) as f:
+            for lineno, line in enumerate(f, 1):
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    queries.append(json.loads(line))
+                except ValueError as e:
+                    print(
+                        f"error: {args.queries}:{lineno}: not JSON "
+                        f"({e})",
+                        file=sys.stderr,
+                    )
+                    return 1
+    if not queries:
+        print(
+            "error: nothing to route — pass --query and/or --queries "
+            "(or --stop)",
+            file=sys.stderr,
+        )
+        return 1
+    try:
+        router = FleetRouter(
+            args.fleet,
+            endpoints,
+            max_workers=args.max_workers,
+            health_interval_s=args.health_interval_s,
+            request_timeout_s=args.request_timeout_s,
+        )
+    except RouterError as e:
+        print(f"error: {e}", file=sys.stderr)
+        for t in endpoints:
+            t.close()
+        return 1
+    if tel is not None:
+        tel.commit_gate()
+    try:
+        results = []
+        for _ in range(max(args.repeat, 1)):
+            results = router.run_queries(queries)
+        out = router.stats()
+        out["fleet"] = args.fleet
+        if args.results:
+            with open(args.results, "w") as f:
+                for r in results:
+                    f.write(json.dumps(r) + "\n")
+            out["results"] = args.results
+        elif not args.quiet and len(queries) <= 16:
+            for r in results:
+                print(json.dumps(r))
+    finally:
+        router.close()
     if tel is not None:
         tel.set_final(out)
     print(json.dumps(out))
@@ -1821,6 +2151,15 @@ def main(argv=None) -> int:
              "utils.checkpoint.publish): `cli serve --snapshots <dir>` "
              "loads it, and a running server hot-swaps to it",
     )
+    p_fit.add_argument(
+        "--publish-shards", type=int, default=0, metavar="S",
+        help="with --publish-dir: publish the generation as S per-shard "
+             "row-range archives + a fleet manifest (ISSUE 18) instead "
+             "of one whole-F archive — `cli serve --fleet <dir> "
+             "--fleet-shard s` replicas and `cli route` consume it; "
+             "store-backed fits slice on the cache's host ranges when S "
+             "divides the shard count (0/1 = single archive)",
+    )
     p_fit.add_argument("--save-f", default=None, help="write F as .npy")
     p_fit.add_argument(
         "--export-gexf", default=None,
@@ -2051,10 +2390,39 @@ def main(argv=None) -> int:
              "jax-free",
     )
     p_srv.add_argument(
-        "--snapshots", required=True,
+        "--snapshots", default=None,
         help="snapshot directory (`cli fit --publish-dir` / "
              "utils.checkpoint.publish): the latest published snapshot "
-             "is served, falling back past corrupt ones",
+             "is served, falling back past corrupt ones (XOR --fleet)",
+    )
+    p_srv.add_argument(
+        "--fleet", default=None, metavar="DIR",
+        help="fleet-replica mode (ISSUE 18): serve ONE shard of a "
+             "fleet publication (`cli fit --publish-shards`) over TCP "
+             "line-framed JSON — needs --fleet-shard and --listen; "
+             "`cli route` is the client",
+    )
+    p_srv.add_argument(
+        "--fleet-shard", type=int, default=0, metavar="S",
+        help="which shard of the fleet manifest this replica serves",
+    )
+    p_srv.add_argument(
+        "--listen", default=None, metavar="HOST:PORT",
+        help="bind address for --fleet replica mode (port 0 picks a "
+             "free port; the chosen endpoint is printed as JSON on "
+             "stdout before serving starts)",
+    )
+    p_srv.add_argument(
+        "--max-queue-depth", type=int, default=0,
+        help="admission control: reject new queries with a fast "
+             "'overloaded' error once the batcher queue holds this many "
+             "requests (0 = unbounded; sheds are counted, not errors)",
+    )
+    p_srv.add_argument(
+        "--shed-wait-ms", type=float, default=0.0,
+        help="admission control: shed queued queries that waited "
+             "longer than this before their batch flushed (0 = never; "
+             "bounds worst-case latency under overload)",
     )
     p_srv.add_argument(
         "--graph", default=None,
@@ -2138,6 +2506,85 @@ def main(argv=None) -> int:
     # pre-delta blobs over the writer's work; ISSUE 15)
     p_srv.add_argument("--quiet", action="store_true")
     p_srv.set_defaults(fn=cmd_serve)
+
+    p_rt = sub.add_parser(
+        "route",
+        help="jax-free fleet query router (ISSUE 18): dispatch "
+             "membership queries over `cli serve --fleet` replicas by "
+             "node range, scatter-gather members_of across shards, pin "
+             "every query to the fleet-wide serving generation "
+             "(barrier-free rollout), pick the least-loaded healthy "
+             "replica",
+    )
+    p_rt.add_argument(
+        "--fleet", required=True, metavar="DIR",
+        help="fleet publication directory (`cli fit --publish-shards`):"
+             " the manifest's row ranges are the routing table",
+    )
+    p_rt.add_argument(
+        "--endpoints", required=True, metavar="HOST:PORT,...",
+        help="comma-separated replica endpoints (every replica of "
+             "every shard; shard ownership is discovered from their "
+             "status answers)",
+    )
+    p_rt.add_argument(
+        "--query", action="append", default=None, metavar="FAMILY:ARG",
+        help="one query: communities_of:<u>, members_of:<c>, "
+             "suggest_for:<u>, or a JSON object (repeatable)",
+    )
+    p_rt.add_argument(
+        "--queries", default=None,
+        help="JSONL file of query objects (one per line) — the load-"
+             "test path (scripts/fleet_gate.py generates Zipf mixes)",
+    )
+    p_rt.add_argument(
+        "--results", default=None,
+        help="write one JSON answer per query line here (default: "
+             "answers echo to stdout only for <= 16 queries)",
+    )
+    p_rt.add_argument(
+        "--repeat", type=int, default=1,
+        help="run the query set this many times (load testing)",
+    )
+    p_rt.add_argument(
+        "--max-workers", type=int, default=16,
+        help="concurrent in-flight queries (the open-loop driver's "
+             "parallelism)",
+    )
+    p_rt.add_argument(
+        "--health-interval-s", type=float, default=0.0,
+        help="re-health-check replicas and re-evaluate the serving "
+             "generation every this many seconds (0 = only at startup; "
+             "the barrier-free rollout needs this to flip mid-stream)",
+    )
+    p_rt.add_argument(
+        "--request-timeout-s", type=float, default=60.0,
+        help="per-sub-query TCP timeout before failing over to the "
+             "next replica of the shard",
+    )
+    p_rt.add_argument(
+        "--stop", action="store_true",
+        help="send a stop op to every endpoint and exit (fleet "
+             "teardown; no queries run)",
+    )
+    p_rt.add_argument(
+        "--telemetry-dir", default=None,
+        help="run-telemetry directory: route events + the final router "
+             "stats (render with `cli report`; jax-free on this entry)",
+    )
+    p_rt.add_argument(
+        "--heartbeat-s", type=float, default=300.0,
+        help="stall-heartbeat deadline with --telemetry-dir "
+             "(0 disables)",
+    )
+    p_rt.add_argument(
+        "--perf-ledger", default=None,
+        help="append this route run's record (router p50/p99/QPS/shed "
+             "rate, shards x replicas in the match key) to a "
+             "perf-ledger JSONL; `cli perf diff` VERDICTS them",
+    )
+    p_rt.add_argument("--quiet", action="store_true")
+    p_rt.set_defaults(fn=cmd_route)
 
     p_ref = sub.add_parser(
         "refit",
@@ -2269,6 +2716,40 @@ def main(argv=None) -> int:
              "chunk budget (0 = fit-only stages)",
     )
     p_pre.add_argument("--csr-block-b", type=int, default=256)
+    p_pre.add_argument(
+        "--serve", action="store_true",
+        help="price a SERVING fleet instead of a fit (ISSUE 18): "
+             "per-replica RSS (sparse-aware snapshot + inverted index "
+             "+ cache + adjacency slice) and fleet QPS capacity vs "
+             "--qps-target, jax-free; same exit-code contract",
+    )
+    p_pre.add_argument(
+        "--serve-shards", type=int, default=1,
+        help="--serve: row-range shards the fleet is split into",
+    )
+    p_pre.add_argument(
+        "--serve-replicas", type=int, default=1,
+        help="--serve: replicas per shard",
+    )
+    p_pre.add_argument(
+        "--qps-target", type=float, default=0.0,
+        help="--serve: offered load to verdict fleet capacity against "
+             "(0 = report capacity without a verdict)",
+    )
+    p_pre.add_argument(
+        "--qps-per-replica", type=float, default=9000.0,
+        help="--serve: read-family throughput of one replica (measure "
+             "with scripts/serve_gate.py on target hardware)",
+    )
+    p_pre.add_argument(
+        "--serve-cache-slots", type=int, default=64,
+        help="--serve: hot-community cache capacity per replica",
+    )
+    p_pre.add_argument(
+        "--avg-memberships", type=float, default=2.0,
+        help="--serve: expected communities per node (sizes the "
+             "inverted index and the cached member lists)",
+    )
     p_pre.add_argument("--json", action="store_true",
                        help="machine-readable verdict")
     p_pre.set_defaults(fn=cmd_preflight)

@@ -299,6 +299,31 @@ class GraphStore:
         entry = self.manifest["shards"][s]
         return int(entry["lo"]), int(entry["hi"])
 
+    def node_ranges(self) -> List[Tuple[int, int]]:
+        """[(lo, hi)] of every cache shard, manifest order — the
+        manifest-driven range map the serving fleet's publication and
+        routing table derive from (ISSUE 18)."""
+        return [self.node_range(s) for s in range(self.num_shards)]
+
+    def host_ranges(self, num_hosts: int) -> List[Tuple[int, int]]:
+        """Node ranges of an even `num_hosts` split of the cache shards
+        (load_shard geometry: num_shards % num_hosts == 0) — the row
+        ranges `cli fit --publish-shards` publishes one fleet shard
+        archive per."""
+        S = self.num_shards
+        if num_hosts <= 0 or S % num_hosts != 0:
+            raise ValueError(
+                f"num_shards={S} not divisible by num_hosts={num_hosts}"
+            )
+        per = S // num_hosts
+        return [
+            (
+                self.node_range(h * per)[0],
+                self.node_range((h + 1) * per - 1)[1],
+            )
+            for h in range(num_hosts)
+        ]
+
     # --- loading ---
     def _load_blob(
         self,

@@ -141,6 +141,12 @@ class Heartbeat:
         # buffers", measured ~ modeled as "wedged, memory healthy"
         hbm_fn = getattr(self.telemetry, "hbm_modeled_bytes", None)
         hbm_modeled = hbm_fn() if callable(hbm_fn) else None
+        # the serving queue depth (serve.batcher admission control,
+        # ISSUE 18), next to the span stack: a serve stall with a full
+        # queue reads as "overloaded / handler wedged under load", an
+        # empty one as "idle or transport-starved" — None off serve
+        depth = getattr(self.telemetry, "last_queue_depth", None)
+        queue_depth = depth() if callable(depth) else depth
         self.telemetry.event(
             "stall",
             silent_s=round(silent_s, 3),
@@ -151,6 +157,7 @@ class Heartbeat:
             health=health,
             sync_s=sync_s,
             hbm_modeled_bytes=hbm_modeled,
+            queue_depth=queue_depth,
         )
         if self.echo:
             where = f"; open span: {spans[-1]}" if spans else ""
@@ -173,6 +180,7 @@ class Heartbeat:
                 health=health,
                 sync_s=sync_s,
                 hbm_modeled_bytes=hbm_modeled,
+                queue_depth=queue_depth,
             )
             if self.echo:
                 print(

@@ -292,6 +292,23 @@ def build_record(
     )
     mix = final.get("serve_mix")
     rec["serve_mix"] = str(mix) if mix else None
+    # serving fleet (ISSUE 18 satellite): shards × replicas join the
+    # match key (a 2×2 fleet's p99 is not a single-process baseline —
+    # None on non-fleet records matches only None, the usual rebaseline
+    # rule) and the shed rate is VERDICTED (an admission-control
+    # regression that sheds 10x more traffic at flat p99 must fail)
+    for field in ("serve_shards", "serve_replicas", "serve_shed"):
+        v = final.get(field)
+        rec[field] = (
+            int(v) if isinstance(v, _NUM) and not isinstance(v, bool)
+            else None
+        )
+    sr = final.get("serve_shed_rate")
+    rec["serve_shed_rate"] = (
+        _round6(float(sr))
+        if isinstance(sr, _NUM) and not isinstance(sr, bool)
+        else None
+    )
     # incremental refit (ISSUE 15): cost ratio vs the last full fit and
     # the touched fraction — both VERDICTED by `cli perf diff` (a refit
     # silently re-touching the whole graph, or costing as much as the
@@ -368,6 +385,12 @@ def match_key(rec: Dict[str, Any]) -> Tuple:
         # differs (a fold-in-heavy load is not comparable to a read-only
         # load at equal QPS). None (non-serve entries) matches None
         rec.get("serve_mix"),
+        # fleet shape (ISSUE 18 satellite): a routed 2-shard × 2-replica
+        # run does different per-query work (scatter-gather, TCP hops)
+        # than a single-process server — fleet and single-process
+        # records never cross-baseline. None matches None as usual
+        rec.get("serve_shards"),
+        rec.get("serve_replicas"),
     )
 
 
@@ -558,6 +581,12 @@ def diff_records(
         check("cache_hit_rate", base.get("cache_hit_rate"),
               new.get("cache_hit_rate"), worse_if_higher=False,
               verdicted=False)
+        # fleet shed rate (ISSUE 18 satellite): admission control
+        # shedding materially more of the load at flat p99 is a
+        # capacity regression — verdicted on router records (check()
+        # itself skips when the baseline shed nothing)
+        check("serve_shed_rate", base.get("serve_shed_rate"),
+              new.get("serve_shed_rate"))
     else:
         # steploss entries (ingest, report-only runs): wall time is the
         # only comparable figure

@@ -762,6 +762,60 @@ def render(directory: str) -> Tuple[str, int]:
                     f"(serving snapshot step "
                     f"{final.get('snapshot_step', '?')})"
                 )
+            # serving fleet (ISSUE 18): shed/overload, generation age,
+            # and the per-shard p99 table of a routed (`cli route`) run
+            if final.get("serve_shed"):
+                lines.append(
+                    f"  shed: {final['serve_shed']} "
+                    f"({final.get('serve_shed_rate', 0):.2%} of offered "
+                    "load) — admission control"
+                )
+            if isinstance(final.get("generation_age_s"), (int, float)):
+                lines.append(
+                    f"  generation age: {final['generation_age_s']:.1f}s "
+                    "since publish"
+                )
+            if final.get("serve_shards"):
+                lines.append(
+                    f"  fleet: {final['serve_shards']} shard(s) x "
+                    f"{final.get('serve_replicas', '?')} replica(s), "
+                    f"serving generation "
+                    f"{final.get('serving_generation', '?')}, "
+                    f"{final.get('rollouts', 0)} rollout(s), "
+                    f"{final.get('mixed_generation', 0)} mixed-generation "
+                    "answer(s)"
+                )
+            shard_stats = final.get("serve_shard_stats") or {}
+            if isinstance(shard_stats, dict) and shard_stats:
+                lines.append(
+                    "  shard    queries      p50 ms      p99 ms       qps"
+                )
+                for s, st in sorted(
+                    shard_stats.items(), key=lambda kv: int(kv[0])
+                ):
+                    if not isinstance(st, dict):
+                        continue
+                    p50 = st.get("p50_s")
+                    p99 = st.get("p99_s")
+                    qps = st.get("qps")
+                    lines.append(
+                        f"  {s:>5} {st.get('queries', 0):>10} "
+                        + (
+                            f"{p50 * 1e3:>11.3f} "
+                            if isinstance(p50, (int, float))
+                            else f"{'-':>11} "
+                        )
+                        + (
+                            f"{p99 * 1e3:>11.3f} "
+                            if isinstance(p99, (int, float))
+                            else f"{'-':>11} "
+                        )
+                        + (
+                            f"{qps:>9.1f}"
+                            if isinstance(qps, (int, float))
+                            else f"{'-':>9}"
+                        )
+                    )
         if merged["final"]:
             lines.append("")
             lines.append("final: " + json.dumps(merged["final"]))

@@ -110,6 +110,17 @@ EVENT_KINDS = {
     "snapshot_swap": {"step": (int,)},
     # a running server hot-swapped to a newly published snapshot
     # (utils.checkpoint publish/latest; `previous` = the old generation)
+    # --- sharded serving fleet (serve.fleet / serve.router, ISSUE 18) ---
+    "fleet_publish": {"step": (int,), "shards": (int,)},
+    # one fleet generation published (per-shard archives + manifest,
+    # utils.checkpoint.publish_fleet_next); `bytes` may ride as an extra
+    "rollout": {"step": (int,)},
+    # the router flipped the fleet-wide serving generation — only after
+    # EVERY healthy replica of EVERY shard reported `step` loaded
+    "route": {"queries": (int,), "shards": (int,)},
+    # one routed query batch (FleetRouter.run_queries); aggregates land
+    # in `final` under the same serve_* keys as `cli serve`, plus
+    # serve_shards/serve_replicas/serve_shard_stats/mixed_generation
     # --- incremental graph deltas (ISSUE 15) ---
     "delta_ingest": {"edges_added": (int,), "touched_shards": (int,)},
     # one applied edge delta (GraphStore.apply_delta): directed edges

@@ -225,6 +225,19 @@ def _render_events(
             f"{skew if isinstance(skew, (int, float)) else '?'}x "
             f"(max {b.get('max')} vs mean {b.get('mean')})"
         )
+    # serving generation age + queue depth (ISSUE 18 satellite): the
+    # newest serve batch carries wall-clock-since-publish and the live
+    # admission queue — "how stale is serving" and "how loaded" as
+    # rendered numbers, refreshed every frame
+    serves = [e for e in events if e.get("kind") == "serve"]
+    if serves:
+        s = serves[-1]
+        parts = [f"serving gen {s.get('step', '?')}"]
+        if isinstance(s.get("gen_age_s"), (int, float)):
+            parts.append(f"age {s['gen_age_s']:.1f}s")
+        if isinstance(s.get("queue_depth"), (int, float)):
+            parts.append(f"queue depth {int(s['queue_depth'])}")
+        lines.append("  " + "  ".join(parts))
     anomalies = [e for e in events if e.get("kind") == "anomaly"]
     for a in anomalies:
         it = a.get("iter")

@@ -20,8 +20,21 @@ _LAZY = {
     "publish_snapshot": (
         "bigclam_tpu.serve.snapshot", "publish_snapshot",
     ),
+    "publish_fleet_snapshot": (
+        "bigclam_tpu.serve.snapshot", "publish_fleet_snapshot",
+    ),
+    "load_fleet_shard": (
+        "bigclam_tpu.serve.snapshot", "load_fleet_shard",
+    ),
     "Future": ("bigclam_tpu.serve.batcher", "Future"),
+    "OverloadedError": ("bigclam_tpu.serve.batcher", "OverloadedError"),
     "RequestBatcher": ("bigclam_tpu.serve.batcher", "RequestBatcher"),
+    "ShardReplica": ("bigclam_tpu.serve.fleet", "ShardReplica"),
+    "ReplicaServer": ("bigclam_tpu.serve.fleet", "ReplicaServer"),
+    "LocalReplica": ("bigclam_tpu.serve.fleet", "LocalReplica"),
+    "FleetRouter": ("bigclam_tpu.serve.router", "FleetRouter"),
+    "TcpReplica": ("bigclam_tpu.serve.router", "TcpReplica"),
+    "RouterError": ("bigclam_tpu.serve.router", "RouterError"),
     "FAMILIES": ("bigclam_tpu.serve.server", "FAMILIES"),
     "FoldInEngine": ("bigclam_tpu.serve.server", "FoldInEngine"),
     "HotCommunityCache": (

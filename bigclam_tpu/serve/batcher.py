@@ -16,6 +16,22 @@ Future. Handler exceptions fail that batch's futures, never the thread.
 the hot-swap barrier (serve.server swaps snapshots between batches, so a
 swap drains in-flight batches and drops zero queries).
 
+Admission control (ISSUE 18 tentpole): overload must degrade p99, not
+OOM. Two watermarks, both off by default:
+
+  * DEPTH — `max_depth` bounds the queue: a submit() finding the queue
+    full fails its future IMMEDIATELY with OverloadedError (the caller
+    gets a fast "overloaded" answer instead of a slot in an unbounded
+    deque whose memory and wait time grow without limit);
+  * DEADLINE — `shed_wait_s` bounds queue AGE: requests that waited
+    longer than the watermark by the time their batch is taken are shed
+    at flush (they would blow the latency SLO anyway; answering them
+    late just steals capacity from requests that can still make it).
+
+Shed counts (`shed_depth` / `shed_deadline`) and the live `depth()` ride
+the server stats and telemetry, so an overload burst is a verdicted
+shed-rate + bounded-p99 curve in the ledger (scripts/fleet_gate.py).
+
 jax-free: pure threading + deque; the handler decides what touches a
 device.
 """
@@ -26,6 +42,12 @@ import threading
 import time
 from collections import deque
 from typing import Any, Callable, List, Optional
+
+
+class OverloadedError(RuntimeError):
+    """Request shed by admission control (queue past the depth/deadline
+    watermark). Servers map this to a fast {"error": "overloaded"}
+    answer — by design the CHEAPEST possible response."""
 
 
 class Future:
@@ -87,10 +109,15 @@ class RequestBatcher:
         handler: Callable[[List[Request]], None],
         max_batch: int = 64,
         budget_s: float = 0.005,
+        max_depth: int = 0,
+        shed_wait_s: float = 0.0,
     ):
         self.handler = handler
         self.max_batch = max(int(max_batch), 1)
         self.budget_s = max(float(budget_s), 0.0)
+        # admission control (module docstring): 0 = unbounded/off
+        self.max_depth = max(int(max_depth), 0)
+        self.shed_wait_s = max(float(shed_wait_s), 0.0)
         self._q: deque = deque()
         self._lock = threading.Lock()
         self._cond = threading.Condition(self._lock)
@@ -100,6 +127,9 @@ class RequestBatcher:
         self.batches = 0
         self.flushed_full = 0       # batches flushed by max_batch
         self.flushed_deadline = 0   # batches flushed by the budget window
+        self.shed_depth = 0         # submits rejected at the depth bound
+        self.shed_deadline = 0      # requests shed stale at flush
+        self.depth_peak = 0         # high-water queue depth observed
 
     # ------------------------------------------------------- lifecycle
     def start(self) -> "RequestBatcher":
@@ -133,9 +163,40 @@ class RequestBatcher:
         with self._cond:
             if self._stop or self._thread is None:
                 raise RuntimeError("batcher is not running")
+            if self.max_depth and len(self._q) >= self.max_depth:
+                # shed at the door (depth watermark): the future fails
+                # NOW — callers see the same Future surface either way
+                self.shed_depth += 1
+                req.future.set_error(
+                    OverloadedError(
+                        f"queue depth {len(self._q)} at the "
+                        f"max_depth={self.max_depth} watermark"
+                    )
+                )
+                return req.future
             self._q.append(req)
+            if len(self._q) > self.depth_peak:
+                self.depth_peak = len(self._q)
             self._cond.notify_all()
         return req.future
+
+    def depth(self) -> int:
+        """Live queue depth (requests admitted, not yet taken into a
+        batch) — the number heartbeat stall events and serve telemetry
+        embed."""
+        with self._lock:
+            return len(self._q)
+
+    def pending_payloads(self) -> List[Any]:
+        """Snapshot of the queued payloads (per-family depth metrics —
+        the server buckets them; O(depth) under the lock, called once
+        per flushed batch)."""
+        with self._lock:
+            return [r.payload for r in self._q]
+
+    @property
+    def shed(self) -> int:
+        return self.shed_depth + self.shed_deadline
 
     def drain(self, timeout: float = 60.0) -> None:
         """Block until the queue is empty and no batch is executing —
@@ -181,6 +242,30 @@ class RequestBatcher:
                     self.flushed_full += 1
                 else:
                     self.flushed_deadline += 1
+            if self.shed_wait_s > 0.0:
+                # deadline watermark: requests older than shed_wait_s by
+                # flush time would blow the SLO anyway — shed them fast
+                # and spend the batch slot on requests that can make it
+                now = time.perf_counter()
+                fresh: List[Request] = []
+                for req in batch:
+                    if now - req.future.t_submit > self.shed_wait_s:
+                        self.shed_deadline += 1
+                        req.future.set_error(
+                            OverloadedError(
+                                "request waited past the "
+                                f"shed_wait_s={self.shed_wait_s:.3f} "
+                                "watermark"
+                            )
+                        )
+                    else:
+                        fresh.append(req)
+                batch = fresh
+                if not batch:
+                    with self._cond:
+                        self._inflight -= 1
+                        self._cond.notify_all()
+                    continue
             try:
                 self.handler(batch)
                 for req in batch:

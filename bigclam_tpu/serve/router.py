@@ -1,0 +1,668 @@
+"""The serving fleet's jax-free query router (ISSUE 18 tentpole).
+
+`FleetRouter` fronts N replicas × S shards of a published fleet
+generation (serve.fleet protocol) and answers the same three query
+families as the single-process MembershipServer, with the same answer
+shapes:
+
+  * communities_of / suggest_for route BY NODE from the manifest's
+    raw-id range map: disjoint raw intervals (unpermuted cache) resolve
+    with one bisect; overlapping intervals (balanced/permuted cache)
+    probe every containing shard and the owner answers (`not_owner`
+    elsewhere);
+  * members_of scatter-gathers every shard's local inverted index and
+    merges with np.unique — ascending raw-id dedup, which IS the
+    single-process sorted-by-raw-id contract (each node lives in
+    exactly one shard, so the union is the full member list);
+  * suggest_for is two-phase: the owner returns its neighbors' GLOBAL
+    internal rows (phase 1), the router gathers their dense rows by
+    DISJOINT row range across shards (order preserved), and the owner
+    folds in against the global sumF (phase 2) — bit-for-bit the
+    single-process batch math, different addressing.
+
+Replica choice is pick-least-loaded over health-checked replicas: every
+fleet answer piggybacks the replica's live queue depth, and `refresh()`
+(the health poll) re-reads status from everyone.
+
+Barrier-free rollout: the router serves generation g until EVERY
+healthy replica of EVERY shard reports g+1 loaded (intersection of
+generation sets), then flips — and never backward. Each query captures
+the serving generation at submit and pins every sub-query to it;
+replicas echo the generation that answered, so a mixed-generation
+answer is a counted tripwire (`mixed_generation`, asserted zero by
+scripts/fleet_gate.py), not a silent wrong answer. A shard one
+generation behind simply keeps the whole fleet pinned at g — correct,
+not an error (tests/test_fleet.py).
+
+Entirely jax-free: routing is bisect + np.unique; the device work stays
+on the replicas.
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import threading
+import time
+from bisect import bisect_right
+from concurrent.futures import ThreadPoolExecutor
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from bigclam_tpu.obs import telemetry as _obs
+from bigclam_tpu.obs.ledger import _percentile
+from bigclam_tpu.utils.checkpoint import CheckpointManager
+
+FAMILIES = ("communities_of", "members_of", "suggest_for")
+
+
+class RouterError(RuntimeError):
+    """No serving generation, or no healthy replica for a shard."""
+
+
+class _Shed(Exception):
+    """A sub-query was shed by replica admission control — the whole
+    routed query degrades to one fast {"error": "overloaded"} answer."""
+
+
+class TcpReplica:
+    """Client transport to one ReplicaServer endpoint: persistent
+    JSON-lines connections (a small pool, so concurrent router workers
+    don't serialize on one socket). On an I/O error the connection is
+    dropped and the request retried once on a fresh one; a second
+    failure propagates (the router marks the endpoint unhealthy)."""
+
+    def __init__(
+        self, host: str, port: int, timeout_s: float = 60.0, pool: int = 4
+    ):
+        self.host = host
+        self.port = int(port)
+        self.timeout_s = float(timeout_s)
+        self.shard: Optional[int] = None   # filled by router discovery
+        self.depth = 0
+        self._pool: List[Any] = []
+        self._pool_lock = threading.Lock()
+        self._pool_max = max(int(pool), 1)
+
+    def _connect(self):
+        sock = socket.create_connection(
+            (self.host, self.port), timeout=self.timeout_s
+        )
+        return (sock, sock.makefile("rb"))
+
+    def _acquire(self):
+        with self._pool_lock:
+            if self._pool:
+                return self._pool.pop()
+        return self._connect()
+
+    def _release(self, conn) -> None:
+        with self._pool_lock:
+            if len(self._pool) < self._pool_max:
+                self._pool.append(conn)
+                return
+        self._discard(conn)
+
+    @staticmethod
+    def _discard(conn) -> None:
+        try:
+            conn[1].close()
+            conn[0].close()
+        except OSError:
+            pass
+
+    def request(
+        self, q: Dict[str, Any], timeout: Optional[float] = None
+    ) -> Dict[str, Any]:
+        payload = (json.dumps(q) + "\n").encode()
+        last: Optional[BaseException] = None
+        for attempt in range(2):
+            conn = None
+            try:
+                conn = self._acquire()
+                sock, rfile = conn
+                if timeout is not None:
+                    sock.settimeout(timeout)
+                sock.sendall(payload)
+                line = rfile.readline()
+                if not line:
+                    raise ConnectionError("replica closed the connection")
+                self._release(conn)
+                return json.loads(line)
+            except (OSError, ValueError, ConnectionError) as e:
+                last = e
+                if conn is not None:
+                    self._discard(conn)
+        raise ConnectionError(
+            f"replica {self.host}:{self.port} unreachable: {last}"
+        )
+
+    def close(self) -> None:
+        with self._pool_lock:
+            pool, self._pool = self._pool, []
+        for conn in pool:
+            self._discard(conn)
+
+
+class FleetRouter:
+    """See module docstring. Transports need `.request(dict) -> dict`,
+    `.shard` (set by discovery from their status answer), and `.depth`
+    (updated from piggybacked answers) — TcpReplica and
+    serve.fleet.LocalReplica both qualify."""
+
+    def __init__(
+        self,
+        directory: str,
+        endpoints: Sequence[Any],
+        max_workers: int = 16,
+        health_interval_s: float = 0.0,
+        request_timeout_s: float = 60.0,
+    ):
+        self.directory = directory
+        self._cm = CheckpointManager(directory)
+        self.endpoints = list(endpoints)
+        self.request_timeout_s = float(request_timeout_s)
+        self._tables: Dict[int, Dict[str, Any]] = {}
+        self._by_shard: Dict[int, List[Any]] = {}
+        self._down: set = set()
+        self._serving: Optional[int] = None
+        self._lock = threading.Lock()
+        self._latencies: Dict[str, List[float]] = {
+            f: [] for f in FAMILIES
+        }
+        self._shard_lat: Dict[int, List[float]] = {}
+        self._errors = 0
+        self._shed = 0
+        self.mixed_generation = 0
+        self.rollouts = 0
+        self._t_first: Optional[float] = None
+        self._t_last: Optional[float] = None
+        self._pool = ThreadPoolExecutor(
+            max_workers=max(int(max_workers), 1),
+            thread_name_prefix="bigclam-route",
+        )
+        self.refresh()
+        if self._serving is None:
+            raise RouterError(
+                f"{directory}: no common generation across healthy "
+                "replicas — is the fleet up?"
+            )
+        self._health_stop = threading.Event()
+        self._health_thread: Optional[threading.Thread] = None
+        if health_interval_s > 0:
+            self._health_thread = threading.Thread(
+                target=self._health_loop,
+                args=(float(health_interval_s),),
+                name="bigclam-route-health",
+                daemon=True,
+            )
+            self._health_thread.start()
+
+    # ------------------------------------------------------ range table
+    def _table(self, step: int) -> Dict[str, Any]:
+        t = self._tables.get(step)
+        if t is not None:
+            return t
+        man = self._cm.load_fleet_manifest(step)
+        if man is None:
+            raise RouterError(
+                f"{self.directory}: fleet manifest for generation "
+                f"{step} is unreadable"
+            )
+        entries = sorted(man["shards"], key=lambda e: int(e["lo"]))
+        raw_sorted = sorted(
+            entries, key=lambda e: int(e.get("raw_lo", 0))
+        )
+        disjoint = all(
+            int(raw_sorted[i]["raw_hi"])
+            < int(raw_sorted[i + 1]["raw_lo"])
+            for i in range(len(raw_sorted) - 1)
+        )
+        t = {
+            "row_lo": [int(e["lo"]) for e in entries],
+            "row_shard": [int(e["shard"]) for e in entries],
+            "shard_ids": [int(e["shard"]) for e in man["shards"]],
+            "raw_lo": [int(e.get("raw_lo", 0)) for e in raw_sorted],
+            "raw_hi": [int(e.get("raw_hi", -1)) for e in raw_sorted],
+            "raw_shard": [int(e["shard"]) for e in raw_sorted],
+            "raw_disjoint": disjoint,
+            "published_ts": man.get("published_ts"),
+        }
+        self._tables[step] = t
+        return t
+
+    def _owners_of_raw(self, u: int, step: int) -> List[int]:
+        """Shards that may own raw id u: one (bisect) when the raw-id
+        intervals are disjoint, every containing interval otherwise."""
+        t = self._table(step)
+        if t["raw_disjoint"]:
+            i = bisect_right(t["raw_lo"], u) - 1
+            if i >= 0 and u <= t["raw_hi"][i]:
+                return [t["raw_shard"][i]]
+            return []
+        hits = [
+            s
+            for lo, hi, s in zip(
+                t["raw_lo"], t["raw_hi"], t["raw_shard"]
+            )
+            if lo <= u <= hi
+        ]
+        return hits or list(t["shard_ids"])
+
+    def _shard_of_row(self, g: int, step: int) -> int:
+        t = self._table(step)
+        i = bisect_right(t["row_lo"], g) - 1
+        return t["row_shard"][max(i, 0)]
+
+    # --------------------------------------------------- health/rollout
+    def refresh(self) -> Optional[int]:
+        """Health-check every endpoint, rebuild the per-shard replica
+        sets, and advance the serving generation iff every healthy
+        replica of every shard holds a newer common one. Never moves
+        backward."""
+        by_shard: Dict[int, List[Any]] = {}
+        common: Optional[set] = None
+        down = set()
+        for t in self.endpoints:
+            try:
+                st = t.request({"family": "status"}, timeout=10.0)
+            except Exception:   # noqa: BLE001 — endpoint down
+                down.add(id(t))
+                continue
+            t.shard = int(st.get("shard", -1))
+            t.depth = int(st.get("depth", 0))
+            by_shard.setdefault(t.shard, []).append(t)
+            gens = set(int(g) for g in st.get("generations", []))
+            common = gens if common is None else (common & gens)
+        with self._lock:
+            self._by_shard = by_shard
+            self._down = down
+            if common:
+                cand = max(common)
+                if self._serving is None or cand > self._serving:
+                    previous = self._serving
+                    self._serving = cand
+                    if previous is not None:
+                        self.rollouts += 1
+                        tel = _obs.current()
+                        if tel is not None:
+                            tel.event("rollout", step=int(cand))
+            return self._serving
+
+    def _health_loop(self, interval: float) -> None:
+        while not self._health_stop.wait(interval):
+            try:
+                self.refresh()
+            except Exception:   # noqa: BLE001 — poller must live
+                pass
+
+    @property
+    def serving_generation(self) -> Optional[int]:
+        return self._serving
+
+    def generation_age_s(self) -> Optional[float]:
+        if self._serving is None:
+            return None
+        ts = self._table(self._serving).get("published_ts")
+        if not isinstance(ts, (int, float)):
+            return None
+        return max(time.time() - float(ts), 0.0)
+
+    # --------------------------------------------------------- dispatch
+    def _send(
+        self, shard: int, q: Dict[str, Any]
+    ) -> Dict[str, Any]:
+        """One sub-query to the least-loaded healthy replica of a shard;
+        a transport failure or an unknown_generation answer (the replica
+        pruned the pinned generation) fails over to the next replica."""
+        with self._lock:
+            reps = list(self._by_shard.get(shard, ()))
+        if not reps:
+            raise RouterError(f"no healthy replica for shard {shard}")
+        last: Optional[str] = None
+        for t in sorted(reps, key=lambda r: getattr(r, "depth", 0)):
+            t0 = time.perf_counter()
+            try:
+                res = t.request(q, timeout=self.request_timeout_s)
+            except Exception as e:   # noqa: BLE001 — fail over
+                last = f"{type(e).__name__}: {e}"
+                with self._lock:
+                    self._down.add(id(t))
+                    if t in self._by_shard.get(shard, ()):
+                        self._by_shard[shard].remove(t)
+                continue
+            self._shard_lat.setdefault(shard, []).append(
+                time.perf_counter() - t0
+            )
+            if not isinstance(res, dict):
+                last = f"non-dict answer {type(res).__name__}"
+                continue
+            t.depth = int(res.get("depth", getattr(t, "depth", 0)))
+            if res.get("error") == "unknown_generation":
+                last = f"replica pruned generation {q.get('gen')}"
+                continue
+            pin = q.get("gen")
+            if (
+                pin is not None
+                and "gen" in res
+                and int(res["gen"]) != int(pin)
+            ):
+                # the tripwire the gate asserts ZERO on — an answer
+                # from a generation the query was not pinned to
+                self.mixed_generation += 1
+            return res
+        raise RouterError(
+            f"every replica of shard {shard} failed: {last}"
+        )
+
+    @staticmethod
+    def _strip(res: Dict[str, Any]) -> Dict[str, Any]:
+        return {
+            k: v for k, v in res.items()
+            if k not in ("gen", "depth", "cached", "not_owner")
+        }
+
+    def _route_communities(
+        self, q: Dict[str, Any], gen: int
+    ) -> Dict[str, Any]:
+        u = int(q["u"])
+        for s in self._owners_of_raw(u, gen):
+            res = self._send(
+                s, {"family": "communities_of", "u": u, "gen": gen}
+            )
+            if not res.get("not_owner"):
+                return self._strip(res)
+        return {"error": f"KeyError: 'unknown node id {u}'"}
+
+    def _route_members(
+        self, q: Dict[str, Any], gen: int
+    ) -> Dict[str, Any]:
+        c = int(q["c"])
+        parts: List[np.ndarray] = []
+        for s in self._table(gen)["shard_ids"]:
+            res = self._send(
+                s, {"family": "members_of", "c": c, "gen": gen}
+            )
+            if "error" in res:
+                return self._strip(res)
+            parts.append(np.asarray(res.get("members", []), np.int64))
+        merged = (
+            np.unique(np.concatenate(parts))
+            if parts else np.zeros(0, np.int64)
+        )
+        return {"c": c, "members": [int(u) for u in merged]}
+
+    def _gather_rows(
+        self, rows: Sequence[int], gen: int
+    ) -> List[List[float]]:
+        """Dense K-vectors of GLOBAL internal rows, gathered by disjoint
+        row range across shards, returned in the REQUESTED order (the
+        fold-in's neighbor order must match the CSR order)."""
+        buckets: Dict[int, List[int]] = {}
+        for i, g in enumerate(rows):
+            buckets.setdefault(
+                self._shard_of_row(int(g), gen), []
+            ).append(i)
+        out: List[Optional[List[float]]] = [None] * len(rows)
+        for s, idxs in buckets.items():
+            res = self._send(
+                s,
+                {
+                    "family": "rows_of",
+                    "rows": [int(rows[i]) for i in idxs],
+                    "gen": gen,
+                },
+            )
+            if res.get("error") == "overloaded":
+                raise _Shed()
+            if "error" in res:
+                raise RouterError(
+                    f"rows_of on shard {s}: {res['error']}"
+                )
+            for i, r in zip(idxs, res["rows"]):
+                out[i] = r
+        return out   # type: ignore[return-value]
+
+    def _route_suggest(
+        self, q: Dict[str, Any], gen: int
+    ) -> Dict[str, Any]:
+        if "neighbors" in q:
+            return self._route_suggest_explicit(q, gen)
+        u = int(q["u"])
+        phase1 = None
+        owner = None
+        for s in self._owners_of_raw(u, gen):
+            res = self._send(
+                s, {"family": "suggest_for", "u": u, "gen": gen}
+            )
+            if not res.get("not_owner"):
+                phase1, owner = res, s
+                break
+        if phase1 is None:
+            return {"error": f"KeyError: 'unknown node id {u}'"}
+        if "error" in phase1:
+            return self._strip(phase1)
+        rows = self._gather_rows(phase1.get("needs_rows", []), gen)
+        res = self._send(
+            owner,
+            {
+                "family": "suggest_rows",
+                "u": u,
+                "gen": gen,
+                "neighbor_rows": rows,
+                "own_row": phase1.get("own_row"),
+            },
+        )
+        return self._strip(res)
+
+    def _route_suggest_explicit(
+        self, q: Dict[str, Any], gen: int
+    ) -> Dict[str, Any]:
+        """suggest_for with an explicit raw-id neighbor list (the
+        brand-new-node path): resolve each neighbor's dense row by
+        probing its owner shards, then phase 2 on the query node's owner
+        (or the least-loaded first shard for a node not in the graph)."""
+        raw = [int(v) for v in q["neighbors"]]
+        need: Dict[int, List[int]] = {}
+        for u in raw:
+            for s in self._owners_of_raw(u, gen):
+                need.setdefault(s, []).append(u)
+        rows_by_raw: Dict[int, List[float]] = {}
+        for s, ids in need.items():
+            res = self._send(
+                s, {"family": "rows_of", "raw": ids, "gen": gen}
+            )
+            for key, row in res.get("raw_rows", {}).items():
+                rows_by_raw[int(key)] = row
+        missing = [u for u in raw if u not in rows_by_raw]
+        if missing:
+            return {
+                "error": f"KeyError: 'unknown node id {missing[0]}'"
+            }
+        own_row = None
+        owner = self._table(gen)["shard_ids"][0]
+        if "u" in q:
+            u = int(q["u"])
+            for s in self._owners_of_raw(u, gen):
+                res = self._send(
+                    s, {"family": "rows_of", "raw": [u], "gen": gen}
+                )
+                got = res.get("raw_rows", {}).get(str(u))
+                if got is not None:
+                    own_row, owner = got, s
+                    break
+        sub = {
+            "family": "suggest_rows",
+            "gen": gen,
+            "neighbor_rows": [rows_by_raw[u] for u in raw],
+            "own_row": own_row,
+        }
+        if "u" in q:
+            sub["u"] = int(q["u"])
+        return self._strip(self._send(owner, sub))
+
+    # ---------------------------------------------------------- queries
+    def route(self, q: Dict[str, Any]) -> Dict[str, Any]:
+        """One fully-routed query -> one answer with the single-process
+        MembershipServer's answer shape. The serving generation is
+        captured HERE and pinned through every sub-query — a rollout
+        mid-query cannot mix generations in one answer."""
+        gen = self._serving
+        if gen is None:
+            return {"error": "RouterError: no serving generation"}
+        fam = q.get("family") if isinstance(q, dict) else None
+        t0 = time.perf_counter()
+        try:
+            if fam == "communities_of":
+                res = self._route_communities(q, gen)
+            elif fam == "members_of":
+                res = self._route_members(q, gen)
+            elif fam == "suggest_for":
+                res = self._route_suggest(q, gen)
+            else:
+                res = {"error": f"KeyError: 'unknown family {fam!r}'"}
+        except _Shed:
+            res = {"error": "overloaded"}
+        except Exception as e:   # noqa: BLE001 — per-query isolation
+            res = {"error": f"{type(e).__name__}: {e}"}
+        lat = time.perf_counter() - t0
+        with self._lock:
+            if res.get("error") == "overloaded":
+                self._shed += 1
+            elif "error" in res:
+                self._errors += 1
+            if fam in self._latencies:
+                self._latencies[fam].append(lat)
+            if self._t_first is None or t0 < self._t_first:
+                self._t_first = t0
+            end = t0 + lat
+            if self._t_last is None or end > self._t_last:
+                self._t_last = end
+        return res
+
+    def run_queries(
+        self,
+        queries: Sequence[Dict[str, Any]],
+        collect: bool = True,
+    ) -> List[Optional[Dict[str, Any]]]:
+        """Open-loop driver (the `cli route --queries` path): fan the
+        queries over the worker pool, preserve order, never raise
+        per-query."""
+        futures = [self._pool.submit(self.route, q) for q in queries]
+        out: List[Optional[Dict[str, Any]]] = []
+        for fut in futures:
+            res = fut.result()
+            out.append(res if collect else None)
+        tel = _obs.current()
+        if tel is not None:
+            tel.event(
+                "route",
+                queries=len(queries),
+                shards=len(self._by_shard),
+            )
+        return out
+
+    # ------------------------------------------------------------ stats
+    def reset_stats(self) -> None:
+        with self._lock:
+            self._latencies = {f: [] for f in FAMILIES}
+            self._shard_lat = {}
+            self._errors = 0
+            self._shed = 0
+            self._t_first = self._t_last = None
+
+    def stats(self) -> Dict[str, Any]:
+        """The router scoreboard, key-compatible with
+        MembershipServer.stats() where the meaning coincides (so
+        obs.ledger harvests both with one code path) plus the
+        fleet-only axes: shards/replicas, per-shard latency tables, the
+        rollout/mixed-generation counters, and the shed rate."""
+        with self._lock:
+            lats = [
+                v for fam in FAMILIES for v in self._latencies[fam]
+            ]
+            by_family = {
+                fam: len(self._latencies[fam])
+                for fam in FAMILIES
+                if self._latencies[fam]
+            }
+            shard_lat = {
+                s: list(v) for s, v in self._shard_lat.items()
+            }
+            errors, shed = self._errors, self._shed
+            t_first, t_last = self._t_first, self._t_last
+            shards = len(self._by_shard)
+            replicas = (
+                len(
+                    [
+                        t for t in self.endpoints
+                        if id(t) not in self._down
+                    ]
+                )
+                // max(shards, 1)
+            )
+        total = len(lats)
+        wall = (
+            max(t_last - t_first, 1e-9)
+            if t_first is not None and t_last is not None
+            else 0.0
+        )
+        mix = "|".join(
+            f"{fam}:{n / total:.2f}" for fam, n in by_family.items()
+        )
+        out = {
+            "serve_queries": total,
+            "serve_errors": errors,
+            "serve_by_family": by_family,
+            "serve_mix": mix,
+            "serve_p50_s": _percentile(lats, 50),
+            "serve_p99_s": _percentile(lats, 99),
+            "serve_qps": (total / wall) if wall else None,
+            "serve_shed": shed,
+            "serve_shed_rate": (
+                round(shed / (total + shed), 4)
+                if (total + shed) else 0.0
+            ),
+            "serve_shards": shards,
+            "serve_replicas": replicas,
+            "serve_shard_stats": {
+                str(s): {
+                    "queries": len(v),
+                    "p50_s": _percentile(v, 50),
+                    "p99_s": _percentile(v, 99),
+                    "qps": (
+                        round(len(v) / wall, 2) if wall else None
+                    ),
+                }
+                for s, v in sorted(shard_lat.items())
+            },
+            "serving_generation": self._serving,
+            "snapshot_step": self._serving,
+            "mixed_generation": self.mixed_generation,
+            "rollouts": self.rollouts,
+        }
+        age = self.generation_age_s()
+        if age is not None:
+            out["generation_age_s"] = round(age, 3)
+        for key in ("serve_p50_s", "serve_p99_s", "serve_qps"):
+            if out[key] is not None:
+                out[key] = round(out[key], 6)
+        for st in out["serve_shard_stats"].values():
+            for key in ("p50_s", "p99_s"):
+                if st[key] is not None:
+                    st[key] = round(st[key], 6)
+        return out
+
+    # -------------------------------------------------------- lifecycle
+    def close(self) -> None:
+        self._health_stop.set()
+        if self._health_thread is not None:
+            self._health_thread.join(timeout=10.0)
+            self._health_thread = None
+        self._pool.shutdown(wait=False)
+        for t in self.endpoints:
+            try:
+                t.close()
+            except Exception:   # noqa: BLE001 — best effort
+                pass

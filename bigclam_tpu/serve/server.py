@@ -49,7 +49,12 @@ import numpy as np
 from bigclam_tpu.obs import telemetry as _obs
 from bigclam_tpu.obs import trace as _trace
 from bigclam_tpu.obs.ledger import _percentile
-from bigclam_tpu.serve.batcher import Future, Request, RequestBatcher
+from bigclam_tpu.serve.batcher import (
+    Future,
+    OverloadedError,
+    Request,
+    RequestBatcher,
+)
 from bigclam_tpu.serve.snapshot import (
     FOLDIN_CFG_FIELDS,
     ServingSnapshot,
@@ -228,6 +233,57 @@ class FoldInEngine:
         rows, llh, iters = self._fit(
             rows0, nbr_rows, mask_dev, sumF_others
         )
+        return self._postprocess(rows, llh, iters, b, top_n)
+
+    def suggest_batch_rows(
+        self,
+        items: Sequence[Tuple[np.ndarray, Optional[np.ndarray]]],
+        top_n: int = 20,
+    ) -> List[dict]:
+        """items: (neighbor ROWS as a (d_i, K) array, own (K,) row or
+        None for a brand-new node). The fleet's two-phase suggest path
+        (serve.fleet): the owner shard only holds its own row range, so
+        non-local neighbor rows arrive pre-gathered from sibling shards
+        by the router, and the fold-in runs against the GLOBAL sumF
+        (the sumF_global array every shard archive carries) — identical
+        math to the id-addressed suggest_batch, different addressing."""
+        jnp, fi = self._jnp, self._fi
+        snap = self.snapshot
+        b = len(items)
+        bp = _pow2(b, self.pad_b_to)
+        d = _pow2(max((len(nr) for nr, _ in items), default=1))
+        dt = snap.sumF.dtype
+        nbr_rows = np.zeros((bp, d, snap.k), dt)
+        mask = np.zeros((bp, d), np.float32)
+        own_rows = np.zeros((bp, snap.k), dt)
+        has_own = np.zeros(bp, bool)
+        for i, (nr, own) in enumerate(items):
+            nr = np.asarray(nr, dt).reshape(-1, snap.k)
+            nbr_rows[i, : len(nr)] = nr
+            mask[i, : len(nr)] = 1.0
+            if own is not None:
+                own_rows[i] = np.asarray(own, dt)
+                # same warm-start policy as suggest_batch: a frozen
+                # all-zero trained row restarts from the neighbor mean
+                has_own[i] = bool(own_rows[i].max() > 0)
+        nbr_dev = jnp.asarray(nbr_rows)
+        mask_dev = jnp.asarray(mask, dt)
+        own_dev = jnp.asarray(own_rows)
+        sumF_others = self._sumF[None, :] - own_dev
+        rows0 = jnp.where(
+            jnp.asarray(has_own)[:, None],
+            own_dev,
+            fi.neighbor_mean_rows(nbr_dev, mask_dev),
+        )
+        rows, llh, iters = self._fit(
+            rows0, nbr_dev, mask_dev, sumF_others
+        )
+        return self._postprocess(rows, llh, iters, b, top_n)
+
+    def _postprocess(
+        self, rows, llh, iters, b: int, top_n: int
+    ) -> List[dict]:
+        snap = self.snapshot
         rows = np.asarray(rows)
         llh = np.asarray(llh)
         iters = np.asarray(iters)
@@ -267,6 +323,8 @@ class MembershipServer:
         foldin_conv_tol: Optional[float] = None,
         foldin_max_deg: int = 4096,
         watch_interval_s: float = 0.0,
+        max_queue_depth: int = 0,
+        shed_wait_s: float = 0.0,
     ):
         self.snapshot_dir = snapshot_dir
         self._store = store
@@ -289,7 +347,11 @@ class MembershipServer:
         self._t_first: Optional[float] = None
         self._t_last: Optional[float] = None
         self._batcher = RequestBatcher(
-            self._handle_batch, max_batch=max_batch, budget_s=budget_s
+            self._handle_batch,
+            max_batch=max_batch,
+            budget_s=budget_s,
+            max_depth=max_queue_depth,
+            shed_wait_s=shed_wait_s,
         ).start()
         self._watch_stop = threading.Event()
         self._watcher: Optional[threading.Thread] = None
@@ -428,6 +490,10 @@ class MembershipServer:
         for fut in futures:
             try:
                 res = fut.result(timeout)
+            except OverloadedError:
+                # admission-control shed: a deliberate fast answer, NOT
+                # a serve error (the batcher already counted it)
+                res = {"error": "overloaded"}
             except Exception as e:   # noqa: BLE001 — batch infra failure
                 self._errors += 1
                 res = {"error": f"{type(e).__name__}: {e}"}
@@ -509,14 +575,24 @@ class MembershipServer:
             if suggests:
                 self._handle_suggests(snap, suggests)
         self._record_latencies(batch)
+        depth = self._batcher.depth()
         tel = _obs.current()
         if tel is not None:
+            # queue depth rides the telemetry object so heartbeat stall
+            # events can embed it next to the span stack (obs.heartbeat)
+            tel.last_queue_depth = depth
+            age = self._snapshot.age_s()
             tel.event(
                 "serve",
                 family="|".join(sorted(families)),
                 batch=len(batch),
                 seconds=round(time.perf_counter() - t0, 6),
                 step=int(snap.step),
+                queue_depth=depth,
+                **(
+                    {"gen_age_s": round(age, 3)} if age is not None
+                    else {}
+                ),
                 **{f"n_{k}": v for k, v in families.items()},
             )
 
@@ -604,6 +680,9 @@ class MembershipServer:
         self._batcher.batches = 0
         self._batcher.flushed_full = 0
         self._batcher.flushed_deadline = 0
+        self._batcher.shed_depth = 0
+        self._batcher.shed_deadline = 0
+        self._batcher.depth_peak = 0
 
     def stats(self) -> Dict[str, Any]:
         """The serving scoreboard `cli serve` stamps into the telemetry
@@ -641,7 +720,15 @@ class MembershipServer:
             "batches_full": self._batcher.flushed_full,
             "batches_deadline": self._batcher.flushed_deadline,
             "foldin_truncated": self._truncated_neighbors,
+            "serve_shed": self._batcher.shed,
+            "serve_shed_rate": round(
+                self._batcher.shed / (total + self._batcher.shed), 4
+            ) if (total + self._batcher.shed) else 0.0,
+            "queue_depth_peak": self._batcher.depth_peak,
         }
+        age = self._snapshot.age_s()
+        if age is not None:
+            out["generation_age_s"] = round(age, 3)
         for key in ("serve_p50_s", "serve_p99_s", "serve_qps"):
             if out[key] is not None:
                 out[key] = round(out[key], 6)

@@ -29,6 +29,7 @@ keys the Zipf-aware hot-community cache (serve.server.HotCommunityCache).
 from __future__ import annotations
 
 import dataclasses
+import time
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -104,6 +105,9 @@ def publish_snapshot(
         "k": int(k),
         "num_edges": int(num_edges),
         "delta": delta_threshold(n, num_edges),
+        # wall-clock publication instant: serving surfaces "generation
+        # age" from this (ISSUE 18 satellite — how stale is serving)
+        "published_ts": time.time(),
         **(meta or {}),
     }
     if cfg is not None:
@@ -114,6 +118,138 @@ def publish_snapshot(
     if step is None:
         return cm.publish_next(arrays, meta=m)[1]
     return cm.publish(step, arrays, meta=m)
+
+
+def publish_fleet_snapshot(
+    directory: str,
+    shard_ranges: Sequence[Tuple[int, int]],
+    F: Optional[np.ndarray] = None,
+    ids: Optional[np.ndarray] = None,
+    w: Optional[np.ndarray] = None,
+    raw_ids: Optional[np.ndarray] = None,
+    num_edges: int = 0,
+    cfg=None,
+    meta: Optional[dict] = None,
+) -> Tuple[int, str]:
+    """Publish ONE serving generation as per-shard row-range archives +
+    a fleet manifest (ISSUE 18 tentpole): shard s gets rows
+    [lo_s, hi_s) of F (dense) or of the member lists (sparse — M-sized
+    slots, never a densified N*K block), its raw-id slice, and the
+    GLOBAL sumF vector (K floats — the fold-in tail term is global even
+    when the rows are sharded). Runs under the same publish-lock
+    monotonicity as publish_snapshot (CheckpointManager.publish_fleet_
+    next — one primitive, fleet-wide). Returns (step, manifest_path).
+
+    On a pod each host calls this with only ITS row range materialized;
+    this single-host entry takes the full arrays and slices — the CLI's
+    `fit --publish-shards` path for store-backed fits."""
+    if (F is None) == (ids is None or w is None):
+        raise ValueError(
+            "publish_fleet_snapshot needs F (dense) XOR ids+w (sparse)"
+        )
+    if not shard_ranges:
+        raise ValueError("publish_fleet_snapshot needs >= 1 shard range")
+    if F is not None:
+        F = np.asarray(F)
+        n, k = F.shape
+        rep = "dense"
+        sumF = F.sum(axis=0)
+    else:
+        ids = np.asarray(ids)
+        w = np.asarray(w)
+        n = ids.shape[0]
+        if meta and "k" in meta:
+            k = int(meta["k"])
+        elif cfg is not None:
+            k = int(cfg.num_communities)
+        else:
+            raise ValueError(
+                "sparse publish_fleet_snapshot needs k (via cfg or meta)"
+            )
+        rep = "sparse"
+        sumF = np.zeros(k, w.dtype)
+        valid = ids < k
+        np.add.at(sumF, ids[valid].astype(np.int64), w[valid])
+    raw = (
+        np.asarray(raw_ids) if raw_ids is not None
+        else np.arange(n, dtype=np.int64)
+    )
+    if int(shard_ranges[0][0]) != 0 or int(shard_ranges[-1][1]) != n:
+        raise ValueError(
+            f"shard ranges {shard_ranges[0]}..{shard_ranges[-1]} do not "
+            f"cover [0, {n})"
+        )
+    common = {
+        "representation": rep,
+        "n_global": int(n),
+        "num_shards": len(shard_ranges),
+        "k": int(k),
+        "num_edges": int(num_edges),
+        # delta from the GLOBAL n/E: membership semantics must not
+        # depend on which shard answers
+        "delta": delta_threshold(n, num_edges),
+        "published_ts": time.time(),
+        **(meta or {}),
+    }
+    if cfg is not None:
+        for f in FOLDIN_CFG_FIELDS:
+            common[f] = getattr(cfg, f)
+    shard_arrays: List[Dict[str, np.ndarray]] = []
+    shard_meta: List[dict] = []
+    for s, (lo, hi) in enumerate(shard_ranges):
+        lo, hi = int(lo), int(hi)
+        raw_s = raw[lo:hi]
+        arrays: Dict[str, np.ndarray] = {
+            "raw_ids": raw_s,
+            "sumF_global": np.asarray(sumF),
+        }
+        if rep == "dense":
+            arrays["F"] = F[lo:hi]
+        else:
+            arrays["ids"] = ids[lo:hi]
+            arrays["w"] = w[lo:hi]
+        shard_arrays.append(arrays)
+        shard_meta.append(
+            {
+                **common,
+                "shard": s,
+                "n": hi - lo,
+                "lo": lo,
+                "hi": hi,
+                # raw-id interval for the router's range map: disjoint
+                # intervals (unpermuted cache) route a raw id with one
+                # bisect; overlapping ones (balanced/permuted cache)
+                # make the router probe every containing shard
+                "raw_lo": int(raw_s.min()) if raw_s.size else 0,
+                "raw_hi": int(raw_s.max()) if raw_s.size else -1,
+            }
+        )
+    manifest_meta = dict(common)
+    return CheckpointManager(directory).publish_fleet_next(
+        shard_arrays, shard_meta, meta=manifest_meta
+    )
+
+
+def load_fleet_shard(
+    directory: str,
+    shard: int,
+    step: Optional[int] = None,
+    manifest: Optional[dict] = None,
+) -> "ServingSnapshot":
+    """Load + index ONE shard of a published fleet generation. The
+    snapshot's n/rows are the SHARD's; delta/sumF/k are global (stamped
+    at publish), so every query family answers with fleet-wide
+    semantics over local rows only."""
+    cm = CheckpointManager(directory)
+    if manifest is None:
+        manifest = cm.load_fleet_manifest(step)
+    if manifest is None:
+        raise SnapshotError(
+            f"{directory}: no published fleet generation (fit with "
+            "--publish-dir --publish-shards, or publish_fleet_snapshot())"
+        )
+    got = cm.load_fleet_shard(manifest, shard)
+    return ServingSnapshot.from_arrays(*got)
 
 
 def pad_neighbor_batch(
@@ -217,6 +353,26 @@ class ServingSnapshot:
                 "--publish-dir, or publish_snapshot())"
             )
         step, arrays, meta = got
+        return cls.from_arrays(
+            step, arrays, meta, store=store, chunk_rows=chunk_rows
+        )
+
+    @classmethod
+    def from_arrays(
+        cls,
+        step: int,
+        arrays: Dict[str, np.ndarray],
+        meta: dict,
+        store=None,
+        chunk_rows: int = 1 << 16,
+    ) -> "ServingSnapshot":
+        """Build + index a snapshot from already-loaded arrays — the
+        shared back half of load() and the per-shard fleet loader
+        (serve.snapshot.load_fleet_shard). A `sumF_global` array (fleet
+        shards stamp it) overrides the locally-summed sumF: mass share,
+        delta context, and the fold-in tail term are global quantities
+        even when this snapshot holds one shard's rows."""
+        directory = "<arrays>"
         rep = meta.get("representation", "dense")
         n = int(meta.get("n", 0))
         k = int(meta.get("k", 0))
@@ -252,6 +408,8 @@ class ServingSnapshot:
             raise SnapshotError(
                 f"{directory}: unknown representation {rep!r}"
             )
+        if "sumF_global" in arrays:
+            sumF = np.asarray(arrays["sumF_global"])
         raw = arrays.get("raw_ids")
         raw_ids = (
             np.asarray(raw)[:n] if raw is not None
@@ -360,3 +518,28 @@ class ServingSnapshot:
         admission ranking (serve.server.HotCommunityCache)."""
         count = max(min(count, self.k), 0)
         return np.argsort(-self.mass_share, kind="stable")[:count]
+
+    # ------------------------------------------- shard / fleet context
+    @property
+    def lo(self) -> int:
+        """First GLOBAL internal row this snapshot holds (0 on a
+        single-archive snapshot; the shard's range start on a fleet
+        shard). Global row g lives at local row g - lo."""
+        return int(self.meta.get("lo", 0))
+
+    @property
+    def n_global(self) -> int:
+        """Fleet-wide node count (== n on a single-archive snapshot)."""
+        return int(self.meta.get("n_global", self.n))
+
+    @property
+    def published_ts(self) -> Optional[float]:
+        ts = self.meta.get("published_ts")
+        return float(ts) if isinstance(ts, (int, float)) else None
+
+    def age_s(self) -> Optional[float]:
+        """Wall-clock seconds since this generation was published — the
+        'how stale is serving' number (None on pre-r22 snapshots that
+        carry no published_ts)."""
+        ts = self.published_ts
+        return max(time.time() - ts, 0.0) if ts is not None else None

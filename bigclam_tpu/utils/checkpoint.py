@@ -29,6 +29,18 @@ complete snapshot or the new complete snapshot, never a torn one.
 A corrupted newest snapshot falls back to the previous published one at
 load, exactly like restore() does for checkpoints. Published snapshots
 are never rotated away by the checkpoint rotation (different prefix).
+
+Fleet publication (ISSUE 18): `publish_fleet_next` publishes ONE
+generation as S per-shard `snap_` archives (each under `shard<NNNN>/`,
+the same fsync-rename + per-array-crc32 primitive) plus a
+`fleet_<step>.json` generation manifest listing every shard's row range,
+raw-id range, archive path and crc set. The whole publication — head
+selection, every shard archive, the manifest, the latest.json flip —
+runs under the SAME publish.lock as single-archive publication, so fleet
+and single-process generations share one strictly-monotonic counter and
+the never-backward pointer rule, fleet-wide. A serving-fleet reader
+(serve.router) resolves the manifest; a shard replica loads only its own
+archive — nothing ever materializes the full N-row block on one host.
 """
 
 from __future__ import annotations
@@ -85,6 +97,12 @@ class CheckpointManager:
 
     def _snap_path(self, step: int) -> str:
         return os.path.join(self.directory, f"snap_{step:09d}.npz")
+
+    def _fleet_manifest_path(self, step: int) -> str:
+        return os.path.join(self.directory, f"fleet_{step:09d}.json")
+
+    def _shard_dir(self, shard: int) -> str:
+        return os.path.join(self.directory, f"shard{shard:04d}")
 
     def _write_archive(
         self,
@@ -253,9 +271,12 @@ class CheckpointManager:
         readable generation is already published (never backward).
         Caller holds the publish lock."""
         current = self._pointer_step()
-        if current is not None and current > step and os.path.exists(
-            self._snap_path(current)
+        if current is not None and current > step and (
+            os.path.exists(self._snap_path(current))
+            or os.path.exists(self._fleet_manifest_path(current))
         ):
+            # a fleet generation is as real as a single archive: a slow
+            # single-process publisher must not roll a fleet back either
             return
         lp = os.path.join(self.directory, "latest.json")
         with open(lp + ".tmp", "w") as f:
@@ -299,6 +320,151 @@ class CheckpointManager:
             if name.startswith("snap_") and name.endswith(".npz"):
                 out.append(int(name[5:-4]))
         return sorted(out)
+
+    # --------------------------------------------- fleet publication
+    def fleet_steps(self) -> list[int]:
+        out = []
+        for name in os.listdir(self.directory):
+            if name.startswith("fleet_") and name.endswith(".json"):
+                try:
+                    out.append(int(name[6:-5]))
+                except ValueError:
+                    continue
+        return sorted(out)
+
+    def publish_fleet_next(
+        self,
+        shard_arrays: list,
+        shard_meta: list,
+        meta: Optional[Dict[str, Any]] = None,
+    ) -> Tuple[int, str]:
+        """Publish the NEXT generation as per-shard archives + a fleet
+        manifest (see module docstring). `shard_arrays[s]` is shard s's
+        array dict (its row range only — never the full block);
+        `shard_meta[s]` its sidecar meta (must carry lo/hi and, for
+        routing, raw_lo/raw_hi). One lock hold covers head selection,
+        every shard write, the manifest, and the pointer flip — exactly
+        `publish_next`'s monotonicity contract, fleet-wide. Returns
+        (step, manifest_path)."""
+        if len(shard_arrays) != len(shard_meta) or not shard_arrays:
+            raise ValueError(
+                "publish_fleet_next needs one meta per shard array "
+                f"(got {len(shard_arrays)} arrays, {len(shard_meta)} meta)"
+            )
+        with self._publish_lock():
+            steps = self.published_steps()
+            fleet = self.fleet_steps()
+            head = max(
+                steps[-1] if steps else 0,
+                fleet[-1] if fleet else 0,
+                self._pointer_step() or 0,
+            )
+            step = head + 1
+            entries = []
+            for s, (arrays, smeta) in enumerate(
+                zip(shard_arrays, shard_meta)
+            ):
+                sub = CheckpointManager(self._shard_dir(s))
+                path = sub._snap_path(step)
+                written = sub._write_archive(path, step, arrays, smeta)
+                entries.append(
+                    {
+                        "shard": s,
+                        "path": os.path.relpath(path, self.directory),
+                        "bytes": os.path.getsize(path),
+                        "array_crc32": {
+                            k: _array_crc32(v) for k, v in written.items()
+                        },
+                        **{
+                            k: smeta[k]
+                            for k in (
+                                "lo", "hi", "raw_lo", "raw_hi", "n",
+                                "representation",
+                            )
+                            if k in smeta
+                        },
+                    }
+                )
+            manifest = {
+                "step": step,
+                "num_shards": len(entries),
+                "shards": entries,
+                **(meta or {}),
+            }
+            mp = self._fleet_manifest_path(step)
+            with open(mp + ".tmp", "w") as f:
+                json.dump(manifest, f)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(mp + ".tmp", mp)
+            self._flip_pointer_locked(step)
+        return step, mp
+
+    def latest_fleet(self) -> Optional[int]:
+        """The currently-published FLEET generation: the latest.json
+        pointer when it names a readable fleet manifest, else the newest
+        manifest on disk. None when no fleet generation exists (the dir
+        may still hold single-archive publications)."""
+        ptr = self._pointer_step()
+        if ptr is not None and os.path.exists(
+            self._fleet_manifest_path(ptr)
+        ):
+            return ptr
+        fleet = self.fleet_steps()
+        return fleet[-1] if fleet else None
+
+    def load_fleet_manifest(
+        self, step: Optional[int] = None
+    ) -> Optional[Dict[str, Any]]:
+        """Decode a fleet generation manifest (latest when step=None,
+        falling back past an unreadable newest one — the manifest twin
+        of load_published's corrupt-newest fallback). None when no
+        readable fleet manifest exists."""
+        if step is not None:
+            with open(self._fleet_manifest_path(step)) as f:
+                return json.load(f)
+        steps = self.fleet_steps()
+        head = self.latest_fleet()
+        if head in steps:
+            steps = [s for s in steps if s <= head]
+        for s in reversed(steps):
+            try:
+                with open(self._fleet_manifest_path(s)) as f:
+                    return json.load(f)
+            except (OSError, ValueError) as e:
+                print(
+                    f"warning: fleet manifest step {s} unreadable "
+                    f"({type(e).__name__}: {e}); falling back to the "
+                    "previous fleet generation",
+                    file=sys.stderr,
+                )
+        return None
+
+    def load_fleet_shard(
+        self, manifest: Dict[str, Any], shard: int
+    ) -> Tuple[int, Dict[str, np.ndarray], Dict[str, Any]]:
+        """Load + crc-verify ONE shard's archive of a fleet generation.
+        The manifest's own per-array crc set must agree with the shard
+        sidecar's (a manifest pointing at a republished/torn archive is
+        corruption, not a fallback case — the generation is atomic or it
+        is nothing)."""
+        entry = manifest["shards"][shard]
+        path = os.path.join(self.directory, entry["path"])
+        step, arrays, meta = self._load_archive(path, int(manifest["step"]))
+        want = entry.get("array_crc32") or {}
+        for name, expect in want.items():
+            if name not in arrays:
+                raise CheckpointCorruption(
+                    f"{path}: array {name!r} in the fleet manifest is "
+                    "missing from the shard archive"
+                )
+            if _array_crc32(arrays[name]) != int(expect):
+                raise CheckpointCorruption(
+                    f"{path}: array {name!r} does not match the fleet "
+                    f"manifest crc for generation {manifest['step']} — "
+                    "torn or republished shard archive"
+                )
+        return step, arrays, meta
 
     def latest(self) -> Optional[int]:
         """The currently-published snapshot step: the `latest.json`

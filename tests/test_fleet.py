@@ -1,0 +1,549 @@
+"""Sharded serving fleet (ISSUE 18): fleet publication monotonicity,
+the router's scatter-gather members_of merge contract (cross-shard
+dedup, sorted-by-raw-id under permuted caches, empty shards), the
+barrier-free rollout's generation pinning, admission control in the
+batcher and over TCP, and the preflight/ledger satellites."""
+
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from bigclam_tpu.config import BigClamConfig
+from bigclam_tpu.models import BigClamModel
+from bigclam_tpu.models.agm import sample_planted_graph
+from bigclam_tpu.serve.batcher import OverloadedError, RequestBatcher
+from bigclam_tpu.serve.fleet import (
+    LocalReplica,
+    ReplicaServer,
+    ShardReplica,
+)
+from bigclam_tpu.serve.router import FleetRouter, RouterError, TcpReplica
+from bigclam_tpu.serve.server import MembershipServer
+from bigclam_tpu.serve.snapshot import (
+    publish_fleet_snapshot,
+    publish_snapshot,
+)
+from bigclam_tpu.utils.checkpoint import CheckpointManager
+
+K = 6
+N = 120
+
+
+@pytest.fixture(scope="module")
+def fitted():
+    rng = np.random.default_rng(3)
+    g, truth, = sample_planted_graph(N, K, p_in=0.8, rng=rng)
+    cfg = BigClamConfig(num_communities=K, max_iters=300)
+    model = BigClamModel(g, cfg)
+    res = model.fit(model.random_init())
+    return g, truth, cfg, model, res
+
+
+def _equal_ranges(n, shards):
+    return [(s * n // shards, (s + 1) * n // shards)
+            for s in range(shards)]
+
+
+@pytest.fixture()
+def fleetdir(tmp_path, fitted):
+    g, _, cfg, _, res = fitted
+    d = str(tmp_path / "fleet")
+    publish_fleet_snapshot(
+        d, _equal_ranges(N, 3), F=res.F, raw_ids=g.raw_ids,
+        num_edges=g.num_edges, cfg=cfg, meta={"llh": res.llh},
+    )
+    return d
+
+
+def _fleet(directory, shards, replicas=1, **kw):
+    """shards x replicas ShardReplicas behind LocalReplica transports +
+    a router over them. Returns (router, replica_objects)."""
+    reps = [
+        ShardReplica(directory, s, **kw)
+        for s in range(shards)
+        for _ in range(replicas)
+    ]
+    router = FleetRouter(directory, [LocalReplica(r) for r in reps])
+    return router, reps
+
+
+# ------------------------------------------------------ fleet publication
+def test_fleet_publish_monotonic_with_single_archives(tmp_path, fitted):
+    """Fleet and single-archive publications share ONE strictly
+    monotonic generation counter (the same publish lock): interleaving
+    them can never reuse or regress a step."""
+    g, _, cfg, _, res = fitted
+    d = str(tmp_path / "snaps")
+    s1, _ = publish_fleet_snapshot(
+        d, _equal_ranges(N, 2), F=res.F, raw_ids=g.raw_ids,
+        num_edges=g.num_edges, cfg=cfg,
+    )
+    p2 = publish_snapshot(
+        d, step=None, F=res.F, raw_ids=g.raw_ids,
+        num_edges=g.num_edges, cfg=cfg,
+    )
+    from bigclam_tpu.utils.checkpoint import published_step_of
+
+    s2 = published_step_of(p2)
+    s3, _ = publish_fleet_snapshot(
+        d, _equal_ranges(N, 2), F=res.F, raw_ids=g.raw_ids,
+        num_edges=g.num_edges, cfg=cfg,
+    )
+    assert s1 < s2 < s3
+    assert CheckpointManager(d).latest_fleet() == s3
+
+
+def test_fleet_manifest_shard_geometry(fleetdir):
+    man = CheckpointManager(fleetdir).load_fleet_manifest()
+    assert man["num_shards"] == 3
+    assert man["n_global"] == N
+    shards = man["shards"]
+    assert [s["lo"] for s in shards] == [r[0] for r in _equal_ranges(N, 3)]
+    assert [s["hi"] for s in shards] == [r[1] for r in _equal_ranges(N, 3)]
+
+
+def test_sparse_fleet_publishes_member_lists_not_dense(tmp_path, fitted):
+    """A sparse fleet publication stores M-sized slots per row, never a
+    densified N*K block — the commodity-RAM contract of the 100M x 25K
+    regime."""
+    g, _, cfg, _, res = fitted
+    from bigclam_tpu.ops.sparse_members import from_dense
+
+    m = 4
+    ids, w, _ = from_dense(res.F, m, K, N)
+    d = str(tmp_path / "sfleet")
+    step, _ = publish_fleet_snapshot(
+        d, _equal_ranges(N, 2), ids=ids, w=w, raw_ids=g.raw_ids,
+        num_edges=g.num_edges, cfg=cfg,
+    )
+    man = CheckpointManager(d).load_fleet_manifest()
+    assert man["representation"] == "sparse"
+    _, arrs, _ = CheckpointManager(d).load_fleet_shard(man, 0)
+    assert "F" not in arrs
+    assert arrs["ids"].shape == (N // 2, m)
+    # and the shard still answers membership over its raw ids
+    rep = ShardReplica(d, 0)
+    ans = rep.answer({"family": "communities_of",
+                      "u": int(g.raw_ids[0]), "gen": step})
+    assert ans["gen"] == step and "communities" in ans
+
+
+# ------------------------------------------- members_of scatter-gather
+def test_members_of_merge_matches_single_process(tmp_path, fleetdir,
+                                                 fitted):
+    g, _, cfg, _, res = fitted
+    single_dir = str(tmp_path / "single")
+    publish_snapshot(
+        single_dir, step=7, F=res.F, raw_ids=g.raw_ids,
+        num_edges=g.num_edges, cfg=cfg,
+    )
+    server = MembershipServer(single_dir)
+    router, _ = _fleet(fleetdir, 3)
+    try:
+        for c in range(K):
+            want = server.run_queries(
+                [{"family": "members_of", "c": c}]
+            )[0]
+            got = router.route({"family": "members_of", "c": c})
+            assert got["members"] == want["members"]
+            assert got["members"] == sorted(set(got["members"]))
+    finally:
+        router.close()
+        server.close()
+
+
+def test_members_cross_shard_dedup():
+    """A raw id materialized on TWO shards (overlapping raw intervals —
+    the balanced-cache world) appears ONCE in the merged answer."""
+    n, k = 10, 2
+    # every row gets an explicit above-delta home in community 1
+    # (membership_mask's zero-row fallback would otherwise make orphan
+    # rows members of EVERY community and drown the assertion)
+    F = np.zeros((n, k))
+    F[:, 1] = 1.0
+    F[5, 0] = 1.0     # shard 0, raw id 100
+    F[8, 0] = 1.0     # shard 1, raw id 100 again
+    raw = np.array([0, 1, 2, 3, 4, 100, 6, 7, 100, 9], np.int64)
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as d:
+        publish_fleet_snapshot(
+            d, [(0, 6), (6, 10)], F=F, raw_ids=raw, num_edges=20,
+            meta={"k": k},
+        )
+        router, _ = _fleet(d, 2)
+        try:
+            got = router.route({"family": "members_of", "c": 0})
+            assert got["members"] == [100]
+        finally:
+            router.close()
+
+
+def test_members_sorted_by_raw_id_under_permuted_cache():
+    """Permuted raw ids (the balanced cache's shuffle): per-shard member
+    lists arrive in arbitrary raw order and interleaved across shards —
+    the merged answer is still globally sorted by raw id."""
+    n, k = 12, 2
+    rng = np.random.default_rng(0)
+    raw = rng.permutation(np.arange(100, 100 + n)).astype(np.int64)
+    F = np.zeros((n, k))
+    F[:, 1] = 1.0                # explicit home for every row
+    members_rows = [0, 3, 5, 7, 8, 11]
+    F[members_rows, 0] = 1.0
+    F[5, 1] = 0.0                # row 5 belongs to community 0 ONLY
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as d:
+        publish_fleet_snapshot(
+            d, [(0, 4), (4, 8), (8, 12)], F=F, raw_ids=raw,
+            num_edges=30, meta={"k": k},
+        )
+        router, _ = _fleet(d, 3)
+        try:
+            got = router.route({"family": "members_of", "c": 0})
+            want = sorted(int(raw[r]) for r in members_rows)
+            assert got["members"] == want
+            # and communities_of routes a raw id through the overlap
+            # probe (raw intervals overlap under the permutation)
+            u = int(raw[5])
+            ans = router.route({"family": "communities_of", "u": u})
+            assert [c for c, _ in ans["communities"]] == [0]
+        finally:
+            router.close()
+
+
+def test_empty_and_zero_width_shards():
+    """A community with members on one shard only: the other shards
+    answer empty lists and the merge still stands. A zero-width row
+    range (an empty shard) answers every family without tripping."""
+    n, k = 8, 3
+    F = np.zeros((n, k))
+    F[:, 1] = 1.0                # explicit home for every row
+    F[[0, 2], 0] = 1.0           # community 0 lives on shard 0 only
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as d:
+        publish_fleet_snapshot(
+            d, [(0, 4), (4, 4), (4, 8)], F=F,
+            raw_ids=np.arange(n, dtype=np.int64), num_edges=16,
+            meta={"k": k},
+        )
+        router, _ = _fleet(d, 3)
+        try:
+            got = router.route({"family": "members_of", "c": 0})
+            assert got["members"] == [0, 2]
+            assert router.route(
+                {"family": "members_of", "c": 2}
+            )["members"] == []
+            ans = router.route({"family": "communities_of", "u": 6})
+            assert [c for c, _ in ans["communities"]] == [1]
+        finally:
+            router.close()
+
+
+# ------------------------------------------------- rollout + generations
+def test_rollout_pins_common_generation(tmp_path, fitted):
+    """One shard a generation behind: the fleet keeps serving the COMMON
+    generation (never mixed); once the laggard loads, one refresh flips
+    the whole fleet."""
+    g, _, cfg, _, res = fitted
+    d = str(tmp_path / "fleet")
+    publish_fleet_snapshot(
+        d, _equal_ranges(N, 2), F=res.F, raw_ids=g.raw_ids,
+        num_edges=g.num_edges, cfg=cfg,
+    )
+    reps = [ShardReplica(d, s) for s in (0, 0, 1, 1)]
+    router = FleetRouter(d, [LocalReplica(r) for r in reps])
+    try:
+        gen1 = router.stats()["serving_generation"]
+        publish_fleet_snapshot(
+            d, _equal_ranges(N, 2), F=res.F, raw_ids=g.raw_ids,
+            num_edges=g.num_edges, cfg=cfg,
+        )
+        for r in reps[:3]:           # one replica of shard 1 lags
+            assert r.maybe_load_next() is not None
+        router.refresh()
+        assert router.stats()["serving_generation"] == gen1
+        ans = router.route({"family": "communities_of",
+                            "u": int(g.raw_ids[0])})
+        assert "error" not in ans
+        assert router.stats()["rollouts"] == 0
+        assert router.stats()["mixed_generation"] == 0
+        assert reps[3].maybe_load_next() is not None
+        router.refresh()
+        st = router.stats()
+        assert st["serving_generation"] == gen1 + 1
+        assert st["rollouts"] == 1
+        ans = router.route({"family": "members_of", "c": 0})
+        assert "error" not in ans
+        assert router.stats()["mixed_generation"] == 0
+    finally:
+        router.close()
+
+
+def test_replica_holds_two_generations_and_answers_pinned(tmp_path,
+                                                          fitted):
+    g, _, cfg, _, res = fitted
+    d = str(tmp_path / "fleet")
+    s1, _ = publish_fleet_snapshot(
+        d, _equal_ranges(N, 2), F=res.F, raw_ids=g.raw_ids,
+        num_edges=g.num_edges, cfg=cfg,
+    )
+    rep = ShardReplica(d, 0)
+    s2, _ = publish_fleet_snapshot(
+        d, _equal_ranges(N, 2), F=res.F, raw_ids=g.raw_ids,
+        num_edges=g.num_edges, cfg=cfg,
+    )
+    assert rep.maybe_load_next() == s2
+    assert rep.generations == [s1, s2]
+    old = rep.answer({"family": "communities_of",
+                      "u": int(g.raw_ids[0]), "gen": s1})
+    assert old["gen"] == s1
+    gone = rep.answer({"family": "communities_of",
+                       "u": int(g.raw_ids[0]), "gen": s2 + 99})
+    assert gone["error"] == "unknown_generation"
+
+
+def test_router_fails_over_on_unknown_generation(fleetdir):
+    """A replica that already dropped the pinned generation answers
+    unknown_generation — the router must retry the next replica of the
+    shard, not surface an error."""
+    rep0 = ShardReplica(fleetdir, 0)
+    rep1 = ShardReplica(fleetdir, 1)
+    rep2 = ShardReplica(fleetdir, 2)
+
+    class _Amnesiac(LocalReplica):
+        def request(self, q, timeout=None):
+            if q.get("family") != "status":
+                return {"error": "unknown_generation",
+                        "gen": q.get("gen")}
+            return super().request(q, timeout)
+
+    healthy0 = LocalReplica(rep0)
+    router = FleetRouter(
+        fleetdir,
+        [_Amnesiac(rep0), healthy0, LocalReplica(rep1),
+         LocalReplica(rep2)],
+    )
+    try:
+        for _ in range(4):
+            ans = router.route({"family": "communities_of", "u": 0})
+            assert "error" not in ans
+        assert router.stats()["serve_errors"] == 0
+    finally:
+        router.close()
+
+
+# ------------------------------------------------------ admission control
+def test_batcher_depth_watermark_sheds_fast():
+    """With the flusher wedged mid-batch, submits past max_depth fail
+    their future IMMEDIATELY (no queue slot, no wait); admitted requests
+    survive the burst and are served once the handler unblocks."""
+    entered = threading.Event()
+    release = threading.Event()
+
+    def handler(batch):
+        entered.set()
+        release.wait(5.0)
+        for r in batch:
+            r.future.set_result(r.payload)
+
+    b = RequestBatcher(handler, max_batch=1, budget_s=0.0, max_depth=2)
+    b.start()
+    first = b.submit("warm")
+    assert entered.wait(2.0)     # handler wedged; queue now grows
+    futs = [b.submit(i) for i in range(4)]   # 2 admitted, 2 shed
+    assert futs[2].done() and futs[3].done()
+    shed = 0
+    for f in futs[2:]:
+        try:
+            f.result(0.0)
+        except OverloadedError:
+            shed += 1
+    assert shed == 2 and b.shed_depth == 2
+    assert b.depth_peak == 2
+    release.set()
+    assert first.result(2.0) == "warm"
+    assert futs[0].result(2.0) == 0
+    assert futs[1].result(2.0) == 1
+    b.stop()
+    assert b.shed == 2
+
+
+def test_batcher_deadline_watermark_sheds_stale():
+    """Requests that aged past shed_wait_s while the flusher was wedged
+    are shed at flush; fresh work after the purge is served normally."""
+    entered = threading.Event()
+    release = threading.Event()
+
+    def handler(batch):
+        entered.set()
+        release.wait(5.0)
+        for r in batch:
+            r.future.set_result("served")
+
+    b = RequestBatcher(handler, max_batch=8, budget_s=0.0,
+                       shed_wait_s=0.05)
+    b.start()
+    first = b.submit("warm")
+    assert entered.wait(2.0)     # handler wedged with the warm batch
+    futs = [b.submit(i) for i in range(3)]
+    time.sleep(0.12)             # all three age past the watermark
+    release.set()
+    assert first.result(2.0) == "served"
+    shed = 0
+    for f in futs:
+        try:
+            f.result(2.0)
+        except OverloadedError:
+            shed += 1
+    assert shed == 3
+    assert b.shed_deadline == 3
+    # fresh work after the purge is served normally
+    assert b.submit("x").result(2.0) == "served"
+    b.stop()
+
+
+def test_replica_server_tcp_roundtrip_and_stop(fleetdir):
+    rep = ShardReplica(fleetdir, 0)
+    srv = ReplicaServer(rep, port=0, budget_s=0.001)
+    t = TcpReplica(srv.host, srv.port, timeout_s=10.0)
+    try:
+        st = t.request({"family": "status"})
+        assert st["shard"] == 0 and "depth" in st
+        ans = t.request({"family": "communities_of", "u": 0,
+                         "gen": rep.generations[-1]})
+        assert ans["gen"] == rep.generations[-1]
+        assert t.request({"family": "stop"})["ok"] is True
+        assert srv.serve_until_stopped(10.0)
+    finally:
+        t.close()
+        srv.close()
+
+
+# ------------------------------------------------------------ satellites
+def test_serve_preflight_prices_fleet():
+    from bigclam_tpu.obs import memory as M
+
+    dense = M.serve_preflight(1_000_000, 20_000_000, 1000, shards=4,
+                              replicas=2)
+    sparse = M.serve_preflight(1_000_000, 20_000_000, 1000, shards=4,
+                               replicas=2, representation="sparse",
+                               sparse_m=64)
+    assert (sparse["per_replica"]["snapshot_bytes"]
+            < dense["per_replica"]["snapshot_bytes"])
+    assert dense["fleet_total_bytes"] == pytest.approx(
+        8 * dense["per_replica"]["total_bytes"]
+    )
+    tight = M.serve_preflight(
+        1_000_000, 20_000_000, 1000, shards=1, replicas=1,
+        qps_target=1e9,
+    )
+    assert not tight["fits_qps"] and not tight["fits"]
+    assert tight["knobs"]
+    small = M.serve_preflight(
+        1_000_000, 20_000_000, 1000, shards=4, replicas=2,
+        qps_target=10_000.0, host_ram_bytes=64 << 30,
+    )
+    assert small["fits"]
+
+
+def test_ledger_fleet_fields_and_shed_verdict():
+    from bigclam_tpu.obs import ledger as L
+
+    def rep(shed_rate, p99=0.002):
+        return {
+            "run": f"r{shed_rate}", "entry": "route", "pid": 0,
+            "processes": 1, "wall_s": 1.0,
+            "fingerprint": {"host": "h", "backend": "cpu",
+                            "device_kind": "cpu", "platform": "cpu"},
+            "compiles": {"count": 0, "by_key": {}},
+            "spans": {"seconds": {}},
+            "final": {
+                "serve_queries": 1000,
+                "serve_p50_s": 0.001,
+                "serve_p99_s": p99,
+                "serve_qps": 500.0,
+                "serve_mix": "members_of:1.00",
+                "serve_shards": 2,
+                "serve_replicas": 2,
+                "serve_shed": int(shed_rate * 1000),
+                "serve_shed_rate": shed_rate,
+            },
+        }
+
+    base = L.build_record(rep(0.01))
+    assert base["serve_shards"] == 2 and base["serve_replicas"] == 2
+    assert base["serve_shed_rate"] == 0.01
+    # fleet geometry joins the match key: a 2x2 fleet never baselines a
+    # single-process serve (both None) or a 4x2 fleet
+    single = L.build_record(rep(0.01))
+    single["serve_shards"] = single["serve_replicas"] = None
+    assert L.match_key(base) != L.match_key(single)
+    d = L.diff_records(base, L.build_record(rep(0.25)))
+    bad = [c for c in d["checks"]
+           if c["metric"] == "serve_shed_rate" and c["regression"]]
+    assert bad and d["regression"]
+
+
+def test_cli_parse_endpoints_rejects_garbage():
+    from bigclam_tpu.cli import _parse_endpoints
+
+    eps = _parse_endpoints("127.0.0.1:70,localhost:71", 5.0)
+    assert [(e.host, e.port) for e in eps] == [
+        ("127.0.0.1", 70), ("localhost", 71)
+    ]
+    with pytest.raises(SystemExit):
+        _parse_endpoints("nope", 5.0)
+    with pytest.raises(SystemExit):
+        _parse_endpoints("", 5.0)
+
+
+# --------------------------------------------------- suggest parity (jax)
+def test_routed_suggest_matches_single_process(tmp_path, fitted):
+    """suggest_for through the two-phase fleet protocol is bit-identical
+    to the single-process fold-in on the same F (same padding, same
+    global sumF, CSR neighbor order preserved by the row gather)."""
+    g, _, cfg, _, res = fitted
+    from bigclam_tpu.graph.store import compile_graph_cache
+
+    etxt = tmp_path / "g.txt"
+    with open(etxt, "w") as f:
+        for u in range(N):
+            for j in range(g.indptr[u], g.indptr[u + 1]):
+                v = int(g.indices[j])
+                if u < v:
+                    f.write(f"{g.raw_ids[u]} {g.raw_ids[v]}\n")
+    store = compile_graph_cache(
+        str(etxt), str(tmp_path / "g.cache"), num_shards=4
+    )
+
+    single_dir = str(tmp_path / "single")
+    publish_snapshot(
+        single_dir, step=5, F=res.F, raw_ids=g.raw_ids,
+        num_edges=g.num_edges, cfg=cfg,
+    )
+    fleet_dir = str(tmp_path / "fleetdir")
+    publish_fleet_snapshot(
+        fleet_dir, store.host_ranges(2), F=res.F, raw_ids=g.raw_ids,
+        num_edges=g.num_edges, cfg=cfg,
+    )
+    server = MembershipServer(single_dir, store=store)
+    router, _ = _fleet(fleet_dir, 2, store=store)
+    try:
+        nodes = [int(g.raw_ids[i]) for i in (0, 17, 63, 111)]
+        want = server.run_queries(
+            [{"family": "suggest_for", "u": u} for u in nodes]
+        )
+        for u, w in zip(nodes, want):
+            got = router.route({"family": "suggest_for", "u": u})
+            for key in ("u", "suggested", "llh", "iters"):
+                assert got.get(key) == w.get(key), (u, key)
+        assert router.stats()["serve_errors"] == 0
+    finally:
+        router.close()
+        server.close()
