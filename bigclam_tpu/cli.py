@@ -1350,7 +1350,27 @@ def cmd_report(args) -> int:
     compile counts, stalls, model health + anomalies, and an events.jsonl
     schema check. Exit 1 when artifacts are missing/invalid, so CI can
     gate on a telemetry dir. --json emits the machine-readable merge
-    (obs.report.render_json) with the SAME exit-code contract."""
+    (obs.report.render_json) with the SAME exit-code contract.
+
+    --fleet flips DIR from one telemetry dir to a fleet ROOT whose
+    immediate subdirectories are member telemetry dirs (the router's
+    plus every replica's --telemetry-dir, ISSUE 19): the report merges
+    them into one fleet view — router latency/QPS, per-shard rollup
+    across replicas, per-hop latency decomposition, generation ages."""
+    if getattr(args, "fleet", False):
+        if getattr(args, "json", False):
+            from bigclam_tpu.obs.report import render_fleet_json
+
+            obj, errors = render_fleet_json(args.dir)
+            print(json.dumps(obj, sort_keys=True))
+            return 1 if errors else 0
+        from bigclam_tpu.obs.report import render_fleet
+
+        text, errors = render_fleet(args.dir)
+        print(text)
+        if errors:
+            print(f"\n{errors} problem(s) found", file=sys.stderr)
+        return 1 if errors else 0
     if getattr(args, "json", False):
         from bigclam_tpu.obs.report import render_json
 
@@ -1543,7 +1563,20 @@ def cmd_watch(args) -> int:
     """Live-tail a telemetry directory (obs.watch): LLH / grad-norm /
     churn sparklines from the health events, anomalies, stalls, last-
     write age. Reads events.jsonl only — safe to run from any host while
-    the fit is still going; exits when the run finalizes."""
+    the fit is still going; exits when the run finalizes.
+
+    --fleet tails a fleet ROOT instead (ISSUE 19): one row per member
+    telemetry dir (router + replicas) with generation age, stalls, and
+    the router's slow-trace sparkline; exits when every member ends."""
+    if getattr(args, "fleet", False):
+        from bigclam_tpu.obs.watch import watch_fleet
+
+        return watch_fleet(
+            args.dir,
+            interval=args.interval,
+            once=args.once,
+            width=args.width,
+        )
     from bigclam_tpu.obs.watch import watch
 
     return watch(
@@ -1787,6 +1820,7 @@ def _cmd_serve_fleet_replica(args, tel=None) -> int:
         pass
     out = replica.status()
     out["shed"] = server._batcher.shed
+    out["depth_peak"] = server._batcher.depth_peak
     server.close()
     if tel is not None:
         tel.set_final(out)
@@ -1898,6 +1932,12 @@ def _cmd_route(args, tel=None) -> int:
             t.close()
         return 1
     if tel is not None:
+        # the stall heartbeat runs ON the router process (ISSUE 19
+        # satellite): stall events embed the in-flight trace registry —
+        # open trace count + oldest in-flight query age — so a wedged
+        # replica hop is attributable from the stall line alone
+        tel.open_traces = router.open_trace_count
+        tel.oldest_inflight_s = router.oldest_inflight_s
         tel.commit_gate()
     try:
         results = []
@@ -2360,6 +2400,13 @@ def main(argv=None) -> int:
         help="machine-readable output (merged reports + events summary + "
              "health/anomalies + recovery) for CI; exit codes unchanged",
     )
+    p_rep.add_argument(
+        "--fleet", action="store_true",
+        help="treat DIR as a fleet root whose subdirectories are member "
+             "telemetry dirs (router + replicas); merge them into one "
+             "fleet view (per-shard latency/QPS rollup, per-hop "
+             "decomposition, generation ages)",
+    )
     p_rep.set_defaults(fn=cmd_report)
 
     p_watch = sub.add_parser(
@@ -2379,6 +2426,13 @@ def main(argv=None) -> int:
     )
     p_watch.add_argument("--width", type=int, default=48,
                          help="sparkline width in samples")
+    p_watch.add_argument(
+        "--fleet", action="store_true",
+        help="treat DIR as a fleet root (subdirectories = member "
+             "telemetry dirs): one row per member with generation age "
+             "and stalls, plus the router's slow-trace sparkline; exits "
+             "when every member finalizes",
+    )
     p_watch.set_defaults(fn=cmd_watch)
 
     p_srv = sub.add_parser(

@@ -147,6 +147,17 @@ class Heartbeat:
         # empty one as "idle or transport-starved" — None off serve
         depth = getattr(self.telemetry, "last_queue_depth", None)
         queue_depth = depth() if callable(depth) else depth
+        # the router's in-flight trace registry (serve.router, ISSUE
+        # 19), the fleet analogue of the open-span stack: a route stall
+        # with open traces + a growing oldest-in-flight age reads as
+        # "wedged on a replica hop", zero open traces as "idle between
+        # batches / driver starved" — None off the router entry
+        ot = getattr(self.telemetry, "open_traces", None)
+        open_traces = ot() if callable(ot) else ot
+        oi = getattr(self.telemetry, "oldest_inflight_s", None)
+        oldest_inflight_s = oi() if callable(oi) else oi
+        if isinstance(oldest_inflight_s, float):
+            oldest_inflight_s = round(oldest_inflight_s, 3)
         self.telemetry.event(
             "stall",
             silent_s=round(silent_s, 3),
@@ -158,6 +169,8 @@ class Heartbeat:
             sync_s=sync_s,
             hbm_modeled_bytes=hbm_modeled,
             queue_depth=queue_depth,
+            open_traces=open_traces,
+            oldest_inflight_s=oldest_inflight_s,
         )
         if self.echo:
             where = f"; open span: {spans[-1]}" if spans else ""
@@ -181,6 +194,8 @@ class Heartbeat:
                 sync_s=sync_s,
                 hbm_modeled_bytes=hbm_modeled,
                 queue_depth=queue_depth,
+                open_traces=open_traces,
+                oldest_inflight_s=oldest_inflight_s,
             )
             if self.echo:
                 print(

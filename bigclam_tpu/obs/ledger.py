@@ -309,6 +309,29 @@ def build_record(
         if isinstance(sr, _NUM) and not isinstance(sr, bool)
         else None
     )
+    # distributed query tracing + freshness (ISSUE 19): the router's
+    # per-hop latency decomposition means and the serving generation age
+    # land as first-class record fields so `cli perf diff` can VERDICT
+    # them — "the router got slower" (merge/transport up) and "shard N's
+    # replica got slower" (queue/execute up) become distinguishable
+    # regressions instead of one conflated p99, and "how stale is
+    # serving" (ROADMAP 3a) gets a baseline. None (untraced / non-route
+    # records) skips the checks as usual.
+    for field in (
+        "serve_hop_transport_s",
+        "serve_hop_decode_s",
+        "serve_hop_queue_s",
+        "serve_hop_batch_wait_s",
+        "serve_hop_execute_s",
+        "serve_hop_merge_s",
+        "generation_age_s",
+    ):
+        v = final.get(field)
+        rec[field] = (
+            _round6(float(v))
+            if isinstance(v, _NUM) and not isinstance(v, bool)
+            else None
+        )
     # incremental refit (ISSUE 15): cost ratio vs the last full fit and
     # the touched fraction — both VERDICTED by `cli perf diff` (a refit
     # silently re-touching the whole graph, or costing as much as the
@@ -587,6 +610,20 @@ def diff_records(
         # itself skips when the baseline shed nothing)
         check("serve_shed_rate", base.get("serve_shed_rate"),
               new.get("serve_shed_rate"))
+        # per-hop decomposition (ISSUE 19): verdicted separately so the
+        # diff NAMES the slow hop. Hop means are micro-quantities over
+        # traced samples — noisier than the aggregate p99 — so they get
+        # a wider band (2x the p50->p90-spread-widened tolerance)
+        for hop in ("transport", "decode", "queue", "batch_wait",
+                    "execute", "merge"):
+            field = f"serve_hop_{hop}_s"
+            check(field, base.get(field), new.get(field), band_mult=2.0)
+        # freshness (ROADMAP 3a): serving a materially older generation
+        # than baseline is a staleness regression — the publish cadence
+        # broke, not the query path. Wall-clock age is scheduler-noisy,
+        # hence the widest band
+        check("generation_age_s", base.get("generation_age_s"),
+              new.get("generation_age_s"), band_mult=4.0)
     else:
         # steploss entries (ingest, report-only runs): wall time is the
         # only comparable figure

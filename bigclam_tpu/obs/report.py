@@ -785,6 +785,34 @@ def render(directory: str) -> Tuple[str, int]:
                     f"{final.get('mixed_generation', 0)} mixed-generation "
                     "answer(s)"
                 )
+            # failover tripwires (ISSUE 19 satellite): counters that used
+            # to live only in the router's stats() dict and die with the
+            # process — rendered whenever the router recorded them
+            if (
+                "transport_failovers" in final
+                or "pruned_generation" in final
+            ):
+                lines.append(
+                    f"  failovers: {final.get('transport_failovers', 0)} "
+                    "transport, "
+                    f"{final.get('pruned_generation', 0)} "
+                    "pruned-generation"
+                )
+            # per-hop latency decomposition (ISSUE 19 tentpole): the
+            # cross-process trace means — where a routed query's time
+            # went, fleet-wide
+            hop_parts = []
+            for hop in ("transport", "decode", "queue", "batch_wait",
+                        "execute", "merge"):
+                v = final.get(f"serve_hop_{hop}_s")
+                if isinstance(v, (int, float)):
+                    hop_parts.append(f"{hop} {v * 1e3:.3g}ms")
+            if hop_parts:
+                lines.append(
+                    "  per-hop mean: " + "  ".join(hop_parts)
+                    + f"  (over {final.get('traced_queries', '?')} "
+                    "traced)"
+                )
             shard_stats = final.get("serve_shard_stats") or {}
             if isinstance(shard_stats, dict) and shard_stats:
                 lines.append(
@@ -816,6 +844,20 @@ def render(directory: str) -> Tuple[str, int]:
                             else f"{'-':>9}"
                         )
                     )
+                for s, st in sorted(
+                    shard_stats.items(), key=lambda kv: int(kv[0])
+                ):
+                    hops = (
+                        st.get("hops") if isinstance(st, dict) else None
+                    )
+                    if isinstance(hops, dict) and hops:
+                        lines.append(
+                            f"    shard {s} hops: " + "  ".join(
+                                f"{k} {v * 1e3:.3g}ms"
+                                for k, v in hops.items()
+                                if isinstance(v, (int, float))
+                            )
+                        )
         if merged["final"]:
             lines.append("")
             lines.append("final: " + json.dumps(merged["final"]))
@@ -865,4 +907,264 @@ def render(directory: str) -> Tuple[str, int]:
             "events.jsonl: absent (non-primary dir? events are written by "
             "process 0 only)"
         )
+    return "\n".join(lines), errors
+
+
+# ------------------------------------------------------------------ fleet
+# Fleet-wide aggregation (ISSUE 19 tentpole): `cli report --fleet ROOT` /
+# `cli watch --fleet ROOT` treat ROOT as a parent directory whose
+# SUBDIRECTORIES are member telemetry dirs — the router's and every
+# replica's --telemetry-dir side by side. Merging is read-time and
+# tolerant by construction: a missing replica dir is simply not a member,
+# an empty or torn events.jsonl decodes to what it holds (load_events),
+# and a member mid-run (events, no run_report yet) contributes its live
+# event stream with an empty final.
+
+
+def fleet_dirs(root: str) -> List[str]:
+    """Member telemetry dirs of a fleet root: immediate subdirectories
+    holding an events.jsonl or any run_report*.json, sorted by name."""
+    if not os.path.isdir(root):
+        return []
+    out = []
+    for name in sorted(os.listdir(root)):
+        d = os.path.join(root, name)
+        if not os.path.isdir(d):
+            continue
+        if os.path.exists(os.path.join(d, EVENTS_NAME)) or glob.glob(
+            os.path.join(d, "run_report*.json")
+        ):
+            out.append(d)
+    return out
+
+
+def load_fleet(root: str) -> List[dict]:
+    """One record per member dir: name, entry (report first, start event
+    fallback), final outcome, decoded events (None when the log is
+    absent). Unreadable reports are treated as not-yet-written — a
+    member can be rendered mid-run."""
+    members = []
+    for d in fleet_dirs(root):
+        try:
+            reports = load_reports(d)
+        except (OSError, ValueError):
+            reports = []
+        events = load_events(d)
+        rep = reports[0] if reports else {}
+        entry = rep.get("entry")
+        if not entry and events:
+            start = next(
+                (e for e in events if e.get("kind") == "start"), {}
+            )
+            entry = start.get("entry")
+        members.append({
+            "dir": d,
+            "name": os.path.basename(d.rstrip(os.sep)),
+            "entry": entry or "?",
+            "final": rep.get("final") or {},
+            "finalized": bool(reports),
+            "events": events,
+        })
+    return members
+
+
+def _fleet_router(members: List[dict]) -> Optional[dict]:
+    """The router member: entry == "route", or (synthesized dirs) the
+    member whose final carries the per-shard stats table."""
+    for m in members:
+        if m["entry"] == "route":
+            return m
+    for m in members:
+        if m["final"].get("serve_shard_stats"):
+            return m
+    return None
+
+
+def render_fleet_json(root: str) -> Tuple[dict, int]:
+    """Machine-readable fleet view: member roster, the router's final
+    scoreboard verbatim, and replica finals grouped by shard. Exit-code
+    errors only when ROOT yields no members at all."""
+    members = load_fleet(root)
+    errors = 0 if members else 1
+    router = _fleet_router(members)
+    by_shard: Dict[str, List[dict]] = {}
+    for m in members:
+        if m is router or (
+            m["entry"] not in ("serve", "?") and "shard" not in m["final"]
+        ):
+            continue
+        if "shard" not in m["final"] and m["entry"] != "serve":
+            continue
+        f = m["final"]
+        s = f.get("shard")
+        key = str(s) if isinstance(s, int) else "?"
+        by_shard.setdefault(key, []).append({
+            "name": m["name"],
+            "finalized": m["finalized"],
+            "queries": f.get("queries"),
+            "errors": f.get("errors"),
+            "shed": f.get("shed"),
+            "depth_peak": f.get("depth_peak"),
+            "generations": f.get("generations"),
+            "gen_age_s": f.get("gen_age_s"),
+            "events": (
+                len(m["events"]) if m["events"] is not None else None
+            ),
+            "stalls": sum(
+                1 for e in (m["events"] or [])
+                if e.get("kind") == "stall"
+            ),
+        })
+    obj = {
+        "root": root,
+        "members": [
+            {
+                "name": m["name"],
+                "entry": m["entry"],
+                "finalized": m["finalized"],
+                "events": (
+                    len(m["events"]) if m["events"] is not None else None
+                ),
+            }
+            for m in members
+        ],
+        "router": (router["final"] or None) if router else None,
+        "router_dir": router["name"] if router else None,
+        "replicas": dict(sorted(by_shard.items())),
+    }
+    return obj, errors
+
+
+def render_fleet(root: str) -> Tuple[str, int]:
+    """Human fleet view: per-shard p50/p99/QPS from the router next to
+    each replica's own queue/shed/generation figures, the per-hop
+    latency decomposition, freshness, and the failover tripwires — one
+    screen answering 'which tier, which shard'."""
+    obj, errors = render_fleet_json(root)
+    if not obj["members"]:
+        return (
+            f"{root}: no member telemetry dirs (expected the router's "
+            "and each replica's --telemetry-dir as subdirectories)",
+            errors,
+        )
+    lines = [f"fleet {root}: {len(obj['members'])} member dir(s)"]
+    for m in obj["members"]:
+        lines.append(
+            f"  {m['name']} [{m['entry']}]  "
+            + (
+                f"{m['events']} event(s)" if m["events"] is not None
+                else "no events.jsonl"
+            )
+            + ("" if m["finalized"] else "  [running]")
+        )
+    rf = obj["router"] or {}
+    if rf:
+        lines.append("")
+        parts = [f"router: {rf.get('serve_queries', 0)} queries"]
+        for key, label in (
+            ("serve_p50_s", "p50"), ("serve_p99_s", "p99"),
+        ):
+            v = rf.get(key)
+            if isinstance(v, (int, float)):
+                parts.append(f"{label} {v * 1e3:.3g} ms")
+        if isinstance(rf.get("serve_qps"), (int, float)):
+            parts.append(f"{rf['serve_qps']:.4g} qps")
+        if rf.get("serve_shed"):
+            parts.append(f"shed {rf['serve_shed']}")
+        lines.append("  ".join(parts))
+        lines.append(
+            f"  generations: serving {rf.get('serving_generation', '?')}"
+            + (
+                f", age {rf['generation_age_s']:.1f}s"
+                if isinstance(rf.get("generation_age_s"), (int, float))
+                else ""
+            )
+            + f", {rf.get('rollouts', 0)} rollout(s), "
+            f"{rf.get('mixed_generation', 0)} mixed, "
+            f"{rf.get('pruned_generation', 0)} pruned-gen failover(s), "
+            f"{rf.get('transport_failovers', 0)} transport failover(s)"
+        )
+        hop_parts = []
+        for hop in ("transport", "decode", "queue", "batch_wait",
+                    "execute", "merge"):
+            v = rf.get(f"serve_hop_{hop}_s")
+            if isinstance(v, (int, float)):
+                hop_parts.append(f"{hop} {v * 1e3:.3g}ms")
+        if hop_parts:
+            lines.append(
+                "  per-hop mean: " + "  ".join(hop_parts)
+                + f"  (over {rf.get('traced_queries', '?')} traced)"
+            )
+    shard_stats = rf.get("serve_shard_stats") or {}
+    shard_keys = sorted(
+        set(shard_stats) | set(obj["replicas"]),
+        key=lambda s: (not s.isdigit(), int(s) if s.isdigit() else 0),
+    )
+    if shard_keys:
+        lines.append("")
+        lines.append(
+            "  shard    queries      p50 ms      p99 ms       qps"
+            "  replicas      shed  depth pk"
+        )
+        for s in shard_keys:
+            st = shard_stats.get(s) or {}
+            reps = obj["replicas"].get(s) or []
+            p50, p99, qps = (
+                st.get("p50_s"), st.get("p99_s"), st.get("qps")
+            )
+            shed = sum(
+                int(r["shed"]) for r in reps
+                if isinstance(r.get("shed"), int)
+            )
+            dpk = max(
+                (
+                    int(r["depth_peak"]) for r in reps
+                    if isinstance(r.get("depth_peak"), int)
+                ),
+                default=None,
+            )
+            lines.append(
+                f"  {s:>5} {st.get('queries', 0):>10} "
+                + (
+                    f"{p50 * 1e3:>11.3f} "
+                    if isinstance(p50, (int, float)) else f"{'-':>11} "
+                )
+                + (
+                    f"{p99 * 1e3:>11.3f} "
+                    if isinstance(p99, (int, float)) else f"{'-':>11} "
+                )
+                + (
+                    f"{qps:>9.1f}"
+                    if isinstance(qps, (int, float)) else f"{'-':>9}"
+                )
+                + f" {len(reps):>9}"
+                + f" {shed:>9}"
+                + (f" {dpk:>9}" if dpk is not None else f" {'-':>9}")
+            )
+            hops = st.get("hops")
+            if isinstance(hops, dict) and hops:
+                lines.append(
+                    f"    shard {s} hops: " + "  ".join(
+                        f"{k} {v * 1e3:.3g}ms"
+                        for k, v in hops.items()
+                        if isinstance(v, (int, float))
+                    )
+                )
+            for r in reps:
+                lines.append(
+                    f"    replica {r['name']}: "
+                    f"{r.get('queries', '?')} queries, "
+                    f"{r.get('errors', 0) or 0} error(s), "
+                    f"shed {r.get('shed', 0) or 0}"
+                    + (
+                        f", gen age {r['gen_age_s']:.1f}s"
+                        if isinstance(r.get("gen_age_s"), (int, float))
+                        else ""
+                    )
+                    + (
+                        f", STALLS {r['stalls']}" if r.get("stalls")
+                        else ""
+                    )
+                    + ("" if r["finalized"] else "  [running]")
+                )
     return "\n".join(lines), errors

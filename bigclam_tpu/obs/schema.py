@@ -121,6 +121,20 @@ EVENT_KINDS = {
     # one routed query batch (FleetRouter.run_queries); aggregates land
     # in `final` under the same serve_* keys as `cli serve`, plus
     # serve_shards/serve_replicas/serve_shard_stats/mixed_generation
+    # --- distributed query tracing + freshness (ISSUE 19) ---
+    "qtrace": {"trace_id": (str,), "family": (str,), "total_s": _NUM},
+    # one slow-query exemplar: the full cross-process trace of a routed
+    # query — `hops` (list of per-sub-send dicts with shard / wire_s /
+    # decode_s / queue_s / batch_wait_s / execute_s) and `merge_s`
+    # (router-side time not spent on the wire) ride as extras. The
+    # router keeps the top-N slowest traces per window (serve.router
+    # TRACE_WINDOW/TRACE_TOP) so the log stays bounded under load
+    "freshness": {"generation_age_s": _NUM},
+    # serving staleness sample (ROADMAP 3a): wall-clock seconds since
+    # the serving generation was published, emitted by the router at
+    # refresh and at batch completion; `step` (the serving generation)
+    # and `rollouts` ride as extras. Aggregates land in `final` as
+    # generation_age_s, which the perf ledger VERDICTS
     # --- incremental graph deltas (ISSUE 15) ---
     "delta_ingest": {"edges_added": (int,), "touched_shards": (int,)},
     # one applied edge delta (GraphStore.apply_delta): directed edges

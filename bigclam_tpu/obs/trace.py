@@ -52,6 +52,7 @@ telemetry's span_orphans counter, so misuse is visible, never corrupting.
 
 from __future__ import annotations
 
+import os
 import sys
 import threading
 import time
@@ -224,6 +225,22 @@ def add_span(name: str, seconds: float, emit: bool = True, **fields) -> None:
     stack = _STACKS.get(threading.get_ident())
     path = f"{stack[-1]}/{name}" if stack else name
     tel.span_complete(path, seconds, ok=True, emit=emit, fields=fields)
+
+
+_TRACE_ID = {"n": 0}
+_TRACE_ID_LOCK = threading.Lock()
+
+
+def new_trace_id() -> str:
+    """Process-unique compact trace id ("<pid hex>-<seq hex>") for the
+    distributed query trace context (ISSUE 19): the fleet router stamps
+    one on every routed query and correlates the per-hop timing blocks
+    its replicas echo. Counter-based, not random — ids stay short,
+    collision-free within a process, and orderable per router."""
+    with _TRACE_ID_LOCK:
+        _TRACE_ID["n"] += 1
+        n = _TRACE_ID["n"]
+    return f"{os.getpid():x}-{n:x}"
 
 
 def open_spans() -> List[str]:

@@ -307,3 +307,146 @@ def watch(
             time.sleep(max(interval, 0.1))
         except KeyboardInterrupt:
             return 0
+
+
+# ------------------------------------------------------------------ fleet
+def render_fleet_frame(root: str, width: int = 48) -> str:
+    """One live frame over a fleet root (ISSUE 19): ROOT's
+    subdirectories are member telemetry dirs (obs.report.fleet_dirs) —
+    the router's and every replica's. Same tolerance contract as the
+    single-dir tailer: a member with no events.jsonl yet renders as a
+    placeholder row, torn lines are skipped by the decoder."""
+    from bigclam_tpu.obs.report import load_fleet
+
+    return _render_fleet_members(root, load_fleet(root), width)
+
+
+def _render_fleet_members(root, members, width: int) -> str:
+    if not members:
+        return (
+            f"{root}: no member telemetry dirs yet (expected the "
+            "router's and each replica's --telemetry-dir as "
+            "subdirectories)"
+        )
+    lines = [f"fleet {root}: {len(members)} member(s)"]
+    for m in members:
+        name, entry, events = m["name"], m["entry"], m["events"]
+        if events is None:
+            lines.append(f"  {name} [{entry}]: no events.jsonl yet")
+            continue
+        ended = any(e.get("kind") == "end" for e in events)
+        parts = [f"events {len(events)}"]
+        fresh = [e for e in events if e.get("kind") == "freshness"]
+        if fresh:
+            f0 = fresh[-1]
+            age = f0.get("generation_age_s")
+            parts.append(
+                f"gen {f0.get('step', '?')}"
+                + (
+                    f" age {age:.1f}s"
+                    if isinstance(age, (int, float)) else ""
+                )
+            )
+        else:
+            serves = [e for e in events if e.get("kind") == "serve"]
+            if serves:
+                s = serves[-1]
+                parts.append(f"gen {s.get('step', '?')}")
+                if isinstance(s.get("gen_age_s"), (int, float)):
+                    parts.append(f"age {s['gen_age_s']:.1f}s")
+                if isinstance(s.get("queue_depth"), (int, float)):
+                    parts.append(
+                        f"queue depth {int(s['queue_depth'])}"
+                    )
+        rollouts = sum(
+            1 for e in events if e.get("kind") == "rollout"
+        )
+        if rollouts:
+            parts.append(f"rollouts {rollouts}")
+        stalls = [e for e in events if e.get("kind") == "stall"]
+        if stalls:
+            s = stalls[-1]
+            stall_part = f"STALLS {len(stalls)}"
+            if isinstance(s.get("open_traces"), int):
+                stall_part += (
+                    f" (open traces {s['open_traces']}, oldest "
+                    f"{s.get('oldest_inflight_s', '?')}s)"
+                )
+            parts.append(stall_part)
+        if ended:
+            parts.append("[finalized]")
+        else:
+            try:
+                age = time.time() - os.path.getmtime(
+                    os.path.join(m["dir"], EVENTS_NAME)
+                )
+                parts.append(f"last write {age:.0f}s ago")
+            except OSError:
+                pass
+        lines.append(f"  {name} [{entry}]: " + "  ".join(parts))
+        # the router member's slow-query exemplar trail (qtrace events):
+        # end-to-end ms of the top-N traces per window as a sparkline —
+        # a widening tail is visible live, before any report runs
+        qt = [
+            float(e["total_s"]) * 1e3
+            for e in events
+            if e.get("kind") == "qtrace"
+            and isinstance(e.get("total_s"), (int, float))
+        ]
+        if qt:
+            lines.append(
+                f"    slow traces  {sparkline(qt, width):<{width}} "
+                f"last {qt[-1]:.3g}ms"
+            )
+        fr = [
+            float(e["generation_age_s"])
+            for e in fresh
+            if isinstance(e.get("generation_age_s"), (int, float))
+        ]
+        if len(fr) >= 2:
+            lines.append(
+                f"    gen age s    {sparkline(fr, width):<{width}} "
+                f"last {fr[-1]:.1f}s"
+            )
+    return "\n".join(lines)
+
+
+def watch_fleet(
+    root: str,
+    interval: float = 2.0,
+    once: bool = False,
+    width: int = 48,
+    max_frames: int = 0,
+    out=None,
+) -> int:
+    """The fleet watch loop (`cli watch --fleet`). Returns 0, or 1 when
+    `once` finds no member dirs; the live loop exits once every member
+    has finalized (each log carries an `end` event)."""
+    import sys
+
+    from bigclam_tpu.obs.report import load_fleet
+
+    out = out or sys.stdout
+    frames = 0
+    while True:
+        members = load_fleet(root)
+        frame = _render_fleet_members(root, members, width)
+        if once:
+            print(frame, file=out)
+            return 0 if members else 1
+        if getattr(out, "isatty", lambda: False)():
+            print("\x1b[2J\x1b[H", end="", file=out)
+        print(frame, file=out, flush=True)
+        frames += 1
+        if members and all(
+            m["events"] is not None
+            and any(e.get("kind") == "end" for e in m["events"])
+            for m in members
+        ):
+            return 0
+        if max_frames and frames >= max_frames:
+            return 0
+        try:
+            time.sleep(max(interval, 0.1))
+        except KeyboardInterrupt:
+            return 0

@@ -54,13 +54,17 @@ class Future:
     """Minimal single-assignment result slot (no concurrent.futures
     executor semantics needed — the batcher owns the lifecycle)."""
 
-    __slots__ = ("_ev", "_value", "_error", "t_submit", "t_done")
+    __slots__ = ("_ev", "_value", "_error", "t_submit", "t_taken", "t_done")
 
     def __init__(self):
         self._ev = threading.Event()
         self._value = None
         self._error: Optional[BaseException] = None
         self.t_submit = time.perf_counter()
+        # stamped when the flusher pops the request into a batch: splits
+        # queue wait (submit->taken) from batch-window wait + execution
+        # (taken->done) for the per-hop trace block (ISSUE 19)
+        self.t_taken: Optional[float] = None
         self.t_done: Optional[float] = None
 
     def set_result(self, value: Any) -> None:
@@ -213,8 +217,11 @@ class RequestBatcher:
     # ----------------------------------------------------------- flush
     def _take_batch_locked(self) -> List[Request]:
         batch = []
+        now = time.perf_counter()
         while self._q and len(batch) < self.max_batch:
-            batch.append(self._q.popleft())
+            req = self._q.popleft()
+            req.future.t_taken = now
+            batch.append(req)
         return batch
 
     def _loop(self) -> None:

@@ -49,8 +49,10 @@ suggest_rows of a generation (serve.server semantics, same engine).
 from __future__ import annotations
 
 import json
+import os
 import socketserver
 import threading
+import time
 from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
@@ -373,7 +375,20 @@ class LocalReplica:
     def request(
         self, q: Dict[str, Any], timeout: Optional[float] = None
     ) -> Dict[str, Any]:
-        return json.loads(json.dumps(self.replica.answer(q)))
+        traced = isinstance(q, dict) and q.get("trace")
+        t0 = time.perf_counter()
+        res = self.replica.answer(q)
+        if traced and isinstance(res, dict):
+            # no socket, no batcher: decode/queue/batch hops are zero
+            # by construction, execute is the whole replica-side time —
+            # the same compact hop block as ReplicaServer (integer
+            # microseconds [decode, queue, batch_wait, execute,
+            # replica]) so single-process tests exercise full trace
+            # assembly
+            us = int((time.perf_counter() - t0) * 1e6 + 0.5)
+            res = dict(res)
+            res["hops"] = [0, 0, 0, us, us]
+        return json.loads(json.dumps(res))
 
     def close(self) -> None:
         pass
@@ -386,7 +401,29 @@ class ReplicaServer:
     admission control (serve.batcher watermarks) — an overload burst
     sheds fast `{"error": "overloaded"}` answers instead of growing an
     unbounded queue; `status`/`stop` bypass the batcher (health checks
-    must answer even when the query queue is saturated)."""
+    must answer even when the query queue is saturated).
+
+    Distributed tracing (ISSUE 19): a sub-query carrying the router's
+    `trace` marker gets a compact `hops` timing block on its answer —
+    an integer-microsecond array [decode, queue, batch_wait, execute,
+    replica]: decode (transport json decode), queue (deque wait until
+    the batch flushed), batch_wait (intra-batch serialization behind
+    batch-mates), execute (ShardReplica.answer), replica (receipt to
+    answer, the wire-vs-replica split the router subtracts). Integers,
+    not named floats: the block rides EVERY traced answer, and the
+    tracing overhead pin (<2% of routed wall, scripts/qtrace_gate.py)
+    is won or lost on wire bytes + float formatting — the router
+    expands it to named `*_s` seconds at assembly. The block exists
+    ONLY on traced requests: untraced answers are byte-identical to
+    pre-trace builds (the off-path contract), and the router strips
+    `hops` with the other transport fields before returning answers to
+    callers.
+
+    Fault injection (scripts/qtrace_gate.py): the BIGCLAM_QTRACE_FAULT
+    env var — a JSON object {"hop": "execute"|"decode", "delay_s": X}
+    — plants a delay into the named hop of THIS replica, so the gate
+    can prove a planted slowdown is attributed to the right (shard,
+    hop) and that a clean run attributes nothing."""
 
     def __init__(
         self,
@@ -407,6 +444,16 @@ class ReplicaServer:
             shed_wait_s=shed_wait_s,
         ).start()
         self._stopped = threading.Event()
+        self._fault_hop = None
+        self._fault_delay_s = 0.0
+        fault = os.environ.get("BIGCLAM_QTRACE_FAULT")
+        if fault:
+            try:
+                fobj = json.loads(fault)
+                self._fault_hop = str(fobj.get("hop", "execute"))
+                self._fault_delay_s = max(float(fobj.get("delay_s", 0.0)), 0.0)
+            except (ValueError, TypeError):
+                pass
         outer = self
 
         class _Handler(socketserver.StreamRequestHandler):
@@ -415,12 +462,19 @@ class ReplicaServer:
                     line = line.strip()
                     if not line:
                         continue
+                    t_recv = time.perf_counter()
+                    if outer._fault_hop == "decode" and outer._fault_delay_s:
+                        time.sleep(outer._fault_delay_s)
                     try:
                         q = json.loads(line)
                     except ValueError:
                         res = {"error": "bad json"}
                     else:
-                        res = outer._dispatch(q)
+                        res = outer._dispatch(
+                            q,
+                            t_recv=t_recv,
+                            decode_s=time.perf_counter() - t_recv,
+                        )
                     try:
                         self.wfile.write(
                             (json.dumps(res) + "\n").encode()
@@ -459,9 +513,40 @@ class ReplicaServer:
     # --------------------------------------------------------- dispatch
     def _handle(self, batch: List[Request]) -> None:
         for req in batch:
-            req.future.set_result(self.replica.answer(req.payload))
+            traced = (
+                isinstance(req.payload, dict) and req.payload.get("trace")
+            )
+            if not traced:
+                if self._fault_hop == "execute" and self._fault_delay_s:
+                    time.sleep(self._fault_delay_s)
+                req.future.set_result(self.replica.answer(req.payload))
+                continue
+            # execute hop + intra-batch serialization wait: this loop
+            # runs the batch serially, so a request's batch_wait is the
+            # gap between the batch being taken and ITS answer starting
+            t0 = time.perf_counter()
+            if self._fault_hop == "execute" and self._fault_delay_s:
+                # inside the timed window: the planted fault must be
+                # ATTRIBUTED to the execute hop, that is what the gate
+                # proves
+                time.sleep(self._fault_delay_s)
+            res = self.replica.answer(req.payload)
+            if isinstance(res, dict):
+                taken = req.future.t_taken
+                # seconds here; _dispatch converts the assembled block
+                # to the compact integer-microsecond wire form
+                res["hops"] = (
+                    t0 - (taken if taken is not None else t0),
+                    time.perf_counter() - t0,
+                )
+            req.future.set_result(res)
 
-    def _dispatch(self, q: Dict[str, Any]) -> Dict[str, Any]:
+    def _dispatch(
+        self,
+        q: Dict[str, Any],
+        t_recv: Optional[float] = None,
+        decode_s: float = 0.0,
+    ) -> Dict[str, Any]:
         fam = q.get("family") if isinstance(q, dict) else None
         if fam == "status":
             st = self.replica.status()
@@ -472,14 +557,36 @@ class ReplicaServer:
         if fam == "stop":
             # the HANDLER schedules close() after flushing this ack
             return {"ok": True}
+        fut = None
         try:
-            res = self._batcher.submit(q).result(60.0)
+            fut = self._batcher.submit(q)
+            res = fut.result(60.0)
         except OverloadedError:
             res = {"error": "overloaded"}
         except Exception as e:   # noqa: BLE001 — transport must live
             res = {"error": f"{type(e).__name__}: {e}"}
         if isinstance(res, dict):
             res.setdefault("depth", self._batcher.depth())
+            if isinstance(q, dict) and q.get("trace"):
+                bw, ex = res.pop("hops", None) or (0.0, 0.0)
+                queue_s = (
+                    fut.t_taken - fut.t_submit
+                    if fut is not None and fut.t_taken is not None
+                    else 0.0
+                )
+                replica_s = (
+                    time.perf_counter() - t_recv
+                    if t_recv is not None else 0.0
+                )
+                # compact wire form: integer microseconds
+                # [decode, queue, batch_wait, execute, replica]
+                res["hops"] = [
+                    int(decode_s * 1e6 + 0.5),
+                    int(queue_s * 1e6 + 0.5),
+                    int(bw * 1e6 + 0.5),
+                    int(ex * 1e6 + 0.5),
+                    int(replica_s * 1e6 + 0.5),
+                ]
         return res
 
     # -------------------------------------------------------- lifecycle

@@ -34,6 +34,26 @@ scripts/fleet_gate.py), not a silent wrong answer. A shard one
 generation behind simply keeps the whole fleet pinned at g — correct,
 not an error (tests/test_fleet.py).
 
+Distributed query tracing (ISSUE 19): with telemetry installed, every
+routed query opens a trace — the router stamps a `trace` marker on each
+sub-query, replicas echo a per-hop timing block (serve.fleet), and the
+router assembles the cross-process decomposition. Sub-sends within one
+route() call are SEQUENTIAL, so the identity
+
+    total_s = sum(wire_s over hops) + merge_s
+
+holds exactly (merge_s is router-side work: bucketing, np.unique, the
+fold-in row gather bookkeeping), and each hop's wire_s further splits
+into transport_s (wire minus replica receipt-to-answer) + decode_s +
+queue_s + batch_wait_s + execute_s. Per-hop means aggregate fleet-wide
+and per-shard into stats() (the perf ledger verdicts them — "the
+router got slower" and "shard 3 got slower" are different regressions),
+the slowest TRACE_TOP traces per TRACE_WINDOW completed queries are
+emitted as schema'd `qtrace` exemplar events, and `freshness` events
+sample generation age (ROADMAP 3a). Tracing is off-path-free: with no
+telemetry installed no marker is stamped, replicas attach nothing, and
+answers are bit-identical to an untraced run.
+
 Entirely jax-free: routing is bisect + np.unique; the device work stays
 on the replicas.
 """
@@ -46,15 +66,28 @@ import threading
 import time
 from bisect import bisect_right
 from concurrent.futures import ThreadPoolExecutor
+from heapq import heappush, heappushpop
 from typing import Any, Dict, List, Optional, Sequence
 
 import numpy as np
 
 from bigclam_tpu.obs import telemetry as _obs
 from bigclam_tpu.obs.ledger import _percentile
+from bigclam_tpu.obs.trace import new_trace_id
 from bigclam_tpu.utils.checkpoint import CheckpointManager
 
 FAMILIES = ("communities_of", "members_of", "suggest_for")
+
+# slow-query exemplar log: keep the TRACE_TOP slowest traces per
+# TRACE_WINDOW completed traced queries, emit them as `qtrace` events,
+# reset — bounded event volume under any load
+TRACE_WINDOW = 1000
+TRACE_TOP = 5
+
+# replica-echoed hop fields (serve.fleet) + the router-derived transport
+# split, in decomposition order; `merge` (router-side) joins them in the
+# fleet-wide accumulators
+_HOP_NAMES = ("transport", "decode", "queue", "batch_wait", "execute")
 
 
 class RouterError(RuntimeError):
@@ -175,9 +208,25 @@ class FleetRouter:
         self._errors = 0
         self._shed = 0
         self.mixed_generation = 0
+        # failover tripwires (ISSUE 19 satellite): how often a sub-query
+        # moved past a replica because its transport failed vs because it
+        # had pruned the pinned generation — surfaced in stats()/report
+        # instead of dying as a local error string
+        self.pruned_generation = 0
+        self.transport_failovers = 0
         self.rollouts = 0
         self._t_first: Optional[float] = None
         self._t_last: Optional[float] = None
+        # --- distributed query tracing (ISSUE 19; module docstring) ---
+        self._trace_local = threading.local()   # per-thread open trace
+        self._inflight: Dict[str, float] = {}   # trace_id -> t0 (perf)
+        self._traced = 0
+        self._hop_sum: Dict[str, float] = {}
+        self._hop_n: Dict[str, int] = {}
+        self._shard_hops: Dict[int, Dict[str, List[float]]] = {}
+        self._trace_heap: List[Any] = []        # (total_s, seq, record)
+        self._trace_seq = 0
+        self._trace_seen = 0                    # window fill counter
         self._pool = ThreadPoolExecutor(
             max_workers=max(int(max_workers), 1),
             thread_name_prefix="bigclam-route",
@@ -288,7 +337,8 @@ class FleetRouter:
                         tel = _obs.current()
                         if tel is not None:
                             tel.event("rollout", step=int(cand))
-            return self._serving
+        self._emit_freshness()
+        return self._serving
 
     def _health_loop(self, interval: float) -> None:
         while not self._health_stop.wait(interval):
@@ -309,6 +359,24 @@ class FleetRouter:
             return None
         return max(time.time() - float(ts), 0.0)
 
+    def _emit_freshness(self) -> None:
+        """One schema'd `freshness` sample — serving staleness (ROADMAP
+        3a) as an event stream instead of a number that dies with the
+        process. Emitted at every refresh and after each run_queries
+        batch; no-op without telemetry."""
+        tel = _obs.current()
+        if tel is None or self._serving is None:
+            return
+        age = self.generation_age_s()
+        if age is None:
+            return
+        tel.event(
+            "freshness",
+            generation_age_s=round(age, 3),
+            step=int(self._serving),
+            rollouts=int(self.rollouts),
+        )
+
     # --------------------------------------------------------- dispatch
     def _send(
         self, shard: int, q: Dict[str, Any]
@@ -320,6 +388,14 @@ class FleetRouter:
             reps = list(self._by_shard.get(shard, ()))
         if not reps:
             raise RouterError(f"no healthy replica for shard {shard}")
+        tr = getattr(self._trace_local, "tr", None)
+        if tr is not None:
+            # stamp the trace marker at the ONE place every sub-query
+            # passes through — replicas echo a `hops` block only when
+            # they see it (off-path contract: untraced wire answers are
+            # byte-identical to pre-trace builds)
+            q = dict(q)
+            q["trace"] = 1
         last: Optional[str] = None
         for t in sorted(reps, key=lambda r: getattr(r, "depth", 0)):
             t0 = time.perf_counter()
@@ -327,20 +403,21 @@ class FleetRouter:
                 res = t.request(q, timeout=self.request_timeout_s)
             except Exception as e:   # noqa: BLE001 — fail over
                 last = f"{type(e).__name__}: {e}"
+                self.transport_failovers += 1
                 with self._lock:
                     self._down.add(id(t))
                     if t in self._by_shard.get(shard, ()):
                         self._by_shard[shard].remove(t)
                 continue
-            self._shard_lat.setdefault(shard, []).append(
-                time.perf_counter() - t0
-            )
+            wire_s = time.perf_counter() - t0
+            self._shard_lat.setdefault(shard, []).append(wire_s)
             if not isinstance(res, dict):
                 last = f"non-dict answer {type(res).__name__}"
                 continue
             t.depth = int(res.get("depth", getattr(t, "depth", 0)))
             if res.get("error") == "unknown_generation":
                 last = f"replica pruned generation {q.get('gen')}"
+                self.pruned_generation += 1
                 continue
             pin = q.get("gen")
             if (
@@ -351,6 +428,26 @@ class FleetRouter:
                 # the tripwire the gate asserts ZERO on — an answer
                 # from a generation the query was not pinned to
                 self.mixed_generation += 1
+            if tr is not None:
+                hop: Dict[str, Any] = {
+                    "shard": int(shard), "wire_s": wire_s,
+                }
+                hb = res.get("hops")
+                if isinstance(hb, (list, tuple)) and len(hb) == 5:
+                    # compact wire form (see serve.fleet): integer
+                    # microseconds [decode, queue, batch_wait, execute,
+                    # replica] — expanded to named float seconds here so
+                    # only the hot wire path pays for compactness
+                    hop["decode_s"] = hb[0] / 1e6
+                    hop["queue_s"] = hb[1] / 1e6
+                    hop["batch_wait_s"] = hb[2] / 1e6
+                    hop["execute_s"] = hb[3] / 1e6
+                    rs = hb[4] / 1e6
+                    hop["replica_s"] = rs
+                    # wire time the replica never saw: connect +
+                    # serialize + kernel/network transit
+                    hop["transport_s"] = max(wire_s - rs, 0.0)
+                tr["hops"].append(hop)
             return res
         raise RouterError(
             f"every replica of shard {shard} failed: {last}"
@@ -360,7 +457,7 @@ class FleetRouter:
     def _strip(res: Dict[str, Any]) -> Dict[str, Any]:
         return {
             k: v for k, v in res.items()
-            if k not in ("gen", "depth", "cached", "not_owner")
+            if k not in ("gen", "depth", "cached", "not_owner", "hops")
         }
 
     def _route_communities(
@@ -513,6 +610,14 @@ class FleetRouter:
             return {"error": "RouterError: no serving generation"}
         fam = q.get("family") if isinstance(q, dict) else None
         t0 = time.perf_counter()
+        tr: Optional[Dict[str, Any]] = None
+        if _obs.current() is not None:
+            # tracing is exactly telemetry-installed: one dict + one
+            # registry entry per query, nothing on the untraced path
+            tr = {"id": new_trace_id(), "family": str(fam), "hops": []}
+            self._trace_local.tr = tr
+            with self._lock:
+                self._inflight[tr["id"]] = t0
         try:
             if fam == "communities_of":
                 res = self._route_communities(q, gen)
@@ -526,7 +631,10 @@ class FleetRouter:
             res = {"error": "overloaded"}
         except Exception as e:   # noqa: BLE001 — per-query isolation
             res = {"error": f"{type(e).__name__}: {e}"}
+        if tr is not None:
+            self._trace_local.tr = None
         lat = time.perf_counter() - t0
+        exemplars = None
         with self._lock:
             if res.get("error") == "overloaded":
                 self._shed += 1
@@ -539,7 +647,104 @@ class FleetRouter:
             end = t0 + lat
             if self._t_last is None or end > self._t_last:
                 self._t_last = end
+            if tr is not None:
+                self._inflight.pop(tr["id"], None)
+                exemplars = self._absorb_trace_locked(tr, lat)
+        if exemplars:
+            self._emit_exemplars(exemplars)
         return res
+
+    # ---------------------------------------------------------- tracing
+    def _absorb_trace_locked(
+        self, tr: Dict[str, Any], total_s: float
+    ) -> Optional[List[Any]]:
+        """Fold one completed trace into the hop accumulators + the
+        slow-query exemplar heap (caller holds the lock). Returns the
+        window's exemplar items when this trace closed a TRACE_WINDOW,
+        else None — the caller emits them OUTSIDE the lock."""
+        self._traced += 1
+        wire = 0.0
+        for hop in tr["hops"]:
+            w = hop.get("wire_s")
+            if isinstance(w, (int, float)):
+                wire += float(w)
+            per = self._shard_hops.setdefault(int(hop["shard"]), {})
+            for name in _HOP_NAMES:
+                v = hop.get(name + "_s")
+                if not isinstance(v, (int, float)):
+                    continue
+                self._hop_sum[name] = self._hop_sum.get(name, 0.0) + v
+                self._hop_n[name] = self._hop_n.get(name, 0) + 1
+                acc = per.setdefault(name, [0.0, 0])
+                acc[0] += v
+                acc[1] += 1
+        # sequential sub-sends: total == sum(wire) + merge exactly, so
+        # merge (router-side work) is the closing residual
+        merge_s = max(total_s - wire, 0.0)
+        self._hop_sum["merge"] = self._hop_sum.get("merge", 0.0) + merge_s
+        self._hop_n["merge"] = self._hop_n.get("merge", 0) + 1
+        heap = self._trace_heap
+        if len(heap) < TRACE_TOP or total_s > heap[0][0]:
+            # only build the rounded exemplar record when this trace
+            # actually enters the top-N — the common (fast) trace pays
+            # one comparison here, not a dict rebuild
+            rec = {
+                "trace_id": tr["id"],
+                "family": tr["family"],
+                "total_s": round(total_s, 6),
+                "merge_s": round(merge_s, 6),
+                "hops": [
+                    {
+                        k: (round(v, 6) if isinstance(v, float) else v)
+                        for k, v in hop.items()
+                    }
+                    for hop in tr["hops"]
+                ],
+            }
+            self._trace_seq += 1
+            item = (total_s, self._trace_seq, rec)
+            if len(heap) < TRACE_TOP:
+                heappush(heap, item)
+            else:
+                heappushpop(heap, item)
+        self._trace_seen += 1
+        if self._trace_seen < TRACE_WINDOW:
+            return None
+        heap, self._trace_heap = self._trace_heap, []
+        self._trace_seen = 0
+        return heap
+
+    @staticmethod
+    def _emit_exemplars(heap: List[Any]) -> None:
+        tel = _obs.current()
+        if tel is None:
+            return
+        for _, _, rec in sorted(heap, key=lambda it: -it[0]):
+            tel.event("qtrace", **rec)
+
+    def flush_traces(self) -> None:
+        """Emit the current window's slow-query exemplars now (the end
+        of a route run / router shutdown — a part-filled window must
+        not die with the process)."""
+        with self._lock:
+            heap, self._trace_heap = self._trace_heap, []
+            self._trace_seen = 0
+        if heap:
+            self._emit_exemplars(heap)
+
+    def open_trace_count(self) -> int:
+        """Routed queries currently in flight (traced) — embedded in
+        heartbeat stall events (ISSUE 19 satellite)."""
+        with self._lock:
+            return len(self._inflight)
+
+    def oldest_inflight_s(self) -> float:
+        """Age of the oldest in-flight routed query (0.0 when idle) —
+        the 'is one query wedged' number stall events carry."""
+        with self._lock:
+            if not self._inflight:
+                return 0.0
+            return time.perf_counter() - min(self._inflight.values())
 
     def run_queries(
         self,
@@ -561,6 +766,7 @@ class FleetRouter:
                 queries=len(queries),
                 shards=len(self._by_shard),
             )
+            self._emit_freshness()
         return out
 
     # ------------------------------------------------------------ stats
@@ -571,6 +777,13 @@ class FleetRouter:
             self._errors = 0
             self._shed = 0
             self._t_first = self._t_last = None
+            # warmup traces must not pollute the measured pass
+            self._traced = 0
+            self._hop_sum = {}
+            self._hop_n = {}
+            self._shard_hops = {}
+            self._trace_heap = []
+            self._trace_seen = 0
 
     def stats(self) -> Dict[str, Any]:
         """The router scoreboard, key-compatible with
@@ -589,6 +802,13 @@ class FleetRouter:
             }
             shard_lat = {
                 s: list(v) for s, v in self._shard_lat.items()
+            }
+            traced = self._traced
+            hop_sum = dict(self._hop_sum)
+            hop_n = dict(self._hop_n)
+            shard_hops = {
+                s: {k: (acc[0], acc[1]) for k, acc in per.items()}
+                for s, per in self._shard_hops.items()
             }
             errors, shed = self._errors, self._shed
             t_first, t_last = self._t_first, self._t_last
@@ -640,8 +860,28 @@ class FleetRouter:
             "serving_generation": self._serving,
             "snapshot_step": self._serving,
             "mixed_generation": self.mixed_generation,
+            "pruned_generation": self.pruned_generation,
+            "transport_failovers": self.transport_failovers,
             "rollouts": self.rollouts,
+            "traced_queries": traced,
         }
+        # fleet-wide per-hop latency means (traced queries only): the
+        # decomposition the ledger verdicts — a transport regression and
+        # an execute regression are different findings
+        for name in _HOP_NAMES + ("merge",):
+            n = hop_n.get(name, 0)
+            if n:
+                out[f"serve_hop_{name}_s"] = round(
+                    hop_sum.get(name, 0.0) / n, 6
+                )
+        for s, per in shard_hops.items():
+            st = out["serve_shard_stats"].get(str(s))
+            if st is not None and per:
+                st["hops"] = {
+                    name: round(tot / n, 6)
+                    for name, (tot, n) in sorted(per.items())
+                    if n
+                }
         age = self.generation_age_s()
         if age is not None:
             out["generation_age_s"] = round(age, 3)
@@ -656,6 +896,7 @@ class FleetRouter:
 
     # -------------------------------------------------------- lifecycle
     def close(self) -> None:
+        self.flush_traces()
         self._health_stop.set()
         if self._health_thread is not None:
             self._health_thread.join(timeout=10.0)

@@ -225,3 +225,104 @@ def test_cli_perf_show_stays_jax_free(tmp_path):
         ["perf", "show", "--ledger", str(ledger)], str(tmp_path)
     )
     assert r.returncode == 0, (r.stdout, r.stderr)
+
+
+def test_cli_route_stays_jax_free(tmp_path):
+    # ISSUE 19 satellite: the fleet router is a pure socket/JSON client —
+    # it must run on a query-front host with no accelerator stack. Fleet
+    # publication is numpy-only and runs in-parent; two shard replicas
+    # run as subprocesses (`serve --fleet` read families are jax-free
+    # too); the routing entry itself runs under the jax assertion.
+    import numpy as np
+
+    from bigclam_tpu.config import BigClamConfig
+    from bigclam_tpu.serve.snapshot import publish_fleet_snapshot
+
+    rng = np.random.default_rng(0)
+    F = rng.uniform(0.0, 1.0, size=(12, 3))
+    snapdir = str(tmp_path / "snaps")
+    publish_fleet_snapshot(
+        snapdir, [(0, 6), (6, 12)], F=F, num_edges=20,
+        cfg=BigClamConfig(num_communities=3),
+    )
+    fleetroot = tmp_path / "telem"
+    fleetroot.mkdir()
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    procs, endpoints = [], []
+    try:
+        for shard in range(2):
+            p = subprocess.Popen(
+                [sys.executable, "-m", "bigclam_tpu.cli", "serve",
+                 "--fleet", snapdir, "--fleet-shard", str(shard),
+                 "--listen", "127.0.0.1:0",
+                 "--telemetry-dir", str(fleetroot / f"rep{shard}"),
+                 "--quiet"],
+                env=env, cwd=str(tmp_path), stdout=subprocess.PIPE,
+                stderr=subprocess.PIPE, text=True,
+            )
+            procs.append(p)
+            hello = json.loads(p.stdout.readline())
+            endpoints.append(hello["listening"])
+        queries = tmp_path / "q.jsonl"
+        queries.write_text(
+            "".join(
+                json.dumps(q) + "\n"
+                for q in (
+                    [{"family": "communities_of", "u": u}
+                     for u in range(12)]
+                    + [{"family": "members_of", "c": c} for c in range(3)]
+                )
+            )
+        )
+        r = _run_jaxfree(
+            ["route", "--fleet", snapdir,
+             "--endpoints", ",".join(endpoints),
+             "--queries", str(queries),
+             "--results", str(tmp_path / "ans.jsonl"),
+             "--telemetry-dir", str(fleetroot / "router"), "--quiet"],
+            str(tmp_path),
+        )
+        assert r.returncode == 0, (r.stdout, r.stderr)
+        stats = json.loads(r.stdout.strip().splitlines()[-1])
+        assert stats["serve_queries"] == 15
+        assert stats["serve_errors"] == 0
+        assert stats["traced_queries"] == 15
+        assert stats["serve_hop_execute_s"] > 0
+        r = _run_jaxfree(
+            ["route", "--fleet", snapdir,
+             "--endpoints", ",".join(endpoints), "--stop"],
+            str(tmp_path),
+        )
+        assert r.returncode == 0, (r.stdout, r.stderr)
+        for p in procs:
+            p.wait(timeout=30)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+            p.stdout.close()
+            p.stderr.close()
+
+    # the fleet observability plane reads those telemetry dirs back,
+    # still jax-free: one merged report + one watch frame over the root
+    r = _run_jaxfree(
+        ["report", "--fleet", str(fleetroot)], str(tmp_path)
+    )
+    assert r.returncode == 0, (r.stdout, r.stderr)
+    assert "3 member dir(s)" in r.stdout
+    assert "router:" in r.stdout and "per-hop mean" in r.stdout
+
+    r = _run_jaxfree(
+        ["report", "--fleet", str(fleetroot), "--json"], str(tmp_path)
+    )
+    assert r.returncode == 0, (r.stdout, r.stderr)
+    obj = json.loads(r.stdout.strip().splitlines()[-1])
+    assert obj["router"]["serve_queries"] == 15
+    assert sorted(obj["replicas"]) == ["0", "1"]
+
+    r = _run_jaxfree(
+        ["watch", "--fleet", str(fleetroot), "--once"], str(tmp_path)
+    )
+    assert r.returncode == 0, (r.stdout, r.stderr)
+    assert "3 member(s)" in r.stdout

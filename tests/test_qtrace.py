@@ -1,0 +1,441 @@
+"""Distributed query tracing + fleet observability plane (ISSUE 19).
+
+Tentpole pins: the router stamps a trace context on every sub-query,
+replicas echo per-hop timing blocks, and the router assembles them into
+schema'd `qtrace` slow-query exemplars plus per-hop latency means in
+stats — while the OFF path stays bit-identical (the `hops` block never
+reaches a client answer, traced and untraced answers serialize the
+same). Satellites: `freshness` events, the router-process heartbeat's
+in-flight trace registry embedding, and the fleet aggregation layer
+(`report --fleet` / `watch --fleet`) under torn, empty, and missing
+member telemetry dirs — all single-process, LocalReplica transports,
+mirroring the PR 10 fake-host pattern."""
+
+import io
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+from bigclam_tpu.config import BigClamConfig
+from bigclam_tpu.models import BigClamModel
+from bigclam_tpu.models.agm import sample_planted_graph
+from bigclam_tpu.obs.schema import validate_event
+from bigclam_tpu.obs.telemetry import (
+    EVENTS_NAME,
+    RunTelemetry,
+    install,
+    uninstall,
+)
+from bigclam_tpu.serve.fleet import LocalReplica, ShardReplica
+from bigclam_tpu.serve.router import FleetRouter
+from bigclam_tpu.serve.snapshot import publish_fleet_snapshot
+
+K = 6
+N = 120
+SHARDS = 3
+
+
+@pytest.fixture(scope="module")
+def fitted():
+    rng = np.random.default_rng(3)
+    g, truth = sample_planted_graph(N, K, p_in=0.8, rng=rng)
+    cfg = BigClamConfig(num_communities=K, max_iters=150)
+    model = BigClamModel(g, cfg)
+    res = model.fit(model.random_init())
+    return g, cfg, res
+
+
+@pytest.fixture()
+def fleetdir(tmp_path, fitted):
+    g, cfg, res = fitted
+    d = str(tmp_path / "fleet")
+    ranges = [(s * N // SHARDS, (s + 1) * N // SHARDS)
+              for s in range(SHARDS)]
+    publish_fleet_snapshot(
+        d, ranges, F=res.F, raw_ids=g.raw_ids,
+        num_edges=g.num_edges, cfg=cfg,
+    )
+    return d
+
+
+def _router(fleetdir):
+    reps = [LocalReplica(ShardReplica(fleetdir, s))
+            for s in range(SHARDS)]
+    return FleetRouter(fleetdir, reps)
+
+
+QUERIES = [
+    {"family": "communities_of", "u": 5},
+    {"family": "members_of", "c": 2},
+    {"family": "communities_of", "u": 77},
+] * 4
+
+
+def _events(directory):
+    out = []
+    with open(os.path.join(directory, EVENTS_NAME)) as f:
+        for line in f:
+            if line.strip():
+                out.append(json.loads(line))
+    return out
+
+
+# ------------------------------------------------------- trace assembly
+def test_traced_run_emits_schema_valid_qtrace_and_freshness(
+    tmp_path, fleetdir
+):
+    tel = install(RunTelemetry(str(tmp_path / "t"), entry="route",
+                               device_memory=False))
+    try:
+        router = _router(fleetdir)
+        router.run_queries(QUERIES)
+        st = router.stats()
+        router.close()          # flushes the part-filled exemplar window
+        tel.set_final(st)
+    finally:
+        tel.finalize()
+        uninstall(tel)
+    evs = _events(tel.directory)
+    errs = [e2 for e in evs for e2 in validate_event(e)]
+    assert errs == [], errs
+
+    qt = [e for e in evs if e["kind"] == "qtrace"]
+    assert qt, "no qtrace exemplars emitted"
+    for rec in qt:
+        assert rec["trace_id"]
+        assert rec["family"] in ("communities_of", "members_of")
+        assert rec["hops"], "exemplar carries no hop breakdown"
+        for hop in rec["hops"]:
+            assert set(hop) >= {"shard", "wire_s", "decode_s",
+                                "queue_s", "batch_wait_s",
+                                "execute_s", "replica_s"}
+        # decomposition identity: sequential sub-sends mean
+        # total = sum(wire) + merge exactly (rounding noise only)
+        acct = sum(h["wire_s"] for h in rec["hops"]) + rec["merge_s"]
+        assert abs(rec["total_s"] - acct) < 5e-5
+    # the exemplar log is slowest-first within each flush
+    totals = [r["total_s"] for r in qt]
+    assert totals == sorted(totals, reverse=True)
+
+    fresh = [e for e in evs if e["kind"] == "freshness"]
+    assert fresh, "no freshness events emitted"
+    for f in fresh:
+        assert f["generation_age_s"] >= 0.0
+        assert f["step"] >= 1
+
+    # stats carry the per-hop means + tripwire counters
+    assert st["traced_queries"] == len(QUERIES)
+    for hop in ("transport", "decode", "queue", "batch_wait",
+                "execute", "merge"):
+        assert f"serve_hop_{hop}_s" in st
+    assert st["pruned_generation"] == 0
+    assert st["transport_failovers"] == 0
+    for sst in st["serve_shard_stats"].values():
+        assert "hops" in sst and "execute" in sst["hops"]
+
+
+def test_trace_off_answers_bit_identical_and_hops_never_leak(
+    tmp_path, fleetdir
+):
+    """The off-path contract: the same queries with telemetry installed
+    and without serialize to byte-identical answer streams — the trace
+    marker changes NOTHING a client sees, and no `hops` block survives
+    the router's merge."""
+    router_off = _router(fleetdir)
+    res_off = router_off.run_queries(QUERIES)
+    router_off.close()
+
+    tel = install(RunTelemetry(str(tmp_path / "t"), entry="route",
+                               device_memory=False))
+    try:
+        router_on = _router(fleetdir)
+        res_on = router_on.run_queries(QUERIES)
+        assert router_on.stats()["traced_queries"] == len(QUERIES)
+        router_on.close()
+    finally:
+        tel.finalize()
+        uninstall(tel)
+
+    assert json.dumps(res_on, sort_keys=True) == \
+        json.dumps(res_off, sort_keys=True)
+    for r in res_on:
+        assert "hops" not in r
+
+
+def test_untraced_run_records_no_trace_state(fleetdir):
+    """No telemetry installed -> zero traced queries, no hop means, no
+    exemplar heap growth (the off path never touches the accumulators)."""
+    router = _router(fleetdir)
+    router.run_queries(QUERIES)
+    st = router.stats()
+    router.close()
+    assert st["traced_queries"] == 0
+    assert not any(k.startswith("serve_hop_") for k in st)
+
+
+def test_reset_stats_clears_trace_accumulators(tmp_path, fleetdir):
+    """Warmup-pass contract: reset_stats() drops traced counts and hop
+    means so a measured pass starts clean (fleet/qtrace gate idiom)."""
+    tel = install(RunTelemetry(str(tmp_path / "t"), entry="route",
+                               device_memory=False))
+    try:
+        router = _router(fleetdir)
+        router.run_queries(QUERIES)
+        assert router.stats()["traced_queries"] == len(QUERIES)
+        router.reset_stats()
+        st = router.stats()
+        assert st["traced_queries"] == 0
+        assert not any(k.startswith("serve_hop_") for k in st)
+        router.run_queries(QUERIES[:3])
+        assert router.stats()["traced_queries"] == 3
+        router.close()
+    finally:
+        tel.finalize()
+        uninstall(tel)
+
+
+def test_inflight_registry_tracks_open_traces(tmp_path, fleetdir):
+    tel = install(RunTelemetry(str(tmp_path / "t"), entry="route",
+                               device_memory=False))
+    try:
+        router = _router(fleetdir)
+        assert router.open_trace_count() == 0
+        assert router.oldest_inflight_s() == 0.0
+        router.run_queries(QUERIES)
+        # synchronous local transports: everything settled by return
+        assert router.open_trace_count() == 0
+        router.close()
+    finally:
+        tel.finalize()
+        uninstall(tel)
+
+
+# -------------------------------------------------- heartbeat satellite
+def test_router_stall_embeds_open_trace_registry(tmp_path):
+    """Satellite: a stall on the router process carries the in-flight
+    trace registry — open trace count + oldest in-flight age — so a
+    wedged replica hop is attributable from the stall event alone."""
+    tel = install(
+        RunTelemetry(str(tmp_path / "t"), entry="route",
+                     heartbeat_s=0.08, quiet=True, device_memory=False)
+    )
+    tel.open_traces = lambda: 3
+    tel.oldest_inflight_s = lambda: 1.5
+    try:
+        time.sleep(0.5)          # no beats -> the watchdog fires
+    finally:
+        tel.finalize()
+        uninstall(tel)
+    stalls = [e for e in _events(tel.directory) if e["kind"] == "stall"]
+    assert stalls, "no stall fired"
+    assert stalls[0]["open_traces"] == 3
+    assert stalls[0]["oldest_inflight_s"] == 1.5
+
+
+# ------------------------------------------------- fleet report / watch
+def _write_member(root, name, entry, final, events, finalized=True):
+    d = os.path.join(root, name)
+    os.makedirs(d, exist_ok=True)
+    if finalized:
+        with open(os.path.join(d, "run_report.json"), "w") as f:
+            json.dump({"run_id": "r1", "entry": entry, "final": final,
+                       "ok": True}, f)
+    base = {"v": 2, "run": "r1", "pid": 0, "ts": 1.0, "t": 0.1,
+            "elapsed_s": 0.1}
+    with open(os.path.join(d, EVENTS_NAME), "w") as f:
+        for e in events:
+            f.write(json.dumps(dict(base, **e)) + "\n")
+    return d
+
+
+def _synth_fleet(root):
+    """Single-process synthesized multi-dir fleet root (the PR 10
+    fake-host pattern): a router dir + two replica dirs, one of them
+    torn mid-write, plus an empty-events member."""
+    _write_member(
+        root, "router", "route",
+        {"serve_queries": 100, "serve_p50_s": 0.001,
+         "serve_p99_s": 0.004, "serve_qps": 900.0,
+         "serve_shed_rate": 0.0, "serving_generation": 3,
+         "generation_age_s": 4.2, "rollouts": 1, "mixed_generation": 0,
+         "pruned_generation": 1, "transport_failovers": 2,
+         "traced_queries": 100, "serve_hop_execute_s": 0.0005,
+         "serve_hop_transport_s": 0.0001,
+         "serve_shard_stats": {
+             "0": {"queries": 60, "p50_s": 0.001, "p99_s": 0.003,
+                   "qps": 500.0, "hops": {"execute": 0.0004}},
+             "1": {"queries": 40, "p50_s": 0.001, "p99_s": 0.005,
+                   "qps": 400.0}}},
+        [{"kind": "start", "entry": "route"},
+         {"kind": "freshness", "generation_age_s": 4.2, "step": 3},
+         {"kind": "qtrace", "trace_id": "a-1", "family": "members_of",
+          "total_s": 0.004, "merge_s": 0.001, "hops": []},
+         {"kind": "end", "ok": True}])
+    _write_member(
+        root, "rep0", "serve",
+        {"shard": 0, "queries": 60, "errors": 0, "shed": 2,
+         "depth_peak": 9, "generations": [2, 3], "gen_age_s": 4.0},
+        [{"kind": "start", "entry": "serve"}, {"kind": "end", "ok": True}])
+    d = _write_member(
+        root, "rep1", "serve",
+        {"shard": 1, "queries": 40, "errors": 1, "shed": 0,
+         "generations": [3], "gen_age_s": 4.1},
+        [{"kind": "start", "entry": "serve"}])
+    with open(os.path.join(d, EVENTS_NAME), "a") as f:
+        f.write('{"kind": "sta')          # torn last line
+    empty = os.path.join(root, "rep2")
+    os.makedirs(empty, exist_ok=True)
+    open(os.path.join(empty, EVENTS_NAME), "w").close()
+
+
+def test_report_fleet_merges_member_dirs(tmp_path):
+    from bigclam_tpu.obs.report import render_fleet, render_fleet_json
+
+    root = str(tmp_path / "fl")
+    os.makedirs(root)
+    _synth_fleet(root)
+    text, errors = render_fleet(root)
+    assert errors == 0
+    assert "4 member dir(s)" in text
+    assert "router: 100 queries" in text
+    assert "serving 3, age 4.2s" in text
+    assert "1 pruned-gen failover(s), 2 transport failover(s)" in text
+    assert "per-hop mean" in text and "execute 0.5ms" in text
+    assert "replica rep0: 60 queries" in text and "shed 2" in text
+    assert "replica rep1: 40 queries, 1 error(s)" in text
+
+    obj, errors = render_fleet_json(root)
+    assert errors == 0
+    assert [m["name"] for m in obj["members"]] == [
+        "rep0", "rep1", "rep2", "router"]
+    assert obj["router"]["serve_queries"] == 100
+    assert sorted(obj["replicas"]) == ["0", "1"]
+    assert obj["replicas"]["0"][0]["depth_peak"] == 9
+    # the torn replica still merged (decoder skips the torn line)
+    assert obj["replicas"]["1"][0]["queries"] == 40
+
+
+def test_report_fleet_missing_and_empty_members(tmp_path):
+    """A member dir deleted mid-run is simply not a member; an empty
+    events.jsonl renders as a not-yet-started member; an empty root is
+    an error (exit-1 contract)."""
+    from bigclam_tpu.obs.report import fleet_dirs, render_fleet
+
+    root = str(tmp_path / "fl")
+    os.makedirs(root)
+    _synth_fleet(root)
+    import shutil
+    shutil.rmtree(os.path.join(root, "rep0"))
+    assert [os.path.basename(d) for d in fleet_dirs(root)] == [
+        "rep1", "rep2", "router"]
+    text, errors = render_fleet(root)
+    assert errors == 0 and "3 member dir(s)" in text
+
+    empty_root = str(tmp_path / "empty")
+    os.makedirs(empty_root)
+    text, errors = render_fleet(empty_root)
+    assert errors == 1 and "no member telemetry dirs" in text
+
+
+def test_watch_fleet_frame_and_once(tmp_path):
+    from bigclam_tpu.obs.watch import render_fleet_frame, watch_fleet
+
+    root = str(tmp_path / "fl")
+    os.makedirs(root)
+    _synth_fleet(root)
+    frame = render_fleet_frame(root)
+    assert "4 member(s)" in frame
+    assert "router [route]" in frame and "gen 3 age 4.2s" in frame
+    assert "slow traces" in frame       # the router's qtrace sparkline
+    assert "rep2 [?]: no events" not in frame   # empty file != missing
+
+    buf = io.StringIO()
+    assert watch_fleet(root, once=True, out=buf) == 0
+    assert "4 member(s)" in buf.getvalue()
+
+    empty_root = str(tmp_path / "empty")
+    os.makedirs(empty_root)
+    buf = io.StringIO()
+    assert watch_fleet(empty_root, once=True, out=buf) == 1
+    assert "no member telemetry dirs" in buf.getvalue()
+
+
+def test_watch_fleet_loop_exits_when_all_members_end(tmp_path):
+    """The live loop's exit contract, bounded by max_frames: every
+    member carries an `end` event -> the loop returns on its own."""
+    from bigclam_tpu.obs.watch import watch_fleet
+
+    root = str(tmp_path / "fl")
+    os.makedirs(root)
+    _write_member(root, "router", "route", {"serve_queries": 1},
+                  [{"kind": "start", "entry": "route"},
+                   {"kind": "end", "ok": True}])
+    _write_member(root, "rep0", "serve", {"shard": 0, "queries": 1},
+                  [{"kind": "start", "entry": "serve"},
+                   {"kind": "end", "ok": True}])
+    buf = io.StringIO()
+    rc = watch_fleet(root, interval=0.01, max_frames=50, out=buf)
+    assert rc == 0
+    assert buf.getvalue().count("fleet ") == 1   # exited on frame one
+
+
+# --------------------------------------------------------- perf ledger
+def test_ledger_verdicts_hops_and_freshness(tmp_path, fleetdir):
+    """generation_age_s + per-hop means land in the ledger record and
+    are VERDICTED by diff_records on the serve branch (ISSUE 19 / 3a)."""
+    from bigclam_tpu.obs.ledger import build_record, diff_records
+
+    tel = install(RunTelemetry(str(tmp_path / "t"), entry="route",
+                               device_memory=False))
+    try:
+        router = _router(fleetdir)
+        router.run_queries(QUERIES)
+        st = router.stats()
+        router.close()
+        tel.set_final(st)
+    finally:
+        tel.finalize()
+        uninstall(tel)
+
+    rec = build_record(tel.report())
+    assert rec["generation_age_s"] is not None
+    assert rec["serve_hop_execute_s"] is not None
+    assert rec["serve_hop_merge_s"] is not None
+
+    base = dict(rec)
+    new = dict(rec)
+    new["serve_hop_execute_s"] = rec["serve_hop_execute_s"] * 50 + 1.0
+    new["generation_age_s"] = rec["generation_age_s"] * 100 + 500.0
+    diff = diff_records(base, new, tolerance=0.25)
+    by_metric = {c["metric"]: c for c in diff["checks"]}
+    assert by_metric["serve_hop_execute_s"]["regression"] is True
+    assert by_metric["generation_age_s"]["regression"] is True
+    assert diff["regression"] is True
+
+    same = diff_records(base, dict(rec), tolerance=0.25)
+    by_metric = {c["metric"]: c for c in same["checks"]}
+    assert by_metric["serve_hop_execute_s"]["regression"] is False
+    assert by_metric["generation_age_s"]["regression"] is False
+    assert same["regression"] is False
+
+
+# -------------------------------------------------------------- schema
+def test_schema_rejects_malformed_qtrace_and_freshness():
+    base = {"v": 2, "kind": "qtrace", "run": "r", "pid": 0, "ts": 1.0,
+            "t": 0.1, "elapsed_s": 0.1, "trace_id": "a-1",
+            "family": "members_of", "total_s": 0.01}
+    assert validate_event(base) == []
+    bad = dict(base, total_s="slow")
+    assert validate_event(bad)
+    missing = dict(base)
+    del missing["trace_id"]
+    assert validate_event(missing)
+
+    f = {"v": 2, "kind": "freshness", "run": "r", "pid": 0, "ts": 1.0,
+         "t": 0.1, "elapsed_s": 0.1, "generation_age_s": 3.5}
+    assert validate_event(f) == []
+    assert validate_event({k: v for k, v in f.items()
+                           if k != "generation_age_s"})
