@@ -269,10 +269,12 @@ def _open_telemetry(args, entry: str):
             entry=entry,
             heartbeat_s=getattr(args, "heartbeat_s", 0.0),
             quiet=getattr(args, "quiet", False),
-            # ingest, serve, and route are jax-free entries (serve only
-            # imports jax lazily for fold-in; the router never does):
-            # device sampling would initialize a backend they never use
-            device_memory=entry not in ("ingest", "serve", "route"),
+            # ingest, serve, route, and fleet are jax-free entries
+            # (serve only imports jax lazily for fold-in; the router
+            # and the supervisor never do): device sampling would
+            # initialize a backend they never use
+            device_memory=entry not in ("ingest", "serve", "route",
+                                        "fleet"),
             auto_gate=not getattr(args, "distributed", False),
             heartbeat_escalate=getattr(args, "heartbeat_escalate", 0),
             # passed THROUGH rather than via os.environ: an env mutation
@@ -1755,6 +1757,17 @@ def _cmd_serve_fleet_replica(args, tel=None) -> int:
         print("error: --fleet needs --listen HOST:PORT",
               file=sys.stderr)
         return 1
+    # supervisor-tagged member id + the crash-loop fault site: firing
+    # BEFORE the snapshot load means an injected kill here costs the
+    # chaos drill milliseconds per respawn, not a full shard load
+    import os as _os
+
+    from bigclam_tpu.resilience.faults import maybe_fire
+
+    member = _os.environ.get("BIGCLAM_FLEET_MEMBER", "")
+    maybe_fire(
+        "replica.start", member=member, shard=int(args.fleet_shard)
+    )
     host, _, port_s = args.listen.rpartition(":")
     try:
         port = int(port_s)
@@ -1876,25 +1889,54 @@ def _cmd_route(args, tel=None) -> int:
     whole fleet at once, never a mixed answer. Stats carry the same
     serve_* keys as `cli serve` plus per-shard latency tables, so the
     perf ledger and `cli perf diff` verdict them with one code path.
-    --stop sends a stop op to every endpoint instead (fleet teardown)."""
+    --stop sends a stop op to every endpoint instead (fleet teardown).
+
+    Self-healing (ISSUE 20): --members watches a supervisor-published
+    membership file instead of a frozen --endpoints list; --daemon
+    serves route() itself over the replica wire (long-lived tier);
+    --deadline-s / --retry-rounds / --hedge are the per-query failure
+    budget (DESIGN.md "Fleet failure model")."""
     from bigclam_tpu.serve.router import FleetRouter, RouterError
 
-    endpoints = _parse_endpoints(
-        args.endpoints, args.request_timeout_s
-    )
+    members = getattr(args, "members", None)
+    endpoints = []
+    if args.endpoints:
+        endpoints = _parse_endpoints(
+            args.endpoints, args.request_timeout_s
+        )
+    elif not members:
+        print(
+            "error: route needs --endpoints and/or --members",
+            file=sys.stderr,
+        )
+        return 1
     if args.stop:
+        # teardown is idempotent: an endpoint that is ALREADY gone is a
+        # success for the operator's goal — note it, keep tearing down
+        # the survivors, exit 0 (ISSUE 20 satellite)
         stopped = 0
+        already_down = 0
         for t in endpoints:
             try:
                 t.request({"family": "stop"})
                 stopped += 1
             except Exception as e:   # noqa: BLE001 — best-effort stop
+                already_down += 1
                 print(
-                    f"note: {t.host}:{t.port}: {e}", file=sys.stderr
+                    f"note: {t.host}:{t.port}: already down ({e})",
+                    file=sys.stderr,
                 )
             t.close()
-        print(json.dumps({"stopped": stopped, "of": len(endpoints)}))
-        return 0 if stopped == len(endpoints) else 1
+        print(
+            json.dumps(
+                {
+                    "stopped": stopped,
+                    "already_down": already_down,
+                    "of": len(endpoints),
+                }
+            )
+        )
+        return 0
     queries = [_parse_query_spec(s) for s in (args.query or [])]
     if args.queries:
         with open(args.queries) as f:
@@ -1911,26 +1953,49 @@ def _cmd_route(args, tel=None) -> int:
                         file=sys.stderr,
                     )
                     return 1
-    if not queries:
+    daemon = getattr(args, "daemon", False)
+    if not queries and not daemon:
         print(
             "error: nothing to route — pass --query and/or --queries "
-            "(or --stop)",
+            "(or --daemon, or --stop)",
             file=sys.stderr,
         )
         return 1
-    try:
-        router = FleetRouter(
+    import time as _time
+
+    def _mk_router():
+        return FleetRouter(
             args.fleet,
             endpoints,
             max_workers=args.max_workers,
             health_interval_s=args.health_interval_s,
             request_timeout_s=args.request_timeout_s,
+            deadline_s=getattr(args, "deadline_s", 0.0),
+            retry_rounds=getattr(args, "retry_rounds", 1),
+            hedge=getattr(args, "hedge", False),
+            hedge_delay_s=getattr(args, "hedge_delay_s", 0.0),
+            hedge_min_samples=getattr(args, "hedge_min_samples", 64),
+            members_file=members,
         )
-    except RouterError as e:
-        print(f"error: {e}", file=sys.stderr)
-        for t in endpoints:
-            t.close()
-        return 1
+
+    router = None
+    wait_deadline = _time.monotonic() + max(
+        getattr(args, "wait_fleet_s", 0.0), 0.0
+    )
+    while router is None:
+        try:
+            router = _mk_router()
+        except RouterError as e:
+            # with a membership file the fleet may still be COMING UP
+            # (supervisor spawning replicas): bounded patience instead
+            # of a start-order race
+            if members and _time.monotonic() < wait_deadline:
+                _time.sleep(0.25)
+                continue
+            print(f"error: {e}", file=sys.stderr)
+            for t in endpoints:
+                t.close()
+            return 1
     if tel is not None:
         # the stall heartbeat runs ON the router process (ISSUE 19
         # satellite): stall events embed the in-flight trace registry —
@@ -1939,6 +2004,46 @@ def _cmd_route(args, tel=None) -> int:
         tel.open_traces = router.open_trace_count
         tel.oldest_inflight_s = router.oldest_inflight_s
         tel.commit_gate()
+    if daemon:
+        from bigclam_tpu.serve.router import RouterServer
+
+        lhost, _, lport_s = (
+            getattr(args, "listen", None) or "127.0.0.1:0"
+        ).rpartition(":")
+        try:
+            lport = int(lport_s)
+        except ValueError:
+            print(
+                f"error: --listen {args.listen!r}: port must be an "
+                "integer",
+                file=sys.stderr,
+            )
+            router.close()
+            return 1
+        server = RouterServer(
+            router, host=lhost or "127.0.0.1", port=lport
+        )
+        # the bound endpoint, printed BEFORE serving (same contract as
+        # the replica hello line: launchers read it for a :0 port)
+        print(
+            json.dumps(
+                {
+                    "routing": f"{server.host}:{server.port}",
+                    "fleet": args.fleet,
+                }
+            ),
+            flush=True,
+        )
+        try:
+            server.serve_until_stopped()
+        except KeyboardInterrupt:
+            server.close()
+        out = router.stats()
+        out["fleet"] = args.fleet
+        if tel is not None:
+            tel.set_final(out)
+        print(json.dumps(out))
+        return 0
     try:
         results = []
         for _ in range(max(args.repeat, 1)):
@@ -1959,6 +2064,146 @@ def _cmd_route(args, tel=None) -> int:
         tel.set_final(out)
     print(json.dumps(out))
     return 1 if out.get("serve_errors") else 0
+
+
+def cmd_fleet(args) -> int:
+    tel = _open_telemetry(args, "fleet")
+    try:
+        return _cmd_fleet(args, tel)
+    finally:
+        _close_telemetry(tel)
+
+
+def _cmd_fleet(args, tel=None) -> int:
+    """Self-healing fleet supervisor (ISSUE 20): own the replica
+    processes of a serving fleet — restart-on-exit with RetryPolicy
+    backoff, crash-loop quarantine, elastic membership published to a
+    watched members file the router reconciles.
+
+        cli fleet up --fleet snaps/ --shards 2 --replicas 2 \\
+            --members members.json
+        cli fleet status --control 127.0.0.1:4444
+        cli fleet add-replica --control ... --shard 0
+        cli fleet drain --control ... --member s0r1
+        cli fleet down --control ...
+
+    `up` prints a hello line with the control endpoint + members path,
+    then parks until a `down` op (or Ctrl-C). Everything is jax-free."""
+    import os as _os
+
+    from bigclam_tpu.resilience.retry import RetryPolicy
+    from bigclam_tpu.serve.supervise import FleetSupervisor, control_op
+
+    if args.action == "up":
+        if not args.fleet:
+            print("error: fleet up needs --fleet DIR", file=sys.stderr)
+            return 1
+        members = args.members or _os.path.join(
+            args.fleet, "members.json"
+        )
+        replica_args = []
+        if args.replica_args:
+            import shlex
+
+            replica_args = shlex.split(args.replica_args)
+        sup = FleetSupervisor(
+            args.fleet,
+            members,
+            shards=args.shards,
+            replicas=args.replicas,
+            host=args.host,
+            control_port=args.control_port,
+            policy=RetryPolicy(
+                base_s=args.restart_base_s,
+                max_s=args.restart_max_s,
+                seed=args.seed,
+            ),
+            quarantine_after=args.quarantine_after,
+            stable_s=args.stable_s,
+            drain_grace_s=args.drain_grace_s,
+            replica_args=replica_args,
+            graph=args.graph,
+            watch_snapshots_s=args.watch_snapshots,
+            log_dir=args.log_dir,
+            seed=args.seed,
+        )
+        sup.up()
+        all_up = sup.wait_all_up(timeout=args.up_timeout_s)
+        st = sup.status()
+        # the launcher contract (like the replica hello line): control
+        # endpoint + members path on stdout BEFORE parking
+        print(
+            json.dumps(
+                {
+                    "control": st["control"],
+                    "members": members,
+                    "all_up": all_up,
+                    "fleet_members": [
+                        m["id"] for m in st["members"]
+                    ],
+                }
+            ),
+            flush=True,
+        )
+        if tel is not None:
+            tel.commit_gate()
+        try:
+            sup.wait_down()
+        except KeyboardInterrupt:
+            sup.down()
+        st = sup.status()
+        out = {
+            "replica_restarts": st["replica_restarts"],
+            "quarantined": st["quarantined"],
+            "fleet_members": {
+                m["id"]: {
+                    "state": m["state"],
+                    "shard": m["shard"],
+                    "restarts": m["restarts"],
+                }
+                for m in st["members"]
+            },
+        }
+        if tel is not None:
+            tel.set_final(out)
+        print(json.dumps(out))
+        return 0
+    if args.action == "status" and not args.control and args.members:
+        # offline roster: read the membership file directly (works even
+        # with the supervisor gone)
+        try:
+            with open(args.members) as f:
+                print(json.dumps(json.load(f)))
+        except (OSError, ValueError) as e:
+            print(f"error: {args.members}: {e}", file=sys.stderr)
+            return 1
+        return 0
+    if not args.control:
+        print(
+            f"error: fleet {args.action} needs --control HOST:PORT "
+            "(printed by `fleet up`)",
+            file=sys.stderr,
+        )
+        return 1
+    op = {"op": args.action.replace("-", "_")}
+    if args.action == "add-replica":
+        op["shard"] = int(args.shard)
+    if args.action == "drain":
+        if not args.member:
+            print(
+                "error: fleet drain needs --member ID", file=sys.stderr
+            )
+            return 1
+        op["member"] = args.member
+    try:
+        res = control_op(args.control, op)
+    except (OSError, ValueError, ConnectionError) as e:
+        print(f"error: control {args.control}: {e}", file=sys.stderr)
+        return 1
+    print(json.dumps(res))
+    if args.action == "drain" and not res.get("ok"):
+        return 1
+    return 0
 
 
 def cmd_refit(args) -> int:
@@ -2576,10 +2821,64 @@ def main(argv=None) -> int:
              " the manifest's row ranges are the routing table",
     )
     p_rt.add_argument(
-        "--endpoints", required=True, metavar="HOST:PORT,...",
+        "--endpoints", default=None, metavar="HOST:PORT,...",
         help="comma-separated replica endpoints (every replica of "
              "every shard; shard ownership is discovered from their "
-             "status answers)",
+             "status answers); alternative: --members",
+    )
+    p_rt.add_argument(
+        "--members", default=None, metavar="FILE",
+        help="watched membership file (published by `cli fleet up`): "
+             "the endpoint set follows it — add-replica/drain reshape "
+             "the fleet mid-stream with zero dropped queries "
+             "(ISSUE 20)",
+    )
+    p_rt.add_argument(
+        "--wait-fleet-s", type=float, default=30.0,
+        help="with --members: how long to wait for the fleet to come "
+             "up before erroring (kills the start-order race between "
+             "`fleet up` and `route`)",
+    )
+    p_rt.add_argument(
+        "--daemon", action="store_true",
+        help="serve the router itself over the replica wire (newline-"
+             "framed JSON TCP, --listen): a long-lived tier instead of "
+             "a one-shot batch — `{\"family\": \"status\"}` answers "
+             "router.stats(), `{\"family\": \"stop\"}` shuts it down",
+    )
+    p_rt.add_argument(
+        "--listen", default=None, metavar="HOST:PORT",
+        help="--daemon bind address (port 0 picks a free port; the "
+             "chosen endpoint is printed as JSON before serving)",
+    )
+    p_rt.add_argument(
+        "--deadline-s", type=float, default=0.0,
+        help="per-query wall deadline: a routed query past it answers "
+             "{\"error\": \"deadline_exceeded\"} (counted + rate-"
+             "verdicted; 0 = off)",
+    )
+    p_rt.add_argument(
+        "--retry-rounds", type=int, default=1,
+        help="refresh+re-dispatch rounds an idempotent read sub-query "
+             "gets after EVERY replica of its shard failed — the "
+             "window in which the supervisor restarts a killed "
+             "replica (0 = fail immediately)",
+    )
+    p_rt.add_argument(
+        "--hedge", action="store_true",
+        help="tail-latency hedging: duplicate a slow read sub-query "
+             "to a second replica after --hedge-delay-s (first answer "
+             "wins, loser cancelled; hedged/hedge_wins counted)",
+    )
+    p_rt.add_argument(
+        "--hedge-delay-s", type=float, default=0.0,
+        help="explicit hedge delay (0 = derive from the rolling wire "
+             "p99 once --hedge-min-samples accumulated)",
+    )
+    p_rt.add_argument(
+        "--hedge-min-samples", type=int, default=64,
+        help="wire-latency samples needed before a derived hedge "
+             "delay engages",
     )
     p_rt.add_argument(
         "--query", action="append", default=None, metavar="FAMILY:ARG",
@@ -2639,6 +2938,125 @@ def main(argv=None) -> int:
     )
     p_rt.add_argument("--quiet", action="store_true")
     p_rt.set_defaults(fn=cmd_route)
+
+    p_fl = sub.add_parser(
+        "fleet",
+        help="jax-free fleet supervisor (ISSUE 20): own the `serve "
+             "--fleet` replica processes — restart-on-exit with "
+             "RetryPolicy backoff, crash-loop quarantine, membership "
+             "published to a watched file `cli route --members` "
+             "follows; up/status/down/add-replica/drain",
+    )
+    p_fl.add_argument(
+        "action",
+        choices=["up", "status", "down", "add-replica", "drain"],
+        help="up: spawn + supervise (parks until a down op); the rest "
+             "talk to a running supervisor's --control endpoint",
+    )
+    p_fl.add_argument(
+        "--fleet", default=None, metavar="DIR",
+        help="fleet publication directory (`cli fit --publish-shards`)",
+    )
+    p_fl.add_argument(
+        "--shards", type=int, default=1,
+        help="up: shards in the fleet manifest",
+    )
+    p_fl.add_argument(
+        "--replicas", type=int, default=1,
+        help="up: replicas per shard",
+    )
+    p_fl.add_argument("--host", default="127.0.0.1",
+                      help="bind host for replicas + control")
+    p_fl.add_argument(
+        "--control-port", type=int, default=0,
+        help="up: control socket port (0 picks; printed in the hello)",
+    )
+    p_fl.add_argument(
+        "--control", default=None, metavar="HOST:PORT",
+        help="status/down/add-replica/drain: the control endpoint "
+             "`fleet up` printed",
+    )
+    p_fl.add_argument(
+        "--members", default=None, metavar="FILE",
+        help="membership file path (default: <fleet>/members.json); "
+             "status can read it directly without --control",
+    )
+    p_fl.add_argument(
+        "--graph", default=None,
+        help="compiled graph cache passed to every replica "
+             "(suggest_for needs it)",
+    )
+    p_fl.add_argument(
+        "--watch-snapshots", type=float, default=1.0,
+        help="replica snapshot poll interval: how a RESTARTED replica "
+             "rejoins at the newest generation (0 = off)",
+    )
+    p_fl.add_argument(
+        "--replica-args", default=None, metavar="'ARGS...'",
+        help="extra `cli serve` flags passed through to every replica "
+             "(shell-quoted string, e.g. '--max-queue-depth 256')",
+    )
+    p_fl.add_argument(
+        "--log-dir", default=None,
+        help="per-member replica stderr logs (default: discarded)",
+    )
+    p_fl.add_argument(
+        "--restart-base-s", type=float, default=0.25,
+        help="restart backoff base (RetryPolicy schedule: base * "
+             "factor^n with deterministic per-member jitter)",
+    )
+    p_fl.add_argument(
+        "--restart-max-s", type=float, default=10.0,
+        help="restart backoff ceiling",
+    )
+    p_fl.add_argument(
+        "--quarantine-after", type=int, default=3,
+        help="consecutive failures (never up for --stable-s) before a "
+             "slot is quarantined — crash-loop detection",
+    )
+    p_fl.add_argument(
+        "--stable-s", type=float, default=5.0,
+        help="uptime that resets a member's failure count",
+    )
+    p_fl.add_argument(
+        "--drain-grace-s", type=float, default=0.5,
+        help="drain: wait this long after publishing state=draining "
+             "before the wire drain op (one router reload interval — "
+             "the zero-drop handshake)",
+    )
+    p_fl.add_argument(
+        "--up-timeout-s", type=float, default=60.0,
+        help="up: how long to wait for every replica's hello before "
+             "printing all_up=false (supervision continues either way)",
+    )
+    p_fl.add_argument(
+        "--member", default=None, metavar="ID",
+        help="drain: which member (e.g. s0r1)",
+    )
+    p_fl.add_argument(
+        "--shard", type=int, default=0,
+        help="add-replica: which shard the new replica serves",
+    )
+    p_fl.add_argument("--seed", type=int, default=0,
+                      help="backoff-jitter seed")
+    p_fl.add_argument(
+        "--telemetry-dir", default=None,
+        help="run-telemetry directory: membership / replica_restart / "
+             "replica_quarantined events + the final supervision "
+             "counters (render with `cli report`; jax-free)",
+    )
+    p_fl.add_argument(
+        "--heartbeat-s", type=float, default=0.0,
+        help="stall-heartbeat deadline with --telemetry-dir "
+             "(0 disables)",
+    )
+    p_fl.add_argument(
+        "--perf-ledger", default=None,
+        help="append the supervision record (replica_restarts) to a "
+             "perf-ledger JSONL",
+    )
+    p_fl.add_argument("--quiet", action="store_true")
+    p_fl.set_defaults(fn=cmd_fleet)
 
     p_ref = sub.add_parser(
         "refit",

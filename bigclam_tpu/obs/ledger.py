@@ -332,6 +332,25 @@ def build_record(
             if isinstance(v, _NUM) and not isinstance(v, bool)
             else None
         )
+    # self-healing fleet (ISSUE 20): the failure-path scoreboard.
+    # replica_restarts comes from the supervisor's final (`cli fleet
+    # up`), the rest from the router's. The rates are VERDICTED by
+    # `cli perf diff` — a fleet that suddenly retries 10x more often,
+    # hedges most of its traffic, or blows deadlines it used to make is
+    # regressing even at flat p99 (the retries ARE hiding the latency).
+    for field in ("hedged_rate", "deadline_exceeded_rate"):
+        v = final.get(field)
+        rec[field] = (
+            _round6(float(v))
+            if isinstance(v, _NUM) and not isinstance(v, bool)
+            else None
+        )
+    for field in ("replica_restarts", "router_retries"):
+        v = final.get(field)
+        rec[field] = (
+            int(v) if isinstance(v, _NUM) and not isinstance(v, bool)
+            else None
+        )
     # incremental refit (ISSUE 15): cost ratio vs the last full fit and
     # the touched fraction — both VERDICTED by `cli perf diff` (a refit
     # silently re-touching the whole graph, or costing as much as the
@@ -624,6 +643,19 @@ def diff_records(
         # hence the widest band
         check("generation_age_s", base.get("generation_age_s"),
               new.get("generation_age_s"), band_mult=4.0)
+        # self-healing rates (ISSUE 20): verdicted when the baseline
+        # exercised them (check() skips a zero/None baseline — a
+        # fault-free baseline cannot band a chaos run). Retries going UP
+        # at flat p99 means the fleet is failing more and hiding it;
+        # hedges going up means the tail got heavier; deadline misses
+        # are client-visible errors.
+        check("router_retries", base.get("router_retries"),
+              new.get("router_retries"))
+        check("hedged_rate", base.get("hedged_rate"),
+              new.get("hedged_rate"))
+        check("deadline_exceeded_rate",
+              base.get("deadline_exceeded_rate"),
+              new.get("deadline_exceeded_rate"))
     else:
         # steploss entries (ingest, report-only runs): wall time is the
         # only comparable figure
@@ -678,6 +710,16 @@ def diff_records(
     ):
         check("touched_frac", base["touched_frac"],
               new["touched_frac"])
+    # fleet supervision verdict (ISSUE 20): a `cli fleet up` record
+    # whose restart count grew past the band means replicas are dying
+    # more than the matched baseline drill — a stability regression the
+    # router's retry counters can mask. check() skips a zero baseline
+    # (a clean run cannot band a chaos drill).
+    if isinstance(base.get("replica_restarts"), _NUM) and isinstance(
+        new.get("replica_restarts"), _NUM
+    ):
+        check("replica_restarts", base["replica_restarts"],
+              new["replica_restarts"])
     # convergence verdicts (ISSUE 8): iteration count to tolerance is
     # VERDICTED (same cfg + workload + seed ⇒ deterministic up to float
     # summation order — growth past the band is a real optimizer

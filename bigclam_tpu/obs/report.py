@@ -980,6 +980,59 @@ def _fleet_router(members: List[dict]) -> Optional[dict]:
     return None
 
 
+def _fleet_supervision(members: List[dict]) -> Optional[dict]:
+    """Supervisor state (ISSUE 20): from the `fleet` entry's final
+    outcome when it finalized, else the roster of its LAST membership
+    event (the supervisor publishes one per state change, with the
+    per-member id/shard/state/restarts riding as the `roster` extra) —
+    so a LIVE fleet renders up/restarting/quarantined/draining per
+    member mid-drill."""
+    sup = next((m for m in members if m["entry"] == "fleet"), None)
+    if sup is None:
+        return None
+    f = sup["final"] or {}
+    roster = None
+    if isinstance(f.get("fleet_members"), dict):
+        roster = [
+            dict(
+                (v if isinstance(v, dict) else {}), id=str(mid)
+            )
+            for mid, v in sorted(f["fleet_members"].items())
+        ]
+    if roster is None:
+        last = next(
+            (
+                e for e in reversed(sup["events"] or [])
+                if e.get("kind") == "membership"
+                and isinstance(e.get("roster"), list)
+            ),
+            None,
+        )
+        if last is not None:
+            roster = [
+                r for r in last["roster"] if isinstance(r, dict)
+            ]
+    events = sup["events"] or []
+    restarts = f.get("replica_restarts")
+    if not isinstance(restarts, int):
+        restarts = sum(
+            1 for e in events if e.get("kind") == "replica_restart"
+        )
+    quarantined = f.get("quarantined")
+    if not isinstance(quarantined, int):
+        quarantined = sum(
+            1 for e in events
+            if e.get("kind") == "replica_quarantined"
+        )
+    return {
+        "dir": sup["name"],
+        "finalized": sup["finalized"],
+        "replica_restarts": restarts,
+        "quarantined": quarantined,
+        "members": roster or [],
+    }
+
+
 def render_fleet_json(root: str) -> Tuple[dict, int]:
     """Machine-readable fleet view: member roster, the router's final
     scoreboard verbatim, and replica finals grouped by shard. Exit-code
@@ -1031,6 +1084,7 @@ def render_fleet_json(root: str) -> Tuple[dict, int]:
         "router": (router["final"] or None) if router else None,
         "router_dir": router["name"] if router else None,
         "replicas": dict(sorted(by_shard.items())),
+        "supervision": _fleet_supervision(members),
     }
     return obj, errors
 
@@ -1094,6 +1148,35 @@ def render_fleet(root: str) -> Tuple[str, int]:
             lines.append(
                 "  per-hop mean: " + "  ".join(hop_parts)
                 + f"  (over {rf.get('traced_queries', '?')} traced)"
+            )
+        heal_parts = []
+        for key, label in (
+            ("router_retries", "retried"),
+            ("hedged", "hedged"),
+            ("hedge_wins", "hedge wins"),
+            ("deadline_exceeded", "deadline exceeded"),
+            ("membership_reloads", "membership reloads"),
+        ):
+            v = rf.get(key)
+            if isinstance(v, int) and v:
+                heal_parts.append(f"{label} {v}")
+        if heal_parts:
+            lines.append("  self-healing: " + "  ".join(heal_parts))
+    sup = obj.get("supervision")
+    if sup:
+        lines.append("")
+        lines.append(
+            f"supervisor [{sup['dir']}]: "
+            f"{sup['replica_restarts']} restart(s), "
+            f"{sup['quarantined']} quarantined"
+            + ("" if sup["finalized"] else "  [running]")
+        )
+        for r in sup["members"]:
+            lines.append(
+                f"  {r.get('id', '?'):<8} shard "
+                f"{r.get('shard', '?')}  "
+                f"{str(r.get('state', '?')):<12} "
+                f"restarts {r.get('restarts', 0)}"
             )
     shard_stats = rf.get("serve_shard_stats") or {}
     shard_keys = sorted(

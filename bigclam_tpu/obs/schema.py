@@ -135,6 +135,21 @@ EVENT_KINDS = {
     # refresh and at batch completion; `step` (the serving generation)
     # and `rollouts` ride as extras. Aggregates land in `final` as
     # generation_age_s, which the perf ledger VERDICTS
+    # --- self-healing serving fleet (serve.supervise, ISSUE 20) ---
+    "replica_restart": {"member": (str,), "shard": (int,)},
+    # the supervisor is respawning a replica slot after an unplanned
+    # exit (restart-on-exit with RetryPolicy backoff); `restarts` (the
+    # slot's lifetime respawn count) rides as an extra
+    "replica_quarantined": {"member": (str,), "shard": (int,)},
+    # crash-loop detection fired: more than quarantine_after consecutive
+    # failures parked the slot — the fleet degrades to its surviving
+    # replicas instead of burning CPU on a doomed respawn loop
+    # (`failures` rides as an extra)
+    "membership": {"seq": (int,), "members": (int,)},
+    # the membership document changed: the supervisor published seq N
+    # with `members` live entries (`roster` — id/shard/state/restarts
+    # per member — rides as an extra on supervisor-emitted events), or
+    # the router reconciled its endpoint set against it
     # --- incremental graph deltas (ISSUE 15) ---
     "delta_ingest": {"edges_added": (int,), "touched_shards": (int,)},
     # one applied edge delta (GraphStore.apply_delta): directed edges

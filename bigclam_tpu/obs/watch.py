@@ -384,6 +384,39 @@ def _render_fleet_members(root, members, width: int) -> str:
             except OSError:
                 pass
         lines.append(f"  {name} [{entry}]: " + "  ".join(parts))
+        if entry == "fleet":
+            # supervisor member (ISSUE 20): per-slot lifecycle state
+            # from the LAST membership event's roster — a live chaos
+            # drill shows up/restarting/quarantined/draining as it runs
+            restarts = sum(
+                1 for e in events
+                if e.get("kind") == "replica_restart"
+            )
+            quar = sum(
+                1 for e in events
+                if e.get("kind") == "replica_quarantined"
+            )
+            if restarts or quar:
+                lines.append(
+                    f"    supervision: {restarts} restart(s), "
+                    f"{quar} quarantined"
+                )
+            last = next(
+                (
+                    e for e in reversed(events)
+                    if e.get("kind") == "membership"
+                    and isinstance(e.get("roster"), list)
+                ),
+                None,
+            )
+            for r in (last or {}).get("roster", []):
+                if isinstance(r, dict):
+                    lines.append(
+                        f"    {str(r.get('id', '?')):<8} shard "
+                        f"{r.get('shard', '?')}  "
+                        f"{str(r.get('state', '?')):<12} "
+                        f"restarts {r.get('restarts', 0)}"
+                    )
         # the router member's slow-query exemplar trail (qtrace events):
         # end-to-end ms of the top-N traces per window as a sparkline —
         # a widening tail is visible live, before any report runs

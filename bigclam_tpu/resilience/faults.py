@@ -9,6 +9,15 @@ deterministic faults at instrumented sites:
     fit.step           every run_fit_loop iteration (ctx: it)
     checkpoint.save    after each CheckpointManager.save (ctx: step, path)
     store.load_shard   before each shard blob read (ctx: shard, path)
+    replica.start      a fleet replica process about to serve (ctx:
+                       member, shard) — a kill here is a crash loop, the
+                       supervisor's quarantine drill (ISSUE 20)
+    replica.answer_write
+                       a replica about to write one answer frame (ctx:
+                       member, shard, family) — the wire-fault kinds below
+                       fire here (serve.fleet applies them)
+    wire.connect       the router about to dial a replica endpoint (ctx:
+                       endpoint) — connect_refuse fires here
 
 Fault kinds:
 
@@ -23,6 +32,17 @@ Fault kinds:
                        (a lost page-cache writeback / silent bit flip)
     corrupt_shard      applied by store.load_shard to the shard's indices
                        blob before the crc check (drives quarantine)
+    connect_refuse     wire.connect raises ConnectionRefusedError (the
+                       endpoint's process is gone; the router must fail
+                       over, not error)
+    torn_frame         replica.answer_write emits HALF the answer frame
+                       then hangs up (a peer killed mid-write) — the
+                       router's bounded reader must discard + retry
+    garbage_line       replica.answer_write emits a non-JSON line (framing
+                       corruption) — same recovery contract
+    stall              replica.answer_write sleeps `seconds` BEFORE
+                       writing (a wedged replica) — the router's read
+                       timeout must bound it, then fail over
 
 A plan is a JSON spec: ``{"seed": 0, "faults": [{"kind": "kill", "site":
 "fit.step", "at": 5}, ...]}``. Each fault fires ONCE (consumed); matching
@@ -204,6 +224,31 @@ def maybe_fire(site: str, **ctx) -> Optional[dict]:
         if plan is None:
             return None
     return plan.fire(site, **ctx)
+
+
+def apply_wire_fault(spec: dict, wfile, payload: bytes) -> Optional[str]:
+    """Apply a replica.answer_write wire fault to one outgoing answer
+    frame. Returns what the transport handler must do next:
+
+      "close"  — torn_frame: half the frame went out, hang up now
+      "skip"   — garbage_line: a non-JSON line replaced the answer;
+                 keep the connection (the peer discards it)
+      None     — stall (the sleep already happened) or an unknown kind:
+                 write the real answer normally
+    """
+    kind = spec["kind"]
+    if kind == "torn_frame":
+        wfile.write(payload[: max(len(payload) // 2, 1)])
+        wfile.flush()
+        return "close"
+    if kind == "garbage_line":
+        wfile.write(b"!! injected garbage frame !!\n")
+        wfile.flush()
+        return "skip"
+    if kind == "stall":
+        time.sleep(float(spec.get("seconds", 1.0)))
+        return None
+    return None
 
 
 def apply_file_fault(spec: dict, path: str) -> None:

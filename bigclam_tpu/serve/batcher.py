@@ -46,8 +46,17 @@ from typing import Any, Callable, List, Optional
 
 class OverloadedError(RuntimeError):
     """Request shed by admission control (queue past the depth/deadline
-    watermark). Servers map this to a fast {"error": "overloaded"}
-    answer — by design the CHEAPEST possible response."""
+    watermark, or the door closed for a drain). Servers map this to a
+    fast {"error": "overloaded"} answer — by design the CHEAPEST
+    possible response."""
+
+
+class BatcherStopped(RuntimeError):
+    """The batcher shut down with this request still queued (or the
+    submit arrived after stop). Typed so clients can tell "server going
+    down" from overload or a handler bug — a fail-fast signal, never a
+    hang (ISSUE 20). Graceful shutdown that must NOT strand requests is
+    close_door() + drain() + stop()."""
 
 
 class Future:
@@ -127,12 +136,14 @@ class RequestBatcher:
         self._cond = threading.Condition(self._lock)
         self._inflight = 0
         self._stop = False
+        self._door_closed = False
         self._thread: Optional[threading.Thread] = None
         self.batches = 0
         self.flushed_full = 0       # batches flushed by max_batch
         self.flushed_deadline = 0   # batches flushed by the budget window
         self.shed_depth = 0         # submits rejected at the depth bound
         self.shed_deadline = 0      # requests shed stale at flush
+        self.shed_door = 0          # submits rejected while draining
         self.depth_peak = 0         # high-water queue depth observed
 
     # ------------------------------------------------------- lifecycle
@@ -145,28 +156,55 @@ class RequestBatcher:
             self._thread.start()
         return self
 
-    def stop(self) -> None:
+    def stop(self, timeout: float = 30.0) -> None:
+        """Fail-fast shutdown: everything still QUEUED fails immediately
+        with BatcherStopped — before the flusher is joined, so a wedged
+        handler can never hold stranded futures hostage. The batch the
+        handler is currently executing finishes normally (its futures
+        belong to the handler). Callers that must not strand requests
+        drain first: close_door() + drain() + stop()."""
         with self._cond:
             self._stop = True
+            stranded = list(self._q)
+            self._q.clear()
             self._cond.notify_all()
-        if self._thread is not None:
-            self._thread.join(timeout=30.0)
-            self._thread = None
-        # fail anything still queued (stop during load is a caller bug,
-        # but futures must never hang)
-        while self._q:
-            req = self._q.popleft()
+        for req in stranded:
             if not req.future.done():
                 req.future.set_error(
-                    RuntimeError("batcher stopped with request queued")
+                    BatcherStopped(
+                        f"batcher stopped with {len(stranded)} "
+                        "request(s) queued"
+                    )
                 )
+        if self._thread is not None:
+            self._thread.join(timeout=timeout)
+            self._thread = None
+
+    def close_door(self) -> None:
+        """Stop admitting: every later submit() sheds fast with
+        OverloadedError. This is the drain protocol's first step
+        (ISSUE 20) — already-queued and in-flight requests finish
+        normally, drain() then observes a quiescent batcher, stop()
+        finds nothing to strand."""
+        with self._cond:
+            self._door_closed = True
+
+    @property
+    def draining(self) -> bool:
+        return self._door_closed
 
     # --------------------------------------------------------- clients
     def submit(self, payload: Any) -> Future:
         req = Request(payload)
         with self._cond:
             if self._stop or self._thread is None:
-                raise RuntimeError("batcher is not running")
+                raise BatcherStopped("batcher is not running")
+            if self._door_closed:
+                self.shed_door += 1
+                req.future.set_error(
+                    OverloadedError("admission door closed (draining)")
+                )
+                return req.future
             if self.max_depth and len(self._q) >= self.max_depth:
                 # shed at the door (depth watermark): the future fails
                 # NOW — callers see the same Future surface either way
@@ -200,7 +238,7 @@ class RequestBatcher:
 
     @property
     def shed(self) -> int:
-        return self.shed_depth + self.shed_deadline
+        return self.shed_depth + self.shed_deadline + self.shed_door
 
     def drain(self, timeout: float = 60.0) -> None:
         """Block until the queue is empty and no batch is executing —
@@ -243,6 +281,10 @@ class RequestBatcher:
                     self._cond.wait(remaining)
                 full = len(self._q) >= self.max_batch
                 batch = self._take_batch_locked()
+                if not batch:
+                    # stop() failed + cleared the queue out from under
+                    # the open window — nothing left to execute
+                    return
                 self._inflight += 1
                 self.batches += 1
                 if full:

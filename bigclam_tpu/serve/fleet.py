@@ -57,6 +57,7 @@ from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from bigclam_tpu.resilience.faults import apply_wire_fault, maybe_fire
 from bigclam_tpu.serve.batcher import (
     OverloadedError,
     Request,
@@ -373,7 +374,10 @@ class LocalReplica:
         self.depth = 0
 
     def request(
-        self, q: Dict[str, Any], timeout: Optional[float] = None
+        self,
+        q: Dict[str, Any],
+        timeout: Optional[float] = None,
+        handle: Optional[Dict[str, Any]] = None,
     ) -> Dict[str, Any]:
         traced = isinstance(q, dict) and q.get("trace")
         t0 = time.perf_counter()
@@ -389,6 +393,11 @@ class LocalReplica:
             res = dict(res)
             res["hops"] = [0, 0, 0, us, us]
         return json.loads(json.dumps(res))
+
+    def cancel(self, handle: Dict[str, Any]) -> None:
+        """No socket to shut down — hedged in-process losers just finish
+        and get ignored (the TcpReplica surface, for hedging tests)."""
+        handle["cancelled"] = True
 
     def close(self) -> None:
         pass
@@ -436,6 +445,10 @@ class ReplicaServer:
         shed_wait_s: float = 0.0,
     ):
         self.replica = replica
+        # fleet-member identity (supervisor-assigned via env): fault
+        # specs match on it, so a chaos drill can hit ONE slot of a
+        # fleet that shares a single BIGCLAM_FAULTS env
+        self.member = os.environ.get("BIGCLAM_FLEET_MEMBER", "")
         self._batcher = RequestBatcher(
             self._handle,
             max_batch=max_batch,
@@ -465,6 +478,7 @@ class ReplicaServer:
                     t_recv = time.perf_counter()
                     if outer._fault_hop == "decode" and outer._fault_delay_s:
                         time.sleep(outer._fault_delay_s)
+                    q = None
                     try:
                         q = json.loads(line)
                     except ValueError:
@@ -475,19 +489,44 @@ class ReplicaServer:
                             t_recv=t_recv,
                             decode_s=time.perf_counter() - t_recv,
                         )
+                    fam = q.get("family") if isinstance(q, dict) else None
+                    payload = (json.dumps(res) + "\n").encode()
                     try:
-                        self.wfile.write(
-                            (json.dumps(res) + "\n").encode()
-                        )
-                        self.wfile.flush()
+                        wired = None
+                        if fam not in ("status", "stop", "drain"):
+                            # the wire-fault chokepoint (ISSUE 20): every
+                            # QUERY answer frame passes here; control ops
+                            # are exempt so health checks and teardown
+                            # stay drillable under an active fault plan
+                            spec = maybe_fire(
+                                "replica.answer_write",
+                                family=str(fam),
+                                shard=outer.replica.shard,
+                                member=outer.member,
+                            )
+                            if spec is not None:
+                                wired = apply_wire_fault(
+                                    spec, self.wfile, payload
+                                )
+                        if wired == "close":
+                            return   # torn frame: hang up mid-answer
+                        if wired != "skip":
+                            self.wfile.write(payload)
+                            self.wfile.flush()
                     except OSError:
                         return       # client went away mid-answer
-                    if isinstance(q, dict) and q.get("family") == "stop":
+                    if fam in ("stop", "drain"):
                         # shutdown AFTER the ack is flushed (and from a
                         # fresh thread — shutdown() deadlocks called
                         # from a handler): acking first is what keeps
                         # `route --stop` from racing the process exit
-                        # and miscounting a clean stop as unreachable
+                        # and miscounting a clean stop as unreachable.
+                        # drain and stop share the teardown: close()
+                        # shuts the admission door, drains in-flight,
+                        # then stops — the zero-drop part of a DRAIN is
+                        # the protocol around it (the supervisor flips
+                        # membership and waits the router-reload grace
+                        # BEFORE sending this op).
                         threading.Thread(
                             target=outer.close, daemon=True
                         ).start()
@@ -553,10 +592,17 @@ class ReplicaServer:
             st["depth"] = self._batcher.depth()
             st["shed"] = self._batcher.shed
             st["depth_peak"] = self._batcher.depth_peak
+            if self._batcher.draining:
+                st["draining"] = True
             return st
         if fam == "stop":
             # the HANDLER schedules close() after flushing this ack
             return {"ok": True}
+        if fam == "drain":
+            # same teardown as stop (the handler schedules close());
+            # the distinct op exists so the supervisor's drain protocol
+            # reads as intent on the wire and in logs
+            return {"ok": True, "draining": True}
         fut = None
         try:
             fut = self._batcher.submit(q)
@@ -603,5 +649,14 @@ class ReplicaServer:
         self._stopped.set()
         self._srv.shutdown()
         self._srv.server_close()
+        # graceful order (ISSUE 20): door first (late submits shed fast
+        # instead of hanging), drain what was admitted (zero drops),
+        # THEN stop — stop() alone fail-fasts queued futures with
+        # BatcherStopped, which is the crash path, not this one
+        self._batcher.close_door()
+        try:
+            self._batcher.drain(timeout=30.0)
+        except TimeoutError:
+            pass
         self._batcher.stop()
         self.replica.close()

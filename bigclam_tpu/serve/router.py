@@ -54,6 +54,22 @@ sample generation age (ROADMAP 3a). Tracing is off-path-free: with no
 telemetry installed no marker is stamped, replicas attach nothing, and
 answers are bit-identical to an untraced run.
 
+Self-healing (ISSUE 20): the router is also a long-lived tier.
+`RouterServer` (`cli route --daemon`) serves route() over the same
+newline-framed JSON wire; each query gets an optional wall DEADLINE,
+idempotent read sub-queries get bounded refresh+retry rounds after a
+whole replica set fails (the window in which the FleetSupervisor
+restarts a kill -9'd replica — the client sees a retried answer, not an
+error), and optional tail-latency HEDGING duplicates a slow read to a
+second replica after a p99-derived delay (winner counted, loser's
+socket shut down). With `members_file` the endpoint set is a watched
+membership document (supervisor-published, serve.supervise): refresh()
+reconciles it, so add-replica and drain work mid-stream with zero
+drops. Counters: router_retries / hedged / hedge_wins /
+deadline_exceeded / membership_reloads, rate-verdicted in the perf
+ledger. For hedged queries the sequential trace identity above becomes
+an inequality (two hops overlap in time); hedged hops are marked.
+
 Entirely jax-free: routing is bisect + np.unique; the device work stays
 on the replicas.
 """
@@ -61,22 +77,41 @@ on the replicas.
 from __future__ import annotations
 
 import json
+import queue as _queuemod
 import socket
+import socketserver
 import threading
 import time
 from bisect import bisect_right
+from collections import deque
 from concurrent.futures import ThreadPoolExecutor
 from heapq import heappush, heappushpop
-from typing import Any, Dict, List, Optional, Sequence
+from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
 from bigclam_tpu.obs import telemetry as _obs
 from bigclam_tpu.obs.ledger import _percentile
 from bigclam_tpu.obs.trace import new_trace_id
+from bigclam_tpu.resilience.faults import maybe_fire
 from bigclam_tpu.utils.checkpoint import CheckpointManager
 
 FAMILIES = ("communities_of", "members_of", "suggest_for")
+
+# sub-query families the router may RE-dispatch after every replica of a
+# shard failed (a refresh + bounded retry round) and may HEDGE: the
+# idempotent cheap reads. suggest_rows — the fold-in execute — is
+# excluded on purpose: duplicating device work amplifies exactly the
+# overload that makes replicas slow, and the transport failover (send
+# failed, no work started) already covers it (DESIGN.md "Fleet failure
+# model").
+_RETRY_FAMILIES = frozenset(
+    ("communities_of", "members_of", "rows_of", "suggest_for")
+)
+
+# rolling window of sub-query wire latencies feeding the p99-derived
+# hedge delay (bounded: old samples age out under any load)
+_WIRE_WINDOW = 512
 
 # slow-query exemplar log: keep the TRACE_TOP slowest traces per
 # TRACE_WINDOW completed traced queries, emit them as `qtrace` events,
@@ -99,12 +134,27 @@ class _Shed(Exception):
     routed query degrades to one fast {"error": "overloaded"} answer."""
 
 
+class _DeadlineExceeded(Exception):
+    """The per-query deadline ran out mid-route — the whole query
+    degrades to one {"error": "deadline_exceeded"} answer (counted;
+    the ledger verdicts the rate)."""
+
+
 class TcpReplica:
     """Client transport to one ReplicaServer endpoint: persistent
     JSON-lines connections (a small pool, so concurrent router workers
-    don't serialize on one socket). On an I/O error the connection is
-    dropped and the request retried once on a fresh one; a second
-    failure propagates (the router marks the endpoint unhealthy)."""
+    don't serialize on one socket). On an I/O error — including a TORN
+    answer frame (peer killed mid-write) or a garbage line — the
+    connection is dropped and the request retried once on a fresh one; a
+    second failure propagates (the router marks the endpoint unhealthy).
+    A read TIMEOUT is different: the socket is closed and TimeoutError
+    raised immediately — a stalled replica costs at most one timeout,
+    never a blind same-budget retry (ISSUE 20 satellite).
+
+    Hedging support: pass a `handle` dict and the in-flight connection
+    is tracked in it; `cancel(handle)` shutdown()s that socket, which
+    reliably wakes a blocked recv so a hedge loser stops consuming a
+    connection the moment the winner answers."""
 
     def __init__(
         self, host: str, port: int, timeout_s: float = 60.0, pool: int = 4
@@ -117,8 +167,16 @@ class TcpReplica:
         self._pool: List[Any] = []
         self._pool_lock = threading.Lock()
         self._pool_max = max(int(pool), 1)
+        self._closed = False
 
     def _connect(self):
+        spec = maybe_fire(
+            "wire.connect", endpoint=f"{self.host}:{self.port}"
+        )
+        if spec is not None and spec.get("kind") == "connect_refuse":
+            raise ConnectionRefusedError(
+                f"injected connect_refuse to {self.host}:{self.port}"
+            )
         sock = socket.create_connection(
             (self.host, self.port), timeout=self.timeout_s
         )
@@ -132,7 +190,7 @@ class TcpReplica:
 
     def _release(self, conn) -> None:
         with self._pool_lock:
-            if len(self._pool) < self._pool_max:
+            if not self._closed and len(self._pool) < self._pool_max:
                 self._pool.append(conn)
                 return
         self._discard(conn)
@@ -145,34 +203,80 @@ class TcpReplica:
         except OSError:
             pass
 
+    def _handle_set(self, handle, conn) -> None:
+        if handle is not None:
+            with self._pool_lock:
+                handle["conn"] = conn
+
     def request(
-        self, q: Dict[str, Any], timeout: Optional[float] = None
+        self,
+        q: Dict[str, Any],
+        timeout: Optional[float] = None,
+        handle: Optional[Dict[str, Any]] = None,
     ) -> Dict[str, Any]:
         payload = (json.dumps(q) + "\n").encode()
+        budget = timeout if timeout is not None else self.timeout_s
         last: Optional[BaseException] = None
         for attempt in range(2):
+            if handle is not None and handle.get("cancelled"):
+                raise ConnectionError("request cancelled (hedge loser)")
             conn = None
             try:
                 conn = self._acquire()
                 sock, rfile = conn
-                if timeout is not None:
-                    sock.settimeout(timeout)
+                sock.settimeout(budget)
+                self._handle_set(handle, conn)
                 sock.sendall(payload)
                 line = rfile.readline()
                 if not line:
                     raise ConnectionError("replica closed the connection")
+                if not line.endswith(b"\n"):
+                    # torn frame: the peer died mid-write (or the read
+                    # was cancelled) — never hand a partial frame to the
+                    # json decoder as if it were an answer
+                    raise ConnectionError("torn answer frame")
+                # parse BEFORE releasing: a garbage line must discard
+                # this connection, never park it back in the pool
+                res = json.loads(line)
+                self._handle_set(handle, None)
                 self._release(conn)
-                return json.loads(line)
+                return res
+            except socket.timeout as e:
+                # bounded read: close the wedged socket and surface the
+                # timeout NOW — the caller (router) owns the deadline
+                # and decides whether another replica gets a try
+                self._handle_set(handle, None)
+                if conn is not None:
+                    self._discard(conn)
+                raise TimeoutError(
+                    f"replica {self.host}:{self.port} timed out "
+                    f"after {budget:.3f}s"
+                ) from e
             except (OSError, ValueError, ConnectionError) as e:
                 last = e
+                self._handle_set(handle, None)
                 if conn is not None:
                     self._discard(conn)
         raise ConnectionError(
             f"replica {self.host}:{self.port} unreachable: {last}"
         )
 
+    def cancel(self, handle: Dict[str, Any]) -> None:
+        """Wake a blocked hedge-loser read NOW: shutdown() the in-flight
+        socket (a plain close() does not reliably interrupt a blocked
+        recv; shutdown does)."""
+        with self._pool_lock:
+            handle["cancelled"] = True
+            conn = handle.get("conn")
+        if conn is not None:
+            try:
+                conn[0].shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+
     def close(self) -> None:
         with self._pool_lock:
+            self._closed = True
             pool, self._pool = self._pool, []
         for conn in pool:
             self._discard(conn)
@@ -187,15 +291,44 @@ class FleetRouter:
     def __init__(
         self,
         directory: str,
-        endpoints: Sequence[Any],
+        endpoints: Sequence[Any] = (),
         max_workers: int = 16,
         health_interval_s: float = 0.0,
         request_timeout_s: float = 60.0,
+        deadline_s: float = 0.0,
+        retry_rounds: int = 1,
+        hedge: bool = False,
+        hedge_delay_s: float = 0.0,
+        hedge_min_samples: int = 64,
+        members_file: Optional[str] = None,
     ):
         self.directory = directory
         self._cm = CheckpointManager(directory)
         self.endpoints = list(endpoints)
         self.request_timeout_s = float(request_timeout_s)
+        # --- fleet self-healing knobs (ISSUE 20; module docstring) ---
+        # deadline_s: per-query wall budget (0 = off); retry_rounds: how
+        # many refresh+re-dispatch rounds a read sub-query gets after
+        # EVERY replica of its shard failed (the window in which the
+        # supervisor restarts a kill -9'd replica); hedge: duplicate a
+        # slow read sub-query to a second replica after hedge_delay_s
+        # (0 = derive from the rolling wire p99 once hedge_min_samples
+        # accumulated), first answer wins, loser cancelled.
+        self._deadline_s = max(float(deadline_s), 0.0)
+        self._retry_rounds = max(int(retry_rounds), 0)
+        self._hedge = bool(hedge)
+        self._hedge_delay_s = max(float(hedge_delay_s), 0.0)
+        self._hedge_min_samples = max(int(hedge_min_samples), 1)
+        self._hedge_pool: Optional[ThreadPoolExecutor] = None
+        self._wire_window: deque = deque(maxlen=_WIRE_WINDOW)
+        self._members_file = members_file
+        self._membership_seq: Optional[int] = None
+        self.membership_reloads = 0
+        self.retried = 0
+        self.hedged = 0
+        self.hedge_wins = 0
+        self.deadline_exceeded = 0
+        self._deadline_local = threading.local()
         self._tables: Dict[int, Dict[str, Any]] = {}
         self._by_shard: Dict[int, List[Any]] = {}
         self._down: set = set()
@@ -305,11 +438,72 @@ class FleetRouter:
         return t["row_shard"][max(i, 0)]
 
     # --------------------------------------------------- health/rollout
+    def _reload_membership(self) -> None:
+        """Re-read the watched membership file (supervisor-published,
+        atomic tmp+rename) and reconcile the endpoint set: members in
+        state "up" are admitted (existing TcpReplica objects — and their
+        warm connection pools — are kept by endpoint), everything else
+        (draining/quarantined/removed) is dropped and closed. A torn or
+        missing file keeps the current set: membership only ever moves
+        on a complete document."""
+        try:
+            with open(self._members_file) as f:
+                doc = json.load(f)
+        except (OSError, ValueError):
+            return
+        seq = doc.get("seq")
+        if seq is not None and seq == self._membership_seq:
+            return
+        want: Dict[str, dict] = {}
+        for m in doc.get("members", []):
+            ep = m.get("endpoint")
+            if ep and m.get("state") == "up":
+                want[str(ep)] = m
+        have = {
+            f"{t.host}:{t.port}": t
+            for t in self.endpoints
+            if isinstance(t, TcpReplica)
+        }
+        if set(want) != set(have):
+            new_eps: List[Any] = []
+            for ep in want:
+                t = have.get(ep)
+                if t is None:
+                    host, port = ep.rsplit(":", 1)
+                    t = TcpReplica(
+                        host, int(port), timeout_s=self.request_timeout_s
+                    )
+                new_eps.append(t)
+            dropped = [t for ep, t in have.items() if ep not in want]
+            with self._lock:
+                self.endpoints = new_eps
+            for t in dropped:
+                # idle pooled connections close here; a sub-query already
+                # in flight on this transport holds its connection checked
+                # out and completes — that is the zero-drop half the
+                # router owns during a drain
+                try:
+                    t.close()
+                except Exception:   # noqa: BLE001 — best effort
+                    pass
+            self.membership_reloads += 1
+            tel = _obs.current()
+            if tel is not None:
+                tel.event(
+                    "membership",
+                    seq=int(seq or 0),
+                    members=len(new_eps),
+                )
+        self._membership_seq = seq
+
     def refresh(self) -> Optional[int]:
         """Health-check every endpoint, rebuild the per-shard replica
         sets, and advance the serving generation iff every healthy
         replica of every shard holds a newer common one. Never moves
-        backward."""
+        backward. With a membership file the endpoint set itself is
+        reconciled first (elastic membership, ISSUE 20)."""
+        if self._members_file:
+            self._reload_membership()
         by_shard: Dict[int, List[Any]] = {}
         common: Optional[set] = None
         down = set()
@@ -378,16 +572,267 @@ class FleetRouter:
         )
 
     # --------------------------------------------------------- dispatch
+    def _deadline(self) -> Optional[float]:
+        return getattr(self._deadline_local, "t", None)
+
+    @staticmethod
+    def _check_deadline(deadline: Optional[float]) -> None:
+        if deadline is not None and time.perf_counter() >= deadline:
+            raise _DeadlineExceeded()
+
+    def _remaining(
+        self, deadline: Optional[float], slack: float = 2.0
+    ) -> float:
+        """Wall budget left for waiting on an in-flight attempt: the
+        attempt's own socket timeout plus slack when no deadline is set,
+        else the remaining deadline plus slack (the attempt thread is
+        itself bounded — the slack only covers its return)."""
+        if deadline is None:
+            return self.request_timeout_s + slack
+        rem = deadline - time.perf_counter()
+        if rem <= 0:
+            raise _DeadlineExceeded()
+        return rem + slack
+
+    def _attempt(
+        self,
+        t: Any,
+        shard: int,
+        q: Dict[str, Any],
+        deadline: Optional[float],
+        tr: Optional[Dict[str, Any]],
+        handle: Optional[Dict[str, Any]] = None,
+        hedged: bool = False,
+    ) -> Tuple[str, Any]:
+        """One sub-query to one replica, bounded by min(request timeout,
+        remaining deadline). Returns ("ok", answer), ("fail", why) — a
+        transport failure, replica marked down — or ("skip", why) — a
+        live replica that cannot serve this query (pruned generation,
+        malformed answer)."""
+        timeout = self.request_timeout_s
+        if deadline is not None:
+            remaining = deadline - time.perf_counter()
+            if remaining <= 0:
+                raise _DeadlineExceeded()
+            timeout = min(timeout, remaining)
+        t0 = time.perf_counter()
+        try:
+            if handle is not None:
+                res = t.request(q, timeout=timeout, handle=handle)
+            else:
+                res = t.request(q, timeout=timeout)
+        except Exception as e:   # noqa: BLE001 — fail over
+            if handle is not None and handle.get("cancelled"):
+                # a hedge loser dying AFTER cancellation is the plan
+                # working, not a sick replica — no down-mark, no counter
+                return "cancelled", f"{type(e).__name__}: {e}"
+            self.transport_failovers += 1
+            with self._lock:
+                self._down.add(id(t))
+                if t in self._by_shard.get(shard, ()):
+                    self._by_shard[shard].remove(t)
+            return "fail", f"{type(e).__name__}: {e}"
+        wire_s = time.perf_counter() - t0
+        self._shard_lat.setdefault(shard, []).append(wire_s)
+        self._wire_window.append(wire_s)
+        if not isinstance(res, dict):
+            return "skip", f"non-dict answer {type(res).__name__}"
+        t.depth = int(res.get("depth", getattr(t, "depth", 0)))
+        if res.get("error") == "unknown_generation":
+            self.pruned_generation += 1
+            return "skip", f"replica pruned generation {q.get('gen')}"
+        pin = q.get("gen")
+        if (
+            pin is not None
+            and "gen" in res
+            and int(res["gen"]) != int(pin)
+        ):
+            # the tripwire the gate asserts ZERO on — an answer
+            # from a generation the query was not pinned to
+            self.mixed_generation += 1
+        if tr is not None:
+            hop: Dict[str, Any] = {
+                "shard": int(shard), "wire_s": wire_s,
+            }
+            if hedged:
+                hop["hedged"] = 1
+            hb = res.get("hops")
+            if isinstance(hb, (list, tuple)) and len(hb) == 5:
+                # compact wire form (see serve.fleet): integer
+                # microseconds [decode, queue, batch_wait, execute,
+                # replica] — expanded to named float seconds here so
+                # only the hot wire path pays for compactness
+                hop["decode_s"] = hb[0] / 1e6
+                hop["queue_s"] = hb[1] / 1e6
+                hop["batch_wait_s"] = hb[2] / 1e6
+                hop["execute_s"] = hb[3] / 1e6
+                rs = hb[4] / 1e6
+                hop["replica_s"] = rs
+                # wire time the replica never saw: connect +
+                # serialize + kernel/network transit
+                hop["transport_s"] = max(wire_s - rs, 0.0)
+            tr["hops"].append(hop)
+        return "ok", res
+
+    def _hedge_delay(self) -> Optional[float]:
+        """The delay before duplicating a read sub-query: explicit when
+        configured, else the p99 of the rolling wire-latency window —
+        None (no hedge) until enough samples exist to derive one."""
+        if self._hedge_delay_s > 0:
+            return self._hedge_delay_s
+        if len(self._wire_window) < self._hedge_min_samples:
+            return None
+        p99 = _percentile(list(self._wire_window), 99)
+        return max(float(p99), 1e-3) if p99 is not None else None
+
+    def _hedge_pool_get(self) -> ThreadPoolExecutor:
+        with self._lock:
+            if self._hedge_pool is None:
+                self._hedge_pool = ThreadPoolExecutor(
+                    max_workers=32,
+                    thread_name_prefix="bigclam-route-hedge",
+                )
+            return self._hedge_pool
+
+    def _request_hedged(
+        self,
+        primary: Any,
+        secondary: Any,
+        q: Dict[str, Any],
+        deadline: Optional[float],
+        shard: int,
+        tr: Optional[Dict[str, Any]],
+        delay: float,
+    ) -> Tuple[Optional[Dict[str, Any]], int, Optional[str]]:
+        """Tail-latency hedging: dispatch to the primary; if no answer
+        within `delay`, duplicate to the secondary and take whichever
+        answers first, cancelling the loser (its socket is shut down so
+        it stops consuming a connection). A primary that FAILS before
+        the delay fast-forwards to the secondary — that is plain
+        failover, only the duplicate-while-in-flight counts as hedged."""
+        outq: "_queuemod.Queue" = _queuemod.Queue()
+        handles = ({"cancelled": False}, {"cancelled": False})
+        transports = (primary, secondary)
+
+        def run(idx: int) -> None:
+            try:
+                kind, val = self._attempt(
+                    transports[idx], shard, q, deadline, tr,
+                    handle=handles[idx], hedged=bool(idx),
+                )
+            except _DeadlineExceeded:
+                kind, val = "fail", "deadline exceeded"
+            except Exception as e:   # noqa: BLE001 — thread must return
+                kind, val = "fail", f"{type(e).__name__}: {e}"
+            outq.put((idx, kind, val))
+
+        pool = self._hedge_pool_get()
+        pool.submit(run, 0)
+        launched = 1
+        pending = 1
+        failures = 0
+        last: Optional[str] = None
+        wait = delay
+        while pending:
+            try:
+                idx, kind, val = outq.get(timeout=wait)
+            except _queuemod.Empty:
+                if launched == 1:
+                    self.hedged += 1
+                    pool.submit(run, 1)
+                    launched = 2
+                    pending += 1
+                    wait = self._remaining(deadline)
+                    continue
+                # both bounded attempts in flight past their budget —
+                # only a blown deadline can get here
+                raise _DeadlineExceeded()
+            pending -= 1
+            if kind == "ok":
+                if launched == 2 and idx == 1:
+                    self.hedge_wins += 1
+                loser = 1 - idx
+                if loser < launched:
+                    handles[loser]["cancelled"] = True
+                    cancel = getattr(transports[loser], "cancel", None)
+                    if cancel is not None:
+                        try:
+                            cancel(handles[loser])
+                        except Exception:   # noqa: BLE001 — best effort
+                            pass
+                return val, failures, None
+            if kind == "fail":
+                failures += 1
+            last = val
+            if launched == 1:
+                # primary failed before the hedge delay: straight to
+                # the secondary (failover, not a hedge)
+                pool.submit(run, 1)
+                launched = 2
+                pending += 1
+            wait = self._remaining(deadline)
+        return None, failures, last
+
+    def _send_once(
+        self,
+        shard: int,
+        q: Dict[str, Any],
+        deadline: Optional[float],
+        tr: Optional[Dict[str, Any]],
+    ) -> Tuple[Optional[Dict[str, Any]], int, Optional[str]]:
+        """One pass over the shard's healthy replicas, least-loaded
+        first (with an optional hedged first attempt). Returns (answer,
+        transport-failure count, last failure reason)."""
+        with self._lock:
+            reps = list(self._by_shard.get(shard, ()))
+        if not reps:
+            return None, 0, f"no healthy replica for shard {shard}"
+        reps.sort(key=lambda r: getattr(r, "depth", 0))
+        failures = 0
+        last: Optional[str] = None
+        start = 0
+        if (
+            self._hedge
+            and len(reps) >= 2
+            and q.get("family") in _RETRY_FAMILIES
+        ):
+            delay = self._hedge_delay()
+            if delay is not None:
+                res, nfail, why = self._request_hedged(
+                    reps[0], reps[1], q, deadline, shard, tr, delay
+                )
+                failures += nfail
+                if res is not None:
+                    return res, failures, None
+                last = why
+                start = 2
+        for t in reps[start:]:
+            self._check_deadline(deadline)
+            kind, val = self._attempt(t, shard, q, deadline, tr)
+            if kind == "ok":
+                return val, failures, None
+            if kind == "fail":
+                failures += 1
+            last = val
+        return None, failures, last
+
     def _send(
         self, shard: int, q: Dict[str, Any]
     ) -> Dict[str, Any]:
         """One sub-query to the least-loaded healthy replica of a shard;
         a transport failure or an unknown_generation answer (the replica
-        pruned the pinned generation) fails over to the next replica."""
-        with self._lock:
-            reps = list(self._by_shard.get(shard, ()))
-        if not reps:
-            raise RouterError(f"no healthy replica for shard {shard}")
+        pruned the pinned generation) fails over to the next replica.
+        When EVERY replica of the shard fails, idempotent read families
+        get `retry_rounds` refresh+re-dispatch rounds (bounded by the
+        query deadline) — the window in which a supervisor restart or a
+        membership change heals the fleet; a sub-query that answers
+        after any failure increments `retried` (a kill -9 mid-query
+        surfaces as a retried answer, not a client error)."""
+        deadline = self._deadline()
+        fam = q.get("family")
+        rounds = 1 + (
+            self._retry_rounds if fam in _RETRY_FAMILIES else 0
+        )
         tr = getattr(self._trace_local, "tr", None)
         if tr is not None:
             # stamp the trace marker at the ONE place every sub-query
@@ -396,59 +841,28 @@ class FleetRouter:
             # byte-identical to pre-trace builds)
             q = dict(q)
             q["trace"] = 1
+        failures = 0
         last: Optional[str] = None
-        for t in sorted(reps, key=lambda r: getattr(r, "depth", 0)):
-            t0 = time.perf_counter()
-            try:
-                res = t.request(q, timeout=self.request_timeout_s)
-            except Exception as e:   # noqa: BLE001 — fail over
-                last = f"{type(e).__name__}: {e}"
-                self.transport_failovers += 1
-                with self._lock:
-                    self._down.add(id(t))
-                    if t in self._by_shard.get(shard, ()):
-                        self._by_shard[shard].remove(t)
-                continue
-            wire_s = time.perf_counter() - t0
-            self._shard_lat.setdefault(shard, []).append(wire_s)
-            if not isinstance(res, dict):
-                last = f"non-dict answer {type(res).__name__}"
-                continue
-            t.depth = int(res.get("depth", getattr(t, "depth", 0)))
-            if res.get("error") == "unknown_generation":
-                last = f"replica pruned generation {q.get('gen')}"
-                self.pruned_generation += 1
-                continue
-            pin = q.get("gen")
-            if (
-                pin is not None
-                and "gen" in res
-                and int(res["gen"]) != int(pin)
-            ):
-                # the tripwire the gate asserts ZERO on — an answer
-                # from a generation the query was not pinned to
-                self.mixed_generation += 1
-            if tr is not None:
-                hop: Dict[str, Any] = {
-                    "shard": int(shard), "wire_s": wire_s,
-                }
-                hb = res.get("hops")
-                if isinstance(hb, (list, tuple)) and len(hb) == 5:
-                    # compact wire form (see serve.fleet): integer
-                    # microseconds [decode, queue, batch_wait, execute,
-                    # replica] — expanded to named float seconds here so
-                    # only the hot wire path pays for compactness
-                    hop["decode_s"] = hb[0] / 1e6
-                    hop["queue_s"] = hb[1] / 1e6
-                    hop["batch_wait_s"] = hb[2] / 1e6
-                    hop["execute_s"] = hb[3] / 1e6
-                    rs = hb[4] / 1e6
-                    hop["replica_s"] = rs
-                    # wire time the replica never saw: connect +
-                    # serialize + kernel/network transit
-                    hop["transport_s"] = max(wire_s - rs, 0.0)
-                tr["hops"].append(hop)
-            return res
+        for rnd in range(rounds):
+            if rnd:
+                # the whole replica set failed: one bounded chance for
+                # the fleet to heal before the query errors — re-read
+                # membership + health, small backoff within the deadline
+                self._check_deadline(deadline)
+                time.sleep(min(0.05 * rnd, 0.25))
+                try:
+                    self.refresh()
+                except Exception:   # noqa: BLE001 — retry is best effort
+                    pass
+            res, nfail, why = self._send_once(shard, q, deadline, tr)
+            failures += nfail
+            if res is not None:
+                if failures or rnd:
+                    self.retried += 1
+                    if tr is not None and tr["hops"]:
+                        tr["hops"][-1]["retried"] = max(failures, 1)
+                return res
+            last = why
         raise RouterError(
             f"every replica of shard {shard} failed: {last}"
         )
@@ -610,6 +1024,12 @@ class FleetRouter:
             return {"error": "RouterError: no serving generation"}
         fam = q.get("family") if isinstance(q, dict) else None
         t0 = time.perf_counter()
+        # per-query deadline, pinned here and read by every sub-send
+        # (thread-local like the trace: route() runs one query per
+        # worker thread end to end)
+        self._deadline_local.t = (
+            t0 + self._deadline_s if self._deadline_s > 0 else None
+        )
         tr: Optional[Dict[str, Any]] = None
         if _obs.current() is not None:
             # tracing is exactly telemetry-installed: one dict + one
@@ -629,8 +1049,12 @@ class FleetRouter:
                 res = {"error": f"KeyError: 'unknown family {fam!r}'"}
         except _Shed:
             res = {"error": "overloaded"}
+        except _DeadlineExceeded:
+            self.deadline_exceeded += 1
+            res = {"error": "deadline_exceeded"}
         except Exception as e:   # noqa: BLE001 — per-query isolation
             res = {"error": f"{type(e).__name__}: {e}"}
+        self._deadline_local.t = None
         if tr is not None:
             self._trace_local.tr = None
         lat = time.perf_counter() - t0
@@ -777,6 +1201,12 @@ class FleetRouter:
             self._errors = 0
             self._shed = 0
             self._t_first = self._t_last = None
+            # the self-healing counters are rate-verdicted per measured
+            # pass (ledger), so a warmup reset clears them too
+            self.retried = 0
+            self.hedged = 0
+            self.hedge_wins = 0
+            self.deadline_exceeded = 0
             # warmup traces must not pollute the measured pass
             self._traced = 0
             self._hop_sum = {}
@@ -864,6 +1294,21 @@ class FleetRouter:
             "transport_failovers": self.transport_failovers,
             "rollouts": self.rollouts,
             "traced_queries": traced,
+            # self-healing scoreboard (ISSUE 20): retried = sub-queries
+            # that answered after at least one failure (the kill -9
+            # drill's "not a client error" proof); the rates are what
+            # the perf ledger verdicts
+            "router_retries": self.retried,
+            "hedged": self.hedged,
+            "hedge_wins": self.hedge_wins,
+            "hedged_rate": (
+                round(self.hedged / total, 4) if total else 0.0
+            ),
+            "deadline_exceeded": self.deadline_exceeded,
+            "deadline_exceeded_rate": (
+                round(self.deadline_exceeded / total, 4) if total else 0.0
+            ),
+            "membership_reloads": self.membership_reloads,
         }
         # fleet-wide per-hop latency means (traced queries only): the
         # decomposition the ledger verdicts — a transport regression and
@@ -902,8 +1347,96 @@ class FleetRouter:
             self._health_thread.join(timeout=10.0)
             self._health_thread = None
         self._pool.shutdown(wait=False)
+        if self._hedge_pool is not None:
+            self._hedge_pool.shutdown(wait=False)
         for t in self.endpoints:
             try:
                 t.close()
             except Exception:   # noqa: BLE001 — best effort
                 pass
+
+
+class RouterServer:
+    """`cli route --daemon` (ISSUE 20): the router itself as a
+    long-lived tier on the SAME newline-framed JSON TCP wire the
+    replicas speak — one query dict per line in, one answer dict per
+    line out. Clients send the three public families verbatim; two
+    control ops ride the same framing: `{"family": "status"}` answers
+    router.stats() (the self-healing scoreboard) and `{"family":
+    "stop"}` acks then shuts the daemon down — so `cli route --stop`
+    pointed at a router daemon does exactly what it does to a replica.
+    Per-connection threads call route() directly: client connections ARE
+    the concurrency, no second worker pool."""
+
+    def __init__(
+        self, router: FleetRouter, host: str = "127.0.0.1", port: int = 0
+    ):
+        self.router = router
+        self._stopped = threading.Event()
+        outer = self
+
+        class _Handler(socketserver.StreamRequestHandler):
+            def handle(self):
+                for line in self.rfile:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    q: Any = None
+                    try:
+                        q = json.loads(line)
+                    except ValueError:
+                        res: Dict[str, Any] = {"error": "bad json"}
+                    else:
+                        fam = (
+                            q.get("family") if isinstance(q, dict) else None
+                        )
+                        if fam == "status":
+                            res = outer.router.stats()
+                        elif fam == "stop":
+                            res = {"ok": True}
+                        else:
+                            res = outer.router.route(q)
+                    try:
+                        self.wfile.write(
+                            (json.dumps(res) + "\n").encode()
+                        )
+                        self.wfile.flush()
+                    except OSError:
+                        return   # client went away mid-answer
+                    if (
+                        isinstance(q, dict)
+                        and q.get("family") == "stop"
+                    ):
+                        # ack first, shut down from a fresh thread
+                        # (shutdown() deadlocks called from a handler)
+                        threading.Thread(
+                            target=outer.close, daemon=True
+                        ).start()
+                        return
+
+        class _Server(socketserver.ThreadingTCPServer):
+            allow_reuse_address = True
+            daemon_threads = True
+            request_queue_size = 128
+
+        self._srv = _Server((host, int(port)), _Handler)
+        self.host, self.port = self._srv.server_address[:2]
+        self._thread = threading.Thread(
+            target=self._srv.serve_forever,
+            name="bigclam-route-daemon",
+            daemon=True,
+        )
+        self._thread.start()
+
+    def serve_until_stopped(
+        self, timeout: Optional[float] = None
+    ) -> bool:
+        return self._stopped.wait(timeout)
+
+    def close(self) -> None:
+        if self._stopped.is_set():
+            return
+        self._stopped.set()
+        self._srv.shutdown()
+        self._srv.server_close()
+        self.router.close()

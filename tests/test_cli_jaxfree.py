@@ -216,6 +216,141 @@ def test_cli_serve_read_queries_and_report_stay_jax_free(tmp_path):
     assert "serving: 15 queries" in r.stdout
 
 
+def _spawn_jaxfree(argv, cwd):
+    """Popen cli.main(argv) in a fresh interpreter for BLOCKING entries
+    (`fleet up`, `route --daemon`): the caller drives the hello-line +
+    control-socket protocol, then waits; the child asserts jax stayed
+    unimported after main() returned."""
+    code = textwrap.dedent(
+        f"""
+        import sys
+        from bigclam_tpu.cli import main
+        rc = main({argv!r})
+        assert "jax" not in sys.modules, "cli entry imported jax"
+        sys.exit(rc)
+        """
+    )
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    return subprocess.Popen(
+        [sys.executable, "-c", code],
+        env=env, cwd=cwd, stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE, text=True,
+    )
+
+
+def _wire_op(endpoint, op, timeout=30.0):
+    import socket
+
+    host, port = endpoint.rsplit(":", 1)
+    with socket.create_connection((host, int(port)),
+                                  timeout=timeout) as sock:
+        sock.settimeout(timeout)
+        sock.sendall((json.dumps(op) + "\n").encode())
+        return json.loads(sock.makefile("rb").readline())
+
+
+def _tiny_fleet(tmp_path):
+    import numpy as np
+
+    from bigclam_tpu.config import BigClamConfig
+    from bigclam_tpu.serve.snapshot import publish_fleet_snapshot
+
+    rng = np.random.default_rng(0)
+    F = rng.uniform(0.0, 1.0, size=(12, 3))
+    snapdir = str(tmp_path / "snaps")
+    publish_fleet_snapshot(
+        snapdir, [(0, 12)], F=F, num_edges=20,
+        cfg=BigClamConfig(num_communities=3),
+    )
+    return snapdir
+
+
+def test_cli_fleet_up_down_stays_jax_free(tmp_path):
+    # ISSUE 20 tentpole: the supervisor is a process-herding parent on a
+    # serving host — it must never drag jax in. `fleet up` parks until
+    # the control wire's `down` op; the test drives the whole lifecycle
+    # over that wire: hello line -> status -> down -> final counters.
+    snapdir = _tiny_fleet(tmp_path)
+    members = str(tmp_path / "members.json")
+    p = _spawn_jaxfree(
+        ["fleet", "up", "--fleet", snapdir, "--shards", "1",
+         "--replicas", "2", "--members", members,
+         "--up-timeout-s", "60", "--quiet"],
+        str(tmp_path),
+    )
+    try:
+        hello = json.loads(p.stdout.readline())
+        assert hello["all_up"] is True
+        assert hello["fleet_members"] == ["s0r0", "s0r1"]
+        st = _wire_op(hello["control"], {"op": "status"})
+        assert {m["state"] for m in st["members"]} == {"up"}
+        with open(members) as f:
+            doc = json.load(f)
+        assert doc["seq"] >= 1 and len(doc["members"]) == 2
+        assert _wire_op(hello["control"], {"op": "down"})["ok"] is True
+        out, err = p.communicate(timeout=60)
+        assert p.returncode == 0, (out, err)
+        final = json.loads(out.strip().splitlines()[-1])
+        assert final["replica_restarts"] == 0
+        assert final["quarantined"] == 0
+        assert {m["state"] for m in final["fleet_members"].values()} == {
+            "stopped"
+        }
+    finally:
+        if p.poll() is None:
+            p.kill()
+        p.stdout.close()
+        p.stderr.close()
+
+
+def test_cli_route_daemon_stays_jax_free(tmp_path):
+    # ISSUE 20 tentpole: the router daemon is a long-lived query-front
+    # tier — a pure socket/JSON process. One replica subprocess behind
+    # it; the daemon answers queries + stats over the wire, and the
+    # `stop` op shuts it down clean (rc 0, jax never imported).
+    snapdir = _tiny_fleet(tmp_path)
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    rep = subprocess.Popen(
+        [sys.executable, "-m", "bigclam_tpu.cli", "serve",
+         "--fleet", snapdir, "--fleet-shard", "0",
+         "--listen", "127.0.0.1:0", "--quiet"],
+        env=env, cwd=str(tmp_path), stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE, text=True,
+    )
+    daemon = None
+    try:
+        endpoint = json.loads(rep.stdout.readline())["listening"]
+        daemon = _spawn_jaxfree(
+            ["route", "--fleet", snapdir, "--endpoints", endpoint,
+             "--daemon", "--listen", "127.0.0.1:0", "--quiet"],
+            str(tmp_path),
+        )
+        hello = json.loads(daemon.stdout.readline())
+        routing = hello["routing"]
+        ans = _wire_op(routing, {"family": "communities_of", "u": 0})
+        assert "communities" in ans and "error" not in ans
+        st = _wire_op(routing, {"family": "status"})
+        assert st["serve_queries"] == 1 and st["serve_errors"] == 0
+        assert st["router_retries"] == 0 and st["hedged"] == 0
+        assert _wire_op(routing, {"family": "stop"})["ok"] is True
+        out, err = daemon.communicate(timeout=60)
+        assert daemon.returncode == 0, (out, err)
+        final = json.loads(out.strip().splitlines()[-1])
+        assert final["serve_queries"] == 1
+        _wire_op(endpoint, {"family": "stop"})
+        rep.wait(timeout=30)
+    finally:
+        for p in (rep, daemon):
+            if p is None:
+                continue
+            if p.poll() is None:
+                p.kill()
+            p.stdout.close()
+            p.stderr.close()
+
+
 def test_cli_perf_show_stays_jax_free(tmp_path):
     # the perf-ledger tooling shares the data-prep-host contract (the
     # module docstring promises it; now the test does)

@@ -1,0 +1,545 @@
+"""Self-healing serving fleet (ISSUE 20): typed fail-fast batcher
+shutdown and the drain door, wire-fault recovery (torn frame, garbage
+line, stall, connect refuse) through the router's bounded reader and
+failover, per-query deadlines, tail-latency hedging, elastic membership
+reload, the FleetSupervisor's restart/quarantine ladder over real
+subprocesses, the router daemon wire, and the `route --stop` idempotent
+teardown. Everything here is fast, localhost, and seeded — chaos-marked
+but part of tier-1."""
+
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from bigclam_tpu.config import BigClamConfig
+from bigclam_tpu.resilience.faults import FaultPlan, install_plan
+from bigclam_tpu.resilience.retry import RetryPolicy
+from bigclam_tpu.serve.batcher import (
+    BatcherStopped,
+    OverloadedError,
+    RequestBatcher,
+)
+from bigclam_tpu.serve.fleet import LocalReplica, ReplicaServer, ShardReplica
+from bigclam_tpu.serve.router import FleetRouter, RouterServer, TcpReplica
+from bigclam_tpu.serve.snapshot import publish_fleet_snapshot
+from bigclam_tpu.serve.supervise import FleetSupervisor
+
+pytestmark = pytest.mark.chaos
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+N, K = 24, 3
+
+
+def _wait_for(pred, timeout=30.0, interval=0.05):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(interval)
+    return False
+
+
+@pytest.fixture(scope="module")
+def fleet1(tmp_path_factory):
+    """Single-shard fleet publication (numpy-only): every replica covers
+    the whole row range, so shard-0 replica sets of any size are valid."""
+    rng = np.random.default_rng(7)
+    F = rng.uniform(0.0, 1.0, size=(N, K))
+    d = str(tmp_path_factory.mktemp("fleet1") / "snaps")
+    publish_fleet_snapshot(
+        d, [(0, N)], F=F, num_edges=40,
+        cfg=BigClamConfig(num_communities=K),
+    )
+    return d
+
+
+@pytest.fixture()
+def faults():
+    """install_plan with guaranteed cleanup (the plan is process-global)."""
+
+    def _install(*specs, seed=0):
+        return install_plan(
+            FaultPlan.from_spec({"seed": seed, "faults": list(specs)})
+        )
+
+    yield _install
+    install_plan(None)
+
+
+# ------------------------------------------------ batcher shutdown (sat 3)
+def test_batcher_stop_fails_queued_futures_fast_and_typed():
+    """stop() with a wedged handler: every still-QUEUED future fails
+    IMMEDIATELY with BatcherStopped (no hang, no silent drop) — the
+    join happens after the strand sweep, so a stuck batch can't hold
+    them hostage. Submits after stop raise the same typed error."""
+    entered = threading.Event()
+    release = threading.Event()
+
+    def handler(batch):
+        entered.set()
+        release.wait(10.0)
+        for r in batch:
+            r.future.set_result(r.payload)
+
+    b = RequestBatcher(handler, max_batch=1, budget_s=0.0)
+    b.start()
+    first = b.submit("warm")
+    assert entered.wait(2.0)          # handler wedged; queue grows
+    queued = [b.submit(i) for i in range(3)]
+    t0 = time.perf_counter()
+    b.stop(timeout=0.2)               # flusher still wedged: join times out
+    for f in queued:
+        with pytest.raises(BatcherStopped):
+            f.result(0.5)
+    assert time.perf_counter() - t0 < 2.0
+    with pytest.raises(BatcherStopped):
+        b.submit("late")
+    release.set()                     # in-flight batch finishes normally
+    assert first.result(2.0) == "warm"
+
+
+def test_batcher_drain_then_stop_strands_nothing():
+    """The zero-drop ordering: close_door() sheds NEW submits fast with
+    OverloadedError, already-admitted work completes, drain() observes a
+    quiescent batcher, and stop() finds nothing to strand."""
+    b = RequestBatcher(lambda batch: [r.future.set_result(r.payload * 2)
+                                      for r in batch],
+                       max_batch=4, budget_s=0.001)
+    b.start()
+    futs = [b.submit(i) for i in range(8)]
+    b.close_door()
+    assert b.draining
+    shed = b.submit("rejected")
+    assert shed.done()
+    with pytest.raises(OverloadedError):
+        shed.result(0.0)
+    assert b.shed_door == 1 and b.shed == 1
+    b.drain(timeout=5.0)
+    assert [f.result(2.0) for f in futs] == [i * 2 for i in range(8)]
+    b.stop()                          # nothing queued: nothing stranded
+
+
+# ------------------------------------------------------ replica drain op
+def test_replica_drain_wire_op_acks_then_exits(fleet1):
+    srv = ReplicaServer(ShardReplica(fleet1, 0), port=0)
+    t = TcpReplica(srv.host, srv.port, timeout_s=10.0)
+    try:
+        st = t.request({"family": "status"})
+        assert "draining" not in st
+        ack = t.request({"family": "drain"})
+        assert ack["ok"] is True and ack["draining"] is True
+        assert srv.serve_until_stopped(10.0)
+        # the listener is gone: a fresh connection cannot be served
+        with pytest.raises((ConnectionError, TimeoutError, OSError)):
+            TcpReplica(srv.host, srv.port, timeout_s=0.5).request(
+                {"family": "status"}
+            )
+    finally:
+        t.close()
+        srv.close()
+
+
+# --------------------------------------------------- wire faults (sat 2)
+def test_torn_frame_recovered_on_fresh_connection(fleet1, faults):
+    """A peer killed mid-write leaves half a frame with no newline: the
+    bounded reader must classify it as a transport failure (never hand
+    it to the json decoder) and the retry on a fresh connection wins."""
+    faults({"kind": "torn_frame", "site": "replica.answer_write", "at": 0})
+    srv = ReplicaServer(ShardReplica(fleet1, 0), port=0)
+    t = TcpReplica(srv.host, srv.port, timeout_s=10.0)
+    try:
+        t0 = time.perf_counter()
+        ans = t.request({"family": "communities_of", "u": 0})
+        assert "communities" in ans and "error" not in ans
+        assert time.perf_counter() - t0 < 5.0   # no wedged reader
+    finally:
+        t.close()
+        srv.close()
+
+
+def test_garbage_line_recovered_on_fresh_connection(fleet1, faults):
+    faults({"kind": "garbage_line", "site": "replica.answer_write",
+            "at": 0})
+    srv = ReplicaServer(ShardReplica(fleet1, 0), port=0)
+    t = TcpReplica(srv.host, srv.port, timeout_s=10.0)
+    try:
+        ans = t.request({"family": "communities_of", "u": 1})
+        assert "communities" in ans and "error" not in ans
+    finally:
+        t.close()
+        srv.close()
+
+
+def test_connect_refuse_consumed_once_then_reaches_replica(fleet1, faults):
+    faults({"kind": "connect_refuse", "site": "wire.connect", "at": 0})
+    srv = ReplicaServer(ShardReplica(fleet1, 0), port=0)
+    t = TcpReplica(srv.host, srv.port, timeout_s=10.0)
+    try:
+        st = t.request({"family": "status"})
+        assert st["shard"] == 0
+    finally:
+        t.close()
+        srv.close()
+
+
+def test_stalled_replica_bounded_then_failover(fleet1, faults):
+    """A stall longer than the request timeout on one replica: the
+    router's read is BOUNDED (timeout, socket closed), the sub-query
+    fails over to the healthy replica, and the client sees a correct
+    retried answer — never an error, never an unbounded wait."""
+    faults({"kind": "stall", "site": "replica.answer_write",
+            "seconds": 3.0, "at": 0})
+    srvs = [ReplicaServer(ShardReplica(fleet1, 0), port=0)
+            for _ in range(2)]
+    eps = [TcpReplica(s.host, s.port, timeout_s=10.0) for s in srvs]
+    router = FleetRouter(fleet1, eps, request_timeout_s=0.4)
+    try:
+        t0 = time.perf_counter()
+        ans = router.route({"family": "communities_of", "u": 2})
+        assert "error" not in ans and "communities" in ans
+        assert time.perf_counter() - t0 < 3.0
+        st = router.stats()
+        assert st["transport_failovers"] >= 1
+        assert st["router_retries"] >= 1
+    finally:
+        router.close()
+        for s in srvs:
+            s.close()
+
+
+# --------------------------------------------------- deadline + hedging
+def test_router_deadline_exceeded_is_typed_and_counted(fleet1,
+                                                       monkeypatch):
+    """A single wedged replica and a 150ms query deadline: the answer is
+    {"error": "deadline_exceeded"} within the budget (plus slack), and
+    the counter + rate ride stats() for the ledger."""
+    monkeypatch.setenv(
+        "BIGCLAM_QTRACE_FAULT",
+        json.dumps({"hop": "decode", "delay_s": 5.0}),
+    )
+    srv = ReplicaServer(ShardReplica(fleet1, 0), port=0)
+    monkeypatch.delenv("BIGCLAM_QTRACE_FAULT")
+    router = FleetRouter(
+        fleet1, [TcpReplica(srv.host, srv.port, timeout_s=10.0)],
+        request_timeout_s=10.0, deadline_s=0.15, retry_rounds=1,
+    )
+    try:
+        t0 = time.perf_counter()
+        ans = router.route({"family": "communities_of", "u": 0})
+        assert ans == {"error": "deadline_exceeded"}
+        assert time.perf_counter() - t0 < 3.0
+        st = router.stats()
+        assert st["deadline_exceeded"] == 1
+        assert st["deadline_exceeded_rate"] > 0
+    finally:
+        router.close()
+        srv.close()
+
+
+def test_hedged_read_wins_on_slow_primary(fleet1):
+    """Tail-latency hedging: the duplicate fired after the explicit
+    delay beats a slow primary; the hedge is counted, the winner's
+    answer is correct, and the loser's eventual return is not punished
+    as a failure."""
+
+    class _Slow(LocalReplica):
+        def request(self, q, timeout=None, handle=None):
+            if q.get("family") != "status":
+                time.sleep(0.25)
+            return super().request(q, timeout=timeout, handle=handle)
+
+    rep = ShardReplica(fleet1, 0)
+    router = FleetRouter(
+        fleet1, [_Slow(rep), LocalReplica(rep)],
+        hedge=True, hedge_delay_s=0.02,
+    )
+    try:
+        for u in range(3):
+            ans = router.route({"family": "communities_of", "u": u})
+            assert "error" not in ans and "communities" in ans
+        st = router.stats()
+        assert st["hedged"] >= 1
+        assert st["hedge_wins"] >= 1
+        assert st["hedged_rate"] > 0
+        assert st["serve_errors"] == 0
+    finally:
+        router.close()
+
+
+# ----------------------------------------------------- elastic membership
+def _write_members(path, seq, members):
+    doc = {"version": 1, "seq": seq, "control": "127.0.0.1:0",
+           "members": members}
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(doc, f)
+    os.replace(tmp, path)
+
+
+def test_membership_file_reload_reconciles_endpoints(fleet1, tmp_path):
+    """The router's endpoint set is the watched membership file: only
+    state == "up" members are admitted, a seq bump with a drained member
+    drops (and closes) its transport, and serving continues on the
+    survivors."""
+    srvs = [ReplicaServer(ShardReplica(fleet1, 0), port=0)
+            for _ in range(2)]
+    members_path = str(tmp_path / "members.json")
+
+    def entry(i, state):
+        return {"id": f"s0r{i}", "shard": 0,
+                "endpoint": f"{srvs[i].host}:{srvs[i].port}",
+                "state": state, "pid": 0, "restarts": 0}
+
+    _write_members(members_path, 1, [entry(0, "up"), entry(1, "up")])
+    router = FleetRouter(fleet1, members_file=members_path)
+    try:
+        assert len(router.endpoints) == 2
+        assert router.membership_reloads == 1
+        ans = router.route({"family": "members_of", "c": 0})
+        assert "error" not in ans
+        _write_members(members_path, 2,
+                       [entry(0, "up"), entry(1, "draining")])
+        router.refresh()
+        assert len(router.endpoints) == 1
+        assert router.membership_reloads == 2
+        ans = router.route({"family": "members_of", "c": 0})
+        assert "error" not in ans
+        # a torn/unchanged file keeps the current set
+        with open(members_path, "w") as f:
+            f.write("{not json")
+        router.refresh()
+        assert len(router.endpoints) == 1
+    finally:
+        router.close()
+        for s in srvs:
+            s.close()
+
+
+# ------------------------------------------------- supervisor subprocesses
+@pytest.fixture()
+def child_env(monkeypatch):
+    monkeypatch.setenv(
+        "PYTHONPATH",
+        REPO + os.pathsep + os.environ.get("PYTHONPATH", ""),
+    )
+
+
+def test_supervisor_restarts_killed_replica(fleet1, tmp_path, child_env):
+    """kill -9 a supervised replica: the monitor respawns it with the
+    RetryPolicy backoff, the membership file republishes the new
+    endpoint, and the rejoined replica answers at the newest
+    generation."""
+    members = str(tmp_path / "members.json")
+    sup = FleetSupervisor(
+        fleet1, members, shards=1, replicas=1,
+        policy=RetryPolicy(base_s=0.05, max_s=0.2, seed=0),
+        stable_s=30.0, poll_s=0.05, hello_timeout_s=60.0,
+    )
+    sup.up()
+    try:
+        assert sup.wait_all_up(timeout=60.0)
+        with open(members) as f:
+            doc = json.load(f)
+        (m,) = doc["members"]
+        assert m["state"] == "up" and m["endpoint"]
+        pid0 = m["pid"]
+        os.kill(pid0, signal.SIGKILL)
+
+        def healed():
+            st = sup.status()
+            (mm,) = st["members"]
+            return (st["replica_restarts"] >= 1 and mm["state"] == "up"
+                    and mm["pid"] not in (None, pid0))
+
+        assert _wait_for(healed, timeout=60.0)
+        st = sup.status()
+        (m2,) = st["members"]
+        t = TcpReplica(*m2["endpoint"].rsplit(":", 1), timeout_s=10.0)
+        try:
+            ans = t.request({"family": "status"})
+            assert ans["shard"] == 0 and ans["generations"]
+        finally:
+            t.close()
+        with open(members) as f:
+            doc2 = json.load(f)
+        assert doc2["seq"] > doc["seq"]
+        assert doc2["members"][0]["restarts"] >= 1
+    finally:
+        sup.down()
+
+
+def test_supervisor_quarantines_crash_loop(fleet1, tmp_path, monkeypatch,
+                                           child_env):
+    """A replica killed at replica.start on EVERY spawn (the env fault
+    plan re-fires in each fresh process): after quarantine_after
+    consecutive failures the slot is parked "quarantined" instead of
+    burning CPU on a doomed respawn loop."""
+    monkeypatch.setenv(
+        "BIGCLAM_FAULTS",
+        json.dumps({"faults": [
+            {"kind": "kill", "site": "replica.start", "at": 0},
+        ]}),
+    )
+    members = str(tmp_path / "members.json")
+    sup = FleetSupervisor(
+        fleet1, members, shards=1, replicas=1,
+        policy=RetryPolicy(base_s=0.02, max_s=0.05, seed=0),
+        quarantine_after=2, stable_s=30.0, poll_s=0.05,
+    )
+    sup.up()
+    try:
+        assert _wait_for(
+            lambda: sup.status()["quarantined"] >= 1, timeout=60.0
+        )
+        st = sup.status()
+        (m,) = st["members"]
+        assert m["state"] == "quarantined"
+        assert st["replica_restarts"] == 2   # quarantine_after respawns
+        with open(members) as f:
+            doc = json.load(f)
+        assert doc["members"][0]["state"] == "quarantined"
+    finally:
+        out = sup.down()
+        assert out["quarantined"] == 1
+
+
+def test_supervisor_drain_and_add_replica(fleet1, tmp_path, child_env):
+    """Elastic membership: add_replica grows the roster with a fresh
+    member id; drain flips the member through draining -> stopped with
+    the replica exiting clean (rc 0, not a kill)."""
+    members = str(tmp_path / "members.json")
+    sup = FleetSupervisor(
+        fleet1, members, shards=1, replicas=1,
+        policy=RetryPolicy(base_s=0.05, max_s=0.2, seed=0),
+        stable_s=30.0, poll_s=0.05, drain_grace_s=0.05,
+    )
+    sup.up()
+    try:
+        assert sup.wait_all_up(timeout=60.0)
+        entry = sup.add_replica(0)
+        assert entry["id"] == "s0r1"
+        assert sup.wait_all_up(timeout=60.0)
+        assert sup.drain("s0r0", timeout=30.0)
+        st = sup.status()
+        states = {m["id"]: m["state"] for m in st["members"]}
+        assert states == {"s0r0": "stopped", "s0r1": "up"}
+        with open(members) as f:
+            doc = json.load(f)
+        # stopped members leave the published roster
+        assert [m["id"] for m in doc["members"]] == ["s0r1"]
+        # draining an already-stopped member is a clean refusal
+        assert not sup.drain("s0r0")
+    finally:
+        sup.down()
+
+
+# ------------------------------------------------------- router daemon
+def test_router_server_wire_roundtrip_status_and_stop(fleet1):
+    rep = ShardReplica(fleet1, 0)
+    server = RouterServer(FleetRouter(fleet1, [LocalReplica(rep)]))
+    try:
+        with socket.create_connection(
+            (server.host, server.port), timeout=10.0
+        ) as sock:
+            sock.settimeout(10.0)
+            f = sock.makefile("rb")
+
+            def ask(q):
+                sock.sendall((json.dumps(q) + "\n").encode())
+                return json.loads(f.readline())
+
+            st = ask({"family": "status"})
+            assert st["serving_generation"] is not None
+            ans = ask({"family": "communities_of", "u": 0})
+            assert "communities" in ans and "error" not in ans
+            assert ask({"family": "not_a_family"}).get("error")
+            assert ask({"family": "stop"})["ok"] is True
+        assert server.serve_until_stopped(10.0)
+    finally:
+        server.close()
+
+
+def test_route_stop_with_dead_endpoint_exits_zero(fleet1, capsys):
+    """`route --stop` against a fleet where one endpoint is ALREADY
+    gone: the survivor is torn down, the dead endpoint is a note (not a
+    failure), and the exit code is 0 — teardown is idempotent."""
+    from bigclam_tpu.cli import main
+
+    srv = ReplicaServer(ShardReplica(fleet1, 0), port=0)
+    # a port with nothing behind it
+    probe = socket.socket()
+    probe.bind(("127.0.0.1", 0))
+    dead_port = probe.getsockname()[1]
+    probe.close()
+    try:
+        rc = main([
+            "route", "--fleet", fleet1,
+            "--endpoints",
+            f"{srv.host}:{srv.port},127.0.0.1:{dead_port}",
+            "--stop",
+        ])
+        assert rc == 0
+        cap = capsys.readouterr()
+        out = json.loads(cap.out.strip().splitlines()[-1])
+        assert out == {"stopped": 1, "already_down": 1, "of": 2}
+        assert "already down" in cap.err
+        assert srv.serve_until_stopped(10.0)
+    finally:
+        srv.close()
+
+
+# ------------------------------------------------------------ perf ledger
+def test_ledger_self_healing_fields_and_verdicts():
+    from bigclam_tpu.obs import ledger as L
+
+    def rep(entry="route", **final):
+        base_final = {
+            "serve_queries": 1000, "serve_p50_s": 0.001,
+            "serve_p99_s": 0.002, "serve_qps": 500.0,
+            "serve_mix": "members_of:1.00",
+        }
+        base_final.update(final)
+        return {
+            "run": "r", "entry": entry, "pid": 0, "processes": 1,
+            "wall_s": 1.0,
+            "fingerprint": {"host": "h", "backend": "cpu",
+                            "device_kind": "cpu", "platform": "cpu"},
+            "compiles": {"count": 0, "by_key": {}},
+            "spans": {"seconds": {}},
+            "final": base_final,
+        }
+
+    base = L.build_record(rep(
+        router_retries=2, hedged_rate=0.01, deadline_exceeded_rate=0.001,
+    ))
+    assert base["router_retries"] == 2
+    assert base["hedged_rate"] == 0.01
+    assert base["deadline_exceeded_rate"] == 0.001
+    worse = L.build_record(rep(
+        router_retries=40, hedged_rate=0.5, deadline_exceeded_rate=0.2,
+    ))
+    d = L.diff_records(base, worse)
+    flagged = {c["metric"] for c in d["checks"] if c.get("regression")}
+    assert {"router_retries", "hedged_rate",
+            "deadline_exceeded_rate"} <= flagged
+    # the supervisor's fleet entry has no serve percentiles — the
+    # replica_restarts verdict stands on its own
+    fb = L.build_record(rep(
+        entry="fleet", replica_restarts=1, serve_p99_s=None,
+    ))
+    assert fb["replica_restarts"] == 1
+    fw = L.build_record(rep(
+        entry="fleet", replica_restarts=30, serve_p99_s=None,
+    ))
+    d2 = L.diff_records(fb, fw)
+    bad = [c for c in d2["checks"]
+           if c["metric"] == "replica_restarts" and c.get("regression")]
+    assert bad and d2["regression"]
